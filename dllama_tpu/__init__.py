@@ -1,0 +1,10 @@
+"""dllama_tpu — a TPU-native distributed LLM inference framework.
+
+Re-implements the capabilities of `distributed-llama` (tensor-parallel Llama /
+Grok-1 / Mixtral inference over commodity clusters) as an idiomatic JAX/XLA
+stack: SPMD over a `jax.sharding.Mesh` instead of a root/worker TCP star,
+XLA collectives over ICI instead of hand-rolled socket broadcast/gather, and
+MXU-shaped bf16/int8 compute instead of NEON/AVX2 kernels.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,165 @@
+"""Model spec: hyperparameters + on-disk header codec for the `.m` weight format.
+
+Mirrors the reference header semantics (`/root/reference/src/transformer.cpp:183-298`,
+writer at `/root/reference/converter/writer.py:110-139`) so published distributed-llama
+model files load directly:
+
+* new format: ``int32 magic 0x0A00ABCD``, ``int32 headerSize`` (bytes, counting the two
+  leading ints), then ``(key, value) int32`` pairs.
+* old format: magic ``0xABCD00`` (llama) / ``0xABCD01`` (grok1) followed by a fixed
+  9-int struct (`/root/reference/src/transformer.hpp:59-69`).
+
+Weights follow the header immediately; tensor order is defined in
+``dllama_tpu.formats.weights``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import struct
+from enum import IntEnum
+
+from dllama_tpu.quants import blocks
+
+MAGIC_KV = 0x0A00ABCD
+MAGIC_OLD_LLAMA = 0xABCD00
+MAGIC_OLD_GROK1 = 0xABCD01
+
+
+class ArchType(IntEnum):
+    LLAMA = 0xABCD00
+    GROK1 = 0xABCD01
+    MIXTRAL = 0xABCD02
+
+
+class HiddenAct(IntEnum):
+    GELU = 0
+    SILU = 1
+
+
+class HeaderKey(IntEnum):
+    """`/root/reference/src/transformer.hpp:41-56`."""
+
+    VERSION = 0
+    ARCH_TYPE = 1
+    DIM = 2
+    HIDDEN_DIM = 3
+    N_LAYERS = 4
+    N_HEADS = 5
+    N_KV_HEADS = 6
+    N_EXPERTS = 7
+    N_ACTIVE_EXPERTS = 8
+    VOCAB_SIZE = 9
+    SEQ_LEN = 10
+    HIDDEN_ACT = 11
+    ROPE_THETA = 12
+    WEIGHTS_FLOAT_TYPE = 13
+
+
+@dataclasses.dataclass
+class ModelSpec:
+    arch: ArchType
+    dim: int
+    hidden_dim: int
+    n_layers: int
+    n_heads: int
+    n_kv_heads: int
+    vocab_size: int
+    seq_len: int
+    n_experts: int = 0
+    n_active_experts: int = 0
+    hidden_act: HiddenAct = HiddenAct.SILU
+    rope_theta: float = 10000.0
+    weights_float_type: int = blocks.F32
+    version: int = 0
+    header_size: int = 0  # bytes from file start to first weight byte
+
+    @property
+    def head_size(self) -> int:
+        return self.dim // self.n_heads
+
+    @property
+    def kv_dim(self) -> int:
+        return (self.dim * self.n_kv_heads) // self.n_heads
+
+    @property
+    def is_moe(self) -> bool:
+        return self.n_experts > 0
+
+    def validate(self) -> None:
+        assert self.dim % self.n_heads == 0
+        assert (self.dim * self.n_kv_heads) % self.n_heads == 0
+        assert self.n_heads % self.n_kv_heads == 0
+        if self.is_moe:
+            assert 0 < self.n_active_experts <= self.n_experts
+
+
+def parse_header(data: bytes) -> ModelSpec:
+    """Parse a `.m` header from the first bytes of the file."""
+    (magic,) = struct.unpack_from("<i", data, 0)
+    if magic in (MAGIC_OLD_LLAMA, MAGIC_OLD_GROK1):
+        fields = struct.unpack_from("<9i", data, 4)
+        dim, hidden_dim, n_layers, n_heads, n_kv_heads, n_experts, n_active, vocab, seq = fields
+        spec = ModelSpec(
+            arch=ArchType(magic),
+            dim=dim,
+            hidden_dim=hidden_dim,
+            n_layers=n_layers,
+            n_heads=n_heads,
+            n_kv_heads=n_kv_heads,
+            n_experts=n_experts,
+            n_active_experts=n_active,
+            vocab_size=vocab,
+            seq_len=seq,
+            header_size=4 + 9 * 4,
+        )
+    elif magic == MAGIC_KV:
+        (header_size,) = struct.unpack_from("<i", data, 4)
+        n_kv_ints = (header_size - 8) // 4
+        values = struct.unpack_from(f"<{n_kv_ints}i", data, 8)
+        kv = {HeaderKey(values[i]): values[i + 1] for i in range(0, n_kv_ints, 2)}
+        spec = ModelSpec(
+            arch=ArchType(kv[HeaderKey.ARCH_TYPE]),
+            dim=kv[HeaderKey.DIM],
+            hidden_dim=kv[HeaderKey.HIDDEN_DIM],
+            n_layers=kv[HeaderKey.N_LAYERS],
+            n_heads=kv[HeaderKey.N_HEADS],
+            n_kv_heads=kv.get(HeaderKey.N_KV_HEADS, kv[HeaderKey.N_HEADS]),
+            n_experts=kv.get(HeaderKey.N_EXPERTS, 0),
+            n_active_experts=kv.get(HeaderKey.N_ACTIVE_EXPERTS, 0),
+            vocab_size=kv[HeaderKey.VOCAB_SIZE],
+            seq_len=kv[HeaderKey.SEQ_LEN],
+            hidden_act=HiddenAct(kv.get(HeaderKey.HIDDEN_ACT, HiddenAct.SILU)),
+            # rope_theta is stored as a plain int in the reference format
+            # (`/root/reference/src/transformer.cpp:240`)
+            rope_theta=float(kv.get(HeaderKey.ROPE_THETA, 10000)),
+            weights_float_type=kv.get(HeaderKey.WEIGHTS_FLOAT_TYPE, blocks.F32),
+            version=kv.get(HeaderKey.VERSION, 0),
+            header_size=8 + n_kv_ints * 4,
+        )
+    else:
+        raise ValueError(f"unsupported model file magic 0x{magic & 0xFFFFFFFF:X}")
+    spec.validate()
+    return spec
+
+
+def write_header(spec: ModelSpec) -> bytes:
+    """Serialize a ModelSpec as a new-style KV header (matches writer.py:110-139)."""
+    pairs = [
+        (HeaderKey.VERSION, spec.version),
+        (HeaderKey.ARCH_TYPE, int(spec.arch)),
+        (HeaderKey.DIM, spec.dim),
+        (HeaderKey.HIDDEN_DIM, spec.hidden_dim),
+        (HeaderKey.N_LAYERS, spec.n_layers),
+        (HeaderKey.N_HEADS, spec.n_heads),
+        (HeaderKey.N_KV_HEADS, spec.n_kv_heads),
+        (HeaderKey.N_EXPERTS, spec.n_experts),
+        (HeaderKey.N_ACTIVE_EXPERTS, spec.n_active_experts),
+        (HeaderKey.VOCAB_SIZE, spec.vocab_size),
+        (HeaderKey.SEQ_LEN, spec.seq_len),
+        (HeaderKey.HIDDEN_ACT, int(spec.hidden_act)),
+        (HeaderKey.ROPE_THETA, int(spec.rope_theta)),
+        (HeaderKey.WEIGHTS_FLOAT_TYPE, spec.weights_float_type),
+    ]
+    data = b"".join(struct.pack("<ii", int(k), int(v)) for k, v in pairs)
+    return struct.pack("<ii", MAGIC_KV, 8 + len(data)) + data

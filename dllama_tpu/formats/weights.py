@@ -1,0 +1,176 @@
+"""`.m` weight-file reader/writer.
+
+The tensor order mirrors the reference root loader exactly
+(`/root/reference/src/transformer.cpp:630-690`):
+
+```
+token_embedding [vocab, dim]            f32 (always)
+repeat n_layers:
+    wq   [dim,    dim]     wft          # RowMatmulSlice(dim -> dim)
+    wk   [kv_dim, dim]     wft
+    wv   [kv_dim, dim]     wft
+    wo   [dim,    dim]     wft          # ColMatmulSlice
+    if moe:
+        moe_router [n_experts, dim] wft
+        repeat n_experts:
+            moe_up   [hidden, dim] wft
+            moe_gate [hidden, dim] wft
+            moe_down [dim, hidden] wft
+    else:
+        w1 [hidden, dim]   wft
+        w2 [dim, hidden]   wft
+        w3 [hidden, dim]   wft
+    rms_att [dim] f32
+    rms_ffn [dim] f32
+    if grok1:
+        rms_moe  [dim] f32
+        rms_ffn2 [dim] f32
+rms_final [dim] f32
+wcls [vocab, dim] wft
+```
+
+All 2-D tensors are row-major ``[out_features, in_features]`` (the reference matmul
+computes ``y[d] = sum_n w[d,n] * x[n]``, `/root/reference/src/funcs.cpp:157-197`).
+
+Reading is mmap-backed and lazy so a 70B file never materializes twice in host RAM;
+callers can also restrict to a shard's row range (tensor-parallel loading) via the
+``rows`` argument of :func:`read_tensor_rows`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import mmap
+from typing import Iterator
+
+import numpy as np
+
+from dllama_tpu.formats.spec import ArchType, ModelSpec, parse_header, write_header
+from dllama_tpu.quants import blocks
+
+
+@dataclasses.dataclass(frozen=True)
+class TensorEntry:
+    name: str
+    d: int  # rows (output features); 1 for 1-D tensors
+    n: int  # row length (input features)
+    float_type: int
+    offset: int  # absolute byte offset in file
+
+    @property
+    def nbytes(self) -> int:
+        return blocks.batch_bytes(self.float_type, self.n, self.d)
+
+    @property
+    def shape(self) -> tuple:
+        return (self.d, self.n) if self.d > 1 else (self.n,)
+
+
+def tensor_plan(spec: ModelSpec) -> list[TensorEntry]:
+    """Ordered tensor table with absolute file offsets."""
+    wft = spec.weights_float_type
+    entries: list[TensorEntry] = []
+    offset = spec.header_size if spec.header_size else 0
+
+    def add(name: str, d: int, n: int, ft: int) -> None:
+        nonlocal offset
+        e = TensorEntry(name, d, n, ft, offset)
+        entries.append(e)
+        offset += e.nbytes
+
+    add("token_embedding", spec.vocab_size, spec.dim, blocks.F32)
+    for i in range(spec.n_layers):
+        p = f"layers.{i}."
+        add(p + "wq", spec.dim, spec.dim, wft)
+        add(p + "wk", spec.kv_dim, spec.dim, wft)
+        add(p + "wv", spec.kv_dim, spec.dim, wft)
+        add(p + "wo", spec.dim, spec.dim, wft)
+        if spec.is_moe:
+            add(p + "moe_router", spec.n_experts, spec.dim, wft)
+            for e in range(spec.n_experts):
+                add(p + f"experts.{e}.up", spec.hidden_dim, spec.dim, wft)
+                add(p + f"experts.{e}.gate", spec.hidden_dim, spec.dim, wft)
+                add(p + f"experts.{e}.down", spec.dim, spec.hidden_dim, wft)
+        else:
+            add(p + "w1", spec.hidden_dim, spec.dim, wft)
+            add(p + "w2", spec.dim, spec.hidden_dim, wft)
+            add(p + "w3", spec.hidden_dim, spec.dim, wft)
+        add(p + "rms_att", 1, spec.dim, blocks.F32)
+        add(p + "rms_ffn", 1, spec.dim, blocks.F32)
+        if spec.arch == ArchType.GROK1:
+            add(p + "rms_moe", 1, spec.dim, blocks.F32)
+            add(p + "rms_ffn2", 1, spec.dim, blocks.F32)
+    add("rms_final", 1, spec.dim, blocks.F32)
+    add("wcls", spec.vocab_size, spec.dim, wft)
+    return entries
+
+
+class WeightFileReader:
+    """mmap-backed reader for `.m` files."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._file = open(path, "rb")
+        self._mm = mmap.mmap(self._file.fileno(), 0, access=mmap.ACCESS_READ)
+        self._buf = np.frombuffer(self._mm, dtype=np.uint8)
+        self.spec = parse_header(self._mm[: 4096])
+        self.entries = tensor_plan(self.spec)
+        end = self.entries[-1].offset + self.entries[-1].nbytes
+        if end != len(self._buf):
+            raise ValueError(
+                f"model file size mismatch: plan ends at {end}, file has {len(self._buf)} bytes"
+            )
+        self._by_name = {e.name: e for e in self.entries}
+
+    def close(self) -> None:
+        self._buf = None  # release the exported mmap buffer before closing it
+        self._mm.close()
+        self._file.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def entry(self, name: str) -> TensorEntry:
+        return self._by_name[name]
+
+    def read_tensor(self, name: str, dtype=np.float32) -> np.ndarray:
+        """Full tensor, dequantized to ``dtype``, shaped ``[d, n]`` (or ``[n]``)."""
+        e = self._by_name[name]
+        raw = self._buf[e.offset : e.offset + e.nbytes]
+        x = blocks.decode_tensor(raw, e.float_type, e.d * e.n)
+        return x.reshape(e.shape).astype(dtype, copy=False)
+
+    def read_tensor_rows(self, name: str, rows: slice, dtype=np.float32) -> np.ndarray:
+        """Dequantize only a row band — the unit of tensor-parallel sharded loading.
+
+        Equivalent to the reference ``RowMatmulSlice.splitWeights`` row-band copy
+        (`/root/reference/src/transformer.cpp:25-42`) but done lazily at load time so
+        each host only ever touches its own shard's bytes.
+        """
+        e = self._by_name[name]
+        start, stop, step = rows.indices(e.d)
+        assert step == 1
+        rb = blocks.row_bytes(e.float_type, e.n)
+        raw = self._buf[e.offset + start * rb : e.offset + stop * rb]
+        x = blocks.decode_tensor(raw, e.float_type, (stop - start) * e.n)
+        return x.reshape(stop - start, e.n).astype(dtype, copy=False)
+
+    def iter_tensors(self, dtype=np.float32) -> Iterator[tuple[str, np.ndarray]]:
+        for e in self.entries:
+            yield e.name, self.read_tensor(e.name, dtype)
+
+
+def write_model(path: str, spec: ModelSpec, tensors: dict) -> None:
+    """Write a `.m` file from a ``name -> ndarray`` dict (shapes per tensor_plan)."""
+    header = write_header(spec)
+    spec = dataclasses.replace(spec, header_size=len(header))
+    plan = tensor_plan(spec)
+    with open(path, "wb") as f:
+        f.write(header)
+        for e in plan:
+            x = np.asarray(tensors[e.name], dtype=np.float32)
+            assert x.size == e.d * e.n, f"{e.name}: expected {e.d}x{e.n}, got {x.shape}"
+            f.write(blocks.encode_tensor(x.reshape(-1), e.float_type))

@@ -1,0 +1,14 @@
+"""Test env: force JAX onto CPU with 8 virtual devices so multi-chip sharding
+paths (tensor/data/sequence parallel) are exercised without TPU hardware —
+the gap the reference left (it has no automated distributed tests, SURVEY.md §4).
+
+Must run before jax is imported anywhere.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")

@@ -1,0 +1,64 @@
+"""Quantization roundtrip bounds, mirroring the reference quants-test
+(`/root/reference/src/quants-test.cpp:7-52`: Q80 roundtrip max err <= 0.0043
+over lengths {1024, 768, 2752})."""
+
+import numpy as np
+import pytest
+
+from dllama_tpu.quants import blocks
+
+
+@pytest.mark.parametrize("n", [1024, 768, 2752])
+def test_q80_roundtrip_bound(n):
+    rng = np.random.default_rng(1988)
+    x = (rng.random(n, dtype=np.float32) / 127.0).astype(np.float32)
+    raw = blocks.quantize_q80(x)
+    assert raw.shape == (n // 32, blocks.Q80_BLOCK_BYTES)
+    y = blocks.dequantize_q80(raw, n)
+    assert np.max(np.abs(x - y)) <= 0.0043
+
+
+@pytest.mark.parametrize("n", [32, 1024, 4096])
+def test_q40_roundtrip_bound(n):
+    rng = np.random.default_rng(7)
+    x = rng.standard_normal(n).astype(np.float32)
+    raw = blocks.quantize_q40(x)
+    assert raw.shape == (n // 32, blocks.Q40_BLOCK_BYTES)
+    y = blocks.dequantize_q40(raw, n)
+    # 4-bit: err bounded by ~delta = absmax/8 per block (asymmetric grid)
+    deltas = np.abs(x.reshape(-1, 32)).max(axis=1) / 8.0
+    err = np.abs((x - y).reshape(-1, 32)).max(axis=1)
+    assert np.all(err <= deltas * 1.05 + 1e-6)
+
+
+def test_q40_bit_layout():
+    """Value i sits in low nibble of byte i (i<16), high nibble of byte i-16 (i>=16),
+    biased by +8 — the exact layout `dequantizeQ40Row` expects
+    (`/root/reference/src/quants.cpp:166-180`)."""
+    x = np.zeros(32, dtype=np.float32)
+    x[0] = -8.0  # extreme -> quant 0 after +8 bias (delta = 1.0)
+    x[5] = 1.0
+    x[20] = -2.0
+    raw = blocks.quantize_q40(x).reshape(-1)
+    delta = raw[:2].copy().view(np.float16)[0]
+    assert float(delta) == 1.0
+    qs = raw[2:]
+    assert qs[0] & 0xF == 0  # x[0] = (0-8)*1.0 = -8
+    assert qs[5] & 0xF == 9  # x[5] = (9-8)*1.0 ~ 1 (+0.5 shift truncated)
+    assert qs[4] >> 4 == 6  # x[20] = (6-8)*1.0 = -2
+    y = blocks.dequantize_q40(raw, 32)
+    assert y[0] == -8.0 and abs(y[5] - 1.0) <= 0.5 and abs(y[20] + 2.0) <= 0.5
+
+
+def test_q80_zero_block():
+    x = np.zeros(64, dtype=np.float32)
+    y = blocks.dequantize_q80(blocks.quantize_q80(x), 64)
+    assert np.all(y == 0.0)
+
+
+def test_row_bytes():
+    assert blocks.row_bytes(blocks.F32, 128) == 512
+    assert blocks.row_bytes(blocks.F16, 128) == 256
+    assert blocks.row_bytes(blocks.Q40, 128) == 4 * 18
+    assert blocks.row_bytes(blocks.Q80, 128) == 4 * 34
+    assert blocks.batch_bytes(blocks.Q40, 4096, 4096) == 4096 * 128 * 18
