@@ -1,0 +1,184 @@
+"""dllama-style CLI: ``inference | generate | chat`` on TPU.
+
+Mirrors the reference app surface (`/root/reference/src/apps/dllama/dllama.cpp:195-220`,
+flag parser at `/root/reference/src/app.cpp:19-93`). There is no ``worker`` mode:
+under SPMD the "workers" are mesh devices of one jitted program — multi-host
+topologies come up via ``jax.distributed`` (all hosts run the same command),
+not a root/worker socket protocol.
+
+Usage:
+    python -m dllama_tpu.cli inference --model m.m --tokenizer t.t \
+        --prompt "Hello" --steps 64 --temperature 0.7 --topp 0.9 [--tp 4]
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="dllama_tpu")
+    sub = p.add_subparsers(dest="mode", required=True)
+    for mode in ("inference", "generate", "chat"):
+        sp = sub.add_parser(mode)
+        sp.add_argument("--model", required=True)
+        sp.add_argument("--tokenizer", required=True)
+        sp.add_argument("--prompt", default=None)
+        sp.add_argument("--steps", type=int, default=64)
+        sp.add_argument("--temperature", type=float, default=0.8)
+        sp.add_argument("--topp", type=float, default=0.9)
+        sp.add_argument("--seed", type=int, default=None)
+        sp.add_argument("--dtype", default="bfloat16", choices=["float32", "bfloat16"])
+        sp.add_argument("--cache-dtype", default=None, choices=[None, "float32", "bfloat16"])
+        sp.add_argument(
+            "--tp",
+            type=int,
+            default=0,
+            help="tensor-parallel shards (0 = all visible devices)",
+        )
+        sp.add_argument("--system-prompt", default=None, help="chat mode system prompt")
+        sp.add_argument(
+            "--chat-template", default="llama2", choices=["llama2", "llama3"]
+        )
+        # accepted for reference-flag compatibility; activations never cross a
+        # wire in SPMD, so there is nothing to requantize (see SURVEY.md §2.4)
+        sp.add_argument("--buffer-float-type", default=None, help=argparse.SUPPRESS)
+        sp.add_argument("--weights-float-type", default=None, help=argparse.SUPPRESS)
+        sp.add_argument("--nthreads", type=int, default=None, help=argparse.SUPPRESS)
+    return p
+
+
+def load_engine(args):
+    import jax
+    import jax.numpy as jnp
+
+    from dllama_tpu.formats.weights import WeightFileReader
+    from dllama_tpu.models import llama
+    from dllama_tpu.models.config import ModelConfig
+    from dllama_tpu.runtime.generate import Engine
+    from dllama_tpu.runtime.sampler import SamplerConfig
+    from dllama_tpu.tokenizer.bpe import Tokenizer
+
+    t0 = time.time()
+    with WeightFileReader(args.model) as reader:
+        cfg = ModelConfig.from_spec(reader.spec, dtype=args.dtype)
+        if cfg.is_moe:
+            raise SystemExit(
+                f"arch {cfg.arch!r} (MoE) is not wired into the CLI engine yet"
+            )
+        print(f"💡 arch: {cfg.arch}")
+        print(f"💡 dim: {cfg.dim}  hiddenDim: {cfg.hidden_dim}  nLayers: {cfg.n_layers}")
+        print(f"💡 nHeads: {cfg.n_heads}  nKvHeads: {cfg.n_kv_heads}")
+        print(f"💡 vocabSize: {cfg.vocab_size}  seqLen: {cfg.seq_len}")
+        params = llama.params_from_reader(reader, cfg)
+    print(f"⏩ loaded weights in {time.time() - t0:.1f}s")
+
+    tok = Tokenizer.from_file(args.tokenizer)
+    seed = args.seed if args.seed is not None else int(time.time())
+    sampler_cfg = SamplerConfig(temperature=args.temperature, topp=args.topp, seed=seed)
+    cache_dtype = jnp.dtype(args.cache_dtype) if args.cache_dtype else jnp.dtype(args.dtype)
+
+    n_tp = args.tp if args.tp > 0 else len(jax.devices())
+    if n_tp > 1:
+        try:
+            from dllama_tpu.parallel.mesh import tp_mesh
+            from dllama_tpu.parallel.sharded_engine import ShardedEngine
+        except ImportError as e:
+            raise SystemExit(f"tensor-parallel engine unavailable ({e}); pass --tp 1") from e
+
+        mesh = tp_mesh(n_tp)
+        engine = ShardedEngine(cfg, params, mesh, sampler_cfg, cache_dtype=cache_dtype)
+        print(f"🔗 tensor-parallel over {n_tp} devices (ICI mesh)")
+    else:
+        engine = Engine(cfg, params, sampler_cfg, cache_dtype=cache_dtype)
+    return engine, tok, cfg
+
+
+def run_generate(args, show_stats: bool) -> None:
+    engine, tok, cfg = load_engine(args)
+    prompt = args.prompt if args.prompt is not None else "Hello"
+    tokens = tok.encode(prompt, add_bos=True)
+    print(f"📄 prompt tokens: {len(tokens)}")
+
+    gen_ms = []
+    prev = tokens[-1]
+    produced = list()
+    for tok_id, stats in engine.generate(tokens, args.steps, stop_tokens=(tok.eos_id,)):
+        piece = tok.decode_piece(prev, tok_id)
+        sys.stdout.write(piece.decode("utf-8", errors="replace"))
+        sys.stdout.flush()
+        prev = tok_id
+        produced.append(tok_id)
+        gen_ms.append(stats.generation_ms)
+        if show_stats:
+            sys.stdout.write(f"  🔶 G {stats.generation_ms:7.2f} ms I {stats.inference_ms:7.2f} ms\n")
+    print()
+    if gen_ms:
+        # skip the first token (prefill) in the average, like the reference
+        # averages steady-state decode (`dllama.cpp:86-91`)
+        steady = gen_ms[1:] if len(gen_ms) > 1 else gen_ms
+        avg = sum(steady) / len(steady)
+        print(f"Generated tokens:    {len(produced)}")
+        print(f"Avg tokens / second: {1000.0 / avg:.2f}")
+        print(f"Avg generation time: {avg:.2f} ms")
+        print(f"Prefill time:        {engine.prefill_ms:.2f} ms ({len(tokens)} tokens)")
+
+
+def run_chat(args) -> None:
+    from dllama_tpu.serving.templates import render_llama2_turn, render_llama3_chat
+
+    engine, tok, cfg = load_engine(args)
+    system = args.system_prompt
+    if system is None:
+        system = input("💻 Enter system prompt (optional): ")
+    session = None
+    while True:
+        try:
+            user = input("👱 User: ")
+        except EOFError:
+            break
+        first = session is None
+        used = session.pos if session else 0
+        if args.chat_template == "llama3":
+            # render only the new turn — prior turns live in the KV cache
+            turn = [{"role": "user", "content": user}]
+            if first and system:
+                turn.insert(0, {"role": "system", "content": system})
+            rendered = render_llama3_chat(turn)
+        else:
+            rendered = render_llama2_turn(user, system or "", first)
+        tokens = tok.encode(rendered, add_bos=first)
+        if used + len(tokens) + 2 > cfg.seq_len:
+            print("(context window exhausted)")
+            break
+        print("🤖 Assistant: ", end="", flush=True)
+        prev = tokens[-1]
+        reply = []
+        for tok_id, _ in engine.generate(
+            tokens, args.steps, session=session, stop_tokens=(tok.eos_id,)
+        ):
+            if tok_id == tok.eos_id:
+                continue  # generator stops itself after yielding a stop token
+            piece = tok.decode_piece(prev, tok_id).decode("utf-8", errors="replace")
+            print(piece, end="", flush=True)
+            prev = tok_id
+            reply.append(piece)
+        print()
+        session = engine.final_session
+        if session.pos >= cfg.seq_len - 1:
+            print("(context window exhausted)")
+            break
+
+
+def main(argv=None) -> None:
+    args = build_parser().parse_args(argv)
+    if args.mode == "chat":
+        run_chat(args)
+    else:
+        run_generate(args, show_stats=args.mode == "inference")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,78 @@
+"""Runtime model configuration, derived from the on-disk ModelSpec."""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+
+from dllama_tpu.formats.spec import ArchType, HiddenAct, ModelSpec
+from dllama_tpu.ops import rope as rope_ops
+
+# Grok-1 scalings (`/root/reference/src/grok1-tasks.cpp:11-14,269-272`)
+GROK_EMBEDDING_SCALE = 78.38367176906169
+GROK_LOGIT_SCALE = 0.5773502691896257
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelConfig:
+    arch: str  # "llama" | "grok1" | "mixtral"
+    dim: int
+    hidden_dim: int
+    n_layers: int
+    n_heads: int
+    n_kv_heads: int
+    vocab_size: int
+    seq_len: int
+    head_size: int
+    kv_dim: int
+    n_experts: int = 0
+    n_active_experts: int = 0
+    hidden_act: str = "silu"
+    rope_theta: float = 10000.0
+    rope_style: str = rope_ops.INTERLEAVED
+    embedding_scale: float = 1.0
+    logit_scale: float = 1.0
+    # grok1 re-normalizes after attention / moe output
+    # (`/root/reference/src/grok1-tasks.cpp:16-41,244-262`)
+    post_norms: bool = False
+    norm_eps: float = 1e-5
+    dtype: str = "float32"
+
+    @property
+    def jax_dtype(self):
+        return jnp.dtype(self.dtype)
+
+    @property
+    def is_moe(self) -> bool:
+        return self.n_experts > 0
+
+    @classmethod
+    def from_spec(cls, spec: ModelSpec, dtype: str = "float32") -> "ModelConfig":
+        arch = {ArchType.LLAMA: "llama", ArchType.GROK1: "grok1", ArchType.MIXTRAL: "mixtral"}[
+            spec.arch
+        ]
+        # Grok/Mixtral use the half-split (NeoX) rotary layout, Llama the
+        # interleaved one (`/root/reference/src/transformer.cpp:398-402`).
+        rope_style = rope_ops.HALF if arch in ("grok1", "mixtral") else rope_ops.INTERLEAVED
+        return cls(
+            arch=arch,
+            dim=spec.dim,
+            hidden_dim=spec.hidden_dim,
+            n_layers=spec.n_layers,
+            n_heads=spec.n_heads,
+            n_kv_heads=spec.n_kv_heads,
+            vocab_size=spec.vocab_size,
+            seq_len=spec.seq_len,
+            head_size=spec.head_size,
+            kv_dim=spec.kv_dim,
+            n_experts=spec.n_experts,
+            n_active_experts=spec.n_active_experts,
+            hidden_act="gelu" if spec.hidden_act == HiddenAct.GELU else "silu",
+            rope_theta=spec.rope_theta,
+            rope_style=rope_style,
+            embedding_scale=GROK_EMBEDDING_SCALE if arch == "grok1" else 1.0,
+            logit_scale=GROK_LOGIT_SCALE if arch == "grok1" else 1.0,
+            post_norms=arch == "grok1",
+            dtype=dtype,
+        )

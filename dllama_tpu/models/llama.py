@@ -1,0 +1,162 @@
+"""Dense Llama-family transformer — the single-program SPMD forward pass.
+
+Where the reference unrolls 25 root + 15 worker task functions per layer with
+explicit broadcast/gather between them (`/root/reference/src/llama2-tasks.cpp:243-300`),
+here the whole forward pass is one jitted function: a ``lax.scan`` over stacked
+layer parameters, with tensor-parallel sharding expressed as PartitionSpecs
+(see ``dllama_tpu.parallel``) so XLA emits the collectives the reference
+hand-rolls over TCP.
+
+Math parity notes:
+* rmsnorm eps semantics: `/root/reference/src/funcs.cpp:94-123`.
+* attention: `/root/reference/src/llama2-tasks.cpp:54-94` (see ops.attention).
+* SwiGLU: ``w2( act(w1 x) * (w3 x) )`` — `/root/reference/src/llama2-tasks.cpp:158-189`.
+* logits: final rmsnorm then ``wcls`` matmul — `/root/reference/src/llama2-tasks.cpp:222-241`.
+
+Weights use kernel layout ``[in_features, out_features]`` (transposed from the
+file's ``[out, in]`` rows) so activations hit the MXU as plain ``x @ w``.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dllama_tpu.formats.weights import WeightFileReader
+from dllama_tpu.models.config import ModelConfig
+from dllama_tpu.ops.activations import ACTIVATIONS
+from dllama_tpu.ops.attention import gqa_attention
+from dllama_tpu.ops.norms import rmsnorm
+from dllama_tpu.ops.rope import apply_rope, rope_table
+
+
+# ---------------------------------------------------------------------------
+# Parameters
+# ---------------------------------------------------------------------------
+
+def params_from_reader(reader: WeightFileReader, cfg: ModelConfig, dtype=None) -> dict:
+    """Load `.m` tensors into the stacked-layer pytree (dense archs)."""
+    dtype = dtype or cfg.jax_dtype
+    p = {
+        "embedding": reader.read_tensor("token_embedding", np.float32),
+        "rms_final": reader.read_tensor("rms_final", np.float32),
+        "wcls": reader.read_tensor("wcls", dtype).T,
+    }
+    names = ["wq", "wk", "wv", "wo", "w1", "w2", "w3"]
+    layers: dict = {n: [] for n in names}
+    layers["rms_att"] = []
+    layers["rms_ffn"] = []
+    for i in range(cfg.n_layers):
+        pre = f"layers.{i}."
+        for n in names:
+            layers[n].append(reader.read_tensor(pre + n, dtype).T)  # [in, out]
+        layers["rms_att"].append(reader.read_tensor(pre + "rms_att", np.float32))
+        layers["rms_ffn"].append(reader.read_tensor(pre + "rms_ffn", np.float32))
+    p["layers"] = {k: np.stack(v) for k, v in layers.items()}
+    return p
+
+
+def random_params(cfg: ModelConfig, seed: int = 0, scale: float = 0.02, dtype=None) -> dict:
+    """Seeded synthetic weights (the llama2-tasks-test pattern, for tests/bench)."""
+    dtype = dtype or cfg.jax_dtype
+    rng = np.random.default_rng(seed)
+
+    def w(*shape):
+        return (rng.standard_normal(shape) * scale).astype(np.float32).astype(dtype)
+
+    L, D, H, KV = cfg.n_layers, cfg.dim, cfg.hidden_dim, cfg.kv_dim
+    return {
+        "embedding": w(cfg.vocab_size, D).astype(np.float32),
+        "rms_final": np.ones(D, np.float32),
+        "wcls": w(D, cfg.vocab_size),
+        "layers": {
+            "wq": w(L, D, D),
+            "wk": w(L, D, KV),
+            "wv": w(L, D, KV),
+            "wo": w(L, D, D),
+            "w1": w(L, D, H),
+            "w2": w(L, H, D),
+            "w3": w(L, D, H),
+            "rms_att": np.ones((L, D), np.float32),
+            "rms_ffn": np.ones((L, D), np.float32),
+        },
+    }
+
+
+def init_cache(cfg: ModelConfig, cache_dtype=jnp.float32) -> dict:
+    """Fixed-size per-layer KV cache [L, seq_len, n_kv_heads, head_size]."""
+    shape = (cfg.n_layers, cfg.seq_len, cfg.n_kv_heads, cfg.head_size)
+    return {"k": jnp.zeros(shape, cache_dtype), "v": jnp.zeros(shape, cache_dtype)}
+
+
+def rope_tables(cfg: ModelConfig) -> dict:
+    cos, sin = rope_table(cfg.seq_len, cfg.head_size, cfg.rope_theta)
+    return {"cos": jnp.asarray(cos), "sin": jnp.asarray(sin)}
+
+
+# ---------------------------------------------------------------------------
+# Forward pass
+# ---------------------------------------------------------------------------
+
+def _dense_ffn(cfg: ModelConfig, lp: dict, xb: jnp.ndarray) -> jnp.ndarray:
+    act = ACTIVATIONS[cfg.hidden_act]
+    h = act(xb @ lp["w1"]) * (xb @ lp["w3"])
+    return h @ lp["w2"]
+
+
+def _attn_block(cfg: ModelConfig, lp: dict, rope: dict, x, k_cache, v_cache, pos):
+    """One attention sub-block. Returns (attn output [T, dim], new k/v cache [S,...])."""
+    T = x.shape[0]
+    xb = rmsnorm(x, lp["rms_att"], cfg.norm_eps)
+
+    q = (xb @ lp["wq"]).reshape(T, cfg.n_heads, cfg.head_size)
+    k = (xb @ lp["wk"]).reshape(T, cfg.n_kv_heads, cfg.head_size)
+    v = (xb @ lp["wv"]).reshape(T, cfg.n_kv_heads, cfg.head_size)
+
+    cos = jax.lax.dynamic_slice_in_dim(rope["cos"], pos, T)[:, None, :]
+    sin = jax.lax.dynamic_slice_in_dim(rope["sin"], pos, T)[:, None, :]
+    q = apply_rope(q, cos, sin, cfg.rope_style)
+    k = apply_rope(k, cos, sin, cfg.rope_style)
+
+    k_cache = jax.lax.dynamic_update_slice_in_dim(k_cache, k.astype(k_cache.dtype), pos, axis=0)
+    v_cache = jax.lax.dynamic_update_slice_in_dim(v_cache, v.astype(v_cache.dtype), pos, axis=0)
+
+    out = gqa_attention(q, k_cache, v_cache, pos)
+    return out.reshape(T, cfg.dim) @ lp["wo"], k_cache, v_cache
+
+
+def forward(
+    cfg: ModelConfig,
+    params: dict,
+    rope: dict,
+    tokens: jnp.ndarray,  # [T] int32
+    cache: dict,  # {"k","v": [L, S, n_kv, hd]}
+    pos,  # scalar int32: sequence position of tokens[0]
+) -> tuple:
+    """Process T tokens starting at ``pos``. Returns (logits [T, vocab] f32, new cache).
+
+    T==1 is the decode step; larger T is batched prefill (the reference feeds
+    prompt tokens one at a time — batching them is the first TPU win).
+    """
+    x = params["embedding"][tokens].astype(cfg.jax_dtype)
+    if cfg.embedding_scale != 1.0:
+        x = x * jnp.asarray(cfg.embedding_scale, cfg.jax_dtype)
+
+    def layer_step(x, layer):
+        lp, k_cache, v_cache = layer
+        att_out, k_cache, v_cache = _attn_block(cfg, lp, rope, x, k_cache, v_cache, pos)
+        x = x + att_out
+        xb = rmsnorm(x, lp["rms_ffn"], cfg.norm_eps)
+        x = x + _dense_ffn(cfg, lp, xb)
+        return x, (k_cache, v_cache)
+
+    x, (new_k, new_v) = jax.lax.scan(
+        layer_step, x, (params["layers"], cache["k"], cache["v"])
+    )
+
+    x = rmsnorm(x, params["rms_final"], cfg.norm_eps)
+    logits = (x @ params["wcls"]).astype(jnp.float32)
+    if cfg.logit_scale != 1.0:
+        logits = logits * cfg.logit_scale
+    return logits, {"k": new_k, "v": new_v}

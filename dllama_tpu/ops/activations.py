@@ -1,0 +1,27 @@
+"""Activations matching the reference kernels.
+
+* ``silu``: ``x * sigmoid(x)`` (`/root/reference/src/funcs.cpp:499-506`).
+* ``gelu``: tanh approximation ``0.5*x*(1+tanh(sqrt(2/pi)*(x+0.044715*x^3)))``
+  (`/root/reference/src/funcs.cpp:490-497`) — used by Grok-1.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+GELU_CONST = 0.044715
+SQRT_2_OVER_PI = 0.7978845608028654
+
+
+def silu(x: jnp.ndarray) -> jnp.ndarray:
+    return x * jax.nn.sigmoid(x)
+
+
+def gelu_tanh(x: jnp.ndarray) -> jnp.ndarray:
+    xf = x.astype(jnp.float32)
+    out = 0.5 * xf * (1.0 + jnp.tanh(SQRT_2_OVER_PI * (xf + GELU_CONST * xf * xf * xf)))
+    return out.astype(x.dtype)
+
+
+ACTIVATIONS = {"silu": silu, "gelu": gelu_tanh}

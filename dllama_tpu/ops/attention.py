@@ -1,0 +1,48 @@
+"""Grouped-query attention over a fixed-size KV cache.
+
+Semantics mirror the reference per-head loop
+(`/root/reference/src/llama2-tasks.cpp:54-94`): score = q.k / sqrt(head_size),
+softmax over positions 0..pos (inclusive), weighted sum of V. The reference
+iterates positions serially per token; here the whole history is one masked
+MXU-friendly einsum, and prefill processes T query positions at once under a
+causal mask — numerically identical, shapes static for XLA.
+
+Softmax runs in f32 whatever the activation dtype (the reference is all-f32).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def gqa_attention(
+    q: jnp.ndarray,  # [T, n_heads, head_size]
+    k_cache: jnp.ndarray,  # [S, n_kv_heads, head_size]
+    v_cache: jnp.ndarray,  # [S, n_kv_heads, head_size]
+    pos: jnp.ndarray,  # scalar int32: position of q[0] in the sequence
+) -> jnp.ndarray:
+    """Masked GQA attention. Returns [T, n_heads, head_size].
+
+    The cache must already contain this step's K/V at positions pos..pos+T-1.
+    Query t attends to cache positions <= pos + t; everything later is masked.
+    """
+    T, n_heads, head_size = q.shape
+    S, n_kv_heads, _ = k_cache.shape
+    group = n_heads // n_kv_heads
+
+    qf = q.astype(jnp.float32).reshape(T, n_kv_heads, group, head_size)
+    kf = k_cache.astype(jnp.float32)
+    vf = v_cache.astype(jnp.float32)
+
+    scores = jnp.einsum("tkgh,skh->tkgs", qf, kf) / jnp.sqrt(jnp.float32(head_size))
+
+    key_idx = jnp.arange(S, dtype=jnp.int32)[None, :]  # [1, S]
+    query_pos = pos + jnp.arange(T, dtype=jnp.int32)[:, None]  # [T, 1]
+    mask = key_idx <= query_pos  # [T, S]
+    scores = jnp.where(mask[:, None, None, :], scores, jnp.float32(-1e30))
+
+    att = jnp.exp(scores - scores.max(axis=-1, keepdims=True))
+    att = att / att.sum(axis=-1, keepdims=True)
+
+    out = jnp.einsum("tkgs,skh->tkgh", att, vf)
+    return out.reshape(T, n_heads, head_size).astype(q.dtype)

@@ -1,0 +1,21 @@
+"""Normalization ops.
+
+RMSNorm semantics match the reference exactly
+(`/root/reference/src/funcs.cpp:94-123`): ``inv = 1/sqrt(mean(x^2) + 1e-5)``,
+``y = w * (inv * x)`` — note eps is added to the *mean*, and the reference
+computes everything in f32. We keep the accumulation in f32 regardless of the
+activation dtype so bf16 runs stay numerically anchored.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+RMS_EPS = 1e-5
+
+
+def rmsnorm(x: jnp.ndarray, weight: jnp.ndarray, eps: float = RMS_EPS) -> jnp.ndarray:
+    """RMS-normalize the last axis. x: [..., dim], weight: [dim]."""
+    xf = x.astype(jnp.float32)
+    inv = jnp.reciprocal(jnp.sqrt(jnp.mean(xf * xf, axis=-1, keepdims=True) + eps))
+    return (weight.astype(jnp.float32) * (xf * inv)).astype(x.dtype)

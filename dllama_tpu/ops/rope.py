@@ -1,0 +1,59 @@
+"""Rotary position embeddings — both conventions the reference supports.
+
+* **interleaved** (Llama archs): pair ``(2j, 2j+1)`` within each head, angle
+  ``pos * theta^(-2j/head_size)`` — matches ``LlamaRopeSlice``
+  (`/root/reference/src/transformer.cpp:98-135`) and the HF->interleaved permute
+  the reference converter applies (`/root/reference/converter/convert-hf.py:12-15`).
+* **half** (Grok-1 / Mixtral, a.k.a. NeoX/Falcon layout): pair
+  ``(j, j + head_size/2)``, same angles — matches ``FalconRopeSlice``
+  (`/root/reference/src/transformer.cpp:137-159`).
+
+Tables are precomputed once per model as f32 ``[seq_len, head_size//2]`` and the
+rotation itself runs in f32 (the reference computes RoPE on f32 activations).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+INTERLEAVED = "interleaved"
+HALF = "half"
+
+
+def rope_table(seq_len: int, head_size: int, theta: float) -> tuple[np.ndarray, np.ndarray]:
+    """(cos, sin) tables, each [seq_len, head_size//2], f32."""
+    j = np.arange(0, head_size, 2, dtype=np.float32)  # 2j over the head
+    freqs = 1.0 / np.power(np.float32(theta), j / np.float32(head_size))
+    angles = np.arange(seq_len, dtype=np.float32)[:, None] * freqs[None, :]
+    return np.cos(angles), np.sin(angles)
+
+
+def apply_rope(
+    x: jnp.ndarray, cos: jnp.ndarray, sin: jnp.ndarray, style: str = INTERLEAVED
+) -> jnp.ndarray:
+    """Rotate ``x [..., n_heads, head_size]`` with per-position tables.
+
+    ``cos``/``sin`` must be broadcastable to ``[..., 1, head_size//2]`` — pass
+    ``table[pos]`` (decode, one position) or ``table[pos:pos+T, None, :]`` (prefill).
+    """
+    dtype = x.dtype
+    xf = x.astype(jnp.float32)
+    c = cos.astype(jnp.float32)
+    s = sin.astype(jnp.float32)
+    if style == INTERLEAVED:
+        x0 = xf[..., 0::2]
+        x1 = xf[..., 1::2]
+        r0 = x0 * c - x1 * s
+        r1 = x0 * s + x1 * c
+        out = jnp.stack([r0, r1], axis=-1).reshape(xf.shape)
+    elif style == HALF:
+        half = xf.shape[-1] // 2
+        x0 = xf[..., :half]
+        x1 = xf[..., half:]
+        r0 = x0 * c - x1 * s
+        r1 = x0 * s + x1 * c
+        out = jnp.concatenate([r0, r1], axis=-1)
+    else:
+        raise ValueError(f"unknown rope style {style!r}")
+    return out.astype(dtype)

@@ -1,0 +1,192 @@
+"""Decode engine: jitted prefill + single-token decode steps with a resident
+KV cache, per-token timing stats, and on-device sampling.
+
+This subsumes the reference's `Inference::infer` loop
+(`/root/reference/src/tasks.cpp:199-215`) and the per-token stats surface the
+CLI prints (`/root/reference/src/apps/dllama/dllama.cpp:43-92`). Differences
+by design, all TPU-motivated:
+
+* The prompt is processed in *batched* prefill (bucketed padded lengths, so a
+  handful of compiles serve any prompt) instead of one forward per token.
+* One jitted program covers embed -> all layers -> logits -> sample; the host
+  sees 4 bytes (the token id) per step, not the logits.
+* The KV cache is donated between steps, so XLA updates it in place in HBM.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from functools import partial
+from typing import Iterator, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dllama_tpu.models import llama
+from dllama_tpu.models.config import ModelConfig
+from dllama_tpu.runtime.sampler import SamplerConfig, sample
+
+PREFILL_BUCKETS = (8, 16, 32, 64, 128, 256, 512, 1024, 2048, 4096, 8192)
+
+
+def prefill_bucket(n: int) -> int:
+    for b in PREFILL_BUCKETS:
+        if n <= b:
+            return b
+    return n
+
+
+@dataclasses.dataclass
+class TokenStats:
+    """Per-token timing, the G/I/T analogue (transfer time is folded into
+    generation time — on a single jitted program there is no separate wire)."""
+
+    generation_ms: float
+    inference_ms: float
+
+
+@dataclasses.dataclass
+class Session:
+    """Conversation state carried across generate() calls (chat mode).
+
+    ``pending_token`` is the last sampled token, which has NOT yet been fed
+    through the model — the next call must consume it first so the KV cache
+    sees every conversation token exactly once (the reference feeds every
+    sampled token back through ``infer``, including EOS —
+    `/root/reference/src/apps/dllama/dllama.cpp:152-166`).
+    """
+
+    cache: dict
+    pos: int
+    pending_token: Optional[int] = None
+
+
+class Engine:
+    """Holds device-resident params + cache and the compiled step functions."""
+
+    def __init__(
+        self,
+        cfg: ModelConfig,
+        params: dict,
+        sampler_cfg: SamplerConfig = SamplerConfig(),
+        cache_dtype=jnp.float32,
+    ):
+        self.cfg = cfg
+        self.sampler_cfg = sampler_cfg
+        self.params = jax.tree.map(jnp.asarray, params)
+        self.rope = llama.rope_tables(cfg)
+        self.cache_dtype = cache_dtype
+        self._key = jax.random.PRNGKey(sampler_cfg.seed)
+
+        @partial(jax.jit, donate_argnums=(0,))
+        def _decode_step(cache, token, pos, key):
+            logits, cache = llama.forward(
+                cfg, self.params, self.rope, token[None], cache, pos
+            )
+            nxt = sample(logits[0], key, self.sampler_cfg)
+            return nxt, cache
+
+        @partial(jax.jit, donate_argnums=(0,))
+        def _prefill(cache, padded_tokens, n_tokens, pos):
+            # n_tokens is traced (dynamic index) so one compile serves every
+            # prompt length within a bucket
+            logits, cache = llama.forward(
+                cfg, self.params, self.rope, padded_tokens, cache, pos
+            )
+            return jax.lax.dynamic_index_in_dim(logits, n_tokens - 1, keepdims=False), cache
+
+        self._decode_step = _decode_step
+        self._prefill = _prefill
+
+    def new_cache(self) -> dict:
+        return llama.init_cache(self.cfg, self.cache_dtype)
+
+    def next_key(self) -> jax.Array:
+        self._key, sub = jax.random.split(self._key)
+        return sub
+
+    def prefill(self, cache: dict, tokens: list, pos: int = 0) -> tuple:
+        """Run the prompt starting at ``pos``. Returns (last_logits, cache).
+
+        Tail-padding to a bucket is safe: padded queries produce garbage
+        logits we never read, and padded cache slots sit at positions a
+        causal query never attends before a real decode overwrites them.
+        """
+        if not 0 < pos + len(tokens) <= self.cfg.seq_len:
+            raise ValueError(
+                f"prompt of {len(tokens)} tokens at pos {pos} exceeds seq_len {self.cfg.seq_len}"
+            )
+        # clamp the padded bucket to the remaining context: an out-of-range
+        # dynamic_update_slice start would be silently clamped by XLA, writing
+        # K/V into wrong slots with wrong rope angles
+        bucket = min(prefill_bucket(len(tokens)), self.cfg.seq_len - pos)
+        padded = np.zeros(bucket, np.int32)
+        padded[: len(tokens)] = tokens
+        return self._prefill(cache, jnp.asarray(padded), len(tokens), jnp.int32(pos))
+
+    def generate(
+        self,
+        prompt_tokens: list,
+        steps: int,
+        session: Optional[Session] = None,
+        stop_tokens: tuple = (),
+    ) -> Iterator[tuple]:
+        """Yield (token_id, TokenStats) for up to ``steps`` generated tokens.
+
+        Pass the previous call's ``engine.final_session`` to continue a
+        conversation with one continuous KV cache and position counter (the
+        reference keeps one continuous pos across turns,
+        `/root/reference/src/apps/dllama/dllama.cpp:154-161`).
+        """
+        if session is None:
+            cache, pos = self.new_cache(), 0
+        else:
+            cache, pos = session.cache, session.pos
+            if session.pending_token is not None:
+                prompt_tokens = [session.pending_token] + list(prompt_tokens)
+        steps = min(steps, self.cfg.seq_len - pos - len(prompt_tokens))
+
+        t0 = time.perf_counter()
+        if len(prompt_tokens) > 1:
+            last_logits, cache = self.prefill(cache, prompt_tokens, pos)
+            # sample the first generated token from the prefill logits
+            token = sample(last_logits, self.next_key(), self.sampler_cfg)
+        else:
+            token = jnp.asarray(prompt_tokens[0], jnp.int32)
+        token.block_until_ready()
+        self.prefill_ms = (time.perf_counter() - t0) * 1000.0
+
+        tok_int: Optional[int] = None
+        if len(prompt_tokens) > 1:
+            pos += len(prompt_tokens)
+            if steps <= 0:
+                # caller asked for no tokens (or the context is full): the
+                # prefill still advanced the session, but nothing is emitted
+                self.final_session = Session(cache, pos, pending_token=None)
+                return
+            tok_int = int(token)
+            yield tok_int, TokenStats(self.prefill_ms, self.prefill_ms)
+            steps -= 1
+            if tok_int in stop_tokens:
+                self.final_session = Session(cache, pos, pending_token=tok_int)
+                return
+        for _ in range(max(steps, 0)):
+            t1 = time.perf_counter()
+            token, cache = self._decode_step(
+                cache, token, jnp.int32(pos), self.next_key()
+            )
+            tok_int = int(token)  # syncs; includes device step time
+            dt = (time.perf_counter() - t1) * 1000.0
+            pos += 1
+            yield tok_int, TokenStats(generation_ms=dt, inference_ms=dt)
+            if tok_int in stop_tokens:
+                break
+        if tok_int is None:
+            # nothing was generated: a 1-token prompt with steps<=0 leaves the
+            # prompt token itself unconsumed
+            pending = prompt_tokens[0] if len(prompt_tokens) == 1 else None
+        else:
+            pending = tok_int
+        self.final_session = Session(cache, pos, pending_token=pending)

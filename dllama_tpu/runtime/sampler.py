@@ -1,0 +1,46 @@
+"""On-device token sampling: greedy argmax / temperature / top-p nucleus.
+
+Same sampling semantics as the reference Sampler
+(`/root/reference/src/tokenizer.cpp:231-356`): temperature 0 means argmax;
+otherwise softmax(logits/temperature), then either plain multinomial or
+nucleus sampling that keeps the smallest prefix of descending-probability
+tokens whose cumulative mass exceeds top-p.
+
+Differences by design: sampling runs inside the jitted step on device (the
+reference pulls full logits to the host every token), and randomness comes
+from JAX's counter-based PRNG rather than xorshift — seeds are reproducible
+within this framework but token-for-token streams differ from the reference.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class SamplerConfig:
+    temperature: float = 0.8
+    topp: float = 0.9
+    seed: int = 0
+
+
+def sample(logits: jnp.ndarray, key: jax.Array, cfg: SamplerConfig) -> jnp.ndarray:
+    """Sample a token id from f32 ``logits [vocab]``. Static config => no retrace."""
+    if cfg.temperature == 0.0:
+        return jnp.argmax(logits).astype(jnp.int32)
+
+    probs = jax.nn.softmax(logits.astype(jnp.float32) / cfg.temperature)
+    if cfg.topp <= 0.0 or cfg.topp >= 1.0:
+        return jax.random.categorical(key, jnp.log(probs)).astype(jnp.int32)
+
+    # nucleus: keep descending-prob prefix until cumulative exceeds topp
+    # (inclusive of the crossing token, `/root/reference/src/tokenizer.cpp:286-296`)
+    sorted_probs, sorted_idx = jax.lax.top_k(probs, probs.shape[-1])
+    cum = jnp.cumsum(sorted_probs)
+    keep = (cum - sorted_probs) < cfg.topp  # mass before this token still < topp
+    masked = jnp.where(keep, sorted_probs, 0.0)
+    choice = jax.random.categorical(key, jnp.log(masked))
+    return sorted_idx[choice].astype(jnp.int32)

@@ -2,13 +2,18 @@
 paths (tensor/data/sequence parallel) are exercised without TPU hardware —
 the gap the reference left (it has no automated distributed tests, SURVEY.md §4).
 
-Must run before jax is imported anywhere.
+Note: this container's sitecustomize imports jax at interpreter start and
+points it at the real TPU tunnel, so setting JAX_PLATFORMS here is too late —
+we must go through jax.config. XLA_FLAGS still works because the CPU backend
+only initializes on first use.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
-os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,87 @@
+"""Independent plain-numpy transformer forward, used as the golden oracle.
+
+Deliberately written in the reference's serial style (per-position loops,
+per-head attention, explicit rope pair rotation — cf.
+`/root/reference/src/llama2-tasks.cpp:33-241`) rather than vectorized, so a
+shared bug with the vectorized JAX implementation is unlikely. All f32.
+"""
+
+import numpy as np
+
+
+def rmsnorm(x, w, eps=1e-5):
+    inv = 1.0 / np.sqrt(np.mean(x * x) + eps)
+    return w * (x * inv)
+
+
+def softmax(x):
+    e = np.exp(x - np.max(x))
+    return e / e.sum()
+
+
+def rope_rotate(vec, pos, head_size, theta, style):
+    """Rotate one flat q-or-k vector [n_heads * head_size] in place-style."""
+    out = vec.copy()
+    n_heads = vec.size // head_size
+    for h in range(n_heads):
+        base = h * head_size
+        for j in range(head_size // 2):
+            freq = 1.0 / (theta ** (2.0 * j / head_size))
+            val = pos * freq
+            fcr, fci = np.cos(val), np.sin(val)
+            if style == "interleaved":
+                i0, i1 = base + 2 * j, base + 2 * j + 1
+            else:  # "half"
+                i0, i1 = base + j, base + j + head_size // 2
+            v0, v1 = vec[i0], vec[i1]
+            out[i0] = v0 * fcr - v1 * fci
+            out[i1] = v0 * fci + v1 * fcr
+    return out
+
+
+def forward_tokens(cfg, params, tokens, n_past=0, kv=None):
+    """Run tokens one at a time (the reference's decode loop). Returns
+    (logits_per_token [T, vocab], kv dict of lists per layer)."""
+    D, HS = cfg.dim, cfg.head_size
+    n_kv = cfg.n_kv_heads
+    group = cfg.n_heads // n_kv
+    act = (lambda x: x / (1 + np.exp(-x))) if cfg.hidden_act == "silu" else (
+        lambda x: 0.5 * x * (1 + np.tanh(0.7978845608028654 * (x + 0.044715 * x**3)))
+    )
+    L = cfg.n_layers
+    if kv is None:
+        kv = {"k": [[] for _ in range(L)], "v": [[] for _ in range(L)]}
+    lp = params["layers"]
+    logits_all = []
+    for t, tok in enumerate(tokens):
+        pos = n_past + t
+        x = params["embedding"][tok].astype(np.float32) * cfg.embedding_scale
+        for l in range(L):
+            xb = rmsnorm(x, lp["rms_att"][l])
+            q = xb @ lp["wq"][l]
+            k = xb @ lp["wk"][l]
+            v = xb @ lp["wv"][l]
+            q = rope_rotate(q, pos, HS, cfg.rope_theta, cfg.rope_style)
+            k = rope_rotate(k, pos, HS, cfg.rope_theta, cfg.rope_style)
+            kv["k"][l].append(k)
+            kv["v"][l].append(v)
+            K = np.stack(kv["k"][l])  # [pos+1, kv_dim]
+            V = np.stack(kv["v"][l])
+            att_out = np.zeros(cfg.dim, np.float32)
+            for h in range(cfg.n_heads):
+                kvh = h // group
+                qh = q[h * HS : (h + 1) * HS]
+                scores = np.array(
+                    [qh @ K[p, kvh * HS : (kvh + 1) * HS] / np.sqrt(HS) for p in range(len(K))]
+                )
+                att = softmax(scores)
+                att_out[h * HS : (h + 1) * HS] = sum(
+                    att[p] * V[p, kvh * HS : (kvh + 1) * HS] for p in range(len(K))
+                )
+            x = x + att_out @ lp["wo"][l]
+            xb2 = rmsnorm(x, lp["rms_ffn"][l])
+            h1 = act(xb2 @ lp["w1"][l]) * (xb2 @ lp["w3"][l])
+            x = x + h1 @ lp["w2"][l]
+        x = rmsnorm(x, params["rms_final"])
+        logits_all.append((x @ params["wcls"]) * cfg.logit_scale)
+    return np.stack(logits_all), kv

@@ -1,0 +1,78 @@
+"""BPE encode/decode tests mirroring the reference algorithm
+(`/root/reference/src/tokenizer.cpp:109-229`)."""
+
+import pytest
+
+from dllama_tpu.formats.tokenizer_file import TokenizerData
+from dllama_tpu.tokenizer.bpe import Tokenizer
+
+
+def make_tokenizer(extra=()):
+    """Vocab layout like real llama .t files: <unk>,<s>,</s>, 256 byte tokens, words."""
+    vocab = [b"<unk>", b"<s>", b"</s>"]
+    vocab += [b"<0x%02X>" % b for b in range(256)]
+    scores = [0.0] * len(vocab)
+    for piece, score in extra:
+        vocab.append(piece)
+        scores.append(score)
+    return Tokenizer(TokenizerData(vocab=vocab, scores=scores, bos_id=1, eos_id=2))
+
+
+def test_encode_merges_best_pair_first():
+    tok = make_tokenizer(
+        extra=[
+            (b" ", -1.0),
+            (b"h", -2.0),
+            (b"i", -2.0),
+            (b"hi", -1.5),
+            (b" hi", -1.2),
+        ]
+    )
+    ids = tok.encode("hi", add_bos=True)
+    # bos, then dummy-prefix space merged with h+i => " hi"
+    assert ids[0] == tok.bos_id
+    assert tok.decode(ids) == "hi"  # leading space stripped after BOS
+    assert ids == [1, tok.piece_id(b" hi")]
+
+
+def test_byte_fallback_roundtrip():
+    tok = make_tokenizer(extra=[(b" ", -1.0)])
+    text = "héllo\n"  # é not in vocab -> falls back to bytes
+    ids = tok.encode(text, add_bos=True)
+    assert all(0 <= i < tok.vocab_size for i in ids)
+    # the dummy-prefix space is stripped after BOS (reference PR #89 semantics)
+    assert tok.decode(ids) == text
+
+
+def test_encode_empty_no_dummy_prefix():
+    tok = make_tokenizer(extra=[(b" ", -1.0)])
+    assert tok.encode("", add_bos=True) == [1]
+    assert tok.encode("", add_bos=False) == []
+
+
+def test_add_eos():
+    tok = make_tokenizer(extra=[(b" ", -1.0), (b"a", -2.0)])
+    ids = tok.encode("a", add_bos=True, add_eos=True)
+    assert ids[-1] == tok.eos_id
+
+
+def test_greedy_merge_prefers_higher_score():
+    # "abc": merges could go (ab)c or a(bc); bc has the higher score
+    tok = make_tokenizer(
+        extra=[
+            (b" ", -1.0),
+            (b"a", -2.0),
+            (b"b", -2.0),
+            (b"c", -2.0),
+            (b"ab", -3.0),
+            (b"bc", -2.5),
+        ]
+    )
+    ids = tok.encode("abc", add_bos=False)
+    assert ids == [tok.piece_id(b" "), tok.piece_id(b"a"), tok.piece_id(b"bc")]
+
+
+def test_multibyte_codepoint_in_vocab():
+    tok = make_tokenizer(extra=[(b" ", -1.0), ("中".encode(), -2.0)])
+    ids = tok.encode("中", add_bos=False)
+    assert ids == [tok.piece_id(b" "), tok.piece_id("中".encode())]
