@@ -1,0 +1,44 @@
+"""Device meshes — the TPU-native replacement for the reference's root/worker
+TCP star (`/root/reference/src/socket.cpp`).
+
+The reference wires ``nSlices = nWorkers + 1`` processes into a star and moves
+activations over Ethernet; here the same slicing is a named mesh axis and XLA
+emits collectives over ICI. Axis names:
+
+* ``tp`` — tensor parallel (the reference's only strategy)
+* ``dp`` — data parallel (batch; absent in the reference, batch=1)
+* ``sp`` — sequence/context parallel (ring attention; absent in the reference)
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+TP = "tp"
+DP = "dp"
+SP = "sp"
+
+
+def tp_mesh(n_tp: int, devices=None) -> Mesh:
+    """1-D tensor-parallel mesh over the first ``n_tp`` devices."""
+    devices = devices if devices is not None else jax.devices()
+    if n_tp > len(devices):
+        raise ValueError(f"requested tp={n_tp} but only {len(devices)} devices visible")
+    return Mesh(np.asarray(devices[:n_tp]), (TP,))
+
+
+def make_mesh(axes: dict, devices=None) -> Mesh:
+    """Mesh from an ordered {axis_name: size} dict, e.g. {"dp": 2, "tp": 4}.
+
+    Axis order follows the dict; put the fastest-communicating axis (tp) last
+    so it maps to the innermost / closest devices on real hardware.
+    """
+    devices = devices if devices is not None else jax.devices()
+    n = int(np.prod(list(axes.values())))
+    if n > len(devices):
+        raise ValueError(f"mesh {axes} needs {n} devices, have {len(devices)}")
+    arr = np.asarray(devices[:n]).reshape(tuple(axes.values()))
+    return Mesh(arr, tuple(axes.keys()))

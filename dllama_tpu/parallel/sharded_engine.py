@@ -1,0 +1,28 @@
+"""Tensor-parallel decode engine (thin front over runtime.generate.Engine).
+
+The sharded engine *is* the plain engine — same jitted step functions, same
+Session semantics — with params/cache placed on a ``tp`` mesh. That identity
+is the point of the SPMD design: going from 1 to N chips changes data
+placement, not program structure (the reference instead splits its task list
+into separate root and worker programs, `/root/reference/src/tasks.cpp:21-42`).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from dllama_tpu.models.config import ModelConfig
+from dllama_tpu.runtime.generate import Engine
+from dllama_tpu.runtime.sampler import SamplerConfig
+
+
+class ShardedEngine(Engine):
+    def __init__(
+        self,
+        cfg: ModelConfig,
+        params: dict,
+        mesh,
+        sampler_cfg: SamplerConfig = SamplerConfig(),
+        cache_dtype=jnp.float32,
+    ):
+        super().__init__(cfg, params, sampler_cfg, cache_dtype=cache_dtype, mesh=mesh)

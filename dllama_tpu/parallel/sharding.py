@@ -1,0 +1,98 @@
+"""Tensor-parallel partition specs — the reference's weight slicing as sharding.
+
+Mapping (SURVEY.md §2.3):
+
+* ``RowMatmulSlice`` (split output dim: wq/wk/wv/w1/w3, per-expert up/gate —
+  `/root/reference/src/transformer.cpp:454-493`) -> shard the kernel's *out*
+  axis over ``tp``.
+* ``ColMatmulSlice`` (split input dim: wo/w2, per-expert down) -> shard the
+  kernel's *in* axis over ``tp``; XLA completes the partial products with an
+  AllReduce, which is exactly the reference's gather-then-root-sum
+  (`/root/reference/src/llama2-tasks.cpp:115-131`) collapsed into one collective.
+* KV cache + attention heads shard by kv-head (``KvCacheSlice``/
+  ``MultiHeadAttSlice``, `/root/reference/src/transformer.cpp:161-181`).
+* The reference's ``nSlices <= nKvHeads`` constraint
+  (`/root/reference/src/transformer.cpp:254-257`) becomes
+  ``n_kv_heads % tp == 0``.
+
+Kernels are stored ``[in, out]`` (see models.llama), so "row slicing the
+output dim" shards axis -1 and "column slicing the input dim" shards axis -2.
+Layer-stacked tensors carry a leading L axis that is never sharded.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from dllama_tpu.models.config import ModelConfig
+from dllama_tpu.parallel.mesh import TP
+
+
+def check_tp_compatible(cfg: ModelConfig, n_tp: int) -> None:
+    if cfg.n_kv_heads % n_tp != 0:
+        raise ValueError(
+            f"tp={n_tp} must divide n_kv_heads={cfg.n_kv_heads} "
+            "(the reference's nSlices<=nKvHeads constraint)"
+        )
+    if cfg.hidden_dim % n_tp != 0:
+        raise ValueError(f"tp={n_tp} must divide hidden_dim={cfg.hidden_dim}")
+
+
+def layer_specs(cfg: ModelConfig) -> dict:
+    specs = {
+        "wq": P(None, None, TP),  # row slice: heads
+        "wk": P(None, None, TP),
+        "wv": P(None, None, TP),
+        "wo": P(None, TP, None),  # col slice + allreduce
+        "rms_att": P(None, None),
+        "rms_ffn": P(None, None),
+    }
+    if cfg.is_moe:
+        specs.update(
+            {
+                "moe_router": P(None, None, None),  # tiny; replicated like the root's copy
+                "moe_up": P(None, None, None, TP),  # TP *within* each expert
+                "moe_gate": P(None, None, None, TP),
+                "moe_down": P(None, None, TP, None),
+            }
+        )
+        if cfg.post_norms:
+            specs["rms_moe"] = P(None, None)
+            specs["rms_ffn2"] = P(None, None)
+    else:
+        specs.update(
+            {
+                "w1": P(None, None, TP),
+                "w2": P(None, TP, None),
+                "w3": P(None, None, TP),
+            }
+        )
+    return specs
+
+
+def param_specs(cfg: ModelConfig, n_tp: int) -> dict:
+    # vocab-shard the classifier when it divides; otherwise replicate it, which
+    # is still parity with the reference (logits are root-only there anyway,
+    # `/root/reference/src/llama2-tasks.cpp:222-241`)
+    wcls = P(None, TP) if cfg.vocab_size % n_tp == 0 else P(None, None)
+    return {
+        "embedding": P(None, None),  # replicated, like the root-resident table
+        "rms_final": P(None),
+        "wcls": wcls,
+        "layers": layer_specs(cfg),
+    }
+
+
+def cache_spec() -> P:
+    # [L, S, n_kv_heads, head_size] — shard kv heads
+    return P(None, None, TP, None)
+
+
+def shard_params(params: dict, mesh, cfg: ModelConfig) -> dict:
+    """Place a host-side param pytree onto the mesh with TP shardings."""
+    check_tp_compatible(cfg, mesh.shape[TP])
+    specs = param_specs(cfg, mesh.shape[TP])
+    return jax.tree.map(
+        lambda x, spec: jax.device_put(x, NamedSharding(mesh, spec)), dict(params), specs
+    )

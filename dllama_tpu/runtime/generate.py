@@ -72,35 +72,56 @@ class Engine:
         params: dict,
         sampler_cfg: SamplerConfig = SamplerConfig(),
         cache_dtype=jnp.float32,
+        mesh=None,
     ):
+        """``mesh``: a 1-D ``tp`` Mesh (see parallel.mesh.tp_mesh) to run
+        tensor-parallel — params are placed with the reference's row/col
+        slicing as NamedShardings and XLA emits the AllReduces the reference
+        hand-rolls as broadcast+gather+root-sum."""
         self.cfg = cfg
         self.sampler_cfg = sampler_cfg
-        self.params = jax.tree.map(jnp.asarray, params)
+        self.mesh = mesh
+        if mesh is not None:
+            from dllama_tpu.parallel import sharding as _sh
+            from jax.sharding import NamedSharding
+
+            self.params = _sh.shard_params(params, mesh, cfg)
+            self._cache_sharding = NamedSharding(mesh, _sh.cache_spec())
+        else:
+            self.params = jax.tree.map(jnp.asarray, params)
+            self._cache_sharding = None
         self.rope = llama.rope_tables(cfg)
         self.cache_dtype = cache_dtype
         self._key = jax.random.PRNGKey(sampler_cfg.seed)
 
-        @partial(jax.jit, donate_argnums=(0,))
-        def _decode_step(cache, token, pos, key):
-            logits, cache = llama.forward(
-                cfg, self.params, self.rope, token[None], cache, pos
-            )
+        # params/rope MUST be jit arguments, not closure captures: a closed-over
+        # sharded array is inlined as a (replicated) constant, silently turning
+        # tensor-parallel into full replication with zero collectives
+        @partial(jax.jit, donate_argnums=(2,))
+        def _decode_step(params, rope, cache, token, pos, key):
+            logits, cache = llama.forward(cfg, params, rope, token[None], cache, pos)
             nxt = sample(logits[0], key, self.sampler_cfg)
             return nxt, cache
 
-        @partial(jax.jit, donate_argnums=(0,))
-        def _prefill(cache, padded_tokens, n_tokens, pos):
+        @partial(jax.jit, donate_argnums=(2,))
+        def _prefill(params, rope, cache, padded_tokens, n_tokens, pos):
             # n_tokens is traced (dynamic index) so one compile serves every
             # prompt length within a bucket
-            logits, cache = llama.forward(
-                cfg, self.params, self.rope, padded_tokens, cache, pos
-            )
+            logits, cache = llama.forward(cfg, params, rope, padded_tokens, cache, pos)
             return jax.lax.dynamic_index_in_dim(logits, n_tokens - 1, keepdims=False), cache
 
-        self._decode_step = _decode_step
-        self._prefill = _prefill
+        self._decode_step = partial(_decode_step, self.params, self.rope)
+        self._prefill = partial(_prefill, self.params, self.rope)
 
     def new_cache(self) -> dict:
+        if self._cache_sharding is not None:
+            # materialize the cache already-sharded: allocate-then-reshard would
+            # transiently put the FULL cache in one device's HBM, the exact OOM
+            # tensor parallelism exists to avoid
+            sh = {"k": self._cache_sharding, "v": self._cache_sharding}
+            return jax.jit(
+                lambda: llama.init_cache(self.cfg, self.cache_dtype), out_shardings=sh
+            )()
         return llama.init_cache(self.cfg, self.cache_dtype)
 
     def next_key(self) -> jax.Array:
