@@ -1,0 +1,127 @@
+"""Benchmark: average single-token generation time — the reference's headline
+metric (README "📊 Measurements": avg token time over N samples, Q40×Q80).
+
+Prints ONE JSON line to stdout:
+    {"metric": ..., "value": ms_per_token, "unit": "ms/token", "vs_baseline": x}
+
+vs_baseline compares against the reference's best published *single-node*
+Llama 2 7B number: 101.81 ms on a GCP c3d-highcpu-30 VM (BASELINE.md /
+reference README.md:88). >1.0 means faster than the reference.
+
+Decoding runs as ONE fused device program per 64 tokens (lax.scan over decode
+steps, sampling on device) — the host sees one dispatch per batch of tokens,
+not per token.
+
+Model selection: Llama-2-7B shape on TPU (random bf16 weights generated on
+device); set BENCH_MODEL=tiny (or run on CPU) for a TinyLlama-1.1B shape.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+
+LLAMA2_7B = dict(
+    arch="llama", dim=4096, hidden_dim=11008, n_layers=32, n_heads=32, n_kv_heads=32,
+    vocab_size=32000, seq_len=512, head_size=128, kv_dim=4096, dtype="bfloat16",
+)
+TINYLLAMA_1_1B = dict(
+    arch="llama", dim=2048, hidden_dim=5632, n_layers=22, n_heads=32, n_kv_heads=4,
+    vocab_size=32000, seq_len=1024, head_size=64, kv_dim=256, dtype="bfloat16",
+)
+
+# reference's best published single-node Llama 2 7B avg token time (ms)
+BASELINE_7B_SINGLE_NODE_MS = 101.81
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def run_decode_bench(cfg_dict: dict, warmup_steps: int = 16, bench_steps: int = 64) -> float:
+    import jax
+    import jax.numpy as jnp
+
+    from dllama_tpu.models import llama
+    from dllama_tpu.models.config import ModelConfig
+    from dllama_tpu.runtime.generate import Engine
+    from dllama_tpu.runtime.sampler import SamplerConfig
+
+    cfg = ModelConfig(**cfg_dict)
+    n_dev = len(jax.devices())
+    mesh = None
+    if n_dev > 1 and cfg.n_kv_heads % n_dev == 0:
+        from dllama_tpu.parallel.mesh import tp_mesh
+
+        mesh = tp_mesh(n_dev)
+        log(f"tensor-parallel over {n_dev} devices")
+
+    log(f"building params on device: dim={cfg.dim} layers={cfg.n_layers} ({cfg.dtype})")
+    # with a mesh, params are written directly into their shards — no chip
+    # ever holds the full model
+    params = llama.device_random_params(cfg, seed=0, mesh=mesh)
+    jax.block_until_ready(params)
+    eng = Engine(cfg, params, SamplerConfig(temperature=0.0), cache_dtype=jnp.bfloat16,
+                 mesh=mesh)
+
+    log(f"warmup ({bench_steps} fused steps, incl. compile)...")
+    t0 = time.perf_counter()
+    eng.generate_fused([1], steps=bench_steps)  # same n_steps as the timed runs
+    log(f"warmup done in {time.perf_counter() - t0:.1f}s")
+
+    times = []
+    for rep in range(3):
+        t1 = time.perf_counter()
+        toks, _, decode_ms = eng.generate_fused([1], steps=bench_steps)
+        wall_ms = (time.perf_counter() - t1) * 1000.0
+        times.append(wall_ms / bench_steps)
+        log(f"rep {rep}: {wall_ms / bench_steps:.3f} ms/token ({bench_steps} tokens)")
+    return min(times)
+
+
+def main() -> None:
+    import jax
+
+    platform = jax.devices()[0].platform
+    choice = os.environ.get("BENCH_MODEL", "")
+    if choice == "tiny" or (not choice and platform == "cpu"):
+        name, cfg_dict = "tinyllama_1.1b", TINYLLAMA_1_1B
+    else:
+        name, cfg_dict = "llama2_7b", LLAMA2_7B
+
+    ms = None
+    try:
+        ms = run_decode_bench(cfg_dict)
+    except Exception as e:  # noqa: BLE001 — OOM etc.: fall back to the small shape
+        if name != "llama2_7b":
+            raise
+        log(f"7B bench failed ({type(e).__name__}: {e}); falling back to TinyLlama shape")
+    if ms is None:
+        # run the fallback OUTSIDE the except block: the live traceback would
+        # pin the 7B device buffers and re-OOM the fallback
+        import gc
+
+        gc.collect()
+        jax.clear_caches()
+        name, cfg_dict = "tinyllama_1.1b", TINYLLAMA_1_1B
+        ms = run_decode_bench(cfg_dict)
+
+    result = {
+        "metric": f"{name}_decode_ms_per_token",
+        "value": round(ms, 3),
+        "unit": "ms/token",
+        # only meaningful for the same model the baseline measured (7B);
+        # a ratio against a 1.1B run would be apples-to-oranges
+        "vs_baseline": round(BASELINE_7B_SINGLE_NODE_MS / ms, 2) if name == "llama2_7b" else None,
+        "baseline": "llama2-7b 1x GCP c3d-highcpu-30, 101.81 ms/token (reference README.md:88)",
+        "platform": jax.devices()[0].device_kind,
+        "n_devices": len(jax.devices()),
+    }
+    print(json.dumps(result), flush=True)
+
+
+if __name__ == "__main__":
+    main()

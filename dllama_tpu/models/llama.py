@@ -84,6 +84,62 @@ def random_params(cfg: ModelConfig, seed: int = 0, scale: float = 0.02, dtype=No
     }
 
 
+def device_random_params(
+    cfg: ModelConfig, seed: int = 0, dtype=None, scale: float = 0.02, mesh=None
+) -> dict:
+    """Random params generated ON DEVICE (one jitted program) — a 7B bf16
+    pytree never exists in host RAM. With ``mesh``, the program writes each
+    tensor directly into its TP sharding, so no chip ever holds the full
+    model. For benchmarks and dry-runs."""
+    dtype = dtype or cfg.jax_dtype
+    L, D, H, KV = cfg.n_layers, cfg.dim, cfg.hidden_dim, cfg.kv_dim
+
+    shapes = {
+        "embedding": ((cfg.vocab_size, D), jnp.float32),
+        "rms_final": ((D,), jnp.float32),
+        "wcls": ((D, cfg.vocab_size), dtype),
+        "layers": {
+            "wq": ((L, D, D), dtype),
+            "wk": ((L, D, KV), dtype),
+            "wv": ((L, D, KV), dtype),
+            "wo": ((L, D, D), dtype),
+            "w1": ((L, D, H), dtype),
+            "w2": ((L, H, D), dtype),
+            "w3": ((L, D, H), dtype),
+            "rms_att": ((L, D), jnp.float32),
+            "rms_ffn": ((L, D), jnp.float32),
+        },
+    }
+
+    def init(key):
+        leaves, treedef = jax.tree.flatten(shapes, is_leaf=lambda x: isinstance(x, tuple) and isinstance(x[0], tuple))
+        keys = jax.random.split(key, len(leaves))
+        out = []
+        for k, (shape, dt) in zip(keys, leaves):
+            # generate directly in the target dtype: an f32 intermediate for a
+            # stacked-layer 7B tensor is a multi-GB transient that OOMs a chip
+            out.append(jax.random.normal(k, shape, dt) * jnp.asarray(scale, dt))
+        return jax.tree.unflatten(treedef, out)
+
+    if mesh is not None:
+        from jax.sharding import NamedSharding
+
+        from dllama_tpu.parallel.mesh import TP
+        from dllama_tpu.parallel.sharding import param_specs
+
+        specs = param_specs(cfg, mesh.shape[TP])
+        out_shardings = jax.tree.map(lambda s: NamedSharding(mesh, s), specs)
+        init_fn = jax.jit(init, out_shardings=out_shardings)
+    else:
+        init_fn = jax.jit(init)
+    params = init_fn(jax.random.PRNGKey(seed))
+    # norms start at 1 like a real checkpoint
+    params["rms_final"] = jnp.ones_like(params["rms_final"])
+    params["layers"]["rms_att"] = jnp.ones_like(params["layers"]["rms_att"])
+    params["layers"]["rms_ffn"] = jnp.ones_like(params["layers"]["rms_ffn"])
+    return params
+
+
 def init_cache(cfg: ModelConfig, cache_dtype=jnp.float32) -> dict:
     """Fixed-size per-layer KV cache [L, seq_len, n_kv_heads, head_size]."""
     shape = (cfg.n_layers, cfg.seq_len, cfg.n_kv_heads, cfg.head_size)
@@ -160,3 +216,53 @@ def forward(
     if cfg.logit_scale != 1.0:
         logits = logits * cfg.logit_scale
     return logits, {"k": new_k, "v": new_v}
+
+
+def forward_train(
+    cfg: ModelConfig, params: dict, tokens: jnp.ndarray, rope: dict = None
+) -> jnp.ndarray:
+    """Batched cache-free causal forward: tokens [B, T] -> logits [B, T, vocab].
+
+    The inference path above is exact for the reference's decode-only scope;
+    this variant exists for the training step (gradients need the whole
+    sequence, no cache) and for throughput-style prefill. Same math per
+    position — the attention just runs against the in-flight K/V of the same
+    sequence instead of a cache.
+    """
+    B, T = tokens.shape
+    x = params["embedding"][tokens].astype(cfg.jax_dtype)
+    if cfg.embedding_scale != 1.0:
+        x = x * jnp.asarray(cfg.embedding_scale, cfg.jax_dtype)
+
+    rope_t = rope if rope is not None else rope_tables(cfg)
+    cos = rope_t["cos"][:T][None, :, None, :]  # [1, T, 1, hs/2]
+    sin = rope_t["sin"][:T][None, :, None, :]
+
+    group = cfg.n_heads // cfg.n_kv_heads
+    causal = jnp.tril(jnp.ones((T, T), bool))
+
+    def layer_step(x, lp):
+        xb = rmsnorm(x, lp["rms_att"], cfg.norm_eps)
+        q = (xb @ lp["wq"]).reshape(B, T, cfg.n_heads, cfg.head_size)
+        k = (xb @ lp["wk"]).reshape(B, T, cfg.n_kv_heads, cfg.head_size)
+        v = (xb @ lp["wv"]).reshape(B, T, cfg.n_kv_heads, cfg.head_size)
+        q = apply_rope(q, cos, sin, cfg.rope_style)
+        k = apply_rope(k, cos, sin, cfg.rope_style)
+
+        qf = q.astype(jnp.float32).reshape(B, T, cfg.n_kv_heads, group, cfg.head_size)
+        scores = jnp.einsum("btkgh,bskh->bkgts", qf, k.astype(jnp.float32))
+        scores = scores / jnp.sqrt(jnp.float32(cfg.head_size))
+        scores = jnp.where(causal[None, None, None], scores, jnp.float32(-1e30))
+        att = jax.nn.softmax(scores, axis=-1)
+        out = jnp.einsum("bkgts,bskh->btkgh", att, v.astype(jnp.float32))
+        out = out.reshape(B, T, cfg.dim).astype(x.dtype)
+        x = x + out @ lp["wo"]
+
+        xb2 = rmsnorm(x, lp["rms_ffn"], cfg.norm_eps)
+        x = x + _dense_ffn(cfg, lp, xb2)
+        return x, None
+
+    x, _ = jax.lax.scan(layer_step, x, params["layers"])
+    x = rmsnorm(x, params["rms_final"], cfg.norm_eps)
+    logits = (x @ params["wcls"]).astype(jnp.float32)
+    return logits * cfg.logit_scale if cfg.logit_scale != 1.0 else logits

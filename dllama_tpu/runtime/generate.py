@@ -110,19 +110,42 @@ class Engine:
             logits, cache = llama.forward(cfg, params, rope, padded_tokens, cache, pos)
             return jax.lax.dynamic_index_in_dim(logits, n_tokens - 1, keepdims=False), cache
 
+        @partial(jax.jit, donate_argnums=(2,), static_argnames=("n_steps",))
+        def _decode_loop(params, rope, cache, token, pos, key, n_steps):
+            """N decode steps fused into ONE device program (lax.scan over
+            steps, sampling on device). The host sees one dispatch per N
+            tokens instead of per token — essential when host<->device launch
+            latency rivals the step itself."""
+
+            def body(carry, _):
+                cache, token, pos, key = carry
+                key, sub = jax.random.split(key)
+                logits, cache = llama.forward(cfg, params, rope, token[None], cache, pos)
+                nxt = sample(logits[0], sub, self.sampler_cfg)
+                return (cache, nxt, pos + 1, key), nxt
+
+            (cache, token, pos, key), toks = jax.lax.scan(
+                body, (cache, token, pos, key), length=n_steps
+            )
+            return toks, cache
+
         self._decode_step = partial(_decode_step, self.params, self.rope)
         self._prefill = partial(_prefill, self.params, self.rope)
+        self._decode_loop = partial(_decode_loop, self.params, self.rope)
+
+        # compiled once; materializes the cache already-sharded (allocate-then-
+        # reshard would transiently put the FULL cache in one device's HBM,
+        # the exact OOM tensor parallelism exists to avoid)
+        if self._cache_sharding is not None:
+            sh = {"k": self._cache_sharding, "v": self._cache_sharding}
+            self._init_cache = jax.jit(
+                lambda: llama.init_cache(cfg, cache_dtype), out_shardings=sh
+            )
+        else:
+            self._init_cache = jax.jit(lambda: llama.init_cache(cfg, cache_dtype))
 
     def new_cache(self) -> dict:
-        if self._cache_sharding is not None:
-            # materialize the cache already-sharded: allocate-then-reshard would
-            # transiently put the FULL cache in one device's HBM, the exact OOM
-            # tensor parallelism exists to avoid
-            sh = {"k": self._cache_sharding, "v": self._cache_sharding}
-            return jax.jit(
-                lambda: llama.init_cache(self.cfg, self.cache_dtype), out_shardings=sh
-            )()
-        return llama.init_cache(self.cfg, self.cache_dtype)
+        return self._init_cache()
 
     def next_key(self) -> jax.Array:
         self._key, sub = jax.random.split(self._key)
@@ -211,3 +234,51 @@ class Engine:
         else:
             pending = tok_int
         self.final_session = Session(cache, pos, pending_token=pending)
+
+    def generate_fused(self, prompt_tokens: list, steps: int) -> tuple:
+        """Batch-generate ``steps`` tokens with the fused on-device loop.
+
+        Returns (tokens list, prefill_ms, decode_ms_total). No early stop —
+        the whole loop runs on device; use generate() when stop tokens or
+        streaming matter more than raw latency.
+        """
+        cache = self.new_cache()
+        steps = min(steps, self.cfg.seq_len - len(prompt_tokens))
+        t0 = time.perf_counter()
+        if steps <= 0 and len(prompt_tokens) > 1:
+            # nothing to emit; prefill still advances the session
+            _, cache = self.prefill(cache, prompt_tokens, 0)
+            self.prefill_ms = (time.perf_counter() - t0) * 1000.0
+            self.final_session = Session(cache, len(prompt_tokens), pending_token=None)
+            return [], self.prefill_ms, 0.0
+        if len(prompt_tokens) > 1:
+            last_logits, cache = self.prefill(cache, prompt_tokens, 0)
+            token = sample(last_logits, self.next_key(), self.sampler_cfg)
+            pos = len(prompt_tokens)
+            first = [int(token)]
+            steps -= 1
+        else:
+            token = jnp.asarray(prompt_tokens[0], jnp.int32)
+            pos = 0
+            first = []
+        token.block_until_ready()
+        prefill_ms = (time.perf_counter() - t0) * 1000.0
+
+        t1 = time.perf_counter()
+        if steps > 0:
+            toks, cache = self._decode_loop(
+                cache, token, jnp.int32(pos), self.next_key(), n_steps=steps
+            )
+            toks = [int(t) for t in np.asarray(toks)]
+            pos += steps
+        else:
+            toks = []
+        decode_ms = (time.perf_counter() - t1) * 1000.0
+
+        emitted = first + toks
+        if emitted:
+            pending = emitted[-1]
+        else:
+            pending = prompt_tokens[0] if len(prompt_tokens) == 1 else None
+        self.final_session = Session(cache, pos, pending_token=pending)
+        return emitted, prefill_ms, decode_ms

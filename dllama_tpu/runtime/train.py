@@ -1,0 +1,39 @@
+"""Training step (next-token LM loss + optax update) over a sharded mesh.
+
+The reference is inference-only; this exists because a TPU framework without a
+trainable path is half a framework — and it is what the multi-chip dry-run
+exercises: dp×tp(+sp) sharded loss/grad/update compiled into one program.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from dllama_tpu.models import llama
+from dllama_tpu.models.config import ModelConfig
+
+
+def lm_loss(cfg: ModelConfig, params: dict, tokens: jnp.ndarray, rope: dict = None) -> jnp.ndarray:
+    """Mean next-token cross-entropy over tokens [B, T]."""
+    logits = llama.forward_train(cfg, params, tokens[:, :-1], rope)
+    targets = tokens[:, 1:]
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return -jnp.mean(ll)
+
+
+def make_train_step(cfg: ModelConfig, optimizer: optax.GradientTransformation):
+    """Returns jittable ``step(params, opt_state, tokens) -> (params, opt_state, loss)``."""
+    rope = llama.rope_tables(cfg)  # precomputed once, closed over (replicated)
+
+    def step(params, opt_state, tokens):
+        loss, grads = jax.value_and_grad(lambda p: lm_loss(cfg, p, tokens, rope))(params)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return step

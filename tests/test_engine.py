@@ -123,3 +123,34 @@ def test_steps_zero_yields_nothing():
     assert out == []
     assert eng.final_session.pos == 3
     assert eng.final_session.pending_token is None
+
+
+def test_fused_decode_matches_stepwise():
+    """The on-device fused loop must produce the same greedy stream as the
+    host-stepped loop."""
+    eng, cfg = make_engine()
+    want = [t for t, _ in eng.generate([1, 5, 9], steps=8)]
+    eng2, _ = make_engine()
+    got, prefill_ms, decode_ms = eng2.generate_fused([1, 5, 9], steps=8)
+    assert got == want
+    # 3 prompt + 7 consumed generated tokens in cache; the 8th is pending
+    assert eng2.final_session.pos == 3 + 7
+    assert eng2.final_session.pending_token == got[-1]
+
+
+def test_fused_decode_steps_zero_and_pending():
+    eng, cfg = make_engine()
+    out, _, _ = eng.generate_fused([1, 5, 9], steps=0)
+    assert out == []
+    assert eng.final_session.pending_token is None
+
+    eng2, _ = make_engine()
+    out1, _, _ = eng2.generate_fused([1, 5, 9], steps=1)
+    assert len(out1) == 1
+    # the prefill-sampled token is pending: continuation must consume it
+    assert eng2.final_session.pending_token == out1[0]
+    cont = [t for t, _ in eng2.generate([7], steps=2, session=eng2.final_session)]
+
+    eng3, _ = make_engine()
+    ref = [t for t, _ in eng3.generate([1, 5, 9] + out1 + [7], steps=2)]
+    assert cont == ref
