@@ -41,7 +41,7 @@ def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
-def run_decode_bench(cfg_dict: dict, warmup_steps: int = 16, bench_steps: int = 64) -> float:
+def run_decode_bench(cfg_dict: dict, bench_steps: int = 64) -> float:
     import jax
     import jax.numpy as jnp
 

@@ -64,10 +64,6 @@ def load_engine(args):
     t0 = time.time()
     with WeightFileReader(args.model) as reader:
         cfg = ModelConfig.from_spec(reader.spec, dtype=args.dtype)
-        if cfg.is_moe:
-            raise SystemExit(
-                f"arch {cfg.arch!r} (MoE) is not wired into the CLI engine yet"
-            )
         print(f"💡 arch: {cfg.arch}")
         print(f"💡 dim: {cfg.dim}  hiddenDim: {cfg.hidden_dim}  nLayers: {cfg.n_layers}")
         print(f"💡 nHeads: {cfg.n_heads}  nKvHeads: {cfg.n_kv_heads}")

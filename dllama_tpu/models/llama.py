@@ -36,23 +36,35 @@ from dllama_tpu.ops.rope import apply_rope, rope_table
 # ---------------------------------------------------------------------------
 
 def params_from_reader(reader: WeightFileReader, cfg: ModelConfig, dtype=None) -> dict:
-    """Load `.m` tensors into the stacked-layer pytree (dense archs)."""
+    """Load `.m` tensors into the stacked-layer pytree (dense and MoE archs)."""
     dtype = dtype or cfg.jax_dtype
     p = {
         "embedding": reader.read_tensor("token_embedding", np.float32),
         "rms_final": reader.read_tensor("rms_final", np.float32),
         "wcls": reader.read_tensor("wcls", dtype).T,
     }
-    names = ["wq", "wk", "wv", "wo", "w1", "w2", "w3"]
-    layers: dict = {n: [] for n in names}
-    layers["rms_att"] = []
-    layers["rms_ffn"] = []
+    mat_names = ["wq", "wk", "wv", "wo"] + ([] if cfg.is_moe else ["w1", "w2", "w3"])
+    vec_names = ["rms_att", "rms_ffn"] + (["rms_moe", "rms_ffn2"] if cfg.post_norms else [])
+    layers: dict = {n: [] for n in mat_names + vec_names}
+    if cfg.is_moe:
+        for n in ("moe_router", "moe_up", "moe_gate", "moe_down"):
+            layers[n] = []
     for i in range(cfg.n_layers):
         pre = f"layers.{i}."
-        for n in names:
+        for n in mat_names:
             layers[n].append(reader.read_tensor(pre + n, dtype).T)  # [in, out]
-        layers["rms_att"].append(reader.read_tensor(pre + "rms_att", np.float32))
-        layers["rms_ffn"].append(reader.read_tensor(pre + "rms_ffn", np.float32))
+        if cfg.is_moe:
+            layers["moe_router"].append(reader.read_tensor(pre + "moe_router", dtype).T)
+            for kind in ("up", "gate", "down"):
+                stacked = np.stack(
+                    [
+                        reader.read_tensor(pre + f"experts.{e}.{kind}", dtype).T
+                        for e in range(cfg.n_experts)
+                    ]
+                )  # [E, in, out]
+                layers[f"moe_{kind}"].append(stacked)
+        for n in vec_names:
+            layers[n].append(reader.read_tensor(pre + n, np.float32))
     p["layers"] = {k: np.stack(v) for k, v in layers.items()}
     return p
 
@@ -66,21 +78,34 @@ def random_params(cfg: ModelConfig, seed: int = 0, scale: float = 0.02, dtype=No
         return (rng.standard_normal(shape) * scale).astype(np.float32).astype(dtype)
 
     L, D, H, KV = cfg.n_layers, cfg.dim, cfg.hidden_dim, cfg.kv_dim
+    layers = {
+        "wq": w(L, D, D),
+        "wk": w(L, D, KV),
+        "wv": w(L, D, KV),
+        "wo": w(L, D, D),
+        "rms_att": np.ones((L, D), np.float32),
+        "rms_ffn": np.ones((L, D), np.float32),
+    }
+    if cfg.is_moe:
+        E = cfg.n_experts
+        layers.update(
+            {
+                "moe_router": w(L, D, E),
+                "moe_up": w(L, E, D, H),
+                "moe_gate": w(L, E, D, H),
+                "moe_down": w(L, E, H, D),
+            }
+        )
+        if cfg.post_norms:
+            layers["rms_moe"] = np.ones((L, D), np.float32)
+            layers["rms_ffn2"] = np.ones((L, D), np.float32)
+    else:
+        layers.update({"w1": w(L, D, H), "w2": w(L, H, D), "w3": w(L, D, H)})
     return {
         "embedding": w(cfg.vocab_size, D).astype(np.float32),
         "rms_final": np.ones(D, np.float32),
         "wcls": w(D, cfg.vocab_size),
-        "layers": {
-            "wq": w(L, D, D),
-            "wk": w(L, D, KV),
-            "wv": w(L, D, KV),
-            "wo": w(L, D, D),
-            "w1": w(L, D, H),
-            "w2": w(L, H, D),
-            "w3": w(L, D, H),
-            "rms_att": np.ones((L, D), np.float32),
-            "rms_ffn": np.ones((L, D), np.float32),
-        },
+        "layers": layers,
     }
 
 
@@ -90,7 +115,10 @@ def device_random_params(
     """Random params generated ON DEVICE (one jitted program) — a 7B bf16
     pytree never exists in host RAM. With ``mesh``, the program writes each
     tensor directly into its TP sharding, so no chip ever holds the full
-    model. For benchmarks and dry-runs."""
+    model. For benchmarks and dry-runs. Dense archs only (use random_params
+    for MoE test models)."""
+    if cfg.is_moe:
+        raise NotImplementedError("device_random_params covers dense archs only")
     dtype = dtype or cfg.jax_dtype
     L, D, H, KV = cfg.n_layers, cfg.dim, cfg.hidden_dim, cfg.kv_dim
 
@@ -161,6 +189,29 @@ def _dense_ffn(cfg: ModelConfig, lp: dict, xb: jnp.ndarray) -> jnp.ndarray:
     return h @ lp["w2"]
 
 
+def _ffn_residual(cfg: ModelConfig, lp: dict, x: jnp.ndarray, att_out: jnp.ndarray):
+    """Post-attention half of a layer, all three arch variants:
+
+    * llama: ``x += att; x += dense_ffn(rmsnorm(x, rms_ffn))``
+      (`/root/reference/src/llama2-tasks.cpp:125-212`)
+    * mixtral: same joins with the MoE FFN
+      (`/root/reference/src/mixtral-tasks.cpp:24-46`)
+    * grok1: the attention output and the MoE output are each rmsnorm'd
+      BEFORE their residual adds, with an extra pre-MoE norm:
+      ``x += rmsnorm(att, rms_ffn); x += rmsnorm(moe(rmsnorm(x, rms_moe)), rms_ffn2)``
+      (`/root/reference/src/grok1-tasks.cpp:16-54,239-262,280-320`)
+    """
+    from dllama_tpu.models.moe import moe_ffn
+
+    if cfg.is_moe and cfg.post_norms:  # grok1
+        x = x + rmsnorm(att_out, lp["rms_ffn"], cfg.norm_eps)
+        xb = rmsnorm(x, lp["rms_moe"], cfg.norm_eps)
+        return x + rmsnorm(moe_ffn(cfg, lp, xb), lp["rms_ffn2"], cfg.norm_eps)
+    x = x + att_out
+    xb = rmsnorm(x, lp["rms_ffn"], cfg.norm_eps)
+    return x + (moe_ffn(cfg, lp, xb) if cfg.is_moe else _dense_ffn(cfg, lp, xb))
+
+
 def _attn_block(cfg: ModelConfig, lp: dict, rope: dict, x, k_cache, v_cache, pos):
     """One attention sub-block. Returns (attn output [T, dim], new k/v cache [S,...])."""
     T = x.shape[0]
@@ -202,9 +253,7 @@ def forward(
     def layer_step(x, layer):
         lp, k_cache, v_cache = layer
         att_out, k_cache, v_cache = _attn_block(cfg, lp, rope, x, k_cache, v_cache, pos)
-        x = x + att_out
-        xb = rmsnorm(x, lp["rms_ffn"], cfg.norm_eps)
-        x = x + _dense_ffn(cfg, lp, xb)
+        x = _ffn_residual(cfg, lp, x, att_out)
         return x, (k_cache, v_cache)
 
     x, (new_k, new_v) = jax.lax.scan(
@@ -256,10 +305,7 @@ def forward_train(
         att = jax.nn.softmax(scores, axis=-1)
         out = jnp.einsum("bkgts,bskh->btkgh", att, v.astype(jnp.float32))
         out = out.reshape(B, T, cfg.dim).astype(x.dtype)
-        x = x + out @ lp["wo"]
-
-        xb2 = rmsnorm(x, lp["rms_ffn"], cfg.norm_eps)
-        x = x + _dense_ffn(cfg, lp, xb2)
+        x = _ffn_residual(cfg, lp, x, out @ lp["wo"])
         return x, None
 
     x, _ = jax.lax.scan(layer_step, x, params["layers"])

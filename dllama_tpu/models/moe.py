@@ -1,0 +1,57 @@
+"""Mixture-of-experts FFN (Grok-1 / Mixtral).
+
+Reference semantics (`/root/reference/src/grok1-tasks.cpp:56-243`):
+router logits -> softmax over ALL experts -> top-k (k = n_active_experts,
+the reference hard-codes 2) -> selected probs renormalized to sum 1 ->
+per selected expert: ``down_e( up_e(x) * act(gate_e(x)) )`` weighted-summed.
+
+TP mapping: every shard holds a 1/tp slice of EVERY expert (the reference
+slices within experts, not across them — `/root/reference/src/transformer.cpp:479-487`),
+so the expert einsums below shard exactly like w1/w2/w3 and no expert-routing
+communication is needed. An optional ``ep`` mesh axis can additionally shard
+the leading expert dim of the stacked tensors (expert parallelism — beyond
+the reference's capabilities).
+
+Compute note: this evaluates all E experts and combines with a [.., E] weight
+matrix that is zero off the top-k — dense and MXU-friendly, exact same math.
+For small E (8) that trades <=E/k extra FLOPs for zero gather/scatter; a
+megablocks-style grouped kernel is the later optimization for big-E models.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from dllama_tpu.models.config import ModelConfig
+from dllama_tpu.ops.activations import ACTIVATIONS
+
+
+def route(cfg: ModelConfig, router_kernel: jnp.ndarray, xb: jnp.ndarray) -> jnp.ndarray:
+    """Top-k routing -> dense combine weights [..., E] (zeros off the top-k).
+
+    Router math runs in f32 like the reference (router matmul outputs F32,
+    `/root/reference/src/grok1-tasks.cpp:56-60`).
+    """
+    logits = xb.astype(jnp.float32) @ router_kernel.astype(jnp.float32)  # [..., E]
+    probs = jax.nn.softmax(logits, axis=-1)
+    topv, topi = jax.lax.top_k(probs, cfg.n_active_experts)
+    weights = topv / topv.sum(axis=-1, keepdims=True)  # renormalize over selected
+    one_hot = jax.nn.one_hot(topi, cfg.n_experts, dtype=jnp.float32)  # [..., k, E]
+    return jnp.einsum("...ke,...k->...e", one_hot, weights)
+
+
+def moe_ffn(cfg: ModelConfig, lp: dict, xb: jnp.ndarray) -> jnp.ndarray:
+    """MoE FFN over xb [..., dim] -> [..., dim].
+
+    lp holds: moe_router [dim, E], moe_up/moe_gate [E, dim, hidden],
+    moe_down [E, hidden, dim].
+    """
+    act = ACTIVATIONS[cfg.hidden_act]
+    combine = route(cfg, lp["moe_router"], xb).astype(xb.dtype)  # [..., E]
+
+    up = jnp.einsum("...d,edh->...eh", xb, lp["moe_up"])
+    gate = jnp.einsum("...d,edh->...eh", xb, lp["moe_gate"])
+    h = up * act(gate)
+    down = jnp.einsum("...eh,ehd->...ed", h, lp["moe_down"])
+    return jnp.einsum("...ed,...e->...d", down, combine)

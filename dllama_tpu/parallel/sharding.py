@@ -28,6 +28,8 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from dllama_tpu.models.config import ModelConfig
 from dllama_tpu.parallel.mesh import TP
 
+EP = "ep"
+
 
 def check_tp_compatible(cfg: ModelConfig, n_tp: int) -> None:
     if cfg.n_kv_heads % n_tp != 0:
@@ -39,7 +41,7 @@ def check_tp_compatible(cfg: ModelConfig, n_tp: int) -> None:
         raise ValueError(f"tp={n_tp} must divide hidden_dim={cfg.hidden_dim}")
 
 
-def layer_specs(cfg: ModelConfig) -> dict:
+def layer_specs(cfg: ModelConfig, use_ep: bool = False) -> dict:
     specs = {
         "wq": P(None, None, TP),  # row slice: heads
         "wk": P(None, None, TP),
@@ -49,12 +51,16 @@ def layer_specs(cfg: ModelConfig) -> dict:
         "rms_ffn": P(None, None),
     }
     if cfg.is_moe:
+        # TP *within* each expert (the reference's scheme); with use_ep the
+        # stacked expert dim additionally shards over the 'ep' axis — expert
+        # parallelism beyond the reference's capabilities
+        ep = EP if use_ep else None
         specs.update(
             {
                 "moe_router": P(None, None, None),  # tiny; replicated like the root's copy
-                "moe_up": P(None, None, None, TP),  # TP *within* each expert
-                "moe_gate": P(None, None, None, TP),
-                "moe_down": P(None, None, TP, None),
+                "moe_up": P(None, ep, None, TP),
+                "moe_gate": P(None, ep, None, TP),
+                "moe_down": P(None, ep, TP, None),
             }
         )
         if cfg.post_norms:
@@ -71,7 +77,7 @@ def layer_specs(cfg: ModelConfig) -> dict:
     return specs
 
 
-def param_specs(cfg: ModelConfig, n_tp: int) -> dict:
+def param_specs(cfg: ModelConfig, n_tp: int, use_ep: bool = False) -> dict:
     # vocab-shard the classifier when it divides; otherwise replicate it, which
     # is still parity with the reference (logits are root-only there anyway,
     # `/root/reference/src/llama2-tasks.cpp:222-241`)
@@ -80,7 +86,7 @@ def param_specs(cfg: ModelConfig, n_tp: int) -> dict:
         "embedding": P(None, None),  # replicated, like the root-resident table
         "rms_final": P(None),
         "wcls": wcls,
-        "layers": layer_specs(cfg),
+        "layers": layer_specs(cfg, use_ep),
     }
 
 
@@ -92,7 +98,10 @@ def cache_spec() -> P:
 def shard_params(params: dict, mesh, cfg: ModelConfig) -> dict:
     """Place a host-side param pytree onto the mesh with TP shardings."""
     check_tp_compatible(cfg, mesh.shape[TP])
-    specs = param_specs(cfg, mesh.shape[TP])
+    use_ep = cfg.is_moe and EP in mesh.axis_names and mesh.shape[EP] > 1
+    if use_ep and cfg.n_experts % mesh.shape[EP] != 0:
+        raise ValueError(f"ep={mesh.shape[EP]} must divide n_experts={cfg.n_experts}")
+    specs = param_specs(cfg, mesh.shape[TP], use_ep)
     return jax.tree.map(
         lambda x, spec: jax.device_put(x, NamedSharding(mesh, spec)), dict(params), specs
     )

@@ -29,6 +29,7 @@ from dllama_tpu.models.config import ModelConfig
 from dllama_tpu.runtime.sampler import SamplerConfig, sample
 
 PREFILL_BUCKETS = (8, 16, 32, 64, 128, 256, 512, 1024, 2048, 4096, 8192)
+DECODE_CHUNK = 64  # fused-loop chunk size: one compile serves any steps count
 
 
 def prefill_bucket(n: int) -> int:
@@ -262,17 +263,27 @@ class Engine:
             pos = 0
             first = []
         token.block_until_ready()
-        prefill_ms = (time.perf_counter() - t0) * 1000.0
+        self.prefill_ms = prefill_ms = (time.perf_counter() - t0) * 1000.0
 
+        # run the scan in BUCKETED chunk sizes so distinct `steps` values reuse
+        # a handful of compiles (like prefill); overshooting the last chunk is
+        # safe for the same reason tail-padded prefill is — discarded tokens
+        # only touch cache slots a later decode overwrites before attending
         t1 = time.perf_counter()
-        if steps > 0:
-            toks, cache = self._decode_loop(
-                cache, token, jnp.int32(pos), self.next_key(), n_steps=steps
+        toks: list = []
+        remaining = steps
+        while remaining > 0:
+            n = DECODE_CHUNK if remaining >= DECODE_CHUNK else prefill_bucket(remaining)
+            n = min(n, self.cfg.seq_len - pos)  # never write cache out of range
+            chunk, cache = self._decode_loop(
+                cache, token, jnp.int32(pos), self.next_key(), n_steps=n
             )
-            toks = [int(t) for t in np.asarray(toks)]
-            pos += steps
-        else:
-            toks = []
+            take = min(n, remaining)
+            chunk_list = [int(t) for t in np.asarray(chunk)]
+            toks.extend(chunk_list[:take])
+            token = chunk[-1]
+            pos += take
+            remaining -= take
         decode_ms = (time.perf_counter() - t1) * 1000.0
 
         emitted = first + toks

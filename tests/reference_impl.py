@@ -39,6 +39,21 @@ def rope_rotate(vec, pos, head_size, theta, style):
     return out
 
 
+def moe_ffn_serial(cfg, lp, l, xb, act):
+    """Serial MoE: explicit top-k selection and per-expert loops, mirroring
+    grokMoeTopk/grokMoeBlock0-2 (`/root/reference/src/grok1-tasks.cpp:70-243`)."""
+    probs = softmax(xb @ lp["moe_router"][l])
+    idx = np.argsort(-probs, kind="stable")[: cfg.n_active_experts]
+    w = probs[idx]
+    w = w / w.sum()
+    out = np.zeros(cfg.dim, np.float32)
+    for ae, e in enumerate(idx):
+        up = xb @ lp["moe_up"][l][e]
+        gate = act(xb @ lp["moe_gate"][l][e])
+        out += w[ae] * ((up * gate) @ lp["moe_down"][l][e])
+    return out
+
+
 def forward_tokens(cfg, params, tokens, n_past=0, kv=None):
     """Run tokens one at a time (the reference's decode loop). Returns
     (logits_per_token [T, vocab], kv dict of lists per layer)."""
@@ -78,10 +93,20 @@ def forward_tokens(cfg, params, tokens, n_past=0, kv=None):
                 att_out[h * HS : (h + 1) * HS] = sum(
                     att[p] * V[p, kvh * HS : (kvh + 1) * HS] for p in range(len(K))
                 )
-            x = x + att_out @ lp["wo"][l]
-            xb2 = rmsnorm(x, lp["rms_ffn"][l])
-            h1 = act(xb2 @ lp["w1"][l]) * (xb2 @ lp["w3"][l])
-            x = x + h1 @ lp["w2"][l]
+            att = att_out @ lp["wo"][l]
+            if cfg.is_moe and cfg.post_norms:  # grok1
+                x = x + rmsnorm(att, lp["rms_ffn"][l])
+                xb2 = rmsnorm(x, lp["rms_moe"][l])
+                x = x + rmsnorm(moe_ffn_serial(cfg, lp, l, xb2, act), lp["rms_ffn2"][l])
+            elif cfg.is_moe:  # mixtral
+                x = x + att
+                xb2 = rmsnorm(x, lp["rms_ffn"][l])
+                x = x + moe_ffn_serial(cfg, lp, l, xb2, act)
+            else:
+                x = x + att
+                xb2 = rmsnorm(x, lp["rms_ffn"][l])
+                h1 = act(xb2 @ lp["w1"][l]) * (xb2 @ lp["w3"][l])
+                x = x + h1 @ lp["w2"][l]
         x = rmsnorm(x, params["rms_final"])
         logits_all.append((x @ params["wcls"]) * cfg.logit_scale)
     return np.stack(logits_all), kv

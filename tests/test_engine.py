@@ -154,3 +154,20 @@ def test_fused_decode_steps_zero_and_pending():
     eng3, _ = make_engine()
     ref = [t for t, _ in eng3.generate([1, 5, 9] + out1 + [7], steps=2)]
     assert cont == ref
+
+
+def test_fused_decode_chunked_long_run():
+    """steps > DECODE_CHUNK spans multiple fused chunks, including a truncated
+    final one — stream must still match the host-stepped loop."""
+    eng, cfg = make_engine(seq_len=128)
+    want = [t for t, _ in eng.generate([1, 5, 9], steps=90)]
+    eng2, _ = make_engine(seq_len=128)
+    got, _, _ = eng2.generate_fused([1, 5, 9], steps=90)
+    assert got == want
+    # 3 prompt + 89 consumed generated tokens; the 90th is pending
+    assert eng2.final_session.pos == 3 + 90 - 1
+    # continuation across the truncation boundary stays exact
+    cont = [t for t, _ in eng2.generate([7], steps=3, session=eng2.final_session)]
+    ref_eng, _ = make_engine(seq_len=128)
+    ref = [t for t, _ in ref_eng.generate([1, 5, 9] + got + [7], steps=3)]
+    assert cont == ref
