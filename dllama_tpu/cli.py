@@ -14,6 +14,7 @@ Usage:
 from __future__ import annotations
 
 import argparse
+import codecs
 import sys
 import time
 
@@ -21,8 +22,11 @@ import time
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="dllama_tpu")
     sub = p.add_subparsers(dest="mode", required=True)
-    for mode in ("inference", "generate", "chat"):
+    for mode in ("inference", "generate", "chat", "serve"):
         sp = sub.add_parser(mode)
+        if mode == "serve":  # the dllama-api surface (`src/apps/dllama-api`)
+            sp.add_argument("--host", default="0.0.0.0")
+            sp.add_argument("--port", type=int, default=9990)
         sp.add_argument("--model", required=True)
         sp.add_argument("--tokenizer", required=True)
         sp.add_argument("--prompt", default=None)
@@ -101,9 +105,11 @@ def run_generate(args, show_stats: bool) -> None:
     gen_ms = []
     prev = tokens[-1]
     produced = list()
+    # incremental decode: multi-byte chars can span byte-fallback tokens
+    utf8 = codecs.getincrementaldecoder("utf-8")("replace")
     for tok_id, stats in engine.generate(tokens, args.steps, stop_tokens=(tok.eos_id,)):
         piece = tok.decode_piece(prev, tok_id)
-        sys.stdout.write(piece.decode("utf-8", errors="replace"))
+        sys.stdout.write(utf8.decode(piece))
         sys.stdout.flush()
         prev = tok_id
         produced.append(tok_id)
@@ -152,12 +158,13 @@ def run_chat(args) -> None:
         print("🤖 Assistant: ", end="", flush=True)
         prev = tokens[-1]
         reply = []
+        utf8 = codecs.getincrementaldecoder("utf-8")("replace")
         for tok_id, _ in engine.generate(
             tokens, args.steps, session=session, stop_tokens=(tok.eos_id,)
         ):
             if tok_id == tok.eos_id:
                 continue  # generator stops itself after yielding a stop token
-            piece = tok.decode_piece(prev, tok_id).decode("utf-8", errors="replace")
+            piece = utf8.decode(tok.decode_piece(prev, tok_id))
             print(piece, end="", flush=True)
             prev = tok_id
             reply.append(piece)
@@ -172,6 +179,10 @@ def main(argv=None) -> None:
     args = build_parser().parse_args(argv)
     if args.mode == "chat":
         run_chat(args)
+    elif args.mode == "serve":
+        from dllama_tpu.serving.api_server import serve
+
+        serve(args)
     else:
         run_generate(args, show_stats=args.mode == "inference")
 

@@ -26,7 +26,7 @@ import numpy as np
 
 from dllama_tpu.models import llama
 from dllama_tpu.models.config import ModelConfig
-from dllama_tpu.runtime.sampler import SamplerConfig, sample
+from dllama_tpu.runtime.sampler import SamplerConfig, sample_dynamic
 
 PREFILL_BUCKETS = (8, 16, 32, 64, 128, 256, 512, 1024, 2048, 4096, 8192)
 DECODE_CHUNK = 64  # fused-loop chunk size: one compile serves any steps count
@@ -97,11 +97,13 @@ class Engine:
 
         # params/rope MUST be jit arguments, not closure captures: a closed-over
         # sharded array is inlined as a (replicated) constant, silently turning
-        # tensor-parallel into full replication with zero collectives
+        # tensor-parallel into full replication with zero collectives.
+        # temperature/topp are traced scalars (see sampler.sample_dynamic): one
+        # compile serves every per-request sampler setting.
         @partial(jax.jit, donate_argnums=(2,))
-        def _decode_step(params, rope, cache, token, pos, key):
+        def _decode_step(params, rope, cache, token, pos, key, temp, topp):
             logits, cache = llama.forward(cfg, params, rope, token[None], cache, pos)
-            nxt = sample(logits[0], key, self.sampler_cfg)
+            nxt = sample_dynamic(logits[0], key, temp, topp)
             return nxt, cache
 
         @partial(jax.jit, donate_argnums=(2,))
@@ -112,7 +114,7 @@ class Engine:
             return jax.lax.dynamic_index_in_dim(logits, n_tokens - 1, keepdims=False), cache
 
         @partial(jax.jit, donate_argnums=(2,), static_argnames=("n_steps",))
-        def _decode_loop(params, rope, cache, token, pos, key, n_steps):
+        def _decode_loop(params, rope, cache, token, pos, key, temp, topp, n_steps):
             """N decode steps fused into ONE device program (lax.scan over
             steps, sampling on device). The host sees one dispatch per N
             tokens instead of per token — essential when host<->device launch
@@ -122,7 +124,7 @@ class Engine:
                 cache, token, pos, key = carry
                 key, sub = jax.random.split(key)
                 logits, cache = llama.forward(cfg, params, rope, token[None], cache, pos)
-                nxt = sample(logits[0], sub, self.sampler_cfg)
+                nxt = sample_dynamic(logits[0], sub, temp, topp)
                 return (cache, nxt, pos + 1, key), nxt
 
             (cache, token, pos, key), toks = jax.lax.scan(
@@ -177,6 +179,7 @@ class Engine:
         steps: int,
         session: Optional[Session] = None,
         stop_tokens: tuple = (),
+        sampler: Optional[SamplerConfig] = None,
     ) -> Iterator[tuple]:
         """Yield (token_id, TokenStats) for up to ``steps`` generated tokens.
 
@@ -184,7 +187,22 @@ class Engine:
         conversation with one continuous KV cache and position counter (the
         reference keeps one continuous pos across turns,
         `/root/reference/src/apps/dllama/dllama.cpp:154-161`).
+
+        ``sampler`` overrides the engine-level SamplerConfig for this call
+        only (per-request temperature/topp/seed, the API-server surface) —
+        no recompilation, the settings are traced scalars.
         """
+        scfg = sampler if sampler is not None else self.sampler_cfg
+        temp, topp = jnp.float32(scfg.temperature), jnp.float32(scfg.topp)
+        if sampler is not None:
+            local_key = jax.random.PRNGKey(scfg.seed)
+
+            def next_key():
+                nonlocal local_key
+                local_key, sub = jax.random.split(local_key)
+                return sub
+        else:
+            next_key = self.next_key
         if session is None:
             cache, pos = self.new_cache(), 0
         else:
@@ -197,7 +215,7 @@ class Engine:
         if len(prompt_tokens) > 1:
             last_logits, cache = self.prefill(cache, prompt_tokens, pos)
             # sample the first generated token from the prefill logits
-            token = sample(last_logits, self.next_key(), self.sampler_cfg)
+            token = sample_dynamic(last_logits, next_key(), temp, topp)
         else:
             token = jnp.asarray(prompt_tokens[0], jnp.int32)
         token.block_until_ready()
@@ -220,7 +238,7 @@ class Engine:
         for _ in range(max(steps, 0)):
             t1 = time.perf_counter()
             token, cache = self._decode_step(
-                cache, token, jnp.int32(pos), self.next_key()
+                cache, token, jnp.int32(pos), next_key(), temp, topp
             )
             tok_int = int(token)  # syncs; includes device step time
             dt = (time.perf_counter() - t1) * 1000.0
@@ -236,13 +254,17 @@ class Engine:
             pending = tok_int
         self.final_session = Session(cache, pos, pending_token=pending)
 
-    def generate_fused(self, prompt_tokens: list, steps: int) -> tuple:
+    def generate_fused(
+        self, prompt_tokens: list, steps: int, sampler: Optional[SamplerConfig] = None
+    ) -> tuple:
         """Batch-generate ``steps`` tokens with the fused on-device loop.
 
         Returns (tokens list, prefill_ms, decode_ms_total). No early stop —
         the whole loop runs on device; use generate() when stop tokens or
         streaming matter more than raw latency.
         """
+        scfg = sampler if sampler is not None else self.sampler_cfg
+        temp, topp = jnp.float32(scfg.temperature), jnp.float32(scfg.topp)
         cache = self.new_cache()
         steps = min(steps, self.cfg.seq_len - len(prompt_tokens))
         t0 = time.perf_counter()
@@ -254,7 +276,7 @@ class Engine:
             return [], self.prefill_ms, 0.0
         if len(prompt_tokens) > 1:
             last_logits, cache = self.prefill(cache, prompt_tokens, 0)
-            token = sample(last_logits, self.next_key(), self.sampler_cfg)
+            token = sample_dynamic(last_logits, self.next_key(), temp, topp)
             pos = len(prompt_tokens)
             first = [int(token)]
             steps -= 1
@@ -276,7 +298,7 @@ class Engine:
             n = DECODE_CHUNK if remaining >= DECODE_CHUNK else prefill_bucket(remaining)
             n = min(n, self.cfg.seq_len - pos)  # never write cache out of range
             chunk, cache = self._decode_loop(
-                cache, token, jnp.int32(pos), self.next_key(), n_steps=n
+                cache, token, jnp.int32(pos), self.next_key(), temp, topp, n_steps=n
             )
             take = min(n, remaining)
             chunk_list = [int(t) for t in np.asarray(chunk)]

@@ -28,19 +28,46 @@ class SamplerConfig:
 
 
 def sample(logits: jnp.ndarray, key: jax.Array, cfg: SamplerConfig) -> jnp.ndarray:
-    """Sample a token id from f32 ``logits [vocab]``. Static config => no retrace."""
-    if cfg.temperature == 0.0:
+    """Sample a token id from f32 ``logits [vocab]`` with a static config."""
+    return sample_dynamic(
+        logits, key, jnp.float32(cfg.temperature), jnp.float32(cfg.topp)
+    )
+
+
+def sample_dynamic(
+    logits: jnp.ndarray, key: jax.Array, temperature: jnp.ndarray, topp: jnp.ndarray
+) -> jnp.ndarray:
+    """Sampling with *traced* temperature/topp scalars.
+
+    Same semantics as the reference Sampler (temperature 0 -> argmax,
+    otherwise softmax(logits/temperature) with optional top-p nucleus keeping
+    the smallest descending-probability prefix whose cumulative mass exceeds
+    topp, inclusive of the crossing token —
+    `/root/reference/src/tokenizer.cpp:231-356`).
+
+    The per-request sampler settings an API server receives become plain jit
+    arguments, so one compiled decode step serves every request (the reference
+    re-reads its Sampler fields on the host each token,
+    `/root/reference/src/apps/dllama-api/dllama-api.cpp:236-249`; under jit a
+    Python-level branch on them would bake one setting into the binary).
+    ``lax.cond`` keeps the greedy path a plain argmax — the full-vocab sort
+    only runs when temperature > 0.
+    """
+    logits = logits.astype(jnp.float32)
+
+    def greedy(_):
         return jnp.argmax(logits).astype(jnp.int32)
 
-    probs = jax.nn.softmax(logits.astype(jnp.float32) / cfg.temperature)
-    if cfg.topp <= 0.0 or cfg.topp >= 1.0:
-        return jax.random.categorical(key, jnp.log(probs)).astype(jnp.int32)
+    def stochastic(_):
+        t = jnp.maximum(temperature, jnp.float32(1e-6))
+        probs = jax.nn.softmax(logits / t)
+        sorted_probs, sorted_idx = jax.lax.top_k(probs, probs.shape[-1])
+        cum = jnp.cumsum(sorted_probs)
+        # topp outside (0,1): threshold 2.0 keeps every token (cum prefix < 2)
+        eff_topp = jnp.where((topp <= 0.0) | (topp >= 1.0), jnp.float32(2.0), topp)
+        keep = (cum - sorted_probs) < eff_topp  # mass before this token < topp
+        masked = jnp.where(keep, sorted_probs, 0.0)
+        choice = jax.random.categorical(key, jnp.log(masked))
+        return sorted_idx[choice].astype(jnp.int32)
 
-    # nucleus: keep descending-prob prefix until cumulative exceeds topp
-    # (inclusive of the crossing token, `/root/reference/src/tokenizer.cpp:286-296`)
-    sorted_probs, sorted_idx = jax.lax.top_k(probs, probs.shape[-1])
-    cum = jnp.cumsum(sorted_probs)
-    keep = (cum - sorted_probs) < cfg.topp  # mass before this token still < topp
-    masked = jnp.where(keep, sorted_probs, 0.0)
-    choice = jax.random.categorical(key, jnp.log(masked))
-    return sorted_idx[choice].astype(jnp.int32)
+    return jax.lax.cond(temperature <= 0.0, greedy, stochastic, None)

@@ -1,0 +1,328 @@
+"""OpenAI-compatible HTTP API server.
+
+TPU-native counterpart of the reference `dllama-api` app
+(`/root/reference/src/apps/dllama-api/dllama-api.cpp`):
+
+* ``POST /v1/chat/completions`` — messages + ``temperature`` / ``top_p`` /
+  ``seed`` / ``max_tokens`` / ``stop`` / ``stream`` (SSE ``data:`` chunks
+  terminated by ``[DONE]``), matching the reference's handled params
+  (`dllama-api.cpp:202-314`).
+* ``GET /v1/models`` — the single loaded model (`dllama-api.cpp:316-322`).
+
+Design differences, all deliberate:
+
+* Requests are parsed by the stdlib ``http.server`` with proper
+  Content-Length framing — the reference's single-``recv`` parse can truncate
+  large bodies (`/root/reference/src/socket.cpp:309-339`, a SURVEY.md §7
+  quirk we do not replicate).
+* Per-request sampler settings are *traced* arguments of the jitted decode
+  step (see runtime.sampler.sample_dynamic), so every request shares one
+  compiled program regardless of its temperature/top_p/seed.
+* Stop sequences use an incremental detector that withholds only the bytes
+  that could still begin a stop string, instead of re-scanning the last 8
+  pieces every token (`dllama-api.cpp:264-299`).
+
+Like the reference, one request is served at a time (the engine owns one KV
+cache); concurrent connections queue on a lock rather than corrupting state.
+"""
+
+from __future__ import annotations
+
+import codecs
+import json
+import threading
+import time
+import uuid
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from dllama_tpu.runtime.sampler import SamplerConfig
+from dllama_tpu.serving.templates import render_llama2_turn, render_llama3_chat
+
+
+class StopDetector:
+    """Incremental stop-string scanner for a streamed byte flow.
+
+    ``feed`` returns (text_safe_to_emit, stopped). Bytes that could be the
+    start of a stop sequence are withheld until disambiguated, so a stop
+    string spanning two tokens is still caught and never leaks downstream.
+    """
+
+    def __init__(self, stops: list):
+        self.stops = [s for s in stops if s]
+        self.hold = ""  # tail that may be a stop-string prefix
+        self.stopped = False
+
+    def _partial_len(self, text: str) -> int:
+        """Length of the longest tail of ``text`` that prefixes any stop."""
+        best = 0
+        for s in self.stops:
+            for k in range(min(len(s) - 1, len(text)), 0, -1):
+                if s.startswith(text[-k:]):
+                    best = max(best, k)
+                    break
+        return best
+
+    def feed(self, piece: str) -> tuple:
+        if self.stopped:
+            return "", True
+        text = self.hold + piece
+        for s in self.stops:
+            i = text.find(s)
+            if i != -1:
+                self.stopped = True
+                self.hold = ""
+                return text[:i], True
+        k = self._partial_len(text)
+        self.hold = text[-k:] if k else ""
+        return text[: len(text) - k], False
+
+    def flush(self) -> str:
+        out, self.hold = self.hold, ""
+        return out
+
+
+class ServerState:
+    """Everything the handler needs; one instance per server."""
+
+    def __init__(self, engine, tokenizer, cfg, model_name: str, template: str = "llama3",
+                 default_sampler: SamplerConfig = SamplerConfig()):
+        self.engine = engine
+        self.tokenizer = tokenizer
+        self.cfg = cfg
+        self.model_name = model_name
+        self.template = template
+        self.default_sampler = default_sampler
+        self.lock = threading.Lock()  # engine serves one request at a time
+
+    def build_prompt(self, messages: list) -> str:
+        """Render a full conversation (the API is stateless: each request
+        carries all messages, same as the reference, `dllama-api.cpp:173-181`)."""
+        if self.template == "llama3":
+            return render_llama3_chat(messages)
+        system = ""
+        parts = []
+        first = True
+        for m in messages:
+            if m["role"] == "system":
+                system = m["content"]
+            elif m["role"] == "user":
+                parts.append(render_llama2_turn(m["content"], system, first))
+                first = False
+            elif m["role"] == "assistant":
+                parts.append(f" {m['content']} ")
+        return "".join(parts)
+
+
+def _completion_id() -> str:
+    return "chatcmpl-" + uuid.uuid4().hex[:16]
+
+
+class OpenAIHandler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    state: ServerState = None  # set by create_server
+
+    def log_message(self, fmt, *args):  # quiet; the CLI prints its own lines
+        pass
+
+    # -- helpers ----------------------------------------------------------
+    def _json(self, code: int, obj: dict) -> None:
+        body = json.dumps(obj).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _error(self, code: int, message: str) -> None:
+        self._json(code, {"error": {"message": message, "type": "invalid_request_error"}})
+
+    # -- routes -----------------------------------------------------------
+    def do_GET(self):
+        if self.path == "/v1/models":
+            self._json(200, {
+                "object": "list",
+                "data": [{
+                    "id": self.state.model_name,
+                    "object": "model",
+                    "created": int(time.time()),
+                    "owned_by": "dllama_tpu",
+                }],
+            })
+        elif self.path in ("/health", "/healthz"):
+            self._json(200, {"status": "ok"})
+        else:
+            self._error(404, f"unknown path {self.path}")
+
+    def do_POST(self):
+        if self.path not in ("/v1/chat/completions", "/chat/completions"):
+            self._error(404, f"unknown path {self.path}")
+            return
+        try:
+            length = int(self.headers.get("Content-Length", "0"))
+            req = json.loads(self.rfile.read(length) or b"{}")
+        except (ValueError, json.JSONDecodeError) as e:
+            self._error(400, f"bad JSON body: {e}")
+            return
+        try:
+            self._handle_completions(req)
+        except BrokenPipeError:
+            pass  # client went away mid-stream; per-request isolation like
+            # the reference's per-request catch (`dllama-api.cpp:347-351`)
+
+    def _handle_completions(self, req: dict) -> None:
+        st = self.state
+        messages = req.get("messages")
+        if not isinstance(messages, list) or not messages:
+            self._error(400, "messages must be a non-empty list")
+            return
+        for m in messages:
+            if not isinstance(m, dict) or "role" not in m or "content" not in m:
+                self._error(400, "each message needs role and content")
+                return
+
+        try:
+            sampler = SamplerConfig(
+                temperature=float(req.get("temperature", st.default_sampler.temperature)),
+                topp=float(req.get("top_p", st.default_sampler.topp)),
+                seed=int(req["seed"]) if req.get("seed") is not None
+                else int(time.time_ns() % (1 << 31)),
+            )
+            stops = req.get("stop") or []
+            if isinstance(stops, str):
+                stops = [stops]
+            if not (isinstance(stops, list) and all(isinstance(s, str) for s in stops)):
+                raise ValueError("stop must be a string or list of strings")
+            stream = bool(req.get("stream", False))
+            mt = req.get("max_tokens")
+            max_tokens = None if mt is None else max(1, int(mt))
+        except (TypeError, ValueError) as e:
+            self._error(400, f"bad request parameter: {e}")
+            return
+
+        tok = st.tokenizer
+        prompt = st.build_prompt(messages)
+        prompt_tokens = tok.encode(prompt, add_bos=True)
+        room = st.cfg.seq_len - len(prompt_tokens)
+        if room <= 0:
+            self._error(400, f"prompt of {len(prompt_tokens)} tokens exceeds "
+                             f"the {st.cfg.seq_len}-token context")
+            return
+        max_tokens = room if max_tokens is None else min(max_tokens, room)
+
+        cid = _completion_id()
+        created = int(time.time())
+        base = {"id": cid, "object": "chat.completion", "created": created,
+                "model": st.model_name}
+
+        if stream:
+            self.send_response(200)
+            self.send_header("Content-Type", "text/event-stream")
+            self.send_header("Cache-Control", "no-cache")
+            self.send_header("Connection", "close")
+            self.end_headers()
+
+        detector = StopDetector(stops)
+        text_parts: list = []
+        finish_reason = "length"
+        n_generated = 0
+
+        def emit_chunk(delta: dict, finish=None) -> None:
+            chunk = dict(base, object="chat.completion.chunk",
+                         choices=[{"index": 0, "delta": delta, "finish_reason": finish}])
+            self.wfile.write(b"data: " + json.dumps(chunk).encode() + b"\n\n")
+            self.wfile.flush()
+
+        if stream:
+            emit_chunk({"role": "assistant"})
+
+        # incremental UTF-8: a multi-byte character split across byte-fallback
+        # tokens must not be decoded per piece (that would emit U+FFFD pairs)
+        utf8 = codecs.getincrementaldecoder("utf-8")("replace")
+        with st.lock:
+            prev = prompt_tokens[-1]
+            stop_ids = tuple(i for i in (tok.eos_id,) if i >= 0)
+            eot = tok.piece_id(b"<|eot_id|>")
+            if eot >= 0:
+                stop_ids += (eot,)
+            for tok_id, _stats in st.engine.generate(
+                prompt_tokens, max_tokens, stop_tokens=stop_ids, sampler=sampler
+            ):
+                n_generated += 1
+                if tok_id in stop_ids:
+                    finish_reason = "stop"
+                    break
+                piece = utf8.decode(tok.decode_piece(prev, tok_id))
+                prev = tok_id
+                out, hit_stop = detector.feed(piece)
+                if out:
+                    text_parts.append(out)
+                    if stream:
+                        emit_chunk({"content": out})
+                if hit_stop:
+                    finish_reason = "stop"
+                    break
+
+        if not detector.stopped:
+            # flush text withheld as a possible stop-string prefix — on EOS or
+            # length it is legitimate output, only a stop-string hit eats it —
+            # plus the replacement char for any dangling incomplete UTF-8 bytes
+            tail = detector.flush() + utf8.decode(b"", True)
+            if tail:
+                text_parts.append(tail)
+                if stream:
+                    emit_chunk({"content": tail})
+
+        if stream:
+            emit_chunk({}, finish=finish_reason)
+            self.wfile.write(b"data: [DONE]\n\n")
+            self.wfile.flush()
+            self.close_connection = True
+        else:
+            self._json(200, dict(base, choices=[{
+                "index": 0,
+                "message": {"role": "assistant", "content": "".join(text_parts)},
+                "finish_reason": finish_reason,
+            }], usage={
+                "prompt_tokens": len(prompt_tokens),
+                "completion_tokens": n_generated,
+                "total_tokens": len(prompt_tokens) + n_generated,
+            }))
+
+
+def create_server(state: ServerState, host: str = "0.0.0.0", port: int = 9990):
+    handler = type("Handler", (OpenAIHandler,), {"state": state})
+    return ThreadingHTTPServer((host, port), handler)
+
+
+def serve(args) -> None:
+    """Start the server from parsed CLI args (the ``serve`` mode of
+    ``dllama_tpu.cli``, analogous to launching the reference's dllama-api
+    binary with the same flag set, `dllama-api.cpp:357-362`)."""
+    from dllama_tpu.cli import load_engine
+
+    engine, tok, cfg = load_engine(args)
+    state = ServerState(
+        engine, tok, cfg,
+        model_name=args.model.rsplit("/", 1)[-1],
+        template=args.chat_template,
+        default_sampler=SamplerConfig(temperature=args.temperature, topp=args.topp,
+                                      seed=args.seed or 0),
+    )
+    srv = create_server(state, host=args.host, port=args.port)
+    print(f"📡 listening on {args.host}:{args.port} "
+          "(POST /v1/chat/completions, GET /v1/models)")
+    srv.serve_forever()
+
+
+def main(argv=None) -> None:
+    import sys
+
+    from dllama_tpu.cli import build_parser
+
+    if argv is None:
+        argv = sys.argv[1:]
+    serve(build_parser().parse_args(["serve"] + list(argv)))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,206 @@
+"""OpenAI-compatible API server tests: request/response shape, SSE streaming,
+stop sequences, per-request sampler settings (mirrors the reference server's
+handled surface, `/root/reference/src/apps/dllama-api/dllama-api.cpp:202-322`)."""
+
+import http.client
+import json
+import threading
+
+import pytest
+
+from dllama_tpu.formats.tokenizer_file import TokenizerData
+from dllama_tpu.models import llama
+from dllama_tpu.runtime.generate import Engine
+from dllama_tpu.runtime.sampler import SamplerConfig
+from dllama_tpu.serving.api_server import ServerState, StopDetector, create_server
+from dllama_tpu.tokenizer.bpe import Tokenizer
+
+from tests.test_llama_forward import tiny_cfg
+
+
+# ---------------------------------------------------------------------------
+# StopDetector unit tests
+# ---------------------------------------------------------------------------
+
+def test_stop_detector_basic():
+    d = StopDetector(["END"])
+    assert d.feed("hello ") == ("hello ", False)
+    assert d.feed("END world") == ("", True)
+    assert d.stopped
+
+
+def test_stop_detector_spanning_pieces():
+    d = StopDetector(["STOP"])
+    out1, s1 = d.feed("abcST")
+    assert (out1, s1) == ("abc", False)  # "ST" withheld: possible prefix
+    out2, s2 = d.feed("OPxyz")
+    assert (out2, s2) == ("", True)
+
+
+def test_stop_detector_false_prefix_released():
+    d = StopDetector(["STOP"])
+    out1, _ = d.feed("abST")
+    assert out1 == "ab"
+    out2, stopped = d.feed("izzle")  # "ST"+"izzle" is not a stop
+    assert out2 == "STizzle"
+    assert not stopped
+    assert d.flush() == ""
+
+
+def test_stop_detector_no_stops_passthrough():
+    d = StopDetector([])
+    assert d.feed("anything") == ("anything", False)
+
+
+# ---------------------------------------------------------------------------
+# Server integration (tiny synthetic model, real HTTP over localhost)
+# ---------------------------------------------------------------------------
+
+def make_tokenizer() -> Tokenizer:
+    vocab = [b"<unk>", b"<s>", b"</s>"]
+    vocab += [b"<0x%02X>" % b for b in range(256)]
+    vocab += [b" ", b"e", b"t", b"he", b" the", b"hello", b" world"]
+    scores = [0.0] * 259 + [-1.0, -2.0, -2.0, -1.5, -1.2, -1.1, -1.1]
+    return Tokenizer(TokenizerData(vocab=vocab, scores=scores, bos_id=1, eos_id=2))
+
+
+@pytest.fixture(scope="module")
+def server():
+    tok = make_tokenizer()
+    cfg = tiny_cfg(vocab_size=tok.vocab_size, seq_len=512, dim=32, kv_dim=16,
+                   head_size=8, hidden_dim=64)
+    params = llama.random_params(cfg, seed=13)
+    engine = Engine(cfg, params, SamplerConfig(temperature=0.0, seed=1))
+    state = ServerState(engine, tok, cfg, model_name="tiny-test", template="llama3")
+    srv = create_server(state, host="127.0.0.1", port=0)
+    port = srv.server_address[1]
+    thread = threading.Thread(target=srv.serve_forever, daemon=True)
+    thread.start()
+    yield port
+    srv.shutdown()
+
+
+def request(port, method, path, body=None):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=120)
+    conn.request(method, path, body=json.dumps(body) if body else None,
+                 headers={"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    data = resp.read()
+    conn.close()
+    return resp.status, data
+
+
+def chat_body(**kw):
+    body = {
+        "model": "tiny-test",
+        "messages": [{"role": "user", "content": "hello world"}],
+        "max_tokens": 8,
+        "temperature": 0.0,
+    }
+    body.update(kw)
+    return body
+
+
+def test_models_endpoint(server):
+    status, data = request(server, "GET", "/v1/models")
+    assert status == 200
+    obj = json.loads(data)
+    assert obj["data"][0]["id"] == "tiny-test"
+
+
+def test_completion_basic(server):
+    status, data = request(server, "POST", "/v1/chat/completions", chat_body())
+    assert status == 200
+    obj = json.loads(data)
+    choice = obj["choices"][0]
+    assert choice["message"]["role"] == "assistant"
+    assert isinstance(choice["message"]["content"], str)
+    assert choice["finish_reason"] in ("stop", "length")
+    assert obj["usage"]["completion_tokens"] <= 8
+    assert obj["usage"]["total_tokens"] == (
+        obj["usage"]["prompt_tokens"] + obj["usage"]["completion_tokens"]
+    )
+
+
+def test_completion_deterministic_at_temp0(server):
+    _, d1 = request(server, "POST", "/v1/chat/completions", chat_body())
+    _, d2 = request(server, "POST", "/v1/chat/completions", chat_body())
+    c1 = json.loads(d1)["choices"][0]["message"]["content"]
+    c2 = json.loads(d2)["choices"][0]["message"]["content"]
+    assert c1 == c2
+
+
+def test_streaming_matches_nonstreaming(server):
+    _, data = request(server, "POST", "/v1/chat/completions", chat_body())
+    want = json.loads(data)["choices"][0]["message"]["content"]
+
+    status, raw = request(server, "POST", "/v1/chat/completions",
+                          chat_body(stream=True))
+    assert status == 200
+    events = [ln[len(b"data: "):] for ln in raw.split(b"\n\n")
+              if ln.startswith(b"data: ")]
+    assert events[-1] == b"[DONE]"
+    deltas = [json.loads(e) for e in events[:-1]]
+    text = "".join(d["choices"][0]["delta"].get("content", "") for d in deltas)
+    assert text == want
+    assert deltas[-1]["choices"][0]["finish_reason"] in ("stop", "length")
+    assert all(d["object"] == "chat.completion.chunk" for d in deltas)
+
+
+def test_stop_sequence_truncates(server):
+    _, data = request(server, "POST", "/v1/chat/completions",
+                      chat_body(max_tokens=16))
+    full = json.loads(data)["choices"][0]["message"]["content"]
+    if len(full) < 4:
+        pytest.skip("model generated too little text to test stop strings")
+    stop = full[2:4]
+    _, data2 = request(server, "POST", "/v1/chat/completions",
+                       chat_body(max_tokens=16, stop=[stop]))
+    obj = json.loads(data2)
+    content = obj["choices"][0]["message"]["content"]
+    assert stop not in content
+    assert content == full[: full.find(stop)]
+    assert obj["choices"][0]["finish_reason"] == "stop"
+
+
+def test_bad_request_400(server):
+    status, data = request(server, "POST", "/v1/chat/completions",
+                           {"messages": []})
+    assert status == 400
+    assert "error" in json.loads(data)
+
+    status, _ = request(server, "POST", "/v1/chat/completions",
+                        {"messages": [{"role": "user"}]})
+    assert status == 400
+
+
+def test_malformed_params_400_not_dropped_connection(server):
+    for bad in ({"seed": "abc"}, {"temperature": "hot"}, {"max_tokens": "x"},
+                {"stop": 5}, {"stop": [1, 2]}):
+        status, data = request(server, "POST", "/v1/chat/completions",
+                               chat_body(**bad))
+        assert status == 400, bad
+        assert "error" in json.loads(data)
+
+
+def test_utf8_multibyte_across_tokens():
+    """A char split across byte-fallback tokens must reach the client whole,
+    not as per-token replacement chars."""
+    import codecs
+
+    utf8 = codecs.getincrementaldecoder("utf-8")("replace")
+    pieces = ["é".encode()[:1], "é".encode()[1:]]  # two byte-fallback tokens
+    out = "".join(utf8.decode(p) for p in pieces)
+    assert out == "é"
+
+
+def test_unknown_path_404(server):
+    status, _ = request(server, "GET", "/v1/nope")
+    assert status == 404
+
+
+def test_max_tokens_respected(server):
+    _, data = request(server, "POST", "/v1/chat/completions",
+                      chat_body(max_tokens=3, stop=None))
+    obj = json.loads(data)
+    assert obj["usage"]["completion_tokens"] <= 3

@@ -102,7 +102,7 @@ def test_sharded_decode_step_emits_collectives():
     cache = eng.new_cache()
     lowered = eng._decode_step.func.lower(
         eng.params, eng.rope, cache, jnp.asarray(5, jnp.int32), jnp.int32(0),
-        jax.random.PRNGKey(0))
+        jax.random.PRNGKey(0), jnp.float32(0.0), jnp.float32(0.9))
     hlo = lowered.compile().as_text()
     assert hlo.count("all-reduce") > 0
     # and the weights really live sharded: 1/8th per device
