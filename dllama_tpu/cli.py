@@ -116,6 +116,7 @@ def run_generate(args, show_stats: bool) -> None:
         gen_ms.append(stats.generation_ms)
         if show_stats:
             sys.stdout.write(f"  🔶 G {stats.generation_ms:7.2f} ms I {stats.inference_ms:7.2f} ms\n")
+    sys.stdout.write(utf8.decode(b"", True))  # dangling incomplete char -> U+FFFD
     print()
     if gen_ms:
         # skip the first token (prefill) in the average, like the reference
@@ -168,7 +169,7 @@ def run_chat(args) -> None:
             print(piece, end="", flush=True)
             prev = tok_id
             reply.append(piece)
-        print()
+        print(utf8.decode(b"", True))
         session = engine.final_session
         if session.pos >= cfg.seq_len - 1:
             print("(context window exhausted)")

@@ -1,0 +1,51 @@
+"""Converter CLI: ``python -m dllama_tpu.convert <command> ...``
+
+Commands (reference tooling in parentheses):
+  hf <folder> <f32|f16|q40|q80> <name>   HF safetensors -> .m   (convert-hf.py)
+  llama <folder> <floatType>             Meta .pth -> .m        (convert-llama.py)
+  grok1 <folder> <floatType>             Grok-1 shards -> .m    (convert-grok-1.py)
+  tokenizer-sp <model> <name>            SentencePiece -> .t    (convert-tokenizer-sentencepiece.py)
+  tokenizer-llama3 <model> <name>        tiktoken ranks -> .t   (convert-tokenizer-llama3.py)
+  download <model>                       fetch prequantized     (download-model.py)
+"""
+
+from __future__ import annotations
+
+import sys
+
+
+def main(argv=None) -> None:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if not argv:
+        print(__doc__)
+        raise SystemExit(1)
+    cmd, rest = argv[0], argv[1:]
+    if cmd == "hf":
+        from dllama_tpu.convert.hf import main as run
+        run(rest)
+    elif cmd == "llama":
+        from dllama_tpu.convert.llama_pth import main as run
+        run(rest)
+    elif cmd == "grok1":
+        from dllama_tpu.convert.grok1 import main as run
+        run(rest)
+    elif cmd == "tokenizer-sp":
+        from dllama_tpu.convert.tokenizers import convert_sentencepiece
+        if len(rest) < 2:
+            raise SystemExit("Usage: ... tokenizer-sp <model.model> <name>")
+        convert_sentencepiece(rest[0], f"dllama_tokenizer_{rest[1]}.t")
+    elif cmd == "tokenizer-llama3":
+        from dllama_tpu.convert.tokenizers import convert_tiktoken
+        if len(rest) < 2:
+            raise SystemExit("Usage: ... tokenizer-llama3 <tokenizer.model> <name>")
+        convert_tiktoken(rest[0], f"dllama_tokenizer_{rest[1]}.t")
+    elif cmd == "download":
+        from dllama_tpu.convert.download import main as run
+        run(rest)
+    else:
+        print(__doc__)
+        raise SystemExit(f"unknown command {cmd!r}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,85 @@
+"""Prequantized model downloader (parity with `/root/reference/download-model.py`,
+urllib instead of requests so there is no extra dependency). Downloads a `.m`
+weight file + `.t` tokenizer into ``models/<name>/`` and writes a ready-to-run
+launch script for the TPU CLI."""
+
+from __future__ import annotations
+
+import os
+import stat
+import sys
+import urllib.request
+
+# same published checkpoints the reference fetches (`download-model.py:5-18`)
+MODELS = {
+    "llama3_8b_q40": [
+        "https://huggingface.co/b4rtaz/llama-3-8b-distributed-llama/resolve/main/dllama_meta-llama-3-8b_q40.bin?download=true",
+        "https://huggingface.co/b4rtaz/llama-3-8b-distributed-llama/resolve/main/dllama_meta-llama3-tokenizer.t?download=true",
+    ],
+    "llama3_8b_instruct_q40": [
+        "https://huggingface.co/Azamorn/Meta-Llama-3-8B-Instruct-Distributed/resolve/main/dllama_original_q40.bin?download=true",
+        "https://huggingface.co/Azamorn/Meta-Llama-3-8B-Instruct-Distributed/resolve/main/dllama-llama3-tokenizer.t?download=true",
+    ],
+    "tinylama_1.1b_3t_q40": [
+        "https://huggingface.co/b4rtaz/tinyllama-1.1b-1431k-3t-distributed-llama/resolve/main/dllama_model_tinylama_1.1b_3t_q40.m?download=true",
+        "https://huggingface.co/b4rtaz/tinyllama-1.1b-1431k-3t-distributed-llama/resolve/main/dllama_tokenizer_tinylama_1.1b_3t_q40.t?download=true",
+    ],
+}
+
+ALIASES = {
+    "llama3": "llama3_8b_q40",
+    "llama3_8b": "llama3_8b_q40",
+    "llama3_instruct": "llama3_8b_instruct_q40",
+    "llama3_8b_instruct": "llama3_8b_instruct_q40",
+    "tinylama": "tinylama_1.1b_3t_q40",
+}
+
+
+def download_file(url: str, path: str) -> None:
+    print(f"📄 {url}")
+
+    def report(blocks, block_size, total):
+        kb = blocks * block_size // 1024
+        if kb % 8192 < block_size // 1024:
+            sys.stdout.write(f"\rDownloaded {kb} kB")
+            sys.stdout.flush()
+
+    urllib.request.urlretrieve(url, path, reporthook=report)
+    sys.stdout.write(" ✅\n")
+
+
+def download_model(name: str, dest_root: str = "models") -> tuple:
+    name = ALIASES.get(name.replace("-", "_"), name.replace("-", "_"))
+    if name not in MODELS:
+        raise SystemExit(
+            f"Model not supported: {name}\nAvailable: {', '.join(MODELS)}"
+        )
+    dir_path = os.path.join(dest_root, name)
+    os.makedirs(dir_path, exist_ok=True)
+    model_path = os.path.join(dir_path, f"dllama_model_{name}.m")
+    tok_path = os.path.join(dir_path, f"dllama_tokenizer_{name}.t")
+    model_url, tok_url = MODELS[name]
+    download_file(model_url, model_path)
+    download_file(tok_url, tok_path)
+    return model_path, tok_path
+
+
+def main(argv: list) -> None:
+    if not argv:
+        print("Usage: python -m dllama_tpu.convert download <model>")
+        print("Available models:")
+        for m in MODELS:
+            print(f"  {m}")
+        raise SystemExit(1)
+    model_path, tok_path = download_model(argv[0])
+    command = (
+        f"python -m dllama_tpu.cli inference --model {model_path} "
+        f"--tokenizer {tok_path} --steps 64 --prompt \"Hello world\""
+    )
+    run_path = f"run_{argv[0]}.sh"
+    with open(run_path, "w") as f:
+        f.write(f"#!/bin/sh\n\n{command}\n")
+    os.chmod(run_path, os.stat(run_path).st_mode | stat.S_IXUSR)
+    print("To run, execute:\n")
+    print(command)
+    print(f"\n🌻 Created {run_path}")

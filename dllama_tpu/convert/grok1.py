@@ -1,0 +1,119 @@
+"""Grok-1 checkpoint (keyfan/grok-1-hf pytorch shards) -> `.m` converter.
+
+Parity with `/root/reference/converter/convert-grok-1.py`: the same fixed
+64-layer/8-expert plan over ``pytorch_model-000NN-of-00019.bin`` shards,
+streamed one tensor at a time with at most one shard resident. Tensor name
+mapping (reference lines 76-103):
+
+    transformer.in_out_embed.weight                          -> token_embedding
+    ...decoder_layer.{i}.multi_head_attention.{query,key,value,linear} -> wq wk wv wo
+    ...decoder_layer.{i}.router.weight                       -> moe_router
+    ...decoder_layer.{i}.moe.{e}.{linear_v,linear,linear_1}  -> up gate down
+    ...decoder_layer.{i}.rms_norm{,_1,_2,_3}                 -> rms_att rms_ffn rms_moe rms_ffn2
+    transformer.rms_norm.weight                              -> rms_final
+    lm_head.weight                                           -> wcls
+
+Grok uses the half-split rotary at runtime (FalconRopeSlice,
+`/root/reference/src/transformer.cpp:137-159`), matching the checkpoint
+layout — no permute.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from dllama_tpu.formats.spec import ArchType, HiddenAct, ModelSpec
+from dllama_tpu.formats.weights import ModelWriter
+from dllama_tpu.quants import blocks
+
+GROK1_SPEC = dict(
+    arch=ArchType.GROK1, dim=6144, hidden_dim=32768, n_layers=64, n_heads=48,
+    n_kv_heads=8, n_experts=8, n_active_experts=2, vocab_size=131072, seq_len=8192,
+    hidden_act=HiddenAct.GELU,
+)
+N_SHARDS = 19
+
+
+class _ShardCache:
+    """One torch shard resident at a time (70 GB more would not fit)."""
+
+    def __init__(self, folder: str):
+        import torch
+
+        self._torch = torch
+        self.folder = folder
+        self.index: dict = {}
+        self.current = None
+        self.current_idx = None
+
+    def _shard_path(self, idx: int) -> str:
+        return os.path.join(
+            self.folder, f"pytorch_model-000{str(idx).zfill(2)}-of-000{N_SHARDS}.bin"
+        )
+
+    def _load(self, idx: int) -> None:
+        if self.current_idx == idx:
+            return
+        self.current = None  # free before loading the next shard
+        print(f"💿 loading shard {idx}/{N_SHARDS}")
+        self.current = self._torch.load(
+            self._shard_path(idx), map_location="cpu", weights_only=True
+        )
+        for k in self.current:
+            self.index[k] = idx
+        self.current_idx = idx
+
+    def get(self, name: str) -> np.ndarray:
+        if self.current is None or name not in self.current:
+            if name in self.index:
+                self._load(self.index[name])
+            else:
+                self._load(1 if self.current_idx is None else self.current_idx + 1)
+        if name not in self.current:
+            raise KeyError(f"tensor {name} not found in shard {self.current_idx}")
+        return np.asarray(self.current[name].to(self._torch.float32))
+
+
+def grok1_tensor_stream(spec: ModelSpec, shards: _ShardCache):
+    yield "token_embedding", shards.get("transformer.in_out_embed.weight")
+    for i in range(spec.n_layers):
+        t = f"transformer.decoder_layer.{i}."
+        our = f"layers.{i}."
+        yield our + "wq", shards.get(t + "multi_head_attention.query.weight")
+        yield our + "wk", shards.get(t + "multi_head_attention.key.weight")
+        yield our + "wv", shards.get(t + "multi_head_attention.value.weight")
+        yield our + "wo", shards.get(t + "multi_head_attention.linear.weight")
+        yield our + "moe_router", shards.get(t + "router.weight")
+        for e in range(spec.n_experts):
+            yield our + f"experts.{e}.up", shards.get(t + f"moe.{e}.linear_v.weight")
+            yield our + f"experts.{e}.gate", shards.get(t + f"moe.{e}.linear.weight")
+            yield our + f"experts.{e}.down", shards.get(t + f"moe.{e}.linear_1.weight")
+        yield our + "rms_att", shards.get(t + "rms_norm.weight")
+        yield our + "rms_ffn", shards.get(t + "rms_norm_1.weight")
+        yield our + "rms_moe", shards.get(t + "rms_norm_2.weight")
+        yield our + "rms_ffn2", shards.get(t + "rms_norm_3.weight")
+    yield "rms_final", shards.get("transformer.rms_norm.weight")
+    yield "wcls", shards.get("lm_head.weight")
+
+
+def convert_grok1(folder: str, float_type_name: str, out_path: str) -> ModelSpec:
+    spec = ModelSpec(
+        weights_float_type=blocks.FLOAT_TYPE_BY_NAME[float_type_name], **GROK1_SPEC
+    )
+    shards = _ShardCache(folder)
+    with ModelWriter(out_path, spec) as w:
+        for name, tensor in grok1_tensor_stream(spec, shards):
+            print(f"🔶 writing {name} {tuple(tensor.shape)}")
+            w.write_next(name, tensor)
+    return spec
+
+
+def main(argv: list) -> None:
+    if len(argv) < 2:
+        print("Usage: python -m dllama_tpu.convert grok1 <shardFolder> <f32|f16|q40|q80>")
+        raise SystemExit(1)
+    out = f"dllama_model_grok1_{argv[1]}.m"
+    convert_grok1(argv[0], argv[1], out)
+    print(f"✅ {out} created")

@@ -1,0 +1,190 @@
+"""HuggingFace safetensors checkpoint -> `.m` converter.
+
+Capability parity with `/root/reference/converter/convert-hf.py` (Llama,
+Mistral, Mixtral families), streamed one tensor at a time so a 70B convert
+never materializes the model in RAM.
+
+Rotary convention: HF checkpoints store q/k projections in the half-split
+(rotate-half) layout. For Llama archs this framework applies *interleaved*
+rotary at runtime (matching the reference's LlamaRopeSlice,
+`/root/reference/src/transformer.cpp:98-135`), so q/k rows are permuted
+half->interleaved exactly like the reference converter
+(`/root/reference/converter/convert-hf.py:12-15`). Mixtral runs the
+half-split (Falcon) rope at runtime (`/root/reference/src/transformer.cpp:137-159`),
+so its q/k are written UNPERMUTED — note the reference converter permutes
+them anyway and then rotates half-split, a double transform its own runtime
+never undoes; we keep the math consistent with the HF checkpoint instead
+(verified against transformers' forward in tests/test_convert.py).
+
+Improvements over the reference converter, by design:
+* tied-embedding models (no ``lm_head.weight``) fall back to
+  ``model.embed_tokens.weight`` for the classifier;
+* Mixtral's router (``block_sparse_moe.gate``) is converted — the reference
+  plan omits it and its loader then reads misaligned bytes;
+* ``--seq-len`` caps the stored context (the KV cache allocates seq_len slots).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+
+from dllama_tpu.formats.spec import ArchType, HiddenAct, ModelSpec
+from dllama_tpu.formats.weights import ModelWriter
+from dllama_tpu.quants import blocks
+
+ARCH_BY_MODEL_TYPE = {
+    "llama": ArchType.LLAMA,
+    "mistral": ArchType.LLAMA,
+    "mixtral": ArchType.MIXTRAL,
+}
+
+
+def permute_rotary(w: np.ndarray, n_heads: int) -> np.ndarray:
+    """Reorder projection rows from half-split to interleaved rotary layout
+    (same transform as `/root/reference/converter/convert-hf.py:12-15`):
+    row (h, j) pairs with (h, j + hs/2) -> rows (h, 2j), (h, 2j+1)."""
+    out_dim = w.shape[0]
+    return (
+        w.reshape(n_heads, 2, out_dim // n_heads // 2, *w.shape[1:])
+        .swapaxes(1, 2)
+        .reshape(w.shape)
+    )
+
+
+def spec_from_hf_config(folder: str, weights_float_type: int,
+                        seq_len: int | None = None) -> ModelSpec:
+    with open(os.path.join(folder, "config.json")) as f:
+        config = json.load(f)
+    model_type = config.get("model_type", "llama")
+    if model_type not in ARCH_BY_MODEL_TYPE:
+        raise ValueError(f"unsupported model_type {model_type!r} "
+                         f"(supported: {sorted(ARCH_BY_MODEL_TYPE)})")
+    n_experts = int(config.get("num_local_experts") or 0)
+    n_active = int(config.get("num_active_local_experts")
+                   or config.get("num_experts_per_tok") or 0)
+    act = config.get("hidden_act", "silu")
+    return ModelSpec(
+        arch=ARCH_BY_MODEL_TYPE[model_type],
+        dim=config["hidden_size"],
+        hidden_dim=config["intermediate_size"],
+        n_layers=config["num_hidden_layers"],
+        n_heads=config["num_attention_heads"],
+        n_kv_heads=config.get("num_key_value_heads", config["num_attention_heads"]),
+        vocab_size=config["vocab_size"],
+        seq_len=seq_len or config["max_position_embeddings"],
+        n_experts=n_experts,
+        n_active_experts=n_active,
+        hidden_act=HiddenAct.GELU if act.startswith("gelu") else HiddenAct.SILU,
+        rope_theta=float(config.get("rope_theta", 10000.0)),
+        weights_float_type=weights_float_type,
+    )
+
+
+class _ShardedSafetensors:
+    """Lazy tensor lookup across a folder's *.safetensors shards, keeping at
+    most one shard open (the reference's lazy multi-file loading,
+    `/root/reference/converter/convert-hf.py:26-43`)."""
+
+    def __init__(self, folder: str):
+        from safetensors import safe_open
+
+        self._safe_open = safe_open
+        self.files = sorted(
+            os.path.join(folder, f) for f in os.listdir(folder)
+            if f.endswith(".safetensors")
+        )
+        if not self.files:
+            raise FileNotFoundError(f"no .safetensors files in {folder}")
+        self.by_name: dict = {}
+        for path in self.files:
+            with safe_open(path, framework="np") as f:
+                for key in f.keys():
+                    self.by_name[key] = path
+        self._open_path = None
+        self._open_file = None
+
+    def close(self) -> None:
+        if self._open_file is not None:
+            self._open_file.__exit__(None, None, None)
+            self._open_file = None
+            self._open_path = None
+
+    def get(self, *candidates: str) -> np.ndarray:
+        for name in candidates:
+            path = self.by_name.get(name)
+            if path is None:
+                continue
+            if path != self._open_path:
+                self.close()  # release the previous shard's handle/mmap
+                self._open_file = self._safe_open(path, framework="np").__enter__()
+                self._open_path = path
+            x = self._open_file.get_tensor(name)
+            # bf16 safetensors load as ml_dtypes bfloat16; promote via f32
+            return np.asarray(x, dtype=np.float32)
+        raise KeyError(f"none of {candidates} found in checkpoint")
+
+
+def hf_tensor_stream(spec: ModelSpec, shards: _ShardedSafetensors):
+    """Yield (our_name, ndarray) in exactly `.m` plan order."""
+    permute_q = spec.arch == ArchType.LLAMA  # half->interleaved only for Llama rope
+    yield "token_embedding", shards.get("model.embed_tokens.weight")
+    for i in range(spec.n_layers):
+        hf = f"model.layers.{i}."
+        our = f"layers.{i}."
+        wq = shards.get(hf + "self_attn.q_proj.weight")
+        wk = shards.get(hf + "self_attn.k_proj.weight")
+        if permute_q:
+            wq = permute_rotary(wq, spec.n_heads)
+            wk = permute_rotary(wk, spec.n_kv_heads)
+        yield our + "wq", wq
+        yield our + "wk", wk
+        yield our + "wv", shards.get(hf + "self_attn.v_proj.weight")
+        yield our + "wo", shards.get(hf + "self_attn.o_proj.weight")
+        if spec.is_moe:
+            yield our + "moe_router", shards.get(hf + "block_sparse_moe.gate.weight")
+            for e in range(spec.n_experts):
+                ex = hf + f"block_sparse_moe.experts.{e}."
+                yield our + f"experts.{e}.up", shards.get(ex + "w3.weight")
+                yield our + f"experts.{e}.gate", shards.get(ex + "w1.weight")
+                yield our + f"experts.{e}.down", shards.get(ex + "w2.weight")
+        else:
+            yield our + "w1", shards.get(hf + "mlp.gate_proj.weight")
+            yield our + "w2", shards.get(hf + "mlp.down_proj.weight")
+            yield our + "w3", shards.get(hf + "mlp.up_proj.weight")
+        yield our + "rms_att", shards.get(hf + "input_layernorm.weight")
+        yield our + "rms_ffn", shards.get(hf + "post_attention_layernorm.weight")
+    yield "rms_final", shards.get("model.norm.weight")
+    # tied-embedding checkpoints have no lm_head
+    yield "wcls", shards.get("lm_head.weight", "model.embed_tokens.weight")
+
+
+def convert_hf(folder: str, float_type_name: str, out_path: str,
+               seq_len: int | None = None) -> ModelSpec:
+    wft = blocks.FLOAT_TYPE_BY_NAME[float_type_name]
+    spec = spec_from_hf_config(folder, wft, seq_len)
+    shards = _ShardedSafetensors(folder)
+    try:
+        with ModelWriter(out_path, spec) as w:
+            for name, tensor in hf_tensor_stream(spec, shards):
+                print(f"🔶 writing {name} {tuple(tensor.shape)}")
+                w.write_next(name, tensor)
+    finally:
+        shards.close()
+    return spec
+
+
+def main(argv: list) -> None:
+    if len(argv) < 3:
+        print("Usage: python -m dllama_tpu.convert hf <hfFolder> <f32|f16|q40|q80> "
+              "<name> [--seq-len N]")
+        raise SystemExit(1)
+    folder, ft, name = argv[0], argv[1], argv[2]
+    seq_len = None
+    if "--seq-len" in argv:
+        seq_len = int(argv[argv.index("--seq-len") + 1])
+    out = f"dllama_model_{name}_{ft}.m"
+    spec = convert_hf(folder, ft, out, seq_len)
+    print(f"✅ {out} created ({spec.n_layers} layers, dim {spec.dim})")

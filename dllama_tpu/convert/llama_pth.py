@@ -1,0 +1,127 @@
+"""Meta-Llama checkpoint (``consolidated.*.pth`` shards) -> `.m` converter.
+
+Capability parity with `/root/reference/converter/convert-llama.py`: shards
+are column-parallel splits, concatenated on axis 0 for row-split tensors
+(wq/wk/wv/w1/w3, output) and axis 1 for col-split ones (tok_embeddings, wo,
+w2); norms are 1-D and identical across shards. Meta checkpoints already use
+the interleaved rotary layout, so no q/k permute is needed (unlike HF, see
+convert.hf).
+
+Requires torch (CPU) for ``torch.load``; everything downstream is numpy.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from pathlib import Path
+
+import numpy as np
+
+from dllama_tpu.formats.spec import ArchType, HiddenAct, ModelSpec
+from dllama_tpu.formats.weights import ModelWriter
+from dllama_tpu.quants import blocks
+
+# tensors whose shards concatenate on axis 1 (`convert-llama.py:73-77`)
+_AXIS1 = ("tok_embeddings.weight", "attention.wo.weight", "feed_forward.w2.weight")
+
+
+def _meta_tensor_order(n_layers: int) -> list:
+    names = ["tok_embeddings.weight"]
+    for i in range(n_layers):
+        names += [
+            f"layers.{i}.attention.wq.weight",
+            f"layers.{i}.attention.wk.weight",
+            f"layers.{i}.attention.wv.weight",
+            f"layers.{i}.attention.wo.weight",
+            f"layers.{i}.feed_forward.w1.weight",
+            f"layers.{i}.feed_forward.w2.weight",
+            f"layers.{i}.feed_forward.w3.weight",
+            f"layers.{i}.attention_norm.weight",
+            f"layers.{i}.ffn_norm.weight",
+        ]
+    return names + ["norm.weight", "output.weight"]
+
+
+_META_TO_OURS = {
+    "tok_embeddings.weight": "token_embedding",
+    "attention.wq.weight": "wq",
+    "attention.wk.weight": "wk",
+    "attention.wv.weight": "wv",
+    "attention.wo.weight": "wo",
+    "feed_forward.w1.weight": "w1",
+    "feed_forward.w2.weight": "w2",
+    "feed_forward.w3.weight": "w3",
+    "attention_norm.weight": "rms_att",
+    "ffn_norm.weight": "rms_ffn",
+    "norm.weight": "rms_final",
+    "output.weight": "wcls",
+}
+
+
+def _our_name(meta_name: str) -> str:
+    if meta_name.startswith("layers."):
+        _, idx, rest = meta_name.split(".", 2)
+        return f"layers.{idx}.{_META_TO_OURS[rest]}"
+    return _META_TO_OURS[meta_name]
+
+
+def convert_llama_pth(model_dir: str, float_type_name: str, out_path: str,
+                      seq_len: int | None = None) -> ModelSpec:
+    import torch
+
+    with open(os.path.join(model_dir, "params.json")) as f:
+        params = json.load(f)
+    if params.get("vocab_size", -1) < 1:
+        raise ValueError("params.json vocab_size is invalid; set the real value")
+    max_seq = seq_len or params.get("max_seq_len")
+    if not max_seq:
+        raise ValueError("params.json lacks max_seq_len; pass --seq-len")
+
+    shard_paths = sorted(Path(model_dir).glob("consolidated.*.pth"))
+    if not shard_paths:
+        raise FileNotFoundError(f"no consolidated.*.pth in {model_dir}")
+    shards = [torch.load(p, map_location="cpu", weights_only=True)
+              for p in shard_paths]
+
+    hidden_dim = shards[0]["layers.0.feed_forward.w1.weight"].shape[0] * len(shards)
+    spec = ModelSpec(
+        arch=ArchType.LLAMA,
+        dim=params["dim"],
+        hidden_dim=hidden_dim,
+        n_layers=params["n_layers"],
+        n_heads=params["n_heads"],
+        n_kv_heads=params.get("n_kv_heads") or params["n_heads"],
+        vocab_size=params["vocab_size"],
+        seq_len=max_seq,
+        hidden_act=HiddenAct.SILU,
+        rope_theta=float(params.get("rope_theta", 10000.0)),
+        weights_float_type=blocks.FLOAT_TYPE_BY_NAME[float_type_name],
+    )
+
+    with ModelWriter(out_path, spec) as w:
+        for meta_name in _meta_tensor_order(spec.n_layers):
+            parts = [np.asarray(s[meta_name].to(torch.float32)) for s in shards]
+            if len(parts) == 1 or parts[0].ndim == 1:
+                tensor = parts[0]
+            else:
+                axis = 1 if meta_name.endswith(_AXIS1) else 0
+                tensor = np.concatenate(parts, axis=axis)
+            print(f"🔶 writing {meta_name} {tuple(tensor.shape)}")
+            w.write_next(_our_name(meta_name), tensor)
+    return spec
+
+
+def main(argv: list) -> None:
+    if len(argv) < 2:
+        print("Usage: python -m dllama_tpu.convert llama <metaModelDir> "
+              "<f32|f16|q40|q80> [--seq-len N]")
+        raise SystemExit(1)
+    model_dir, ft = argv[0], argv[1]
+    seq_len = None
+    if "--seq-len" in argv:
+        seq_len = int(argv[argv.index("--seq-len") + 1])
+    name = os.path.basename(os.path.normpath(model_dir)).lower()
+    out = f"dllama_model_{name}_{ft}.m"
+    spec = convert_llama_pth(model_dir, ft, out, seq_len)
+    print(f"✅ {out} created ({spec.n_layers} layers, dim {spec.dim})")

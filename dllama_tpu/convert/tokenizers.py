@@ -1,0 +1,159 @@
+"""Tokenizer converters -> `.t` files.
+
+* SentencePiece ``.model`` (Llama 2 / Mistral / Mixtral):
+  parity with `/root/reference/converter/convert-tokenizer-sentencepiece.py`,
+  but with a built-in minimal protobuf wire parser — no sentencepiece
+  dependency (the proto schema is stable: ModelProto field 1 = repeated
+  SentencePiece{piece:1 string, score:2 float, type:3 enum}).
+* tiktoken base64 rank file + 256 Llama-3 special tokens:
+  parity with `/root/reference/converter/convert-tokenizer-llama3.py`
+  (scores are negative ranks so greedy BPE picks lowest-rank merges first).
+"""
+
+from __future__ import annotations
+
+import base64
+import struct
+
+from dllama_tpu.formats.tokenizer_file import TokenizerData, write_tokenizer
+
+# SentencePiece piece types (sentencepiece_model.proto)
+SP_NORMAL, SP_UNKNOWN, SP_CONTROL, SP_USER_DEFINED, SP_UNUSED, SP_BYTE = 1, 2, 3, 4, 5, 6
+
+
+# ---------------------------------------------------------------------------
+# Minimal protobuf wire-format reader (only what ModelProto needs)
+# ---------------------------------------------------------------------------
+
+def _read_varint(data: bytes, off: int) -> tuple:
+    result = shift = 0
+    while True:
+        b = data[off]
+        off += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, off
+        shift += 7
+
+
+def _iter_fields(data: bytes):
+    """Yield (field_number, wire_type, value) over one protobuf message."""
+    off = 0
+    while off < len(data):
+        key, off = _read_varint(data, off)
+        field, wire = key >> 3, key & 0x7
+        if wire == 0:  # varint
+            value, off = _read_varint(data, off)
+        elif wire == 1:  # 64-bit
+            value, off = data[off : off + 8], off + 8
+        elif wire == 2:  # length-delimited
+            length, off = _read_varint(data, off)
+            value, off = data[off : off + length], off + length
+        elif wire == 5:  # 32-bit
+            value, off = data[off : off + 4], off + 4
+        else:
+            raise ValueError(f"unsupported protobuf wire type {wire}")
+        yield field, wire, value
+
+
+def parse_sentencepiece_model(data: bytes) -> list:
+    """Return [(piece_bytes, score, type)] in id order from a .model file."""
+    pieces = []
+    for field, wire, value in _iter_fields(data):
+        if field == 1 and wire == 2:  # repeated SentencePiece
+            piece, score, ptype = b"", 0.0, SP_NORMAL
+            for f2, w2, v2 in _iter_fields(value):
+                if f2 == 1 and w2 == 2:
+                    piece = v2
+                elif f2 == 2 and w2 == 5:
+                    (score,) = struct.unpack("<f", v2)
+                elif f2 == 3 and w2 == 0:
+                    ptype = v2
+            pieces.append((piece, score, ptype))
+    if not pieces:
+        raise ValueError("no sentencepiece pieces found — not a .model file?")
+    return pieces
+
+
+def sentencepiece_to_tokenizer(data: bytes) -> TokenizerData:
+    """Apply the reference export transforms
+    (`convert-tokenizer-sentencepiece.py:34-53`): control pieces <s>/</s>
+    become '\\n<s>\\n'/'\\n</s>\\n', the '▁' whitespace marker becomes ' '."""
+    pieces = parse_sentencepiece_model(data)
+    vocab: list = []
+    scores: list = []
+    bos_id = eos_id = -1
+    for i, (piece, score, ptype) in enumerate(pieces):
+        text = piece.decode("utf-8", errors="replace")
+        if ptype == SP_CONTROL and text == "<s>":
+            bos_id = i
+            text = "\n<s>\n"
+        elif ptype == SP_CONTROL and text == "</s>":
+            eos_id = i
+            text = "\n</s>\n"
+        vocab.append(text.replace("\u2581", " ").encode("utf-8"))
+        scores.append(score)
+    # trainer-spec defaults when the control pieces use nonstandard text:
+    # unk=0, bos=1, eos=2
+    if bos_id < 0:
+        bos_id = 1
+    if eos_id < 0:
+        eos_id = 2
+    return TokenizerData(vocab=vocab, scores=scores, bos_id=bos_id, eos_id=eos_id,
+                         pad_id=-1)
+
+
+def convert_sentencepiece(model_path: str, out_path: str) -> TokenizerData:
+    with open(model_path, "rb") as f:
+        tok = sentencepiece_to_tokenizer(f.read())
+    write_tokenizer(out_path, tok)
+    print(f"✅ {out_path}: vocab={tok.vocab_size} bos={tok.bos_id} eos={tok.eos_id}")
+    return tok
+
+
+# ---------------------------------------------------------------------------
+# Llama-3 tiktoken ranks
+# ---------------------------------------------------------------------------
+
+N_SPECIAL_TOKENS = 256
+# `/root/reference/converter/convert-tokenizer-llama3.py:14-28`
+LLAMA3_SPECIAL_TOKENS = [
+    "<|begin_of_text|>",
+    "<|end_of_text|>",
+    "<|reserved_special_token_0|>",
+    "<|reserved_special_token_1|>",
+    "<|reserved_special_token_2|>",
+    "<|reserved_special_token_3|>",
+    "<|start_header_id|>",
+    "<|end_header_id|>",
+    "<|reserved_special_token_4|>",
+    "<|eot_id|>",
+] + [f"<|reserved_special_token_{i}|>" for i in range(5, N_SPECIAL_TOKENS - 5)]
+
+
+def tiktoken_to_tokenizer(lines: list, bos_id: int = 128000,
+                          eos_id: int = 128001) -> TokenizerData:
+    vocab: list = []
+    scores: list = []
+    for line in lines:
+        if not line.strip():
+            continue
+        b64, rank = line.split()
+        vocab.append(base64.b64decode(b64))
+        scores.append(-float(rank))
+    next_rank = len(vocab)
+    for token in LLAMA3_SPECIAL_TOKENS:
+        vocab.append(token.encode("utf-8"))
+        scores.append(-float(next_rank))
+        next_rank += 1
+    return TokenizerData(vocab=vocab, scores=scores, bos_id=bos_id, eos_id=eos_id,
+                         pad_id=-1)
+
+
+def convert_tiktoken(model_path: str, out_path: str, bos_id: int = 128000,
+                     eos_id: int = 128001) -> TokenizerData:
+    with open(model_path) as f:
+        tok = tiktoken_to_tokenizer(f.readlines(), bos_id, eos_id)
+    write_tokenizer(out_path, tok)
+    print(f"✅ {out_path}: vocab={tok.vocab_size} bos={tok.bos_id} eos={tok.eos_id}")
+    return tok

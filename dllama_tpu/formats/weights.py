@@ -163,14 +163,49 @@ class WeightFileReader:
             yield e.name, self.read_tensor(e.name, dtype)
 
 
+class ModelWriter:
+    """Streaming `.m` writer: header first, then tensors appended strictly in
+    plan order — a 70B conversion never holds more than one tensor in RAM
+    (the reference converters stream the same way,
+    `/root/reference/converter/convert-hf.py:92-125`)."""
+
+    def __init__(self, path: str, spec: ModelSpec):
+        header = write_header(spec)
+        self.spec = dataclasses.replace(spec, header_size=len(header))
+        self.plan = tensor_plan(self.spec)
+        self._i = 0
+        self._f = open(path, "wb")
+        self._f.write(header)
+
+    def write_next(self, name: str, x: np.ndarray) -> None:
+        e = self.plan[self._i]
+        if name != e.name:
+            raise ValueError(f"tensor order violation: expected {e.name!r}, got {name!r}")
+        x = np.asarray(x, dtype=np.float32)
+        if x.size != e.d * e.n:
+            raise ValueError(f"{e.name}: expected {e.d}x{e.n} values, got shape {x.shape}")
+        self._f.write(blocks.encode_tensor(x.reshape(-1), e.float_type))
+        self._i += 1
+
+    def close(self) -> None:
+        if self._i != len(self.plan):
+            missing = self.plan[self._i].name
+            self._f.close()
+            raise ValueError(f"model file incomplete: next expected tensor is {missing!r}")
+        self._f.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, *exc):
+        if exc_type is None:
+            self.close()
+        else:
+            self._f.close()
+
+
 def write_model(path: str, spec: ModelSpec, tensors: dict) -> None:
     """Write a `.m` file from a ``name -> ndarray`` dict (shapes per tensor_plan)."""
-    header = write_header(spec)
-    spec = dataclasses.replace(spec, header_size=len(header))
-    plan = tensor_plan(spec)
-    with open(path, "wb") as f:
-        f.write(header)
-        for e in plan:
-            x = np.asarray(tensors[e.name], dtype=np.float32)
-            assert x.size == e.d * e.n, f"{e.name}: expected {e.d}x{e.n}, got {x.shape}"
-            f.write(blocks.encode_tensor(x.reshape(-1), e.float_type))
+    with ModelWriter(path, spec) as w:
+        for e in w.plan:
+            w.write_next(e.name, tensors[e.name])

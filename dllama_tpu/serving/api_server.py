@@ -66,12 +66,13 @@ class StopDetector:
         if self.stopped:
             return "", True
         text = self.hold + piece
-        for s in self.stops:
-            i = text.find(s)
-            if i != -1:
-                self.stopped = True
-                self.hold = ""
-                return text[:i], True
+        # earliest occurrence across ALL stops wins (OpenAI semantics), not
+        # first stop in list order
+        hits = [i for i in (text.find(s) for s in self.stops) if i != -1]
+        if hits:
+            self.stopped = True
+            self.hold = ""
+            return text[: min(hits)], True
         k = self._partial_len(text)
         self.hold = text[-k:] if k else ""
         return text[: len(text) - k], False
@@ -85,13 +86,18 @@ class ServerState:
     """Everything the handler needs; one instance per server."""
 
     def __init__(self, engine, tokenizer, cfg, model_name: str, template: str = "llama3",
-                 default_sampler: SamplerConfig = SamplerConfig()):
+                 default_sampler: SamplerConfig = SamplerConfig(),
+                 default_seed: int = None):
+        """``default_seed``: seed for requests that send none — None means a
+        fresh time-based seed per request (the launch-flag --seed plumbs in
+        here so an operator can make the whole server reproducible)."""
         self.engine = engine
         self.tokenizer = tokenizer
         self.cfg = cfg
         self.model_name = model_name
         self.template = template
         self.default_sampler = default_sampler
+        self.default_seed = default_seed
         self.lock = threading.Lock()  # engine serves one request at a time
 
     def build_prompt(self, messages: list) -> str:
@@ -185,6 +191,7 @@ class OpenAIHandler(BaseHTTPRequestHandler):
                 temperature=float(req.get("temperature", st.default_sampler.temperature)),
                 topp=float(req.get("top_p", st.default_sampler.topp)),
                 seed=int(req["seed"]) if req.get("seed") is not None
+                else st.default_seed if st.default_seed is not None
                 else int(time.time_ns() % (1 << 31)),
             )
             stops = req.get("stop") or []
@@ -307,6 +314,7 @@ def serve(args) -> None:
         template=args.chat_template,
         default_sampler=SamplerConfig(temperature=args.temperature, topp=args.topp,
                                       seed=args.seed or 0),
+        default_seed=args.seed,
     )
     srv = create_server(state, host=args.host, port=args.port)
     print(f"📡 listening on {args.host}:{args.port} "

@@ -47,6 +47,13 @@ def test_stop_detector_false_prefix_released():
     assert d.flush() == ""
 
 
+def test_stop_detector_earliest_occurrence_wins():
+    # stop list order must not matter: "l" occurs before "world"
+    d = StopDetector(["world", "l"])
+    out, stopped = d.feed("hello world")
+    assert (out, stopped) == ("he", True)
+
+
 def test_stop_detector_no_stops_passthrough():
     d = StopDetector([])
     assert d.feed("anything") == ("anything", False)

@@ -1,0 +1,271 @@
+"""Converter tests.
+
+The load-bearing one is HF->.m->forward logit parity against transformers'
+own forward on the same checkpoint — it pins down the rotary permute
+convention (half-split HF layout -> our interleaved runtime for Llama,
+unpermuted -> half-split runtime for Mixtral) that SURVEY.md §7 flags as
+the easiest thing to get silently wrong.
+"""
+
+import json
+import struct
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from dllama_tpu.convert.tokenizers import (
+    LLAMA3_SPECIAL_TOKENS,
+    parse_sentencepiece_model,
+    sentencepiece_to_tokenizer,
+    tiktoken_to_tokenizer,
+)
+from dllama_tpu.formats.weights import WeightFileReader
+from dllama_tpu.models import llama
+from dllama_tpu.models.config import ModelConfig
+
+
+# ---------------------------------------------------------------------------
+# HF -> .m -> forward parity vs transformers
+# ---------------------------------------------------------------------------
+
+def _hf_llama_dir(tmp_path, tied=False):
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+
+    cfg = transformers.LlamaConfig(
+        vocab_size=96, hidden_size=64, intermediate_size=96, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=2, max_position_embeddings=32,
+        rope_theta=10000.0, tie_word_embeddings=tied,
+        # the .m format has no eps field; the runtime uses the reference's 1e-5
+        # (`/root/reference/src/funcs.cpp:120`), so pin HF to the same value
+        rms_norm_eps=1e-5,
+    )
+    torch.manual_seed(7)
+    model = transformers.LlamaForCausalLM(cfg)
+    model.eval()
+    d = tmp_path / ("hf_tied" if tied else "hf")
+    model.save_pretrained(d, safe_serialization=True)
+    return d, model
+
+
+@pytest.mark.parametrize("tied", [False, True])
+def test_hf_convert_matches_transformers_forward(tmp_path, tied):
+    torch = pytest.importorskip("torch")
+    from dllama_tpu.convert.hf import convert_hf
+
+    d, hf_model = _hf_llama_dir(tmp_path, tied)
+    out = str(tmp_path / "model.m")
+    spec = convert_hf(str(d), "f32", out)
+    assert spec.n_kv_heads == 2
+
+    tokens = np.array([5, 17, 42, 3], dtype=np.int32)
+    with torch.no_grad():
+        want = hf_model(torch.tensor(tokens[None].astype(np.int64))).logits[0].numpy()
+
+    with WeightFileReader(out) as reader:
+        cfg = ModelConfig.from_spec(reader.spec)
+        params = llama.params_from_reader(reader, cfg)
+    logits, _ = llama.forward(
+        cfg, jax.tree.map(jnp.asarray, params), llama.rope_tables(cfg),
+        jnp.asarray(tokens), llama.init_cache(cfg), 0,
+    )
+    np.testing.assert_allclose(np.asarray(logits), want, atol=5e-4, rtol=5e-3)
+
+
+def test_hf_convert_mixtral_matches_transformers(tmp_path):
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+    from dllama_tpu.convert.hf import convert_hf
+
+    cfg = transformers.MixtralConfig(
+        vocab_size=96, hidden_size=64, intermediate_size=96, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=2, max_position_embeddings=32,
+        num_local_experts=4, num_experts_per_tok=2, rope_theta=10000.0,
+        rms_norm_eps=1e-5,
+    )
+    torch.manual_seed(3)
+    model = transformers.MixtralForCausalLM(cfg)
+    model.eval()
+    d = tmp_path / "hf_mixtral"
+    model.save_pretrained(d, safe_serialization=True)
+
+    out = str(tmp_path / "mixtral.m")
+    spec = convert_hf(str(d), "f32", out)
+    assert spec.n_experts == 4 and spec.n_active_experts == 2
+
+    tokens = np.array([9, 2, 55], dtype=np.int32)
+    with torch.no_grad():
+        want = model(torch.tensor(tokens[None].astype(np.int64))).logits[0].numpy()
+
+    with WeightFileReader(out) as reader:
+        mcfg = ModelConfig.from_spec(reader.spec)
+        params = llama.params_from_reader(reader, mcfg)
+    logits, _ = llama.forward(
+        mcfg, jax.tree.map(jnp.asarray, params), llama.rope_tables(mcfg),
+        jnp.asarray(tokens), llama.init_cache(mcfg), 0,
+    )
+    np.testing.assert_allclose(np.asarray(logits), want, atol=1e-3, rtol=1e-2)
+
+
+def test_hf_convert_q40_still_close(tmp_path):
+    """Quantized conversion path: logits move, but stay correlated."""
+    pytest.importorskip("torch")
+    from dllama_tpu.convert.hf import convert_hf
+
+    d, _ = _hf_llama_dir(tmp_path)
+    out_f32 = str(tmp_path / "f32.m")
+    out_q40 = str(tmp_path / "q40.m")
+    convert_hf(str(d), "f32", out_f32)
+    convert_hf(str(d), "q40", out_q40)
+
+    tokens = jnp.asarray([5, 17, 42], jnp.int32)
+    outs = []
+    for path in (out_f32, out_q40):
+        with WeightFileReader(path) as reader:
+            cfg = ModelConfig.from_spec(reader.spec)
+            params = llama.params_from_reader(reader, cfg)
+        logits, _ = llama.forward(
+            cfg, jax.tree.map(jnp.asarray, params), llama.rope_tables(cfg),
+            tokens, llama.init_cache(cfg), 0,
+        )
+        outs.append(np.asarray(logits))
+    corr = np.corrcoef(outs[0].ravel(), outs[1].ravel())[0, 1]
+    # 4-bit noise dominates on a tiny random model; real checkpoints land far
+    # closer — this only guards the q40 write path being wired up at all
+    assert corr > 0.95
+
+
+# ---------------------------------------------------------------------------
+# SentencePiece .model parser (protobuf hand-encoded in the test)
+# ---------------------------------------------------------------------------
+
+def _sp_piece(piece: bytes, score: float, ptype: int) -> bytes:
+    body = b"\x0a" + bytes([len(piece)]) + piece
+    body += b"\x15" + struct.pack("<f", score)
+    body += b"\x18" + bytes([ptype])
+    return b"\x0a" + bytes([len(body)]) + body
+
+
+def _sp_model() -> bytes:
+    from dllama_tpu.convert.tokenizers import (
+        SP_BYTE, SP_CONTROL, SP_NORMAL, SP_UNKNOWN,
+    )
+
+    out = b""
+    out += _sp_piece(b"<unk>", 0.0, SP_UNKNOWN)
+    out += _sp_piece(b"<s>", 0.0, SP_CONTROL)
+    out += _sp_piece(b"</s>", 0.0, SP_CONTROL)
+    out += _sp_piece(b"<0x41>", 0.0, SP_BYTE)
+    out += _sp_piece("▁hello".encode(), -1.5, SP_NORMAL)
+    # a trailing unknown field that parsers must skip (trainer_spec, field 2)
+    out += b"\x12\x02\x08\x01"
+    return out
+
+
+def test_sentencepiece_parser():
+    pieces = parse_sentencepiece_model(_sp_model())
+    assert len(pieces) == 5
+    assert pieces[4][0] == "▁hello".encode()
+    assert pieces[4][1] == pytest.approx(-1.5)
+
+
+def test_sentencepiece_to_tokenizer_transforms():
+    tok = sentencepiece_to_tokenizer(_sp_model())
+    assert tok.bos_id == 1 and tok.eos_id == 2
+    assert tok.vocab[1] == b"\n<s>\n"
+    assert tok.vocab[2] == b"\n</s>\n"
+    assert tok.vocab[4] == b" hello"  # ▁ -> space
+    assert tok.vocab[3] == b"<0x41>"  # byte token text preserved
+
+
+# ---------------------------------------------------------------------------
+# tiktoken -> .t
+# ---------------------------------------------------------------------------
+
+def test_tiktoken_converter():
+    import base64
+
+    lines = [f"{base64.b64encode(bytes([65 + i])).decode()} {i}" for i in range(4)]
+    tok = tiktoken_to_tokenizer(lines, bos_id=2, eos_id=3)
+    assert tok.vocab[:4] == [b"A", b"B", b"C", b"D"]
+    assert tok.scores[:4] == [0.0, -1.0, -2.0, -3.0]
+    # specials appended with continuing negative ranks
+    assert tok.vocab[4] == b"<|begin_of_text|>"
+    assert tok.scores[4] == -4.0
+    assert len(tok.vocab) == 4 + len(LLAMA3_SPECIAL_TOKENS)
+    assert b"<|eot_id|>" in tok.vocab
+
+
+def test_llama_pth_convert_concats_shards(tmp_path):
+    """Meta consolidated.*.pth shards: axis-0 concat for row-split tensors,
+    axis-1 for col-split ones (`/root/reference/converter/convert-llama.py:69-93`)."""
+    torch = pytest.importorskip("torch")
+    from dllama_tpu.convert.llama_pth import convert_llama_pth
+
+    dim, hidden, n_layers, n_heads, vocab = 16, 24, 1, 4, 32
+    rng = np.random.default_rng(0)
+
+    def t(*shape):
+        return torch.tensor(rng.standard_normal(shape).astype(np.float32))
+
+    full = {
+        "tok_embeddings.weight": t(vocab, dim),
+        "layers.0.attention.wq.weight": t(dim, dim),
+        "layers.0.attention.wk.weight": t(dim, dim),
+        "layers.0.attention.wv.weight": t(dim, dim),
+        "layers.0.attention.wo.weight": t(dim, dim),
+        "layers.0.feed_forward.w1.weight": t(hidden, dim),
+        "layers.0.feed_forward.w2.weight": t(dim, hidden),
+        "layers.0.feed_forward.w3.weight": t(hidden, dim),
+        "layers.0.attention_norm.weight": t(dim),
+        "layers.0.ffn_norm.weight": t(dim),
+        "norm.weight": t(dim),
+        "output.weight": t(vocab, dim),
+    }
+    axis1 = ("tok_embeddings.weight", "attention.wo.weight", "feed_forward.w2.weight")
+    shards = [{}, {}]
+    for name, tensor in full.items():
+        if tensor.ndim == 1:
+            shards[0][name], shards[1][name] = tensor, tensor
+        else:
+            axis = 1 if name.endswith(axis1) else 0
+            halves = torch.chunk(tensor, 2, dim=axis)
+            shards[0][name], shards[1][name] = halves[0], halves[1]
+
+    d = tmp_path / "meta"
+    d.mkdir()
+    torch.save(shards[0], d / "consolidated.00.pth")
+    torch.save(shards[1], d / "consolidated.01.pth")
+    (d / "params.json").write_text(json.dumps({
+        "dim": dim, "n_layers": n_layers, "n_heads": n_heads,
+        "vocab_size": vocab, "max_seq_len": 16, "norm_eps": 1e-5,
+    }))
+
+    out = str(tmp_path / "meta.m")
+    spec = convert_llama_pth(str(d), "f32", out)
+    assert spec.hidden_dim == hidden
+
+    with WeightFileReader(out) as reader:
+        np.testing.assert_array_equal(
+            reader.read_tensor("token_embedding"), full["tok_embeddings.weight"].numpy()
+        )
+        np.testing.assert_array_equal(
+            reader.read_tensor("layers.0.w1"), full["layers.0.feed_forward.w1.weight"].numpy()
+        )
+        np.testing.assert_array_equal(
+            reader.read_tensor("layers.0.wo"), full["layers.0.attention.wo.weight"].numpy()
+        )
+
+
+def test_model_writer_enforces_plan_order(tmp_path):
+    from dllama_tpu.formats.spec import ArchType, ModelSpec
+    from dllama_tpu.formats.weights import ModelWriter
+
+    spec = ModelSpec(arch=ArchType.LLAMA, dim=64, hidden_dim=96, n_layers=1,
+                     n_heads=4, n_kv_heads=2, vocab_size=32, seq_len=16)
+    w = ModelWriter(str(tmp_path / "x.m"), spec)
+    with pytest.raises(ValueError, match="order violation"):
+        w.write_next("layers.0.wq", np.zeros(64 * 64, np.float32))
