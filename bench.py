@@ -59,10 +59,20 @@ def run_decode_bench(cfg_dict: dict, bench_steps: int = 64) -> float:
         mesh = tp_mesh(n_dev)
         log(f"tensor-parallel over {n_dev} devices")
 
-    log(f"building params on device: dim={cfg.dim} layers={cfg.n_layers} ({cfg.dtype})")
+    # Q40 weights by default: the baseline numbers are Q40xQ80 runs, and the
+    # fused dequant-matmul kernels keep 4-bit weights resident in HBM (4x less
+    # weight traffic per token). BENCH_WEIGHTS=bf16|q80 overrides. The Pallas
+    # kernels don't partition under pjit, so a multi-device mesh forces bf16.
+    weights = os.environ.get("BENCH_WEIGHTS", "q40")
+    if mesh is not None:
+        weights = "bf16"
+    log(f"building params on device: dim={cfg.dim} layers={cfg.n_layers} ({weights})")
     # with a mesh, params are written directly into their shards — no chip
     # ever holds the full model
-    params = llama.device_random_params(cfg, seed=0, mesh=mesh)
+    if weights in ("q40", "q80"):
+        params = llama.device_random_quant_params(cfg, kind=weights, seed=0)
+    else:
+        params = llama.device_random_params(cfg, seed=0, mesh=mesh)
     jax.block_until_ready(params)
     eng = Engine(cfg, params, SamplerConfig(temperature=0.0), cache_dtype=jnp.bfloat16,
                  mesh=mesh)

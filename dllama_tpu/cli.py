@@ -49,7 +49,15 @@ def build_parser() -> argparse.ArgumentParser:
         # accepted for reference-flag compatibility; activations never cross a
         # wire in SPMD, so there is nothing to requantize (see SURVEY.md §2.4)
         sp.add_argument("--buffer-float-type", default=None, help=argparse.SUPPRESS)
-        sp.add_argument("--weights-float-type", default=None, help=argparse.SUPPRESS)
+        sp.add_argument(
+            "--weights-float-type",
+            default=None,
+            choices=["q40", "q80", "bf16", "f16", "f32"],
+            help="on-device weight storage: q40/q80 keep weights block-quantized "
+            "in HBM and matmul through the fused Pallas dequant kernels "
+            "(default on TPU: q40 when the model file is q40, else the --dtype); "
+            "bf16/f16/f32 dequantize at load",
+        )
         sp.add_argument("--nthreads", type=int, default=None, help=argparse.SUPPRESS)
     return p
 
@@ -65,6 +73,9 @@ def load_engine(args):
     from dllama_tpu.runtime.sampler import SamplerConfig
     from dllama_tpu.tokenizer.bpe import Tokenizer
 
+    from dllama_tpu.quants import blocks
+
+    n_tp = args.tp if args.tp > 0 else len(jax.devices())
     t0 = time.time()
     with WeightFileReader(args.model) as reader:
         cfg = ModelConfig.from_spec(reader.spec, dtype=args.dtype)
@@ -72,7 +83,31 @@ def load_engine(args):
         print(f"💡 dim: {cfg.dim}  hiddenDim: {cfg.hidden_dim}  nLayers: {cfg.n_layers}")
         print(f"💡 nHeads: {cfg.n_heads}  nKvHeads: {cfg.n_kv_heads}")
         print(f"💡 vocabSize: {cfg.vocab_size}  seqLen: {cfg.seq_len}")
-        params = llama.params_from_reader(reader, cfg)
+        wft = args.weights_float_type
+        if (
+            wft is None
+            and not cfg.is_moe
+            and n_tp == 1
+            and jax.default_backend() == "tpu"
+        ):
+            # default to the file's own quantized format: the fused Pallas
+            # kernels read 4x fewer HBM bytes/token than bf16 weights. Only
+            # on TPU — elsewhere the kernels run in (slow) interpret mode, so
+            # quantized residency must be asked for explicitly.
+            wft = {blocks.Q40: "q40", blocks.Q80: "q80"}.get(
+                reader.spec.weights_float_type
+            )
+        if wft in ("q40", "q80"):
+            if cfg.is_moe or n_tp > 1:
+                raise SystemExit(
+                    "--weights-float-type q40/q80 currently requires a dense "
+                    "arch and --tp 1 (quantized kernels + tensor-parallel is "
+                    "on the roadmap)"
+                )
+            print(f"🧮 weights resident as {wft} (fused dequant-matmul kernels)")
+            params = llama.quant_params_from_reader(reader, cfg, wft)
+        else:
+            params = llama.params_from_reader(reader, cfg)
     print(f"⏩ loaded weights in {time.time() - t0:.1f}s")
 
     tok = Tokenizer.from_file(args.tokenizer)
@@ -80,7 +115,6 @@ def load_engine(args):
     sampler_cfg = SamplerConfig(temperature=args.temperature, topp=args.topp, seed=seed)
     cache_dtype = jnp.dtype(args.cache_dtype) if args.cache_dtype else jnp.dtype(args.dtype)
 
-    n_tp = args.tp if args.tp > 0 else len(jax.devices())
     if n_tp > 1:
         try:
             from dllama_tpu.parallel.mesh import tp_mesh

@@ -143,6 +143,12 @@ class WeightFileReader:
         x = blocks.decode_tensor(raw, e.float_type, e.d * e.n)
         return x.reshape(e.shape).astype(dtype, copy=False)
 
+    def read_raw(self, name: str) -> np.ndarray:
+        """The tensor's undecoded file bytes (uint8 view into the mmap) —
+        the input to lossless quantized repacking (ops.qmatmul.repack_q40)."""
+        e = self._by_name[name]
+        return self._buf[e.offset : e.offset + e.nbytes]
+
     def read_tensor_rows(self, name: str, rows: slice, dtype=np.float32) -> np.ndarray:
         """Dequantize only a row band — the unit of tensor-parallel sharded loading.
 

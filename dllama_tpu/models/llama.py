@@ -28,6 +28,7 @@ from dllama_tpu.models.config import ModelConfig
 from dllama_tpu.ops.activations import ACTIVATIONS
 from dllama_tpu.ops.attention import gqa_attention
 from dllama_tpu.ops.norms import rmsnorm
+from dllama_tpu.ops.qmatmul import QuantTensor, matmul_any, quantize_tensor
 from dllama_tpu.ops.rope import apply_rope, rope_table
 
 
@@ -67,6 +68,121 @@ def params_from_reader(reader: WeightFileReader, cfg: ModelConfig, dtype=None) -
             layers[n].append(reader.read_tensor(pre + n, np.float32))
     p["layers"] = {k: np.stack(v) for k, v in layers.items()}
     return p
+
+
+#: per-layer matrices eligible for fused-quantized storage (dense archs; MoE
+#: expert stacks keep the dense einsum path — see models.moe docstring)
+QUANTIZABLE = ("wq", "wk", "wv", "wo", "w1", "w2", "w3")
+
+
+def quantize_params(params: dict, kind: str, quantize_wcls: bool = True) -> dict:
+    """Convert dense layer matrices (and wcls) into stacked ``QuantTensor``s
+    for the fused dequant-matmul kernels (ops.qmatmul). Embedding and norms
+    stay dense f32 — same split as the reference, which keeps rms weights and
+    the embedding table F32 whatever the weight type
+    (`/root/reference/converter/convert-llama.py:78-84`)."""
+    out = dict(params)
+    out["layers"] = dict(params["layers"])
+    for name in QUANTIZABLE:
+        if name not in out["layers"]:
+            continue
+        stacked = np.asarray(
+            jax.device_get(out["layers"][name]), np.float32
+        )  # [L, in, out]
+        qts = [quantize_tensor(stacked[i], kind) for i in range(stacked.shape[0])]
+        out["layers"][name] = jax.tree.map(lambda *xs: jnp.stack(xs), *qts)
+    if quantize_wcls:
+        wcls = np.asarray(jax.device_get(params["wcls"]), np.float32)
+        out["wcls"] = quantize_tensor(wcls, kind)
+    return out
+
+
+def quant_params_from_reader(reader: WeightFileReader, cfg: ModelConfig,
+                             kind: str = "q40") -> dict:
+    """Load a `.m` file with the big matrices kept block-quantized for the
+    fused kernels. When the file's own float type matches ``kind``, the file
+    bits are repacked losslessly (no dequant->requant roundtrip), so decode
+    uses the exact published Q40/Q80 checkpoint values — the TPU equivalent
+    of the reference's ``matmulQ40vQ80`` production path
+    (`/root/reference/src/funcs.cpp:267-385`). Dense archs only."""
+    from dllama_tpu.ops import qmatmul as qm
+    from dllama_tpu.quants import blocks
+
+    if cfg.is_moe:
+        raise NotImplementedError("quantized loading covers dense archs (MoE stays bf16)")
+    file_ft = reader.spec.weights_float_type
+    lossless = (kind == "q40" and file_ft == blocks.Q40) or (
+        kind == "q80" and file_ft == blocks.Q80
+    )
+    repack = qm.repack_q40 if kind == "q40" else qm.repack_q80
+
+    def load_matrix(name: str):
+        e = reader.entry(name)
+        if lossless and e.n % 64 == 0:
+            return repack(reader.read_raw(name), e.d, e.n)
+        return quantize_tensor(reader.read_tensor(name, np.float32).T, kind)
+
+    p = {
+        "embedding": reader.read_tensor("token_embedding", np.float32),
+        "rms_final": reader.read_tensor("rms_final", np.float32),
+        "wcls": load_matrix("wcls"),
+    }
+    layers: dict = {}
+    for i in range(cfg.n_layers):
+        pre = f"layers.{i}."
+        for n in QUANTIZABLE:
+            layers.setdefault(n, []).append(load_matrix(pre + n))
+        for n in ("rms_att", "rms_ffn"):
+            layers.setdefault(n, []).append(
+                jnp.asarray(reader.read_tensor(pre + n, np.float32))
+            )
+    p["layers"] = {
+        k: jax.tree.map(lambda *xs: jnp.stack(xs), *v) for k, v in layers.items()
+    }
+    return p
+
+
+def device_random_quant_params(cfg: ModelConfig, kind: str = "q40", seed: int = 0) -> dict:
+    """Random *quantized* params built directly on device — the benchmark's
+    7B-shape model with Q40/Q80 HBM residency and no host-side 7B pytree.
+    The packed bits are random (valid nibbles/int8) with small scales; the
+    model is numerically plausible but meaningless, like device_random_params."""
+    if cfg.is_moe:
+        raise NotImplementedError("quantized random params cover dense archs only")
+    L, D, H, KV = cfg.n_layers, cfg.dim, cfg.hidden_dim, cfg.kv_dim
+    key = jax.random.PRNGKey(seed)
+    ks = iter(jax.random.split(key, 32))
+
+    def qrand(K_, O_, prefix=(L,)):
+        """Random QuantTensor, shape prefix () for unstacked (wcls)."""
+        if kind == "q40":
+            w = jax.random.randint(
+                next(ks), (*prefix, K_ // 2, O_), 0, 256, jnp.int32
+            ).astype(jnp.uint8)
+            s = jax.random.uniform(next(ks), (*prefix, K_ // 64, O_), jnp.float32) * 0.004
+            s2 = jax.random.uniform(next(ks), (*prefix, K_ // 64, O_), jnp.float32) * 0.004
+            return QuantTensor(w=w, s=s, s2=s2, kind="q40")
+        w = jax.random.randint(next(ks), (*prefix, K_, O_), -127, 128, jnp.int8)
+        s = jax.random.uniform(next(ks), (*prefix, K_ // 32, O_), jnp.float32) * 0.0003
+        return QuantTensor(w=w, s=s, s2=jnp.zeros((*prefix, 0), jnp.float32), kind="q80")
+
+    layers = {
+        "wq": qrand(D, D),
+        "wk": qrand(D, KV),
+        "wv": qrand(D, KV),
+        "wo": qrand(D, D),
+        "w1": qrand(D, H),
+        "w3": qrand(D, H),
+        "w2": qrand(H, D),
+        "rms_att": jnp.ones((L, D), jnp.float32),
+        "rms_ffn": jnp.ones((L, D), jnp.float32),
+    }
+    return {
+        "embedding": jax.random.normal(next(ks), (cfg.vocab_size, D), jnp.float32) * 0.02,
+        "rms_final": jnp.ones(D, jnp.float32),
+        "wcls": qrand(D, cfg.vocab_size, prefix=()),
+        "layers": layers,
+    }
 
 
 def random_params(cfg: ModelConfig, seed: int = 0, scale: float = 0.02, dtype=None) -> dict:
@@ -185,8 +301,8 @@ def rope_tables(cfg: ModelConfig) -> dict:
 
 def _dense_ffn(cfg: ModelConfig, lp: dict, xb: jnp.ndarray) -> jnp.ndarray:
     act = ACTIVATIONS[cfg.hidden_act]
-    h = act(xb @ lp["w1"]) * (xb @ lp["w3"])
-    return h @ lp["w2"]
+    h = act(matmul_any(xb, lp["w1"])) * matmul_any(xb, lp["w3"])
+    return matmul_any(h, lp["w2"])
 
 
 def _ffn_residual(cfg: ModelConfig, lp: dict, x: jnp.ndarray, att_out: jnp.ndarray):
@@ -217,9 +333,9 @@ def _attn_block(cfg: ModelConfig, lp: dict, rope: dict, x, k_cache, v_cache, pos
     T = x.shape[0]
     xb = rmsnorm(x, lp["rms_att"], cfg.norm_eps)
 
-    q = (xb @ lp["wq"]).reshape(T, cfg.n_heads, cfg.head_size)
-    k = (xb @ lp["wk"]).reshape(T, cfg.n_kv_heads, cfg.head_size)
-    v = (xb @ lp["wv"]).reshape(T, cfg.n_kv_heads, cfg.head_size)
+    q = matmul_any(xb, lp["wq"]).reshape(T, cfg.n_heads, cfg.head_size)
+    k = matmul_any(xb, lp["wk"]).reshape(T, cfg.n_kv_heads, cfg.head_size)
+    v = matmul_any(xb, lp["wv"]).reshape(T, cfg.n_kv_heads, cfg.head_size)
 
     cos = jax.lax.dynamic_slice_in_dim(rope["cos"], pos, T)[:, None, :]
     sin = jax.lax.dynamic_slice_in_dim(rope["sin"], pos, T)[:, None, :]
@@ -230,7 +346,7 @@ def _attn_block(cfg: ModelConfig, lp: dict, rope: dict, x, k_cache, v_cache, pos
     v_cache = jax.lax.dynamic_update_slice_in_dim(v_cache, v.astype(v_cache.dtype), pos, axis=0)
 
     out = gqa_attention(q, k_cache, v_cache, pos)
-    return out.reshape(T, cfg.dim) @ lp["wo"], k_cache, v_cache
+    return matmul_any(out.reshape(T, cfg.dim), lp["wo"]), k_cache, v_cache
 
 
 def forward(
@@ -261,7 +377,7 @@ def forward(
     )
 
     x = rmsnorm(x, params["rms_final"], cfg.norm_eps)
-    logits = (x @ params["wcls"]).astype(jnp.float32)
+    logits = matmul_any(x, params["wcls"]).astype(jnp.float32)
     if cfg.logit_scale != 1.0:
         logits = logits * cfg.logit_scale
     return logits, {"k": new_k, "v": new_v}

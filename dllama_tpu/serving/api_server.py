@@ -312,8 +312,9 @@ def serve(args) -> None:
         engine, tok, cfg,
         model_name=args.model.rsplit("/", 1)[-1],
         template=args.chat_template,
-        default_sampler=SamplerConfig(temperature=args.temperature, topp=args.topp,
-                                      seed=args.seed or 0),
+        # default_sampler carries only temperature/topp; the per-request seed
+        # comes from default_seed (single source of truth)
+        default_sampler=SamplerConfig(temperature=args.temperature, topp=args.topp),
         default_seed=args.seed,
     )
     srv = create_server(state, host=args.host, port=args.port)
