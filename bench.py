@@ -59,11 +59,13 @@ def run_decode_bench(cfg_dict: dict, bench_steps: int = 64) -> float:
         mesh = tp_mesh(n_dev)
         log(f"tensor-parallel over {n_dev} devices")
 
-    # Q40 weights by default: the baseline numbers are Q40xQ80 runs, and the
-    # fused dequant-matmul kernels keep 4-bit weights resident in HBM (4x less
-    # weight traffic per token). BENCH_WEIGHTS=bf16|q80 overrides. The Pallas
-    # kernels don't partition under pjit, so a multi-device mesh forces bf16.
-    weights = os.environ.get("BENCH_WEIGHTS", "q40")
+    # Q40 weights by default on TPU: the baseline numbers are Q40xQ80 runs,
+    # and the fused dequant-matmul kernels keep 4-bit weights resident in HBM
+    # (4x less weight traffic per token). BENCH_WEIGHTS=bf16|q80 overrides.
+    # Off-TPU the Pallas kernels run in interpret mode (orders of magnitude
+    # slower), and they don't partition under pjit — both cases force bf16.
+    default_weights = "q40" if jax.default_backend() == "tpu" else "bf16"
+    weights = os.environ.get("BENCH_WEIGHTS", default_weights)
     if mesh is not None:
         weights = "bf16"
     log(f"building params on device: dim={cfg.dim} layers={cfg.n_layers} ({weights})")

@@ -107,7 +107,14 @@ def load_engine(args):
             print(f"🧮 weights resident as {wft} (fused dequant-matmul kernels)")
             params = llama.quant_params_from_reader(reader, cfg, wft)
         else:
-            params = llama.params_from_reader(reader, cfg)
+            # bf16/f16/f32 request a dense on-device dtype for the weights
+            # (dequantized at load when the file is q40/q80)
+            dense_dtype = {
+                "bf16": jnp.bfloat16,
+                "f16": jnp.float16,
+                "f32": jnp.float32,
+            }.get(wft)
+            params = llama.params_from_reader(reader, cfg, dtype=dense_dtype)
     print(f"⏩ loaded weights in {time.time() - t0:.1f}s")
 
     tok = Tokenizer.from_file(args.tokenizer)

@@ -116,9 +116,17 @@ def quant_params_from_reader(reader: WeightFileReader, cfg: ModelConfig,
     )
     repack = qm.repack_q40 if kind == "q40" else qm.repack_q80
 
+    # the fused kernels need in_features divisible by the packing unit
+    # (64 for the q40 nibble pairs, 32 = one block for q80)
+    kernel_multiple = 64 if kind == "q40" else 32
+
     def load_matrix(name: str):
         e = reader.entry(name)
-        if lossless and e.n % 64 == 0:
+        if e.n % kernel_multiple != 0:
+            # valid in the file format (blocks are 32-wide) but not packable
+            # for the kernel: keep this matrix dense instead of crashing
+            return jnp.asarray(reader.read_tensor(name, cfg.jax_dtype).T)
+        if lossless:
             return repack(reader.read_raw(name), e.d, e.n)
         return quantize_tensor(reader.read_tensor(name, np.float32).T, kind)
 

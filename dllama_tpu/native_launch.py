@@ -1,0 +1,41 @@
+"""Launcher for the native CLI: establish the PJRT plugin environment, then
+exec ``dllama-native``.
+
+The axon TPU plugin reads connection settings (pool service, compat version,
+session) from environment variables that this container's ``sitecustomize``
+sets while registering the JAX backend. A bare shell doesn't have them, so
+``dllama-native`` run directly fails at ``PJRT_Client_Create``. This wrapper
+imports jax (triggering that registration side effect), then ``exec``s the
+native binary with the now-complete environment — the Python process is
+replaced, so no JAX client stays alive to contend for the device.
+
+Usage:
+    python -m dllama_tpu.native_launch generate --export-dir dir/ [...]
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+
+    import jax  # noqa: F401  — side effect: plugin registration sets env
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    binary = os.environ.get(
+        "DLLAMA_NATIVE_BIN", os.path.join(repo, "native", "build", "dllama-native")
+    )
+    if not os.path.exists(binary):
+        sys.stderr.write(
+            f"native binary not found at {binary}; build it with "
+            f"`make -C {os.path.join(repo, 'native')}`\n"
+        )
+        return 1
+    os.execv(binary, [binary] + argv)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,248 @@
+// dllama-native — C++ CLI hosting the TPU decode loop via PJRT.
+//
+// Native counterpart of `dllama inference|generate`
+// (/root/reference/src/apps/dllama/dllama.cpp:14-92): loads a model exported
+// by `python -m dllama_tpu.export_native`, creates a PJRT client on the TPU
+// plugin, uploads weights once, then runs the autoregressive loop — execute
+// decode step on device, pull f32 logits, sample on host, feed the token
+// back. Prints the reference's per-token stats line (generation time and
+// device/step time split).
+//
+// Usage:
+//   dllama-native generate --export-dir DIR --tokenizer T.t
+//     [--prompt "..."] [--steps N] [--temperature F] [--topp F] [--seed N]
+//     [--plugin /path/to/pjrt_plugin.so]
+
+#include <chrono>
+#include <cstdio>
+#include <cstring>
+#include <iostream>
+#include <map>
+#include <string>
+#include <vector>
+
+#include "manifest.h"
+#include "pjrt.h"
+#include "sampler.h"
+#include "tokenizer.h"
+
+namespace dllama {
+namespace {
+
+int64_t NowMs() {
+  return std::chrono::duration_cast<std::chrono::milliseconds>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+
+struct Args {
+  std::string mode;
+  std::string export_dir;
+  std::string tokenizer;
+  std::string prompt = "Hello";
+  std::string plugin;  // override manifest plugin path
+  int steps = 32;
+  float temperature = 0.8f;
+  float topp = 0.9f;
+  uint64_t seed = 12345;
+
+  static Args Parse(int argc, char** argv) {
+    if (argc < 2) throw std::runtime_error("usage: dllama-native <generate>");
+    Args a;
+    a.mode = argv[1];
+    for (int i = 2; i < argc; i += 2) {
+      const std::string k = argv[i];
+      if (i + 1 >= argc)
+        throw std::runtime_error("flag " + k + " is missing its value");
+      const std::string v = argv[i + 1];
+      if (k == "--export-dir") a.export_dir = v;
+      else if (k == "--tokenizer") a.tokenizer = v;
+      else if (k == "--prompt") a.prompt = v;
+      else if (k == "--plugin") a.plugin = v;
+      else if (k == "--steps") a.steps = std::stoi(v);
+      else if (k == "--temperature") a.temperature = std::stof(v);
+      else if (k == "--topp") a.topp = std::stof(v);
+      else if (k == "--seed") a.seed = std::stoull(v);
+      else throw std::runtime_error("unknown flag " + k);
+    }
+    if (a.export_dir.empty()) throw std::runtime_error("--export-dir required");
+    return a;
+  }
+};
+
+std::vector<ClientOption> BuildOptions(const Manifest& m) {
+  std::vector<ClientOption> opts;
+  for (const PluginOption& o : m.options) {
+    switch (o.type) {
+      case 'i': opts.push_back(ClientOption::Int(o.name, std::stoll(o.value))); break;
+      case 's': opts.push_back(ClientOption::Str(o.name, o.value)); break;
+      case 'b': opts.push_back(ClientOption::Bool(o.name, o.value == "1")); break;
+      case 'f': opts.push_back(ClientOption::Float(o.name, std::stof(o.value))); break;
+      default: throw std::runtime_error("bad option type in manifest");
+    }
+  }
+  return opts;
+}
+
+int Generate(const Args& args) {
+  Manifest m = LoadManifest(args.export_dir);
+  const std::string plugin =
+      !args.plugin.empty() ? args.plugin : m.plugin_path;
+  std::fprintf(stderr, "💡 plugin: %s\n", plugin.c_str());
+
+  Client client(plugin, BuildOptions(m));
+  std::fprintf(stderr, "💡 platform: %s, devices: %zu\n",
+               client.platform_name().c_str(), client.num_devices());
+
+  // Deserialize the AOT executable if present (fast path), else compile the
+  // StableHLO module on the plugin.
+  const int64_t t_compile0 = NowMs();
+  Executable exec;
+  bool loaded = false;
+  if (!m.executable_file.empty()) {
+    try {
+      exec = client.Deserialize(ReadFile(m.path(m.executable_file)));
+      loaded = true;
+      std::fprintf(stderr, "⏩ deserialized executable\n");
+    } catch (const std::exception& e) {
+      std::fprintf(stderr, "⚠️  deserialize failed (%s), compiling\n",
+                   e.what());
+    }
+  }
+  if (!loaded) {
+    exec = client.Compile(ReadFile(m.path(m.mlir_file)),
+                          ReadFile(m.path(m.compile_options_file)));
+  }
+  std::fprintf(stderr, "🕒 program ready in %lld ms\n",
+               static_cast<long long>(NowMs() - t_compile0));
+
+  // Upload weights + init caches. args_bufs[i] mirrors m.inputs[i].
+  const std::string blob = ReadFile(m.path(m.weights_file));
+  std::vector<Buffer> bufs(m.inputs.size());
+  int token_idx = -1, pos_idx = -1;
+  std::vector<int> cache_idx;  // manifest input index of each cache slot
+  int64_t weight_bytes = 0;
+  const int64_t t_load0 = NowMs();
+  for (size_t i = 0; i < m.inputs.size(); ++i) {
+    const ArgSpec& in = m.inputs[i];
+    const PJRT_Buffer_Type ty = dtype_from_string(in.dtype);
+    switch (in.kind) {
+      case ArgKind::kWeight: {
+        if (in.offset < 0 ||
+            static_cast<size_t>(in.offset + in.nbytes) > blob.size())
+          throw std::runtime_error("weight " + in.name + " out of range");
+        bufs[i] = client.ToDevice(blob.data() + in.offset, ty, in.dims);
+        weight_bytes += in.nbytes;
+        break;
+      }
+      case ArgKind::kCache: {
+        std::vector<char> zeros(static_cast<size_t>(in.nbytes), 0);
+        bufs[i] = client.ToDevice(zeros.data(), ty, in.dims);
+        cache_idx.push_back(static_cast<int>(i));
+        break;
+      }
+      case ArgKind::kToken:
+        token_idx = static_cast<int>(i);
+        break;
+      case ArgKind::kPos:
+        pos_idx = static_cast<int>(i);
+        break;
+    }
+  }
+  if (token_idx < 0 || pos_idx < 0)
+    throw std::runtime_error("manifest missing token/pos inputs");
+  std::fprintf(stderr, "⏩ loaded %lld MB of weights in %lld ms\n",
+               static_cast<long long>(weight_bytes >> 20),
+               static_cast<long long>(NowMs() - t_load0));
+
+  // Output layout: [0]=logits f32[vocab], [1..]=new cache (same order as
+  // cache inputs). Validate against the manifest.
+  if (m.outputs.empty() || m.outputs[0].kind != "logits")
+    throw std::runtime_error("manifest output 0 must be logits");
+  if (m.outputs.size() != 1 + cache_idx.size())
+    throw std::runtime_error("manifest outputs must be logits + caches");
+
+  Tokenizer tok(args.tokenizer.empty() ? m.path("tokenizer.t")
+                                       : args.tokenizer);
+  Sampler sampler(args.temperature, args.topp, args.seed);
+  std::vector<int> prompt_tokens = tok.Encode(args.prompt, /*add_bos=*/true);
+  const int n_prompt = static_cast<int>(prompt_tokens.size());
+  const int total = std::min<int>(n_prompt + args.steps,
+                                  static_cast<int>(m.seq_len));
+
+  std::vector<float> logits(static_cast<size_t>(m.vocab_size));
+  int token = prompt_tokens.empty() ? tok.bos_id() : prompt_tokens[0];
+  int64_t infer_ms_total = 0, gen_ms_total = 0;
+  int generated = 0;
+
+  for (int pos = 0; pos < total; ++pos) {
+    const int64_t t0 = NowMs();
+    // Host-fed scalars for this step.
+    const int32_t tok_host[1] = {token};
+    const int32_t pos_host = pos;
+    bufs[token_idx] = client.ToDevice(tok_host, PJRT_Buffer_Type_S32, {1});
+    bufs[pos_idx] = client.ToDevice(&pos_host, PJRT_Buffer_Type_S32, {});
+
+    std::vector<PJRT_Buffer*> arglist(bufs.size());
+    for (size_t i = 0; i < bufs.size(); ++i) arglist[i] = bufs[i].get();
+    std::vector<Buffer> outs = exec.Execute(arglist);
+
+    // Donated cache inputs were consumed; adopt the aliased outputs.
+    for (size_t c = 0; c < cache_idx.size(); ++c)
+      bufs[cache_idx[c]] = std::move(outs[1 + c]);
+
+    outs[0].ToHost(logits.data(), logits.size() * sizeof(float));
+    const int64_t t_infer = NowMs() - t0;
+
+    int next;
+    if (pos + 1 < n_prompt) {
+      next = prompt_tokens[pos + 1];  // forced prompt token
+    } else {
+      next = sampler.Sample(logits);
+      ++generated;
+      infer_ms_total += t_infer;
+      gen_ms_total += NowMs() - t0;
+    }
+
+    if (pos + 1 >= n_prompt) {
+      const std::string piece = tok.DecodePiece(token, next);
+      std::fwrite(piece.data(), 1, piece.size(), stdout);
+      std::fflush(stdout);
+    }
+    std::fprintf(stderr, "🔶 G %4lld ms I %4lld ms T %4lld ms | pos %d\n",
+                 static_cast<long long>(NowMs() - t0),
+                 static_cast<long long>(t_infer),
+                 static_cast<long long>(NowMs() - t0 - t_infer),
+                 pos);
+    token = next;
+    if (token == tok.eos_id()) break;
+  }
+
+  std::printf("\n");
+  if (generated > 0) {
+    std::printf("Generated tokens:    %d\n", generated);
+    std::printf("Avg tokens / second: %.2f\n",
+                1000.0 * generated / static_cast<double>(gen_ms_total));
+    std::printf("Avg generation time: %.2f ms\n",
+                static_cast<double>(gen_ms_total) / generated);
+    std::printf("Avg inference time:  %.2f ms\n",
+                static_cast<double>(infer_ms_total) / generated);
+  }
+  return 0;
+}
+
+}  // namespace
+}  // namespace dllama
+
+int main(int argc, char** argv) {
+  try {
+    dllama::Args args = dllama::Args::Parse(argc, argv);
+    if (args.mode == "generate" || args.mode == "inference")
+      return dllama::Generate(args);
+    std::fprintf(stderr, "unknown mode: %s\n", args.mode.c_str());
+    return 2;
+  } catch (const std::exception& e) {
+    std::fprintf(stderr, "💥 %s\n", e.what());
+    return 1;
+  }
+}

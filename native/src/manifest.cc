@@ -1,0 +1,96 @@
+#include "manifest.h"
+
+#include <fstream>
+#include <sstream>
+#include <stdexcept>
+
+namespace dllama {
+namespace {
+
+ArgKind ParseKind(const std::string& s) {
+  if (s == "weight") return ArgKind::kWeight;
+  if (s == "cache") return ArgKind::kCache;
+  if (s == "token") return ArgKind::kToken;
+  if (s == "pos") return ArgKind::kPos;
+  throw std::runtime_error("manifest: unknown input kind " + s);
+}
+
+}  // namespace
+
+std::string ReadFile(const std::string& path) {
+  std::ifstream f(path, std::ios::binary);
+  if (!f) throw std::runtime_error("cannot open " + path);
+  std::ostringstream ss;
+  ss << f.rdbuf();
+  return ss.str();
+}
+
+Manifest LoadManifest(const std::string& dir) {
+  Manifest m;
+  m.dir = dir;
+  std::ifstream f(dir + "/manifest.txt");
+  if (!f) throw std::runtime_error("cannot open " + dir + "/manifest.txt");
+
+  std::string line;
+  while (std::getline(f, line)) {
+    if (line.empty() || line[0] == '#') continue;
+    std::istringstream ss(line);
+    std::string key;
+    ss >> key;
+    if (key == "dllama_native") {
+      ss >> m.version;
+    } else if (key == "model") {
+      ss >> m.model_name;
+    } else if (key == "vocab_size") {
+      ss >> m.vocab_size;
+    } else if (key == "seq_len") {
+      ss >> m.seq_len;
+    } else if (key == "plugin") {
+      ss >> m.plugin_path;
+    } else if (key == "option") {
+      PluginOption o;
+      ss >> o.type >> o.name;
+      // value = rest of line (strings may be URLs with ':' but no spaces;
+      // take one token)
+      ss >> o.value;
+      m.options.push_back(o);
+    } else if (key == "weights_file") {
+      ss >> m.weights_file;
+    } else if (key == "mlir_file") {
+      ss >> m.mlir_file;
+    } else if (key == "compile_options_file") {
+      ss >> m.compile_options_file;
+    } else if (key == "executable_file") {
+      ss >> m.executable_file;
+    } else if (key == "input") {
+      // input <name> <kind> <dtype> <offset> <nbytes> <ndims> <dims...>
+      ArgSpec a;
+      std::string kind;
+      size_t ndims = 0;
+      ss >> a.name >> kind >> a.dtype >> a.offset >> a.nbytes >> ndims;
+      a.kind = ParseKind(kind);
+      a.dims.resize(ndims);
+      for (size_t i = 0; i < ndims; ++i) ss >> a.dims[i];
+      if (!ss) throw std::runtime_error("manifest: bad input line: " + line);
+      m.inputs.push_back(std::move(a));
+    } else if (key == "output") {
+      // output <name> <kind> <dtype> <ndims> <dims...>
+      OutSpec o;
+      size_t ndims = 0;
+      ss >> o.name >> o.kind >> o.dtype >> ndims;
+      o.dims.resize(ndims);
+      for (size_t i = 0; i < ndims; ++i) ss >> o.dims[i];
+      if (!ss) throw std::runtime_error("manifest: bad output line: " + line);
+      m.outputs.push_back(std::move(o));
+    } else {
+      throw std::runtime_error("manifest: unknown key " + key);
+    }
+  }
+  if (m.version != 1)
+    throw std::runtime_error("manifest: unsupported version");
+  if (m.inputs.empty() || m.outputs.empty())
+    throw std::runtime_error("manifest: no inputs/outputs");
+  return m;
+}
+
+}  // namespace dllama

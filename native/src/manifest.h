@@ -1,0 +1,67 @@
+// Export-directory manifest: the contract between the JAX exporter
+// (dllama_tpu/export_native.py) and this native runtime.
+//
+// A text manifest (one record per line, space-separated) describes the
+// decode-step program's flat argument list — weights (with byte offsets into
+// weights.bin), KV-cache slots (zero-initialized on device), and the
+// host-fed token/pos scalars — plus the PJRT plugin and its client-creation
+// options. This replaces the reference's .m weight header + socket weight
+// streaming (/root/reference/src/transformer.cpp:569-728): weights go
+// straight from the file to device HBM, no wire protocol.
+#pragma once
+
+#include <cstdint>
+#include <string>
+#include <vector>
+
+namespace dllama {
+
+enum class ArgKind { kWeight, kCache, kToken, kPos };
+
+struct ArgSpec {
+  std::string name;
+  ArgKind kind;
+  std::string dtype;          // "f32" | "bf16" | "i32" | ...
+  std::vector<int64_t> dims;  // [] for scalars
+  int64_t offset = -1;        // byte offset into weights.bin (kWeight only)
+  int64_t nbytes = 0;
+};
+
+struct OutSpec {
+  std::string name;
+  std::string kind;  // "logits" | "cache"
+  std::string dtype;
+  std::vector<int64_t> dims;
+};
+
+struct PluginOption {
+  char type;  // 'i' | 's' | 'b' | 'f'
+  std::string name;
+  std::string value;
+};
+
+struct Manifest {
+  int version = 0;
+  std::string model_name;
+  int64_t vocab_size = 0;
+  int64_t seq_len = 0;
+  std::string plugin_path;
+  std::vector<PluginOption> options;
+  std::string weights_file;   // relative to the manifest dir
+  std::string mlir_file;
+  std::string compile_options_file;
+  std::string executable_file;  // "" if absent
+  std::vector<ArgSpec> inputs;
+  std::vector<OutSpec> outputs;
+  std::string dir;  // directory the manifest was loaded from
+
+  std::string path(const std::string& rel) const { return dir + "/" + rel; }
+};
+
+// Parses <dir>/manifest.txt. Throws std::runtime_error on malformed input.
+Manifest LoadManifest(const std::string& dir);
+
+// Whole-file read ("" + throw on failure).
+std::string ReadFile(const std::string& path);
+
+}  // namespace dllama

@@ -1,0 +1,366 @@
+#include "pjrt.h"
+
+#include <dlfcn.h>
+
+#include <cstring>
+
+namespace dllama {
+namespace {
+
+// Raise PjrtError (and free the PJRT_Error) if err != nullptr.
+void Check(const PJRT_Api* api, PJRT_Error* err, const char* what) {
+  if (err == nullptr) return;
+  PJRT_Error_Message_Args msg;
+  std::memset(&msg, 0, sizeof(msg));
+  msg.struct_size = PJRT_Error_Message_Args_STRUCT_SIZE;
+  msg.error = err;
+  api->PJRT_Error_Message(&msg);
+  std::string text(msg.message, msg.message_size);
+  PJRT_Error_Destroy_Args d;
+  std::memset(&d, 0, sizeof(d));
+  d.struct_size = PJRT_Error_Destroy_Args_STRUCT_SIZE;
+  d.error = err;
+  api->PJRT_Error_Destroy(&d);
+  throw PjrtError(std::string(what) + ": " + text);
+}
+
+// Block on an event, then destroy it; throws on event error.
+void AwaitAndDestroy(const PJRT_Api* api, PJRT_Event* event, const char* what) {
+  if (event == nullptr) return;
+  PJRT_Event_Await_Args a;
+  std::memset(&a, 0, sizeof(a));
+  a.struct_size = PJRT_Event_Await_Args_STRUCT_SIZE;
+  a.event = event;
+  PJRT_Error* err = api->PJRT_Event_Await(&a);
+  PJRT_Event_Destroy_Args d;
+  std::memset(&d, 0, sizeof(d));
+  d.struct_size = PJRT_Event_Destroy_Args_STRUCT_SIZE;
+  d.event = event;
+  api->PJRT_Event_Destroy(&d);
+  Check(api, err, what);
+}
+
+}  // namespace
+
+ClientOption ClientOption::Int(std::string n, int64_t v) {
+  ClientOption o;
+  o.name = std::move(n);
+  o.type = PJRT_NamedValue_kInt64;
+  o.int_value = v;
+  return o;
+}
+ClientOption ClientOption::Str(std::string n, std::string v) {
+  ClientOption o;
+  o.name = std::move(n);
+  o.type = PJRT_NamedValue_kString;
+  o.str_value = std::move(v);
+  return o;
+}
+ClientOption ClientOption::Bool(std::string n, bool v) {
+  ClientOption o;
+  o.name = std::move(n);
+  o.type = PJRT_NamedValue_kBool;
+  o.bool_value = v;
+  return o;
+}
+ClientOption ClientOption::Float(std::string n, float v) {
+  ClientOption o;
+  o.name = std::move(n);
+  o.type = PJRT_NamedValue_kFloat;
+  o.float_value = v;
+  return o;
+}
+
+// ---------------------------------------------------------------------------
+// Buffer
+
+Buffer& Buffer::operator=(Buffer&& o) noexcept {
+  if (this != &o) {
+    reset();
+    api_ = o.api_;
+    buf_ = o.buf_;
+    o.buf_ = nullptr;
+  }
+  return *this;
+}
+
+Buffer::~Buffer() { reset(); }
+
+void Buffer::reset() {
+  if (buf_ != nullptr) {
+    PJRT_Buffer_Destroy_Args d;
+    std::memset(&d, 0, sizeof(d));
+    d.struct_size = PJRT_Buffer_Destroy_Args_STRUCT_SIZE;
+    d.buffer = buf_;
+    api_->PJRT_Buffer_Destroy(&d);  // error on destroy is not recoverable
+    buf_ = nullptr;
+  }
+}
+
+size_t Buffer::host_size() const {
+  PJRT_Buffer_ToHostBuffer_Args a;
+  std::memset(&a, 0, sizeof(a));
+  a.struct_size = PJRT_Buffer_ToHostBuffer_Args_STRUCT_SIZE;
+  a.src = buf_;
+  a.dst = nullptr;  // size query only
+  Check(api_, api_->PJRT_Buffer_ToHostBuffer(&a), "ToHostBuffer(size)");
+  return a.dst_size;
+}
+
+void Buffer::ToHost(void* dst, size_t dst_size) const {
+  PJRT_Buffer_ToHostBuffer_Args a;
+  std::memset(&a, 0, sizeof(a));
+  a.struct_size = PJRT_Buffer_ToHostBuffer_Args_STRUCT_SIZE;
+  a.src = buf_;
+  a.dst = dst;
+  a.dst_size = dst_size;
+  Check(api_, api_->PJRT_Buffer_ToHostBuffer(&a), "ToHostBuffer");
+  AwaitAndDestroy(api_, a.event, "ToHostBuffer event");
+}
+
+// ---------------------------------------------------------------------------
+// Executable
+
+Executable& Executable::operator=(Executable&& o) noexcept {
+  if (this != &o) {
+    this->~Executable();
+    api_ = o.api_;
+    exec_ = o.exec_;
+    o.exec_ = nullptr;
+  }
+  return *this;
+}
+
+Executable::~Executable() {
+  if (exec_ != nullptr) {
+    PJRT_LoadedExecutable_Destroy_Args d;
+    std::memset(&d, 0, sizeof(d));
+    d.struct_size = PJRT_LoadedExecutable_Destroy_Args_STRUCT_SIZE;
+    d.executable = exec_;
+    api_->PJRT_LoadedExecutable_Destroy(&d);
+    exec_ = nullptr;
+  }
+}
+
+size_t Executable::num_outputs() const {
+  PJRT_LoadedExecutable_GetExecutable_Args g;
+  std::memset(&g, 0, sizeof(g));
+  g.struct_size = PJRT_LoadedExecutable_GetExecutable_Args_STRUCT_SIZE;
+  g.loaded_executable = exec_;
+  Check(api_, api_->PJRT_LoadedExecutable_GetExecutable(&g), "GetExecutable");
+  PJRT_Executable_NumOutputs_Args n;
+  std::memset(&n, 0, sizeof(n));
+  n.struct_size = PJRT_Executable_NumOutputs_Args_STRUCT_SIZE;
+  n.executable = g.executable;
+  PJRT_Error* err = api_->PJRT_Executable_NumOutputs(&n);
+  PJRT_Executable_Destroy_Args d;
+  std::memset(&d, 0, sizeof(d));
+  d.struct_size = PJRT_Executable_Destroy_Args_STRUCT_SIZE;
+  d.executable = g.executable;
+  api_->PJRT_Executable_Destroy(&d);
+  Check(api_, err, "NumOutputs");
+  return n.num_outputs;
+}
+
+std::vector<Buffer> Executable::Execute(
+    const std::vector<PJRT_Buffer*>& args) {
+  const size_t n_out = num_outputs();
+  std::vector<PJRT_Buffer*> outputs(n_out, nullptr);
+  PJRT_Buffer** output_list = outputs.data();
+  PJRT_Buffer* const* arg_list = args.data();
+
+  PJRT_ExecuteOptions opts;
+  std::memset(&opts, 0, sizeof(opts));
+  opts.struct_size = PJRT_ExecuteOptions_STRUCT_SIZE;
+
+  PJRT_Event* done = nullptr;
+  PJRT_LoadedExecutable_Execute_Args a;
+  std::memset(&a, 0, sizeof(a));
+  a.struct_size = PJRT_LoadedExecutable_Execute_Args_STRUCT_SIZE;
+  a.executable = exec_;
+  a.options = &opts;
+  a.argument_lists = &arg_list;
+  a.num_devices = 1;
+  a.num_args = args.size();
+  a.output_lists = &output_list;
+  a.device_complete_events = &done;
+  Check(api_, api_->PJRT_LoadedExecutable_Execute(&a), "Execute");
+  AwaitAndDestroy(api_, done, "Execute completion");
+
+  std::vector<Buffer> out;
+  out.reserve(n_out);
+  for (PJRT_Buffer* b : outputs) out.emplace_back(api_, b);
+  return out;
+}
+
+// ---------------------------------------------------------------------------
+// Client
+
+Client::Client(const std::string& plugin_path,
+               const std::vector<ClientOption>& options) {
+  dl_ = dlopen(plugin_path.c_str(), RTLD_NOW | RTLD_LOCAL);
+  if (dl_ == nullptr)
+    throw PjrtError("dlopen(" + plugin_path + "): " + dlerror());
+  using GetPjrtApiFn = const PJRT_Api* (*)();
+  auto get_api = reinterpret_cast<GetPjrtApiFn>(dlsym(dl_, "GetPjrtApi"));
+  if (get_api == nullptr)
+    throw PjrtError(plugin_path + " does not export GetPjrtApi");
+  api_ = get_api();
+  if (api_ == nullptr) throw PjrtError("GetPjrtApi returned null");
+
+  PJRT_Plugin_Initialize_Args init;
+  std::memset(&init, 0, sizeof(init));
+  init.struct_size = PJRT_Plugin_Initialize_Args_STRUCT_SIZE;
+  Check(api_, api_->PJRT_Plugin_Initialize(&init), "Plugin_Initialize");
+
+  // Marshal options into PJRT_NamedValue (string storage stays in `options`).
+  std::vector<PJRT_NamedValue> nvs(options.size());
+  for (size_t i = 0; i < options.size(); ++i) {
+    const ClientOption& o = options[i];
+    PJRT_NamedValue& nv = nvs[i];
+    std::memset(&nv, 0, sizeof(nv));
+    nv.struct_size = PJRT_NamedValue_STRUCT_SIZE;
+    nv.name = o.name.c_str();
+    nv.name_size = o.name.size();
+    nv.type = o.type;
+    switch (o.type) {
+      case PJRT_NamedValue_kString:
+        nv.string_value = o.str_value.c_str();
+        nv.value_size = o.str_value.size();
+        break;
+      case PJRT_NamedValue_kInt64:
+        nv.int64_value = o.int_value;
+        nv.value_size = 1;
+        break;
+      case PJRT_NamedValue_kBool:
+        nv.bool_value = o.bool_value;
+        nv.value_size = 1;
+        break;
+      case PJRT_NamedValue_kFloat:
+        nv.float_value = o.float_value;
+        nv.value_size = 1;
+        break;
+      default:
+        throw PjrtError("unsupported option type for " + o.name);
+    }
+  }
+
+  PJRT_Client_Create_Args c;
+  std::memset(&c, 0, sizeof(c));
+  c.struct_size = PJRT_Client_Create_Args_STRUCT_SIZE;
+  c.create_options = nvs.data();
+  c.num_options = nvs.size();
+  Check(api_, api_->PJRT_Client_Create(&c), "Client_Create");
+  client_ = c.client;
+
+  PJRT_Client_AddressableDevices_Args d;
+  std::memset(&d, 0, sizeof(d));
+  d.struct_size = PJRT_Client_AddressableDevices_Args_STRUCT_SIZE;
+  d.client = client_;
+  Check(api_, api_->PJRT_Client_AddressableDevices(&d), "AddressableDevices");
+  devices_.assign(d.addressable_devices,
+                  d.addressable_devices + d.num_addressable_devices);
+  if (devices_.empty()) throw PjrtError("no addressable devices");
+}
+
+Client::~Client() {
+  if (client_ != nullptr) {
+    PJRT_Client_Destroy_Args d;
+    std::memset(&d, 0, sizeof(d));
+    d.struct_size = PJRT_Client_Destroy_Args_STRUCT_SIZE;
+    d.client = client_;
+    api_->PJRT_Client_Destroy(&d);
+  }
+  if (dl_ != nullptr) dlclose(dl_);
+}
+
+std::string Client::platform_name() const {
+  PJRT_Client_PlatformName_Args a;
+  std::memset(&a, 0, sizeof(a));
+  a.struct_size = PJRT_Client_PlatformName_Args_STRUCT_SIZE;
+  a.client = client_;
+  Check(api_, api_->PJRT_Client_PlatformName(&a), "PlatformName");
+  return std::string(a.platform_name, a.platform_name_size);
+}
+
+Buffer Client::ToDevice(const void* data, PJRT_Buffer_Type type,
+                        const std::vector<int64_t>& dims) {
+  PJRT_Client_BufferFromHostBuffer_Args a;
+  std::memset(&a, 0, sizeof(a));
+  a.struct_size = PJRT_Client_BufferFromHostBuffer_Args_STRUCT_SIZE;
+  a.client = client_;
+  a.data = data;
+  a.type = type;
+  a.dims = dims.data();
+  a.num_dims = dims.size();
+  a.host_buffer_semantics =
+      PJRT_HostBufferSemantics_kImmutableUntilTransferCompletes;
+  a.device = devices_[0];
+  Check(api_, api_->PJRT_Client_BufferFromHostBuffer(&a), "BufferFromHost");
+  AwaitAndDestroy(api_, a.done_with_host_buffer, "BufferFromHost transfer");
+  return Buffer(api_, a.buffer);
+}
+
+Executable Client::Compile(const std::string& mlir_bytecode,
+                           const std::string& compile_options_proto) {
+  static const char kFormat[] = "mlir";
+  PJRT_Program prog;
+  std::memset(&prog, 0, sizeof(prog));
+  prog.struct_size = PJRT_Program_STRUCT_SIZE;
+  prog.code = const_cast<char*>(mlir_bytecode.data());
+  prog.code_size = mlir_bytecode.size();
+  prog.format = kFormat;
+  prog.format_size = sizeof(kFormat) - 1;
+
+  PJRT_Client_Compile_Args a;
+  std::memset(&a, 0, sizeof(a));
+  a.struct_size = PJRT_Client_Compile_Args_STRUCT_SIZE;
+  a.client = client_;
+  a.program = &prog;
+  a.compile_options = compile_options_proto.data();
+  a.compile_options_size = compile_options_proto.size();
+  Check(api_, api_->PJRT_Client_Compile(&a), "Compile");
+  return Executable(api_, a.executable);
+}
+
+Executable Client::Deserialize(const std::string& serialized) {
+  PJRT_Executable_DeserializeAndLoad_Args a;
+  std::memset(&a, 0, sizeof(a));
+  a.struct_size = PJRT_Executable_DeserializeAndLoad_Args_STRUCT_SIZE;
+  a.client = client_;
+  a.serialized_executable = serialized.data();
+  a.serialized_executable_size = serialized.size();
+  Check(api_, api_->PJRT_Executable_DeserializeAndLoad(&a),
+        "DeserializeAndLoad");
+  return Executable(api_, a.loaded_executable);
+}
+
+size_t dtype_bytes(PJRT_Buffer_Type t) {
+  switch (t) {
+    case PJRT_Buffer_Type_F32:
+    case PJRT_Buffer_Type_S32:
+    case PJRT_Buffer_Type_U32:
+      return 4;
+    case PJRT_Buffer_Type_BF16:
+    case PJRT_Buffer_Type_F16:
+      return 2;
+    case PJRT_Buffer_Type_S8:
+    case PJRT_Buffer_Type_U8:
+      return 1;
+    default:
+      throw PjrtError("unsupported dtype");
+  }
+}
+
+PJRT_Buffer_Type dtype_from_string(const std::string& s) {
+  if (s == "f32") return PJRT_Buffer_Type_F32;
+  if (s == "bf16") return PJRT_Buffer_Type_BF16;
+  if (s == "f16") return PJRT_Buffer_Type_F16;
+  if (s == "i32") return PJRT_Buffer_Type_S32;
+  if (s == "u32") return PJRT_Buffer_Type_U32;
+  if (s == "i8") return PJRT_Buffer_Type_S8;
+  if (s == "u8") return PJRT_Buffer_Type_U8;
+  throw PjrtError("unknown dtype string: " + s);
+}
+
+}  // namespace dllama

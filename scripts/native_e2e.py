@@ -1,0 +1,74 @@
+"""End-to-end test of the native PJRT runtime on the real TPU.
+
+Run OUTSIDE pytest's CPU-forced env (fresh process, default backend):
+
+    python scripts/native_e2e.py /tmp/native_export
+
+Exports a tiny random Llama + a synthetic vocab with the current backend's
+PJRT plugin options in the manifest, builds native/, then runs
+``dllama-native generate`` against the plugin and checks it emits tokens.
+Exits 0 on success.
+"""
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def main() -> int:
+    out_dir = sys.argv[1] if len(sys.argv) > 1 else "/tmp/dllama_native_e2e"
+
+    import jax.numpy as jnp
+
+    from dllama_tpu import export_native
+    from dllama_tpu.formats.tokenizer_file import TokenizerData, write_tokenizer
+    from dllama_tpu.models import llama
+    from dllama_tpu.models.config import ModelConfig
+
+    cfg = ModelConfig(
+        arch="llama", dim=128, hidden_dim=256, n_layers=2, n_heads=4,
+        n_kv_heads=4, vocab_size=259, seq_len=64, head_size=32, kv_dim=128,
+        dtype="bfloat16",
+    )
+    params = llama.device_random_params(cfg, seed=0)
+    export_native.export_model(
+        cfg, params, out_dir, cache_dtype=jnp.bfloat16, model_name="tiny-e2e"
+    )
+
+    # byte-level vocab: 3 specials + 256 byte tokens = 259 == cfg.vocab_size
+    vocab = [b"<unk>", b"<s>", b"</s>"]
+    vocab += [f"<0x{b:02X}>".encode() for b in range(256)]
+    tok = TokenizerData(vocab=vocab, scores=[0.0] * len(vocab), bos_id=1, eos_id=2)
+    write_tokenizer(os.path.join(out_dir, "tokenizer.t"), tok)
+
+    native = os.path.join(REPO, "native")
+    subprocess.run(["make", "-j4"], cwd=native, check=True)
+    proc = subprocess.run(
+        [
+            os.path.join(native, "build", "dllama-native"), "generate",
+            "--export-dir", out_dir,
+            "--prompt", "hi",
+            "--steps", "8",
+            "--temperature", "0",
+        ],
+        capture_output=True,
+        timeout=600,
+    )
+    stdout = proc.stdout.decode("utf-8", errors="replace")
+    sys.stderr.write(proc.stderr.decode("utf-8", errors="replace"))
+    sys.stdout.write(stdout)
+    if proc.returncode != 0:
+        print("❌ dllama-native failed")
+        return 1
+    if "Generated tokens" not in stdout:
+        print("❌ no generation stats in output")
+        return 1
+    print("✅ native e2e OK")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

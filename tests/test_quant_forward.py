@@ -39,7 +39,7 @@ def test_quantized_forward_close_to_dense(kind):
         "rms_final": params["rms_final"],
         "wcls": _deq(qparams["wcls"]),
         "layers": {
-            k: (_deq_stacked(v) if k in llama.QUANTIZABLE else v)
+            k: (_deq(v) if k in llama.QUANTIZABLE else v)
             for k, v in qparams["layers"].items()
         },
     }
@@ -62,11 +62,6 @@ def _deq(qt):
 
     return jnp.asarray(qmatmul.dequantize(qt), jnp.float32)
 
-
-def _deq_stacked(qt):
-    from dllama_tpu.ops import qmatmul
-
-    return jnp.asarray(qmatmul.dequantize(qt), jnp.float32)
 
 
 def test_engine_decodes_with_quantized_params():
