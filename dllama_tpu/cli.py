@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import argparse
 import codecs
+import os
 import sys
 import time
 
@@ -22,7 +23,7 @@ import time
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="dllama_tpu")
     sub = p.add_subparsers(dest="mode", required=True)
-    for mode in ("inference", "generate", "chat", "serve"):
+    for mode in ("inference", "generate", "chat", "serve", "worker"):
         sp = sub.add_parser(mode)
         if mode == "serve":  # the dllama-api surface (`src/apps/dllama-api`)
             sp.add_argument("--host", default="0.0.0.0")
@@ -59,7 +60,40 @@ def build_parser() -> argparse.ArgumentParser:
             "bf16/f16/f32 dequantize at load",
         )
         sp.add_argument("--nthreads", type=int, default=None, help=argparse.SUPPRESS)
+        # multi-host topology (the reference's `--workers h:p ...` analog,
+        # `/root/reference/src/app.cpp:60-80`): under SPMD every host runs the
+        # SAME command with its own --host-id; JAX wires the hosts into one
+        # mesh over ICI/DCN (no root/worker socket protocol)
+        sp.add_argument(
+            "--coordinator",
+            default=None,
+            help="host:port of process 0 for jax.distributed.initialize",
+        )
+        sp.add_argument("--num-hosts", type=int, default=None)
+        sp.add_argument("--host-id", type=int, default=None)
     return p
+
+
+def maybe_init_distributed(args) -> int:
+    """Join the multi-host SPMD job when topology flags are present.
+
+    Returns this process's index (0 in single-host runs). Replaces the
+    reference's root-connects-to-workers bootstrap
+    (`/root/reference/src/app.cpp:103-112`): there is no weight streaming —
+    every host loads its own shard of the weights through its sharded mesh.
+    """
+    if args.coordinator is None:
+        return 0
+    if args.num_hosts is None or args.host_id is None:
+        raise SystemExit("--coordinator requires --num-hosts and --host-id")
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=args.coordinator,
+        num_processes=args.num_hosts,
+        process_id=args.host_id,
+    )
+    return jax.process_index()
 
 
 def load_engine(args):
@@ -118,7 +152,12 @@ def load_engine(args):
     print(f"⏩ loaded weights in {time.time() - t0:.1f}s")
 
     tok = Tokenizer.from_file(args.tokenizer)
-    seed = args.seed if args.seed is not None else int(time.time())
+    if args.seed is not None:
+        seed = args.seed
+    elif jax.process_count() > 1:
+        seed = 0  # hosts must agree: per-host time seeds would diverge SPMD
+    else:
+        seed = int(time.time())
     sampler_cfg = SamplerConfig(temperature=args.temperature, topp=args.topp, seed=seed)
     cache_dtype = jnp.dtype(args.cache_dtype) if args.cache_dtype else jnp.dtype(args.dtype)
 
@@ -217,14 +256,50 @@ def run_chat(args) -> None:
             break
 
 
+def run_worker(args) -> None:
+    """SPMD participant for a multi-host run.
+
+    The reference's `dllama worker` binds a port, receives its weight slice,
+    and loops on broadcast positions (`/root/reference/src/apps/dllama/
+    dllama.cpp:180-193`). Under SPMD there is no asymmetric protocol: a
+    "worker" runs the SAME jitted program as the root over the shared mesh,
+    so this mode re-runs generate with output suppressed on non-zero hosts.
+    Launch every host with identical --model/--prompt/--steps/--seed and a
+    unique --host-id; host 0 is the one whose stdout you read.
+    """
+    if args.coordinator is None:
+        raise SystemExit("worker mode requires --coordinator/--num-hosts/--host-id")
+    import contextlib
+    import io
+
+    ctx = (
+        contextlib.redirect_stdout(io.StringIO())
+        if args.host_id != 0
+        else contextlib.nullcontext()
+    )
+    with ctx:
+        run_generate(args, show_stats=False)
+
+
 def main(argv=None) -> None:
+    # DLLAMA_PLATFORM=cpu|tpu forces the JAX backend via jax.config — unlike
+    # the JAX_PLATFORMS env var this works even when a sitecustomize has
+    # already imported jax and pinned a different platform
+    platform = os.environ.get("DLLAMA_PLATFORM")
+    if platform:
+        import jax
+
+        jax.config.update("jax_platforms", platform)
     args = build_parser().parse_args(argv)
+    maybe_init_distributed(args)
     if args.mode == "chat":
         run_chat(args)
     elif args.mode == "serve":
         from dllama_tpu.serving.api_server import serve
 
         serve(args)
+    elif args.mode == "worker":
+        run_worker(args)
     else:
         run_generate(args, show_stats=args.mode == "inference")
 

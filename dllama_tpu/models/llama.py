@@ -392,7 +392,12 @@ def forward(
 
 
 def forward_train(
-    cfg: ModelConfig, params: dict, tokens: jnp.ndarray, rope: dict = None
+    cfg: ModelConfig,
+    params: dict,
+    tokens: jnp.ndarray,
+    rope: dict = None,
+    mesh=None,
+    sp_axis: str = "sp",
 ) -> jnp.ndarray:
     """Batched cache-free causal forward: tokens [B, T] -> logits [B, T, vocab].
 
@@ -401,7 +406,16 @@ def forward_train(
     sequence, no cache) and for throughput-style prefill. Same math per
     position — the attention just runs against the in-flight K/V of the same
     sequence instead of a cache.
+
+    Long context: pass a ``mesh`` whose ``sp_axis`` has size > 1 and the
+    attention runs as ring attention (``ops.ring_attention``) — each device
+    keeps its sequence chunk of K/V, chunks rotate over ICI, per-device
+    memory stays O(T / n_sp). Everything else (QKV/FFN matmuls, scan over
+    layers) is unchanged; XLA keeps shardings the surrounding pjit chose.
+    The sequence axis of ``tokens`` must be sharded over ``sp_axis`` in ring
+    order (plain ``P(..., "sp")`` contiguous chunks).
     """
+    use_ring = mesh is not None and mesh.shape.get(sp_axis, 1) > 1
     B, T = tokens.shape
     x = params["embedding"][tokens].astype(cfg.jax_dtype)
     if cfg.embedding_scale != 1.0:
@@ -412,7 +426,20 @@ def forward_train(
     sin = rope_t["sin"][:T][None, :, None, :]
 
     group = cfg.n_heads // cfg.n_kv_heads
-    causal = jnp.tril(jnp.ones((T, T), bool))
+    causal = None if use_ring else jnp.tril(jnp.ones((T, T), bool))
+
+    def attend(q, k, v, x_dtype):
+        if use_ring:
+            from dllama_tpu.ops.ring_attention import ring_self_attention
+
+            return ring_self_attention(q, k, v, mesh, axis_name=sp_axis)
+        qf = q.astype(jnp.float32).reshape(B, T, cfg.n_kv_heads, group, cfg.head_size)
+        scores = jnp.einsum("btkgh,bskh->bkgts", qf, k.astype(jnp.float32))
+        scores = scores / jnp.sqrt(jnp.float32(cfg.head_size))
+        scores = jnp.where(causal[None, None, None], scores, jnp.float32(-1e30))
+        att = jax.nn.softmax(scores, axis=-1)
+        out = jnp.einsum("bkgts,bskh->btkgh", att, v.astype(jnp.float32))
+        return out.reshape(B, T, cfg.n_heads, cfg.head_size).astype(x_dtype)
 
     def layer_step(x, lp):
         xb = rmsnorm(x, lp["rms_att"], cfg.norm_eps)
@@ -422,13 +449,7 @@ def forward_train(
         q = apply_rope(q, cos, sin, cfg.rope_style)
         k = apply_rope(k, cos, sin, cfg.rope_style)
 
-        qf = q.astype(jnp.float32).reshape(B, T, cfg.n_kv_heads, group, cfg.head_size)
-        scores = jnp.einsum("btkgh,bskh->bkgts", qf, k.astype(jnp.float32))
-        scores = scores / jnp.sqrt(jnp.float32(cfg.head_size))
-        scores = jnp.where(causal[None, None, None], scores, jnp.float32(-1e30))
-        att = jax.nn.softmax(scores, axis=-1)
-        out = jnp.einsum("bkgts,bskh->btkgh", att, v.astype(jnp.float32))
-        out = out.reshape(B, T, cfg.dim).astype(x.dtype)
+        out = attend(q, k, v, x.dtype).reshape(B, T, cfg.dim)
         x = _ffn_residual(cfg, lp, x, out @ lp["wo"])
         return x, None
 

@@ -17,21 +17,37 @@ from dllama_tpu.models import llama
 from dllama_tpu.models.config import ModelConfig
 
 
-def lm_loss(cfg: ModelConfig, params: dict, tokens: jnp.ndarray, rope: dict = None) -> jnp.ndarray:
-    """Mean next-token cross-entropy over tokens [B, T]."""
-    logits = llama.forward_train(cfg, params, tokens[:, :-1], rope)
+def lm_loss(
+    cfg: ModelConfig,
+    params: dict,
+    tokens: jnp.ndarray,
+    rope: dict = None,
+    mesh=None,
+) -> jnp.ndarray:
+    """Mean next-token cross-entropy over tokens [B, T]. With a ``mesh``
+    whose ``sp`` axis is >1, the forward runs ring attention (sequence
+    sharded over ICI) — gradients flow through the ppermute ring.
+
+    The forward always sees the full T (ring attention needs T divisible by
+    the sp axis; slicing tokens to T-1 first would break that) and the last
+    position's logits are dropped from the loss instead."""
+    logits = llama.forward_train(cfg, params, tokens, rope, mesh=mesh)
     targets = tokens[:, 1:]
-    logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+    logp = jax.nn.log_softmax(logits[:, :-1].astype(jnp.float32))
     ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
     return -jnp.mean(ll)
 
 
-def make_train_step(cfg: ModelConfig, optimizer: optax.GradientTransformation):
+def make_train_step(
+    cfg: ModelConfig, optimizer: optax.GradientTransformation, mesh=None
+):
     """Returns jittable ``step(params, opt_state, tokens) -> (params, opt_state, loss)``."""
     rope = llama.rope_tables(cfg)  # precomputed once, closed over (replicated)
 
     def step(params, opt_state, tokens):
-        loss, grads = jax.value_and_grad(lambda p: lm_loss(cfg, p, tokens, rope))(params)
+        loss, grads = jax.value_and_grad(
+            lambda p: lm_loss(cfg, p, tokens, rope, mesh=mesh)
+        )(params)
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         return params, opt_state, loss

@@ -1,0 +1,58 @@
+// Minimal client for the OpenAI-compatible API server — the reference's
+// examples/chat-api-client.js analog. Works with `python -m dllama_tpu.cli
+// serve --model m.m --tokenizer t.t --port 9990`.
+//
+// Usage: node examples/chat-api-client.js [host] [port]
+
+const host = process.argv[2] || "127.0.0.1";
+const port = parseInt(process.argv[3] || "9990", 10);
+
+async function chat(messages, stream = false) {
+  const res = await fetch(`http://${host}:${port}/v1/chat/completions`, {
+    method: "POST",
+    headers: { "Content-Type": "application/json" },
+    body: JSON.stringify({
+      model: "dllama",
+      messages,
+      temperature: 0.7,
+      max_tokens: 128,
+      stream,
+    }),
+  });
+  if (!stream) {
+    const body = await res.json();
+    return body.choices[0].message.content;
+  }
+  // SSE: data: {...}\n\n, terminated by data: [DONE]
+  const reader = res.body.getReader();
+  const decoder = new TextDecoder();
+  let out = "";
+  for (;;) {
+    const { done, value } = await reader.read();
+    if (done) break;
+    for (const line of decoder.decode(value).split("\n")) {
+      if (!line.startsWith("data: ")) continue;
+      const payload = line.slice(6).trim();
+      if (payload === "[DONE]") return out;
+      const delta = JSON.parse(payload).choices[0].delta;
+      if (delta.content) {
+        process.stdout.write(delta.content);
+        out += delta.content;
+      }
+    }
+  }
+  return out;
+}
+
+(async () => {
+  const models = await (await fetch(`http://${host}:${port}/v1/models`)).json();
+  console.log("models:", models.data.map((m) => m.id).join(", "));
+  console.log("\n--- non-streaming ---");
+  console.log(await chat([{ role: "user", content: "Say hello in one word." }]));
+  console.log("\n--- streaming ---");
+  await chat([{ role: "user", content: "Count to five." }], true);
+  console.log();
+})().catch((e) => {
+  console.error(e);
+  process.exit(1);
+});

@@ -1,0 +1,27 @@
+#!/bin/bash
+# Multi-host launch recipe — the reference's root+workers bootstrap analog
+# (README "How to run": dllama worker on each node, then dllama inference
+# --workers on the root). Under SPMD there is no root/worker asymmetry:
+# EVERY host runs the same command with its own --host-id, and JAX forms one
+# mesh across all hosts' chips (collectives ride ICI within a slice, DCN
+# across slices).
+#
+# On host 0 (the "root" — its stdout is the one you read):
+#   python -m dllama_tpu.cli generate --model m.m --tokenizer t.t \
+#     --prompt "Hello" --steps 64 --seed 1 \
+#     --coordinator host0:8476 --num-hosts 2 --host-id 0
+#
+# On host 1..N-1 (the "workers"):
+#   python -m dllama_tpu.cli worker --model m.m --tokenizer t.t \
+#     --prompt "Hello" --steps 64 --seed 1 \
+#     --coordinator host0:8476 --num-hosts 2 --host-id 1
+#
+# Notes:
+# * --model/--prompt/--steps/--seed must be IDENTICAL everywhere (one SPMD
+#   program; a worker is just a host whose stdout is suppressed).
+# * --seed is required implicitly: hosts must agree (the CLI forces seed=0
+#   in multi-host runs when unset).
+# * Each host loads only its own weight shards — no host ever streams
+#   weights to another, unlike the reference's startup distribution
+#   (/root/reference/src/transformer.cpp:569-598).
+echo "This script documents the multi-host launch pattern; read its comments."

@@ -1,0 +1,31 @@
+#!/bin/bash
+# Tensor-parallel scaling sweep — the reference's examples/n-workers.sh analog.
+#
+# Where the reference boots N worker processes in screen sessions and wires
+# them over TCP (n-workers.sh:1-55), a TPU run is one process whose mesh
+# spans the chips: this sweep re-runs the same generate over tp=1,2,4,8 and
+# prints the per-token time for each. On a machine without a TPU slice it
+# uses 8 virtual CPU devices — same code path, same collectives.
+#
+# Usage: examples/n-chips.sh <model.m> <tokenizer.t> [prompt] [steps]
+set -e
+cd "$(dirname "$0")/.."
+
+MODEL=${1:?usage: n-chips.sh model.m tokenizer.t [prompt] [steps]}
+TOKENIZER=${2:?usage: n-chips.sh model.m tokenizer.t [prompt] [steps]}
+PROMPT=${3:-"Hello world"}
+STEPS=${4:-32}
+
+if [ -n "$DLLAMA_PLATFORM" ] || ! timeout 60 python -c 'import jax; assert jax.default_backend() == "tpu"' 2>/dev/null; then
+  export DLLAMA_PLATFORM=${DLLAMA_PLATFORM:-cpu}
+  export XLA_FLAGS="--xla_force_host_platform_device_count=8 ${XLA_FLAGS}"
+  echo "(no TPU detected: using 8 virtual CPU devices)"
+fi
+
+for TP in 1 2 4 8; do
+  echo "=== tp=${TP} ==="
+  python -m dllama_tpu.cli inference \
+    --model "$MODEL" --tokenizer "$TOKENIZER" \
+    --prompt "$PROMPT" --steps "$STEPS" --temperature 0 --tp "$TP" \
+    2>&1 | grep -E "Avg|tensor-parallel|Generated" || true
+done

@@ -167,7 +167,10 @@ int Generate(const Args& args) {
   Sampler sampler(args.temperature, args.topp, args.seed);
   std::vector<int> prompt_tokens = tok.Encode(args.prompt, /*add_bos=*/true);
   const int n_prompt = static_cast<int>(prompt_tokens.size());
-  const int total = std::min<int>(n_prompt + args.steps,
+  // sampling happens at positions n_prompt-1 .. total-1, one sampled token
+  // per position: total = n_prompt + steps - 1 emits exactly `steps` tokens
+  // (matching the Python engine's steps = generated-token count)
+  const int total = std::min<int>(n_prompt + std::max(args.steps - 1, 0),
                                   static_cast<int>(m.seq_len));
 
   std::vector<float> logits(static_cast<size_t>(m.vocab_size));
@@ -215,16 +218,18 @@ int Generate(const Args& args) {
                  static_cast<long long>(NowMs() - t0 - t_infer),
                  pos);
     token = next;
-    if (token == tok.eos_id()) break;
+    // stop only on a SAMPLED eos — a prompt may legitimately contain eos
+    // tokens (e.g. multi-turn chat transcripts with turn separators)
+    if (pos + 1 >= n_prompt && token == tok.eos_id()) break;
   }
 
   std::printf("\n");
   if (generated > 0) {
+    // sub-ms steps can leave the ms-granular total at 0; clamp for the rates
+    const double gen_ms = std::max<double>(gen_ms_total, 1.0);
     std::printf("Generated tokens:    %d\n", generated);
-    std::printf("Avg tokens / second: %.2f\n",
-                1000.0 * generated / static_cast<double>(gen_ms_total));
-    std::printf("Avg generation time: %.2f ms\n",
-                static_cast<double>(gen_ms_total) / generated);
+    std::printf("Avg tokens / second: %.2f\n", 1000.0 * generated / gen_ms);
+    std::printf("Avg generation time: %.2f ms\n", gen_ms / generated);
     std::printf("Avg inference time:  %.2f ms\n",
                 static_cast<double>(infer_ms_total) / generated);
   }

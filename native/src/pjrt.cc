@@ -123,15 +123,19 @@ void Buffer::ToHost(void* dst, size_t dst_size) const {
 
 Executable& Executable::operator=(Executable&& o) noexcept {
   if (this != &o) {
-    this->~Executable();
+    reset();
     api_ = o.api_;
     exec_ = o.exec_;
+    n_out_ = o.n_out_;
     o.exec_ = nullptr;
+    o.n_out_ = 0;
   }
   return *this;
 }
 
-Executable::~Executable() {
+Executable::~Executable() { reset(); }
+
+void Executable::reset() {
   if (exec_ != nullptr) {
     PJRT_LoadedExecutable_Destroy_Args d;
     std::memset(&d, 0, sizeof(d));
@@ -140,9 +144,11 @@ Executable::~Executable() {
     api_->PJRT_LoadedExecutable_Destroy(&d);
     exec_ = nullptr;
   }
+  n_out_ = 0;
 }
 
 size_t Executable::num_outputs() const {
+  if (n_out_ != 0) return n_out_;
   PJRT_LoadedExecutable_GetExecutable_Args g;
   std::memset(&g, 0, sizeof(g));
   g.struct_size = PJRT_LoadedExecutable_GetExecutable_Args_STRUCT_SIZE;
@@ -159,7 +165,8 @@ size_t Executable::num_outputs() const {
   d.executable = g.executable;
   api_->PJRT_Executable_Destroy(&d);
   Check(api_, err, "NumOutputs");
-  return n.num_outputs;
+  n_out_ = n.num_outputs;
+  return n_out_;
 }
 
 std::vector<Buffer> Executable::Execute(

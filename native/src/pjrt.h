@@ -77,7 +77,7 @@ class Executable {
   Executable(const Executable&) = delete;
   ~Executable();
 
-  size_t num_outputs() const;
+  size_t num_outputs() const;  // cached after the first call
   // Single-device synchronous execute. Donated inputs (per the program's
   // input/output aliasing, e.g. the KV cache) are consumed: their Buffer
   // handles are invalidated by the runtime even though we don't reset them —
@@ -86,8 +86,11 @@ class Executable {
   std::vector<Buffer> Execute(const std::vector<PJRT_Buffer*>& args);
 
  private:
+  void reset();
+
   const PJRT_Api* api_ = nullptr;
   PJRT_LoadedExecutable* exec_ = nullptr;
+  mutable size_t n_out_ = 0;  // 0 = not yet queried
 };
 
 // dlopen()s a PJRT plugin, owns the PJRT_Client.

@@ -171,3 +171,28 @@ def test_fused_decode_chunked_long_run():
     ref_eng, _ = make_engine(seq_len=128)
     ref = [t for t, _ in ref_eng.generate([1, 5, 9] + got + [7], steps=3)]
     assert cont == ref
+
+
+def test_cli_parser_worker_and_multihost_flags():
+    """CLI surface parity: worker mode + multi-host topology flags parse; a
+    coordinator without host identity is rejected (cli.maybe_init_distributed)."""
+    import pytest as _pytest
+
+    from dllama_tpu import cli
+
+    p = cli.build_parser()
+    args = p.parse_args(
+        ["worker", "--model", "m.m", "--tokenizer", "t.t",
+         "--coordinator", "h:1234", "--num-hosts", "2", "--host-id", "1"]
+    )
+    assert args.mode == "worker" and args.host_id == 1
+
+    incomplete = p.parse_args(
+        ["generate", "--model", "m.m", "--tokenizer", "t.t", "--coordinator", "h:1"]
+    )
+    with _pytest.raises(SystemExit):
+        cli.maybe_init_distributed(incomplete)
+
+    # no topology flags -> single host, no jax.distributed call
+    plain = p.parse_args(["generate", "--model", "m.m", "--tokenizer", "t.t"])
+    assert cli.maybe_init_distributed(plain) == 0
