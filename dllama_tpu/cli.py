@@ -60,6 +60,16 @@ def build_parser() -> argparse.ArgumentParser:
             "bf16/f16/f32 dequantize at load",
         )
         sp.add_argument("--nthreads", type=int, default=None, help=argparse.SUPPRESS)
+        if mode in ("inference", "generate"):
+            sp.add_argument(
+                "--profile",
+                default=None,
+                metavar="DIR",
+                help="write a jax.profiler trace of the run to DIR (the TPU "
+                "equivalent of the reference's I/T per-task timing split, "
+                "`/root/reference/src/utils.cpp:179-182` — open in XProf/"
+                "TensorBoard for per-op device timelines)",
+            )
         # multi-host topology (the reference's `--workers h:p ...` analog,
         # `/root/reference/src/app.cpp:60-80`): under SPMD every host runs the
         # SAME command with its own --host-id; JAX wires the hosts into one
@@ -182,22 +192,36 @@ def run_generate(args, show_stats: bool) -> None:
     tokens = tok.encode(prompt, add_bos=True)
     print(f"📄 prompt tokens: {len(tokens)}")
 
+    profile_dir = getattr(args, "profile", None)
+    if profile_dir:
+        import jax
+
+        jax.profiler.start_trace(profile_dir)
+
     gen_ms = []
     prev = tokens[-1]
     produced = list()
-    # incremental decode: multi-byte chars can span byte-fallback tokens
-    utf8 = codecs.getincrementaldecoder("utf-8")("replace")
-    for tok_id, stats in engine.generate(tokens, args.steps, stop_tokens=(tok.eos_id,)):
-        piece = tok.decode_piece(prev, tok_id)
-        sys.stdout.write(utf8.decode(piece))
-        sys.stdout.flush()
-        prev = tok_id
-        produced.append(tok_id)
-        gen_ms.append(stats.generation_ms)
-        if show_stats:
-            sys.stdout.write(f"  🔶 G {stats.generation_ms:7.2f} ms I {stats.inference_ms:7.2f} ms\n")
-    sys.stdout.write(utf8.decode(b"", True))  # dangling incomplete char -> U+FFFD
-    print()
+    try:
+        # incremental decode: multi-byte chars can span byte-fallback tokens
+        utf8 = codecs.getincrementaldecoder("utf-8")("replace")
+        for tok_id, stats in engine.generate(tokens, args.steps, stop_tokens=(tok.eos_id,)):
+            piece = tok.decode_piece(prev, tok_id)
+            sys.stdout.write(utf8.decode(piece))
+            sys.stdout.flush()
+            prev = tok_id
+            produced.append(tok_id)
+            gen_ms.append(stats.generation_ms)
+            if show_stats:
+                sys.stdout.write(f"  🔶 G {stats.generation_ms:7.2f} ms I {stats.inference_ms:7.2f} ms\n")
+        sys.stdout.write(utf8.decode(b"", True))  # dangling incomplete char -> U+FFFD
+        print()
+    finally:
+        # a failing/interrupted run is the one you most want the trace of
+        if profile_dir:
+            import jax
+
+            jax.profiler.stop_trace()
+            print(f"🔬 profiler trace written to {profile_dir}")
     if gen_ms:
         # skip the first token (prefill) in the average, like the reference
         # averages steady-state decode (`dllama.cpp:86-91`)

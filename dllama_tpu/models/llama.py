@@ -370,9 +370,7 @@ def forward(
     T==1 is the decode step; larger T is batched prefill (the reference feeds
     prompt tokens one at a time — batching them is the first TPU win).
     """
-    x = params["embedding"][tokens].astype(cfg.jax_dtype)
-    if cfg.embedding_scale != 1.0:
-        x = x * jnp.asarray(cfg.embedding_scale, cfg.jax_dtype)
+    x = embed(cfg, params, tokens)
 
     def layer_step(x, layer):
         lp, k_cache, v_cache = layer
@@ -416,44 +414,66 @@ def forward_train(
     order (plain ``P(..., "sp")`` contiguous chunks).
     """
     use_ring = mesh is not None and mesh.shape.get(sp_axis, 1) > 1
-    B, T = tokens.shape
-    x = params["embedding"][tokens].astype(cfg.jax_dtype)
-    if cfg.embedding_scale != 1.0:
-        x = x * jnp.asarray(cfg.embedding_scale, cfg.jax_dtype)
+    T = tokens.shape[1]
+    x = embed(cfg, params, tokens)
 
     rope_t = rope if rope is not None else rope_tables(cfg)
     cos = rope_t["cos"][:T][None, :, None, :]  # [1, T, 1, hs/2]
     sin = rope_t["sin"][:T][None, :, None, :]
 
+    ring = (mesh, sp_axis) if use_ring else None
+
+    def layer_step(x, lp):
+        return train_layer(cfg, lp, cos, sin, x, ring=ring), None
+
+    x, _ = jax.lax.scan(layer_step, x, params["layers"])
+    x = rmsnorm(x, params["rms_final"], cfg.norm_eps)
+    logits = (x @ params["wcls"]).astype(jnp.float32)
+    return logits * cfg.logit_scale if cfg.logit_scale != 1.0 else logits
+
+
+def embed(cfg: ModelConfig, params: dict, tokens: jnp.ndarray) -> jnp.ndarray:
+    """Token embedding lookup (+ Grok's input scale) in the compute dtype."""
+    x = params["embedding"][tokens].astype(cfg.jax_dtype)
+    if cfg.embedding_scale != 1.0:
+        x = x * jnp.asarray(cfg.embedding_scale, cfg.jax_dtype)
+    return x
+
+
+def train_layer(
+    cfg: ModelConfig,
+    lp: dict,
+    cos: jnp.ndarray,  # [1, T, 1, hs/2]
+    sin: jnp.ndarray,
+    x: jnp.ndarray,  # [B, T, dim]
+    ring=None,  # (mesh, sp_axis) -> ring attention over that axis
+) -> jnp.ndarray:
+    """One cache-free causal transformer layer (the batched-training twin of
+    the incremental ``_attn_block``/``_ffn_residual`` pair). Shared by the
+    ``forward_train`` layer scan and the pipeline-parallel stage body."""
+    B, T = x.shape[:2]
     group = cfg.n_heads // cfg.n_kv_heads
-    causal = None if use_ring else jnp.tril(jnp.ones((T, T), bool))
 
-    def attend(q, k, v, x_dtype):
-        if use_ring:
-            from dllama_tpu.ops.ring_attention import ring_self_attention
+    xb = rmsnorm(x, lp["rms_att"], cfg.norm_eps)
+    q = (xb @ lp["wq"]).reshape(B, T, cfg.n_heads, cfg.head_size)
+    k = (xb @ lp["wk"]).reshape(B, T, cfg.n_kv_heads, cfg.head_size)
+    v = (xb @ lp["wv"]).reshape(B, T, cfg.n_kv_heads, cfg.head_size)
+    q = apply_rope(q, cos, sin, cfg.rope_style)
+    k = apply_rope(k, cos, sin, cfg.rope_style)
 
-            return ring_self_attention(q, k, v, mesh, axis_name=sp_axis)
+    if ring is not None:
+        from dllama_tpu.ops.ring_attention import ring_self_attention
+
+        mesh, sp_axis = ring
+        out = ring_self_attention(q, k, v, mesh, axis_name=sp_axis)
+    else:
+        causal = jnp.tril(jnp.ones((T, T), bool))
         qf = q.astype(jnp.float32).reshape(B, T, cfg.n_kv_heads, group, cfg.head_size)
         scores = jnp.einsum("btkgh,bskh->bkgts", qf, k.astype(jnp.float32))
         scores = scores / jnp.sqrt(jnp.float32(cfg.head_size))
         scores = jnp.where(causal[None, None, None], scores, jnp.float32(-1e30))
         att = jax.nn.softmax(scores, axis=-1)
         out = jnp.einsum("bkgts,bskh->btkgh", att, v.astype(jnp.float32))
-        return out.reshape(B, T, cfg.n_heads, cfg.head_size).astype(x_dtype)
-
-    def layer_step(x, lp):
-        xb = rmsnorm(x, lp["rms_att"], cfg.norm_eps)
-        q = (xb @ lp["wq"]).reshape(B, T, cfg.n_heads, cfg.head_size)
-        k = (xb @ lp["wk"]).reshape(B, T, cfg.n_kv_heads, cfg.head_size)
-        v = (xb @ lp["wv"]).reshape(B, T, cfg.n_kv_heads, cfg.head_size)
-        q = apply_rope(q, cos, sin, cfg.rope_style)
-        k = apply_rope(k, cos, sin, cfg.rope_style)
-
-        out = attend(q, k, v, x.dtype).reshape(B, T, cfg.dim)
-        x = _ffn_residual(cfg, lp, x, out @ lp["wo"])
-        return x, None
-
-    x, _ = jax.lax.scan(layer_step, x, params["layers"])
-    x = rmsnorm(x, params["rms_final"], cfg.norm_eps)
-    logits = (x @ params["wcls"]).astype(jnp.float32)
-    return logits * cfg.logit_scale if cfg.logit_scale != 1.0 else logits
+        out = out.astype(x.dtype)
+    out = out.reshape(B, T, cfg.dim)
+    return _ffn_residual(cfg, lp, x, out @ lp["wo"])

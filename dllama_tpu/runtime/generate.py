@@ -230,10 +230,13 @@ class Engine:
                 self.final_session = Session(cache, pos, pending_token=None)
                 return
             tok_int = int(token)
+            # final_session is refreshed BEFORE every yield so a consumer that
+            # abandons the generator mid-stream (stop-string hit, client
+            # disconnect) still observes the state matching what it received
+            self.final_session = Session(cache, pos, pending_token=tok_int)
             yield tok_int, TokenStats(self.prefill_ms, self.prefill_ms)
             steps -= 1
             if tok_int in stop_tokens:
-                self.final_session = Session(cache, pos, pending_token=tok_int)
                 return
         for _ in range(max(steps, 0)):
             t1 = time.perf_counter()
@@ -243,6 +246,7 @@ class Engine:
             tok_int = int(token)  # syncs; includes device step time
             dt = (time.perf_counter() - t1) * 1000.0
             pos += 1
+            self.final_session = Session(cache, pos, pending_token=tok_int)
             yield tok_int, TokenStats(generation_ms=dt, inference_ms=dt)
             if tok_int in stop_tokens:
                 break

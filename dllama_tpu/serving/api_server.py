@@ -99,6 +99,38 @@ class ServerState:
         self.default_sampler = default_sampler
         self.default_seed = default_seed
         self.lock = threading.Lock()  # engine serves one request at a time
+        # prefix cache: the KV state + token history of the last completion.
+        # Multi-turn chats resend the whole conversation; when the new prompt
+        # extends the cached tokens, only the suffix is prefilled. The
+        # reference restarts pos=0 with no reuse every request
+        # (`/root/reference/src/apps/dllama-api/dllama-api.cpp:257`).
+        self._prefix_tokens: list = []
+        self._prefix_session = None
+
+    def take_prefix_session(self, prompt_tokens: list) -> tuple:
+        """Returns (session, tokens_to_feed). Claims (and clears) the cached
+        session when ``prompt_tokens`` extends the cached history; otherwise
+        (None, prompt_tokens) for a from-scratch prefill. Call under lock."""
+        session, cached = self._prefix_session, self._prefix_tokens
+        self._prefix_session, self._prefix_tokens = None, []
+        if (
+            session is not None
+            and 0 < len(cached) <= len(prompt_tokens)
+            and prompt_tokens[: len(cached)] == cached
+        ):
+            suffix = prompt_tokens[len(cached) :]
+            # the cached session's pending token is cached[-1] (fed on the
+            # next generate); an empty suffix with nothing pending would
+            # leave generate() with no input at all
+            if suffix or session.pending_token is not None:
+                return session, suffix
+        return None, prompt_tokens
+
+    def store_prefix_session(self, tokens: list, session) -> None:
+        """Cache the post-request state: ``tokens`` = every token fed or
+        sampled this request (the session's pending token last)."""
+        self._prefix_tokens = list(tokens)
+        self._prefix_session = session
 
     def build_prompt(self, messages: list) -> str:
         """Render a full conversation (the API is stateless: each request
@@ -251,10 +283,14 @@ class OpenAIHandler(BaseHTTPRequestHandler):
             eot = tok.piece_id(b"<|eot_id|>")
             if eot >= 0:
                 stop_ids += (eot,)
+            session, feed_tokens = st.take_prefix_session(prompt_tokens)
+            history = list(prompt_tokens)
             for tok_id, _stats in st.engine.generate(
-                prompt_tokens, max_tokens, stop_tokens=stop_ids, sampler=sampler
+                feed_tokens, max_tokens, session=session,
+                stop_tokens=stop_ids, sampler=sampler,
             ):
                 n_generated += 1
+                history.append(tok_id)
                 if tok_id in stop_ids:
                     finish_reason = "stop"
                     break
@@ -268,6 +304,7 @@ class OpenAIHandler(BaseHTTPRequestHandler):
                 if hit_stop:
                     finish_reason = "stop"
                     break
+            st.store_prefix_session(history, st.engine.final_session)
 
         if not detector.stopped:
             # flush text withheld as a possible stop-string prefix — on EOS or

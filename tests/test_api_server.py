@@ -211,3 +211,71 @@ def test_max_tokens_respected(server):
                       chat_body(max_tokens=3, stop=None))
     obj = json.loads(data)
     assert obj["usage"]["completion_tokens"] <= 3
+
+
+# ---------------------------------------------------------------------------
+# Prefix-cache (KV reuse across requests)
+# ---------------------------------------------------------------------------
+
+def test_take_prefix_session_logic():
+    from dllama_tpu.runtime.generate import Session
+
+    class _S(ServerState):
+        def __init__(self):  # no engine needed for the cache logic
+            self._prefix_tokens = []
+            self._prefix_session = None
+
+    st = _S()
+    sess = Session(cache={}, pos=3, pending_token=7)
+    st.store_prefix_session([1, 5, 6, 7], sess)
+
+    # extending prompt -> reuse, feed only the suffix
+    got, feed = st.take_prefix_session([1, 5, 6, 7, 9, 9])
+    assert got is sess and feed == [9, 9]
+    # cache is claimed (single-slot): a second take misses
+    got2, feed2 = st.take_prefix_session([1, 5, 6, 7, 9, 9])
+    assert got2 is None and feed2 == [1, 5, 6, 7, 9, 9]
+
+    # diverging prompt -> no reuse
+    st.store_prefix_session([1, 5, 6, 7], sess)
+    got3, feed3 = st.take_prefix_session([1, 5, 2])
+    assert got3 is None and feed3 == [1, 5, 2]
+
+    # identical prompt with a pending token -> reuse with empty suffix
+    st.store_prefix_session([1, 5, 6, 7], sess)
+    got4, feed4 = st.take_prefix_session([1, 5, 6, 7])
+    assert got4 is sess and feed4 == []
+
+    # identical prompt, nothing pending -> cannot resume (nothing to feed)
+    st.store_prefix_session([1, 5, 6], Session(cache={}, pos=3, pending_token=None))
+    got5, feed5 = st.take_prefix_session([1, 5, 6])
+    assert got5 is None and feed5 == [1, 5, 6]
+
+
+def test_multi_turn_prefix_reuse_matches_fresh(server):
+    """A second request that extends the conversation must produce the same
+    greedy completion whether or not the KV prefix cache is hit."""
+    first = [{"role": "user", "content": "hello world"}]
+    status, data = request(server, "POST", "/v1/chat/completions",
+                           chat_body(messages=first, max_tokens=4))
+    assert status == 200
+    reply = json.loads(data)["choices"][0]["message"]["content"]
+
+    followup = first + [
+        {"role": "assistant", "content": reply},
+        {"role": "user", "content": "hello the world"},
+    ]
+    # warm path: prefix cache was just populated by the first request
+    status, data = request(server, "POST", "/v1/chat/completions",
+                           chat_body(messages=followup, max_tokens=6))
+    assert status == 200
+    warm = json.loads(data)["choices"][0]["message"]["content"]
+
+    # cold path: an unrelated request evicts the cache, then repeat
+    request(server, "POST", "/v1/chat/completions",
+            chat_body(messages=[{"role": "user", "content": "the the the"}]))
+    status, data = request(server, "POST", "/v1/chat/completions",
+                           chat_body(messages=followup, max_tokens=6))
+    assert status == 200
+    cold = json.loads(data)["choices"][0]["message"]["content"]
+    assert warm == cold
