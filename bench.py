@@ -41,7 +41,42 @@ def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
-def run_decode_bench(cfg_dict: dict, bench_steps: int = 64) -> float:
+def _probe_quant_kernels(kind: str = "q40", timeout_s: int = 240) -> bool:
+    """Compile+run one tiny fused dequant-matmul in a subprocess.
+
+    MUST run before this process touches the backend (some TPU runtimes are
+    per-process exclusive — a child spawned after the parent holds the chip
+    could silently land on CPU and validate nothing). The child asserts it is
+    actually on TPU; any other platform, error, or hang returns False and the
+    bench falls back to dense bf16 — slower but it always finishes.
+    """
+    import subprocess
+    import sys as _sys
+
+    code = (
+        "import jax, jax.numpy as jnp\n"
+        "assert jax.default_backend() == 'tpu', jax.default_backend()\n"
+        "from dllama_tpu.ops import qmatmul\n"
+        f"qt = qmatmul.quantize_tensor(__import__('numpy').ones((128, 128), 'float32'), {kind!r})\n"
+        "y = qmatmul.matmul_any(jnp.ones((1, 128), jnp.bfloat16), qt)\n"
+        "jax.block_until_ready(y)\n"
+        "print('QPROBE_OK')\n"
+    )
+    try:
+        proc = subprocess.run(
+            [_sys.executable, "-c", code],
+            capture_output=True,
+            text=True,
+            timeout=timeout_s,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+        return proc.returncode == 0 and "QPROBE_OK" in proc.stdout
+    except subprocess.TimeoutExpired:
+        return False
+
+
+def run_decode_bench(cfg_dict: dict, bench_steps: int = 64, quant_ok: bool = False):
+    """Returns (best ms/token, weights_kind_used)."""
     import jax
     import jax.numpy as jnp
 
@@ -64,7 +99,8 @@ def run_decode_bench(cfg_dict: dict, bench_steps: int = 64) -> float:
     # (4x less weight traffic per token). BENCH_WEIGHTS=bf16|q80 overrides.
     # Off-TPU the Pallas kernels run in interpret mode (orders of magnitude
     # slower), and they don't partition under pjit — both cases force bf16.
-    default_weights = "q40" if jax.default_backend() == "tpu" else "bf16"
+    # quant_ok comes from the pre-backend-init subprocess probe in main().
+    default_weights = "q40" if jax.default_backend() == "tpu" and quant_ok else "bf16"
     weights = os.environ.get("BENCH_WEIGHTS", default_weights)
     if mesh is not None:
         weights = "bf16"
@@ -91,10 +127,24 @@ def run_decode_bench(cfg_dict: dict, bench_steps: int = 64) -> float:
         wall_ms = (time.perf_counter() - t1) * 1000.0
         times.append(wall_ms / bench_steps)
         log(f"rep {rep}: {wall_ms / bench_steps:.3f} ms/token ({bench_steps} tokens)")
-    return min(times)
+    return min(times), weights
 
 
 def main() -> None:
+    if os.environ.get("DLLAMA_PLATFORM"):
+        # same escape hatch as the CLI: force the backend via jax.config
+        # (works even when a sitecustomize pinned another platform)
+        import jax
+
+        jax.config.update("jax_platforms", os.environ["DLLAMA_PLATFORM"])
+
+    # IMPORTANT: probe before anything initializes this process's backend —
+    # a child spawned after the parent holds an exclusive TPU would silently
+    # land on CPU and validate nothing
+    quant_ok = "BENCH_WEIGHTS" in os.environ or _probe_quant_kernels()
+    if not quant_ok:
+        log("q40 kernel probe failed/timed out; bench will use bf16 weights")
+
     import jax
 
     platform = jax.devices()[0].platform
@@ -104,9 +154,9 @@ def main() -> None:
     else:
         name, cfg_dict = "llama2_7b", LLAMA2_7B
 
-    ms = None
+    ms = weights = None
     try:
-        ms = run_decode_bench(cfg_dict)
+        ms, weights = run_decode_bench(cfg_dict, quant_ok=quant_ok)
     except Exception as e:  # noqa: BLE001 — OOM etc.: fall back to the small shape
         if name != "llama2_7b":
             raise
@@ -119,7 +169,7 @@ def main() -> None:
         gc.collect()
         jax.clear_caches()
         name, cfg_dict = "tinyllama_1.1b", TINYLLAMA_1_1B
-        ms = run_decode_bench(cfg_dict)
+        ms, weights = run_decode_bench(cfg_dict, quant_ok=quant_ok)
 
     result = {
         "metric": f"{name}_decode_ms_per_token",
@@ -129,6 +179,7 @@ def main() -> None:
         # a ratio against a 1.1B run would be apples-to-oranges
         "vs_baseline": round(BASELINE_7B_SINGLE_NODE_MS / ms, 2) if name == "llama2_7b" else None,
         "baseline": "llama2-7b 1x GCP c3d-highcpu-30, 101.81 ms/token (reference README.md:88)",
+        "weights": weights,
         "platform": jax.devices()[0].device_kind,
         "n_devices": len(jax.devices()),
     }

@@ -141,6 +141,16 @@ def load_engine(args):
             wft = {blocks.Q40: "q40", blocks.Q80: "q80"}.get(
                 reader.spec.weights_float_type
             )
+        mesh = None
+        if n_tp > 1:
+            try:
+                from dllama_tpu.parallel.mesh import tp_mesh
+            except ImportError as e:
+                raise SystemExit(
+                    f"tensor-parallel engine unavailable ({e}); pass --tp 1"
+                ) from e
+
+            mesh = tp_mesh(n_tp)
         if wft in ("q40", "q80"):
             if cfg.is_moe or n_tp > 1:
                 raise SystemExit(
@@ -158,7 +168,14 @@ def load_engine(args):
                 "f16": jnp.float16,
                 "f32": jnp.float32,
             }.get(wft)
-            params = llama.params_from_reader(reader, cfg, dtype=dense_dtype)
+            if mesh is not None:
+                # stream tensors straight onto the mesh: peak host memory is
+                # one stacked tensor, never the whole model (the 70B case)
+                from dllama_tpu.parallel.sharding import sharded_params_from_reader
+
+                params = sharded_params_from_reader(reader, cfg, mesh, dtype=dense_dtype)
+            else:
+                params = llama.params_from_reader(reader, cfg, dtype=dense_dtype)
     print(f"⏩ loaded weights in {time.time() - t0:.1f}s")
 
     tok = Tokenizer.from_file(args.tokenizer)
@@ -171,14 +188,9 @@ def load_engine(args):
     sampler_cfg = SamplerConfig(temperature=args.temperature, topp=args.topp, seed=seed)
     cache_dtype = jnp.dtype(args.cache_dtype) if args.cache_dtype else jnp.dtype(args.dtype)
 
-    if n_tp > 1:
-        try:
-            from dllama_tpu.parallel.mesh import tp_mesh
-            from dllama_tpu.parallel.sharded_engine import ShardedEngine
-        except ImportError as e:
-            raise SystemExit(f"tensor-parallel engine unavailable ({e}); pass --tp 1") from e
+    if mesh is not None:
+        from dllama_tpu.parallel.sharded_engine import ShardedEngine
 
-        mesh = tp_mesh(n_tp)
         engine = ShardedEngine(cfg, params, mesh, sampler_cfg, cache_dtype=cache_dtype)
         print(f"🔗 tensor-parallel over {n_tp} devices (ICI mesh)")
     else:

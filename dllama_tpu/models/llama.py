@@ -36,38 +36,69 @@ from dllama_tpu.ops.rope import apply_rope, rope_table
 # Parameters
 # ---------------------------------------------------------------------------
 
-def params_from_reader(reader: WeightFileReader, cfg: ModelConfig, dtype=None) -> dict:
-    """Load `.m` tensors into the stacked-layer pytree (dense and MoE archs)."""
+def iter_param_tensors(reader: WeightFileReader, cfg: ModelConfig, dtype=None):
+    """Yield ``(path, array)`` pairs of the stacked-layer pytree, one tensor
+    at a time — ``path`` is ``("embedding",)`` / ``("layers", "wq")`` / etc.
+
+    The streaming unit is one *stacked* tensor (all layers of one matrix), so
+    peak host memory is one [L, in, out] array rather than the whole model —
+    the TPU analog of the reference's slice-streaming weight distribution
+    where no worker ever holds more than its share
+    (`/root/reference/src/transformer.cpp:569-598`). Exception: MoE expert
+    stacks stream as one [L, E, in, out] tensor per up/gate/down — all
+    experts of all layers at once (~1/3 of a Mixtral-class model on the
+    host); per-layer expert streaming is future work."""
     dtype = dtype or cfg.jax_dtype
-    p = {
-        "embedding": reader.read_tensor("token_embedding", np.float32),
-        "rms_final": reader.read_tensor("rms_final", np.float32),
-        "wcls": reader.read_tensor("wcls", dtype).T,
-    }
+    yield ("embedding",), reader.read_tensor("token_embedding", np.float32)
+    yield ("rms_final",), reader.read_tensor("rms_final", np.float32)
+    yield ("wcls",), reader.read_tensor("wcls", dtype).T
+
     mat_names = ["wq", "wk", "wv", "wo"] + ([] if cfg.is_moe else ["w1", "w2", "w3"])
     vec_names = ["rms_att", "rms_ffn"] + (["rms_moe", "rms_ffn2"] if cfg.post_norms else [])
-    layers: dict = {n: [] for n in mat_names + vec_names}
+    for n in mat_names:
+        yield ("layers", n), np.stack(
+            [reader.read_tensor(f"layers.{i}.{n}", dtype).T for i in range(cfg.n_layers)]
+        )  # [L, in, out]
     if cfg.is_moe:
-        for n in ("moe_router", "moe_up", "moe_gate", "moe_down"):
-            layers[n] = []
-    for i in range(cfg.n_layers):
-        pre = f"layers.{i}."
-        for n in mat_names:
-            layers[n].append(reader.read_tensor(pre + n, dtype).T)  # [in, out]
-        if cfg.is_moe:
-            layers["moe_router"].append(reader.read_tensor(pre + "moe_router", dtype).T)
-            for kind in ("up", "gate", "down"):
-                stacked = np.stack(
-                    [
-                        reader.read_tensor(pre + f"experts.{e}.{kind}", dtype).T
-                        for e in range(cfg.n_experts)
-                    ]
-                )  # [E, in, out]
-                layers[f"moe_{kind}"].append(stacked)
-        for n in vec_names:
-            layers[n].append(reader.read_tensor(pre + n, np.float32))
-    p["layers"] = {k: np.stack(v) for k, v in layers.items()}
+        yield ("layers", "moe_router"), np.stack(
+            [reader.read_tensor(f"layers.{i}.moe_router", dtype).T for i in range(cfg.n_layers)]
+        )
+        for kind in ("up", "gate", "down"):
+            yield ("layers", f"moe_{kind}"), np.stack(
+                [
+                    np.stack(
+                        [
+                            reader.read_tensor(f"layers.{i}.experts.{e}.{kind}", dtype).T
+                            for e in range(cfg.n_experts)
+                        ]
+                    )
+                    for i in range(cfg.n_layers)
+                ]
+            )  # [L, E, in, out]
+    for n in vec_names:
+        yield ("layers", n), np.stack(
+            [reader.read_tensor(f"layers.{i}.{n}", np.float32) for i in range(cfg.n_layers)]
+        )
+
+
+def assemble_params(pairs, transform=None) -> dict:
+    """Build the param pytree from ``iter_param_tensors`` pairs, applying
+    ``transform(path, arr)`` to each leaf (identity when None). The single
+    place that knows the path -> pytree mapping, shared by the full and the
+    streaming-sharded loaders."""
+    p: dict = {"layers": {}}
+    for path, arr in pairs:
+        leaf = transform(path, arr) if transform is not None else arr
+        if path[0] == "layers":
+            p["layers"][path[1]] = leaf
+        else:
+            p[path[0]] = leaf
     return p
+
+
+def params_from_reader(reader: WeightFileReader, cfg: ModelConfig, dtype=None) -> dict:
+    """Load `.m` tensors into the stacked-layer pytree (dense and MoE archs)."""
+    return assemble_params(iter_param_tensors(reader, cfg, dtype))
 
 
 #: per-layer matrices eligible for fused-quantized storage (dense archs; MoE

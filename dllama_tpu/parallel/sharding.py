@@ -97,11 +97,32 @@ def cache_spec() -> P:
 
 def shard_params(params: dict, mesh, cfg: ModelConfig) -> dict:
     """Place a host-side param pytree onto the mesh with TP shardings."""
+    specs = _checked_specs(cfg, mesh)
+    return jax.tree.map(
+        lambda x, spec: jax.device_put(x, NamedSharding(mesh, spec)), dict(params), specs
+    )
+
+
+def _checked_specs(cfg: ModelConfig, mesh) -> dict:
     check_tp_compatible(cfg, mesh.shape[TP])
     use_ep = cfg.is_moe and EP in mesh.axis_names and mesh.shape[EP] > 1
     if use_ep and cfg.n_experts % mesh.shape[EP] != 0:
         raise ValueError(f"ep={mesh.shape[EP]} must divide n_experts={cfg.n_experts}")
-    specs = param_specs(cfg, mesh.shape[TP], use_ep)
-    return jax.tree.map(
-        lambda x, spec: jax.device_put(x, NamedSharding(mesh, spec)), dict(params), specs
-    )
+    return param_specs(cfg, mesh.shape[TP], use_ep)
+
+
+def sharded_params_from_reader(reader, cfg: ModelConfig, mesh, dtype=None) -> dict:
+    """Stream `.m` tensors straight onto the mesh, one stacked tensor at a
+    time — peak host memory is a single [L, in, out] array, never the whole
+    model (how a 70B checkpoint loads without a 140GB host). Equivalent to
+    ``shard_params(params_from_reader(...))`` (tested), minus the full host
+    materialization."""
+    from dllama_tpu.models.llama import assemble_params, iter_param_tensors
+
+    specs = _checked_specs(cfg, mesh)
+
+    def place(path, arr):
+        spec = specs[path[0]] if len(path) == 1 else specs["layers"][path[1]]
+        return jax.device_put(arr, NamedSharding(mesh, spec))
+
+    return assemble_params(iter_param_tensors(reader, cfg, dtype), transform=place)

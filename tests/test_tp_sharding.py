@@ -108,3 +108,44 @@ def test_sharded_decode_step_emits_collectives():
     # and the weights really live sharded: 1/8th per device
     shapes = {s.data.shape for s in eng.params["layers"]["wq"].addressable_shards}
     assert shapes == {(cfg.n_layers, cfg.dim, cfg.dim // 8)}
+
+
+def test_streaming_sharded_load_matches_full_load(tmp_path):
+    """sharded_params_from_reader (per-tensor streaming onto the mesh) must
+    produce the exact pytree of shard_params(params_from_reader(...))."""
+    import numpy as np
+
+    from dllama_tpu.formats.spec import ArchType, ModelSpec
+    from dllama_tpu.formats.weights import tensor_plan, write_model
+    from dllama_tpu.formats.weights import WeightFileReader
+    from dllama_tpu.models import llama
+    from dllama_tpu.models.config import ModelConfig
+    from dllama_tpu.parallel.mesh import tp_mesh
+    from dllama_tpu.parallel.sharding import shard_params, sharded_params_from_reader
+    from dllama_tpu.quants import blocks
+
+    spec = ModelSpec(
+        arch=ArchType.LLAMA, dim=64, hidden_dim=96, n_layers=2, n_heads=4,
+        n_kv_heads=4, vocab_size=96, seq_len=32, weights_float_type=blocks.F32,
+    )
+    rng = np.random.default_rng(8)
+    path = str(tmp_path / "m.m")
+    write_model(path, spec, {
+        e.name: 0.05 * rng.standard_normal(e.d * e.n).astype(np.float32)
+        for e in tensor_plan(spec)
+    })
+
+    mesh = tp_mesh(4)
+    with WeightFileReader(path) as r:
+        cfg = ModelConfig.from_spec(r.spec, dtype="float32")
+        streamed = sharded_params_from_reader(r, cfg, mesh)
+    with WeightFileReader(path) as r:
+        full = shard_params(llama.params_from_reader(r, cfg), mesh, cfg)
+
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a), np.asarray(b)),
+        streamed, full,
+    )
+    # and the shardings themselves agree
+    jax.tree.map(lambda a, b: (a.sharding == b.sharding) or (_ for _ in ()).throw(
+        AssertionError((a.sharding, b.sharding))), streamed, full)
