@@ -193,17 +193,24 @@ def device_random_quant_params(cfg: ModelConfig, kind: str = "q40", seed: int = 
     ks = iter(jax.random.split(key, 32))
 
     def qrand(K_, O_, prefix=(L,)):
-        """Random QuantTensor, shape prefix () for unstacked (wcls)."""
+        """Random QuantTensor, shape prefix () for unstacked (wcls). The
+        packed K is padded like pack_q40/pack_q80 (random pad bits are fine:
+        padded activation rows are zero, so the pad contributes nothing)."""
+        from dllama_tpu.ops.qmatmul import K_MULTIPLE, _pad_up
+
+        kp = _pad_up(K_, K_MULTIPLE[kind])
         if kind == "q40":
             w = jax.random.randint(
-                next(ks), (*prefix, K_ // 2, O_), 0, 256, jnp.int32
+                next(ks), (*prefix, kp // 2, O_), 0, 256, jnp.int32
             ).astype(jnp.uint8)
-            s = jax.random.uniform(next(ks), (*prefix, K_ // 64, O_), jnp.float32) * 0.004
-            s2 = jax.random.uniform(next(ks), (*prefix, K_ // 64, O_), jnp.float32) * 0.004
-            return QuantTensor(w=w, s=s, s2=s2, kind="q40")
-        w = jax.random.randint(next(ks), (*prefix, K_, O_), -127, 128, jnp.int8)
-        s = jax.random.uniform(next(ks), (*prefix, K_ // 32, O_), jnp.float32) * 0.0003
-        return QuantTensor(w=w, s=s, s2=jnp.zeros((*prefix, 0), jnp.float32), kind="q80")
+            s = jax.random.uniform(next(ks), (*prefix, kp // 64, O_), jnp.float32) * 0.004
+            s2 = jax.random.uniform(next(ks), (*prefix, kp // 64, O_), jnp.float32) * 0.004
+            return QuantTensor(w=w, s=s, s2=s2, kind="q40", k_logical=K_)
+        w = jax.random.randint(next(ks), (*prefix, kp, O_), -127, 128, jnp.int8)
+        s = jax.random.uniform(next(ks), (*prefix, kp // 32, O_), jnp.float32) * 0.0003
+        return QuantTensor(
+            w=w, s=s, s2=jnp.zeros((*prefix, 0), jnp.float32), kind="q80", k_logical=K_
+        )
 
     layers = {
         "wq": qrand(D, D),

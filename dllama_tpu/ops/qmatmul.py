@@ -55,6 +55,21 @@ def _pick_tile(n: int, candidates: tuple[int, ...]) -> int:
     return n
 
 
+#: input-dim padding unit per kind. Mosaic requires the second-to-minor dim of
+#: every block to be a multiple of 8 sublanes; the q40 scale planes have one
+#: row per 64 input rows (8 * 64 = 512) and the q80 plane one per 32
+#: (8 * 32 = 256). Packing pads K up to this, with zero scales in the pad
+#: region and zero-padded activation rows at call time, so the padding
+#: contributes exactly 0 to every dot product. Without this, shapes like
+#: Llama-2-7B's hidden 11008 (divisible by 256, not 512) force a (4, bo)
+#: scale block and crash Mosaic — the round-2 bench failure.
+K_MULTIPLE = {"q40": 512, "q80": 256}
+
+
+def _pad_up(n: int, multiple: int) -> int:
+    return (n + multiple - 1) // multiple * multiple
+
+
 def _pad_rows(x: jnp.ndarray, multiple: int = 8) -> tuple[jnp.ndarray, int]:
     """Pad the leading (token) dim up to a sublane multiple."""
     t = x.shape[0]
@@ -62,6 +77,33 @@ def _pad_rows(x: jnp.ndarray, multiple: int = 8) -> tuple[jnp.ndarray, int]:
     if tp != t:
         x = jnp.pad(x, ((0, tp - t), (0, 0)))
     return x, t
+
+
+def _pad_cols(x: jnp.ndarray, k_padded: int) -> jnp.ndarray:
+    """Zero-pad the input-feature dim of activations up to the packed K."""
+    if x.shape[1] != k_padded:
+        x = jnp.pad(x, ((0, 0), (0, k_padded - x.shape[1])))
+    return x
+
+
+def tile_plan(kind: str, k_padded: int, out_features: int) -> tuple[int, int]:
+    """The (bk, bo) grid block sizes the kernels use for a packed matrix.
+
+    Invariant (asserted by tests/test_qmatmul.py over the real model shapes):
+    every operand block satisfies Mosaic's (8, 128) tiling — in particular the
+    scale planes, whose sublane count is bk/64 (q40) or bk/32 (q80)."""
+    if k_padded % K_MULTIPLE[kind] != 0:
+        raise ValueError(
+            f"{kind} packed input dim {k_padded} is not a multiple of "
+            f"{K_MULTIPLE[kind]} — build QuantTensors via pack_q40/pack_q80, "
+            "which pad K so every Mosaic block satisfies (8, 128) tiling"
+        )
+    if kind == "q40":
+        bk = _pick_tile(k_padded, (1024, 512))
+    else:
+        bk = _pick_tile(k_padded, (512, 256))
+    bo = _pick_tile(out_features, (1024, 512, 256, 128))
+    return bk, bo
 
 
 # ---------------------------------------------------------------------------
@@ -94,11 +136,10 @@ def q80_matmul(x: jnp.ndarray, w: jnp.ndarray, scales: jnp.ndarray,
 
     if interpret is None:
         interpret = _interpret_default()
-    K, O = w.shape
-    xp, t = _pad_rows(x.astype(jnp.bfloat16))
+    K, O = w.shape  # K is the *packed* (padded) input dim
+    xp, t = _pad_rows(_pad_cols(x.astype(jnp.bfloat16), K))
     T = xp.shape[0]
-    bk = _pick_tile(K, (512, 256, 128, 64, 32))
-    bo = _pick_tile(O, (1024, 512, 256, 128))
+    bk, bo = tile_plan("q80", K, O)
     out = pl.pallas_call(
         functools.partial(_q80_kernel, acc_dtype=jnp.float32),
         grid=(O // bo, K // bk),
@@ -155,15 +196,14 @@ def q40_matmul(x: jnp.ndarray, packed: jnp.ndarray, s_lo: jnp.ndarray,
     if interpret is None:
         interpret = _interpret_default()
     O = packed.shape[1]
-    K = packed.shape[0] * 2
-    xp, t = _pad_rows(x.astype(jnp.bfloat16))
+    K = packed.shape[0] * 2  # the *packed* (padded) input dim
+    xp, t = _pad_rows(_pad_cols(x.astype(jnp.bfloat16), K))
     T = xp.shape[0]
     # split activations into the lo/hi 32-row halves of each 64-row superblock
     xr = xp.reshape(T, K // 64, 64)
     x_lo = xr[:, :, :QK].reshape(T, K // 2)
     x_hi = xr[:, :, QK:].reshape(T, K // 2)
-    bk = _pick_tile(K, (512, 256, 128, 64))
-    bo = _pick_tile(O, (1024, 512, 256, 128))
+    bk, bo = tile_plan("q40", K, O)
     out = pl.pallas_call(
         functools.partial(_q40_kernel, acc_dtype=jnp.float32),
         grid=(O // bo, K // bk),
@@ -197,16 +237,25 @@ class QuantTensor:
     uint8 plane and ``s2`` the second (odd-block) scale plane; for q80, ``w``
     is int8 and ``s2`` is an empty placeholder (pytree leaves must be arrays).
     Works stacked: a leading layer axis on every field makes it scannable.
+
+    ``k_logical`` is the pre-padding input dim (0 = no padding; see
+    ``K_MULTIPLE``). The padded tail rows multiply zero-padded activation
+    rows, so every matmul result is exact for the logical shape.
     """
 
     w: jnp.ndarray
     s: jnp.ndarray
     s2: jnp.ndarray
     kind: str = field(metadata=dict(static=True), default="q40")
+    k_logical: int = field(metadata=dict(static=True), default=0)
+
+    @property
+    def k_padded(self) -> int:
+        return self.w.shape[-2] * (2 if self.kind == "q40" else 1)
 
     @property
     def in_features(self) -> int:
-        return self.w.shape[-2] * (2 if self.kind == "q40" else 1)
+        return self.k_logical or self.k_padded
 
     @property
     def out_features(self) -> int:
@@ -238,27 +287,46 @@ def matmul_any(x: jnp.ndarray, w) -> jnp.ndarray:
 
 def pack_q40(quants: np.ndarray, deltas: np.ndarray) -> QuantTensor:
     """Build the kernel layout from unpacked quants ``int [K, O]`` in -8..7
-    and per-block deltas ``[K/32, O]`` (block = 32 consecutive input rows)."""
+    and per-block deltas ``[K/32, O]`` (block = 32 consecutive input rows).
+    K is padded up to ``K_MULTIPLE['q40']`` (zero quants + zero scales) so the
+    kernel's scale-plane blocks always satisfy Mosaic's 8-sublane tiling."""
     K, O = quants.shape
     assert K % 64 == 0, f"q40 kernel needs in_features % 64 == 0, got {K}"
+    kp = _pad_up(K, K_MULTIPLE["q40"])
+    if kp != K:
+        quants = np.concatenate(
+            [quants, np.zeros((kp - K, O), quants.dtype)], axis=0
+        )
+        deltas = np.concatenate(
+            [deltas, np.zeros(((kp - K) // QK, O), np.float32)], axis=0
+        )
     u = (quants.astype(np.int16) + 8).astype(np.uint8)
-    ur = u.reshape(K // 64, 2, QK, O)
-    packed = (ur[:, 0] | (ur[:, 1] << 4)).reshape(K // 2, O)
-    d = deltas.astype(np.float32).reshape(K // 64, 2, O)
+    ur = u.reshape(kp // 64, 2, QK, O)
+    packed = (ur[:, 0] | (ur[:, 1] << 4)).reshape(kp // 2, O)
+    d = deltas.astype(np.float32).reshape(kp // 64, 2, O)
     return QuantTensor(
         w=jnp.asarray(packed), s=jnp.asarray(d[:, 0].copy()),
-        s2=jnp.asarray(d[:, 1].copy()), kind="q40",
+        s2=jnp.asarray(d[:, 1].copy()), kind="q40", k_logical=K,
     )
 
 
 def pack_q80(quants: np.ndarray, deltas: np.ndarray) -> QuantTensor:
-    """int8 quants [K, O] + per-block deltas [K/32, O] -> kernel layout."""
+    """int8 quants [K, O] + per-block deltas [K/32, O] -> kernel layout.
+    K is padded up to ``K_MULTIPLE['q80']`` like ``pack_q40``."""
     K, O = quants.shape
     assert K % QK == 0
+    kp = _pad_up(K, K_MULTIPLE["q80"])
+    if kp != K:
+        quants = np.concatenate(
+            [quants, np.zeros((kp - K, O), quants.dtype)], axis=0
+        )
+        deltas = np.concatenate(
+            [deltas, np.zeros(((kp - K) // QK, O), np.float32)], axis=0
+        )
     return QuantTensor(
         w=jnp.asarray(quants.astype(np.int8)),
         s=jnp.asarray(deltas.astype(np.float32)),
-        s2=jnp.zeros((0,), jnp.float32), kind="q80",
+        s2=jnp.zeros((0,), jnp.float32), kind="q80", k_logical=K,
     )
 
 
@@ -298,19 +366,22 @@ def repack_q80(raw: np.ndarray, d: int, n: int) -> QuantTensor:
 
 
 def dequantize(qt: QuantTensor) -> np.ndarray:
-    """QuantTensor -> dense f32 [K, O] (reference semantics, for tests)."""
+    """QuantTensor -> dense f32 [K, O] at the *logical* K (padding stripped;
+    reference semantics, for tests)."""
     if qt.kind == "q80":
         q = np.asarray(qt.w, np.float32)
         s = np.repeat(np.asarray(qt.s, np.float32), QK, axis=-2)
-        return q * s
-    pk = np.asarray(qt.w)
-    half, O = pk.shape[-2:]
-    lo = (pk & 0xF).astype(np.float32) - 8.0
-    hi = ((pk >> 4) & 0xF).astype(np.float32) - 8.0
-    s_lo = np.repeat(np.asarray(qt.s, np.float32), QK, axis=-2)
-    s_hi = np.repeat(np.asarray(qt.s2, np.float32), QK, axis=-2)
-    dq_lo = (lo * s_lo).reshape(*pk.shape[:-2], half // QK, QK, O)
-    dq_hi = (hi * s_hi).reshape(*pk.shape[:-2], half // QK, QK, O)
-    return np.concatenate([dq_lo, dq_hi], axis=-2).reshape(
-        *pk.shape[:-2], half * 2, O
-    )
+        dense = q * s
+    else:
+        pk = np.asarray(qt.w)
+        half, O = pk.shape[-2:]
+        lo = (pk & 0xF).astype(np.float32) - 8.0
+        hi = ((pk >> 4) & 0xF).astype(np.float32) - 8.0
+        s_lo = np.repeat(np.asarray(qt.s, np.float32), QK, axis=-2)
+        s_hi = np.repeat(np.asarray(qt.s2, np.float32), QK, axis=-2)
+        dq_lo = (lo * s_lo).reshape(*pk.shape[:-2], half // QK, QK, O)
+        dq_hi = (hi * s_hi).reshape(*pk.shape[:-2], half // QK, QK, O)
+        dense = np.concatenate([dq_lo, dq_hi], axis=-2).reshape(
+            *pk.shape[:-2], half * 2, O
+        )
+    return dense[..., : qt.in_features, :]

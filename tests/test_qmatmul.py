@@ -95,6 +95,52 @@ def test_quant_tensor_is_scannable():
     np.testing.assert_allclose(np.asarray(out), np.asarray(want), rtol=1e-5, atol=1e-5)
 
 
+# the K (input-feature) dims of every published model the bench/CLI loads:
+# Llama-2-7B dim/hidden (4096/11008 — 11008 is the round-2 Mosaic crash),
+# TinyLlama (2048/5632), Llama-3-8B hidden (14336), Llama-2-13B (5120/13824)
+REAL_MODEL_KS = [2048, 4096, 5120, 5632, 11008, 13824, 14336]
+
+
+@pytest.mark.parametrize("kind", ["q40", "q80"])
+@pytest.mark.parametrize("k", REAL_MODEL_KS)
+def test_tile_plan_satisfies_mosaic_tiling(kind, k):
+    """Every block the kernels feed Mosaic must satisfy (8, 128) tiling for
+    every real model shape — the guard the round-2 bench crash showed was
+    missing (block shape (4, 1024) for the 7B scale plane, qmatmul.py)."""
+    kp = qmatmul._pad_up(k, qmatmul.K_MULTIPLE[kind])
+    for o in (4096, 11008, 32000, 128256):
+        bk, bo = qmatmul.tile_plan(kind, kp, o)
+        assert kp % bk == 0 and o % bo == 0
+        assert bo % 128 == 0
+        # activation / packed-weight blocks
+        if kind == "q40":
+            assert (bk // 2) % 8 == 0
+            scale_rows = bk // 64
+        else:
+            assert bk % 8 == 0
+            scale_rows = bk // qmatmul.QK
+        # the scale-plane block: the round-2 failure mode
+        assert scale_rows % 8 == 0, (kind, k, bk, scale_rows)
+
+
+@pytest.mark.parametrize("kind", ["q40", "q80"])
+@pytest.mark.parametrize("k", [192, 11008])
+def test_kernel_exact_on_padded_k(kind, k):
+    """K dims that need padding (192 < one tile; 11008 % 512 != 0) must still
+    produce the exact logical-shape result."""
+    O = 128
+    w = _rand((k, O), seed=8, scale=0.05)
+    x = jnp.asarray(_rand((2, k), seed=9))
+    qt = qmatmul.quantize_tensor(w, kind)
+    assert qt.in_features == k
+    assert qt.k_padded % qmatmul.K_MULTIPLE[kind] == 0
+    out = qmatmul.qmatmul(x, qt)
+    assert out.shape == (2, O)
+    ref = np.asarray(x, np.float32) @ qmatmul.dequantize(qt)
+    err = np.abs(np.asarray(out, np.float32) - ref).max()
+    assert err <= 0.02 * np.abs(ref).max() + 1e-4, err
+
+
 def test_matmul_any_dispatch():
     x = jnp.asarray(_rand((2, 64), seed=6))
     w = jnp.asarray(_rand((64, 128), seed=7))
