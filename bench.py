@@ -96,17 +96,17 @@ def run_decode_bench(cfg_dict: dict, bench_steps: int = 64, quant_ok: bool = Fal
 
     # Q40 weights by default on TPU: the baseline numbers are Q40xQ80 runs,
     # and the fused dequant-matmul kernels keep 4-bit weights resident in HBM
-    # (4x less weight traffic per token). BENCH_WEIGHTS=bf16|q80 overrides.
-    # Off-TPU the Pallas kernels run in interpret mode (orders of magnitude
-    # slower), and they don't partition under pjit — both cases force bf16.
+    # (4x less weight traffic per token) — including under TP, where the
+    # quant planes shard over the mesh (parallel.quant_tp), the reference's
+    # production Q40-on-every-node configuration. BENCH_WEIGHTS=bf16|q80
+    # overrides. Off-TPU the Pallas kernels run in interpret mode (orders of
+    # magnitude slower), so bf16 is the default there.
     # quant_ok comes from the pre-backend-init subprocess probe in main().
     default_weights = "q40" if jax.default_backend() == "tpu" and quant_ok else "bf16"
     weights = os.environ.get("BENCH_WEIGHTS", default_weights)
-    if mesh is not None:
-        weights = "bf16"
     log(f"building params on device: dim={cfg.dim} layers={cfg.n_layers} ({weights})")
-    # with a mesh, params are written directly into their shards — no chip
-    # ever holds the full model
+    # with a mesh, dense params are written directly into their shards — no
+    # chip ever holds the full model
     if weights in ("q40", "q80"):
         params = llama.device_random_quant_params(cfg, kind=weights, seed=0)
     else:
@@ -155,12 +155,14 @@ def main() -> None:
         name, cfg_dict = "llama2_7b", LLAMA2_7B
 
     ms = weights = None
+    fallback_reason = None
     try:
         ms, weights = run_decode_bench(cfg_dict, quant_ok=quant_ok)
     except Exception as e:  # noqa: BLE001 — OOM etc.: fall back to the small shape
         if name != "llama2_7b":
             raise
-        log(f"7B bench failed ({type(e).__name__}: {e}); falling back to TinyLlama shape")
+        fallback_reason = f"{type(e).__name__}: {e}"
+        log(f"7B bench failed ({fallback_reason}); falling back to TinyLlama shape")
     if ms is None:
         # run the fallback OUTSIDE the except block: the live traceback would
         # pin the 7B device buffers and re-OOM the fallback
@@ -183,6 +185,9 @@ def main() -> None:
         "platform": jax.devices()[0].device_kind,
         "n_devices": len(jax.devices()),
     }
+    if fallback_reason is not None:
+        # a fallback number must never read as a green headline run
+        result["error"] = f"7B CONFIG FAILED, fallback metric only: {fallback_reason}"
     print(json.dumps(result), flush=True)
 
 

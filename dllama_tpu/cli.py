@@ -128,12 +128,7 @@ def load_engine(args):
         print(f"💡 nHeads: {cfg.n_heads}  nKvHeads: {cfg.n_kv_heads}")
         print(f"💡 vocabSize: {cfg.vocab_size}  seqLen: {cfg.seq_len}")
         wft = args.weights_float_type
-        if (
-            wft is None
-            and not cfg.is_moe
-            and n_tp == 1
-            and jax.default_backend() == "tpu"
-        ):
+        if wft is None and not cfg.is_moe and jax.default_backend() == "tpu":
             # default to the file's own quantized format: the fused Pallas
             # kernels read 4x fewer HBM bytes/token than bf16 weights. Only
             # on TPU — elsewhere the kernels run in (slow) interpret mode, so
@@ -152,13 +147,13 @@ def load_engine(args):
 
             mesh = tp_mesh(n_tp)
         if wft in ("q40", "q80"):
-            if cfg.is_moe or n_tp > 1:
+            if cfg.is_moe:
                 raise SystemExit(
                     "--weights-float-type q40/q80 currently requires a dense "
-                    "arch and --tp 1 (quantized kernels + tensor-parallel is "
-                    "on the roadmap)"
+                    "arch (quantized MoE expert stacks are on the roadmap)"
                 )
-            print(f"🧮 weights resident as {wft} (fused dequant-matmul kernels)")
+            tp_note = f" x tp={n_tp} (shard_map)" if n_tp > 1 else ""
+            print(f"🧮 weights resident as {wft} (fused dequant-matmul kernels){tp_note}")
             params = llama.quant_params_from_reader(reader, cfg, wft)
         else:
             # bf16/f16/f32 request a dense on-device dtype for the weights
@@ -188,13 +183,9 @@ def load_engine(args):
     sampler_cfg = SamplerConfig(temperature=args.temperature, topp=args.topp, seed=seed)
     cache_dtype = jnp.dtype(args.cache_dtype) if args.cache_dtype else jnp.dtype(args.dtype)
 
+    engine = Engine(cfg, params, sampler_cfg, cache_dtype=cache_dtype, mesh=mesh)
     if mesh is not None:
-        from dllama_tpu.parallel.sharded_engine import ShardedEngine
-
-        engine = ShardedEngine(cfg, params, mesh, sampler_cfg, cache_dtype=cache_dtype)
         print(f"🔗 tensor-parallel over {n_tp} devices (ICI mesh)")
-    else:
-        engine = Engine(cfg, params, sampler_cfg, cache_dtype=cache_dtype)
     return engine, tok, cfg
 
 

@@ -101,17 +101,19 @@ def params_from_reader(reader: WeightFileReader, cfg: ModelConfig, dtype=None) -
     return assemble_params(iter_param_tensors(reader, cfg, dtype))
 
 
-#: per-layer matrices eligible for fused-quantized storage (dense archs; MoE
-#: expert stacks keep the dense einsum path — see models.moe docstring)
+#: per-layer matrices eligible for fused-quantized storage
 QUANTIZABLE = ("wq", "wk", "wv", "wo", "w1", "w2", "w3")
+#: expert stacks [L, E, in, out] eligible for fused-quantized storage
+MOE_QUANTIZABLE = ("moe_up", "moe_gate", "moe_down")
 
 
 def quantize_params(params: dict, kind: str, quantize_wcls: bool = True) -> dict:
     """Convert dense layer matrices (and wcls) into stacked ``QuantTensor``s
-    for the fused dequant-matmul kernels (ops.qmatmul). Embedding and norms
-    stay dense f32 — same split as the reference, which keeps rms weights and
-    the embedding table F32 whatever the weight type
-    (`/root/reference/converter/convert-llama.py:78-84`)."""
+    for the fused dequant-matmul kernels (ops.qmatmul). Embedding, norms and
+    the MoE router stay dense f32 — same split as the reference, which keeps
+    rms weights and the embedding table F32 whatever the weight type
+    (`/root/reference/converter/convert-llama.py:78-84`; router logits are F32
+    at `/root/reference/src/grok1-tasks.cpp:56-60`)."""
     out = dict(params)
     out["layers"] = dict(params["layers"])
     for name in QUANTIZABLE:
@@ -122,6 +124,20 @@ def quantize_params(params: dict, kind: str, quantize_wcls: bool = True) -> dict
         )  # [L, in, out]
         qts = [quantize_tensor(stacked[i], kind) for i in range(stacked.shape[0])]
         out["layers"][name] = jax.tree.map(lambda *xs: jnp.stack(xs), *qts)
+    for name in MOE_QUANTIZABLE:
+        if name not in out["layers"]:
+            continue
+        stacked = np.asarray(
+            jax.device_get(out["layers"][name]), np.float32
+        )  # [L, E, in, out]
+        per_layer = [
+            jax.tree.map(
+                lambda *xs: jnp.stack(xs),
+                *[quantize_tensor(stacked[l, e], kind) for e in range(stacked.shape[1])],
+            )
+            for l in range(stacked.shape[0])
+        ]
+        out["layers"][name] = jax.tree.map(lambda *xs: jnp.stack(xs), *per_layer)
     if quantize_wcls:
         wcls = np.asarray(jax.device_get(params["wcls"]), np.float32)
         out["wcls"] = quantize_tensor(wcls, kind)
@@ -135,12 +151,13 @@ def quant_params_from_reader(reader: WeightFileReader, cfg: ModelConfig,
     bits are repacked losslessly (no dequant->requant roundtrip), so decode
     uses the exact published Q40/Q80 checkpoint values — the TPU equivalent
     of the reference's ``matmulQ40vQ80`` production path
-    (`/root/reference/src/funcs.cpp:267-385`). Dense archs only."""
+    (`/root/reference/src/funcs.cpp:267-385`). MoE archs load their expert
+    stacks as per-expert QuantTensors (the reference runs Q40 Grok-1 314B —
+    `/root/reference/src/transformer.cpp:479-487` — a model class that cannot
+    exist unquantized)."""
     from dllama_tpu.ops import qmatmul as qm
     from dllama_tpu.quants import blocks
 
-    if cfg.is_moe:
-        raise NotImplementedError("quantized loading covers dense archs (MoE stays bf16)")
     file_ft = reader.spec.weights_float_type
     lossless = (kind == "q40" and file_ft == blocks.Q40) or (
         kind == "q80" and file_ft == blocks.Q80
@@ -166,15 +183,31 @@ def quant_params_from_reader(reader: WeightFileReader, cfg: ModelConfig,
         "rms_final": reader.read_tensor("rms_final", np.float32),
         "wcls": load_matrix("wcls"),
     }
+    mat_names = ("wq", "wk", "wv", "wo") if cfg.is_moe else QUANTIZABLE
+    vec_names = ["rms_att", "rms_ffn"] + (
+        ["rms_moe", "rms_ffn2"] if cfg.post_norms else []
+    )
     layers: dict = {}
     for i in range(cfg.n_layers):
         pre = f"layers.{i}."
-        for n in QUANTIZABLE:
+        for n in mat_names:
             layers.setdefault(n, []).append(load_matrix(pre + n))
-        for n in ("rms_att", "rms_ffn"):
+        for n in vec_names:
             layers.setdefault(n, []).append(
                 jnp.asarray(reader.read_tensor(pre + n, np.float32))
             )
+        if cfg.is_moe:
+            layers.setdefault("moe_router", []).append(
+                jnp.asarray(reader.read_tensor(pre + "moe_router", cfg.jax_dtype).T)
+            )
+            for kind_ in ("up", "gate", "down"):
+                experts = [
+                    load_matrix(f"{pre}experts.{e}.{kind_}")
+                    for e in range(cfg.n_experts)
+                ]
+                layers.setdefault(f"moe_{kind_}", []).append(
+                    jax.tree.map(lambda *xs: jnp.stack(xs), *experts)
+                )
     p["layers"] = {
         k: jax.tree.map(lambda *xs: jnp.stack(xs), *v) for k, v in layers.items()
     }
@@ -345,13 +378,25 @@ def rope_tables(cfg: ModelConfig) -> dict:
 # Forward pass
 # ---------------------------------------------------------------------------
 
-def _dense_ffn(cfg: ModelConfig, lp: dict, xb: jnp.ndarray) -> jnp.ndarray:
+def _gather(x: jnp.ndarray, tp_axis) -> jnp.ndarray:
+    """Concatenate the feature (last) axis across the tp axis (identity when
+    tp_axis is None). The quantized-TP forward shards every matrix on its
+    *output* axis only — so each matmul's input must be gathered, but no
+    K-axis resharding of packed quant blocks is ever needed and every local
+    kernel keeps its Mosaic-valid tiling (see parallel.quant_tp)."""
+    if tp_axis is None:
+        return x
+    return jax.lax.all_gather(x, tp_axis, axis=-1, tiled=True)
+
+
+def _dense_ffn(cfg: ModelConfig, lp: dict, xb: jnp.ndarray, tp_axis=None) -> jnp.ndarray:
     act = ACTIVATIONS[cfg.hidden_act]
     h = act(matmul_any(xb, lp["w1"])) * matmul_any(xb, lp["w3"])
-    return matmul_any(h, lp["w2"])
+    return _gather(matmul_any(_gather(h, tp_axis), lp["w2"]), tp_axis)
 
 
-def _ffn_residual(cfg: ModelConfig, lp: dict, x: jnp.ndarray, att_out: jnp.ndarray):
+def _ffn_residual(cfg: ModelConfig, lp: dict, x: jnp.ndarray, att_out: jnp.ndarray,
+                  tp_axis=None):
     """Post-attention half of a layer, all three arch variants:
 
     * llama: ``x += att; x += dense_ffn(rmsnorm(x, rms_ffn))``
@@ -371,17 +416,25 @@ def _ffn_residual(cfg: ModelConfig, lp: dict, x: jnp.ndarray, att_out: jnp.ndarr
         return x + rmsnorm(moe_ffn(cfg, lp, xb), lp["rms_ffn2"], cfg.norm_eps)
     x = x + att_out
     xb = rmsnorm(x, lp["rms_ffn"], cfg.norm_eps)
-    return x + (moe_ffn(cfg, lp, xb) if cfg.is_moe else _dense_ffn(cfg, lp, xb))
+    return x + (moe_ffn(cfg, lp, xb) if cfg.is_moe else _dense_ffn(cfg, lp, xb, tp_axis))
 
 
-def _attn_block(cfg: ModelConfig, lp: dict, rope: dict, x, k_cache, v_cache, pos):
-    """One attention sub-block. Returns (attn output [T, dim], new k/v cache [S,...])."""
+def _attn_block(cfg: ModelConfig, lp: dict, rope: dict, x, k_cache, v_cache, pos,
+                tp_axis=None):
+    """One attention sub-block. Returns (attn output [T, dim], new k/v cache [S,...]).
+
+    With ``tp_axis`` (inside shard_map, quantized TP): the projections are
+    output-sharded, so head counts are *local* — derived from the array
+    shapes, never from cfg — and the attention runs on this device's heads
+    against its kv-head slice of the cache (the reference's
+    ``MultiHeadAttSlice``/``KvCacheSlice`` head split,
+    `/root/reference/src/transformer.cpp:161-181`)."""
     T = x.shape[0]
     xb = rmsnorm(x, lp["rms_att"], cfg.norm_eps)
 
-    q = matmul_any(xb, lp["wq"]).reshape(T, cfg.n_heads, cfg.head_size)
-    k = matmul_any(xb, lp["wk"]).reshape(T, cfg.n_kv_heads, cfg.head_size)
-    v = matmul_any(xb, lp["wv"]).reshape(T, cfg.n_kv_heads, cfg.head_size)
+    q = matmul_any(xb, lp["wq"]).reshape(T, -1, cfg.head_size)
+    k = matmul_any(xb, lp["wk"]).reshape(T, -1, cfg.head_size)
+    v = matmul_any(xb, lp["wv"]).reshape(T, -1, cfg.head_size)
 
     cos = jax.lax.dynamic_slice_in_dim(rope["cos"], pos, T)[:, None, :]
     sin = jax.lax.dynamic_slice_in_dim(rope["sin"], pos, T)[:, None, :]
@@ -392,7 +445,8 @@ def _attn_block(cfg: ModelConfig, lp: dict, rope: dict, x, k_cache, v_cache, pos
     v_cache = jax.lax.dynamic_update_slice_in_dim(v_cache, v.astype(v_cache.dtype), pos, axis=0)
 
     out = gqa_attention(q, k_cache, v_cache, pos)
-    return matmul_any(out.reshape(T, cfg.dim), lp["wo"]), k_cache, v_cache
+    out = _gather(out.reshape(T, -1), tp_axis)  # [T, dim] (local heads -> full)
+    return _gather(matmul_any(out, lp["wo"]), tp_axis), k_cache, v_cache
 
 
 def forward(
@@ -402,18 +456,28 @@ def forward(
     tokens: jnp.ndarray,  # [T] int32
     cache: dict,  # {"k","v": [L, S, n_kv, hd]}
     pos,  # scalar int32: sequence position of tokens[0]
+    tp_axis: str | None = None,
+    gather_logits: bool = True,
 ) -> tuple:
     """Process T tokens starting at ``pos``. Returns (logits [T, vocab] f32, new cache).
 
     T==1 is the decode step; larger T is batched prefill (the reference feeds
     prompt tokens one at a time — batching them is the first TPU win).
+
+    ``tp_axis``: when called inside shard_map over a tp mesh axis (the
+    quantized-TP path, parallel.quant_tp), params/cache are local shards and
+    activations are re-gathered after each output-sharded matmul. With
+    ``gather_logits=False`` the classifier is replicated (vocab not divisible
+    by tp) and the final gather is skipped.
     """
     x = embed(cfg, params, tokens)
 
     def layer_step(x, layer):
         lp, k_cache, v_cache = layer
-        att_out, k_cache, v_cache = _attn_block(cfg, lp, rope, x, k_cache, v_cache, pos)
-        x = _ffn_residual(cfg, lp, x, att_out)
+        att_out, k_cache, v_cache = _attn_block(
+            cfg, lp, rope, x, k_cache, v_cache, pos, tp_axis
+        )
+        x = _ffn_residual(cfg, lp, x, att_out, tp_axis)
         return x, (k_cache, v_cache)
 
     x, (new_k, new_v) = jax.lax.scan(
@@ -422,6 +486,8 @@ def forward(
 
     x = rmsnorm(x, params["rms_final"], cfg.norm_eps)
     logits = matmul_any(x, params["wcls"]).astype(jnp.float32)
+    if tp_axis is not None and gather_logits:
+        logits = _gather(logits, tp_axis)
     if cfg.logit_scale != 1.0:
         logits = logits * cfg.logit_scale
     return logits, {"k": new_k, "v": new_v}

@@ -25,6 +25,7 @@ import jax.numpy as jnp
 
 from dllama_tpu.models.config import ModelConfig
 from dllama_tpu.ops.activations import ACTIVATIONS
+from dllama_tpu.ops.qmatmul import QuantTensor, matmul_any
 
 
 def route(cfg: ModelConfig, router_kernel: jnp.ndarray, xb: jnp.ndarray) -> jnp.ndarray:
@@ -41,17 +42,53 @@ def route(cfg: ModelConfig, router_kernel: jnp.ndarray, xb: jnp.ndarray) -> jnp.
     return jnp.einsum("...ke,...k->...e", one_hot, weights)
 
 
+def _expert_up(xb: jnp.ndarray, w) -> jnp.ndarray:
+    """``xb [..., D] x w [E, D, H] -> [..., E, H]``; ``w`` is a dense stack or
+    an expert-stacked QuantTensor (leading E axis on every plane). Quantized
+    experts run one fused dequant-matmul per expert via lax.scan over the
+    stack — the per-expert twin of the reference's sliced expert matmuls
+    (`/root/reference/src/grok1-tasks.cpp:128-143`, Q40 weights per
+    `/root/reference/src/transformer.cpp:479-487`)."""
+    if not isinstance(w, QuantTensor):
+        return jnp.einsum("...d,edh->...eh", xb, w)
+    lead = xb.shape[:-1]
+    x2 = xb.reshape(-1, xb.shape[-1])  # [N, D]
+
+    def step(_, qt_e):
+        return None, matmul_any(x2, qt_e)
+
+    _, outs = jax.lax.scan(step, None, w)  # [E, N, H]
+    return jnp.moveaxis(outs, 0, 1).reshape(*lead, outs.shape[0], outs.shape[-1])
+
+
+def _expert_down(h: jnp.ndarray, w) -> jnp.ndarray:
+    """``h [..., E, H] x w [E, H, D] -> [..., E, D]`` (dense or QuantTensor)."""
+    if not isinstance(w, QuantTensor):
+        return jnp.einsum("...eh,ehd->...ed", h, w)
+    lead = h.shape[:-2]
+    E, H = h.shape[-2], h.shape[-1]
+    hm = jnp.moveaxis(h.reshape(-1, E, H), 1, 0)  # [E, N, H]
+
+    def step(_, eh):
+        h_e, qt_e = eh
+        return None, matmul_any(h_e, qt_e)
+
+    _, outs = jax.lax.scan(step, None, (hm, w))  # [E, N, D]
+    return jnp.moveaxis(outs, 0, 1).reshape(*lead, E, outs.shape[-1])
+
+
 def moe_ffn(cfg: ModelConfig, lp: dict, xb: jnp.ndarray) -> jnp.ndarray:
     """MoE FFN over xb [..., dim] -> [..., dim].
 
     lp holds: moe_router [dim, E], moe_up/moe_gate [E, dim, hidden],
-    moe_down [E, hidden, dim].
+    moe_down [E, hidden, dim] — each expert stack a dense array or a
+    quantized (QuantTensor) stack.
     """
     act = ACTIVATIONS[cfg.hidden_act]
     combine = route(cfg, lp["moe_router"], xb).astype(xb.dtype)  # [..., E]
 
-    up = jnp.einsum("...d,edh->...eh", xb, lp["moe_up"])
-    gate = jnp.einsum("...d,edh->...eh", xb, lp["moe_gate"])
+    up = _expert_up(xb, lp["moe_up"])
+    gate = _expert_up(xb, lp["moe_gate"])
     h = up * act(gate)
-    down = jnp.einsum("...eh,ehd->...ed", h, lp["moe_down"])
+    down = _expert_down(h, lp["moe_down"])
     return jnp.einsum("...ed,...e->...d", down, combine)

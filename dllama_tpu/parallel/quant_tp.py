@@ -1,0 +1,135 @@
+"""Tensor parallelism for block-quantized weights (shard_map + Pallas).
+
+The reference's production configuration is Q40 weights sliced across *every*
+node (`/root/reference/src/transformer.cpp:454-493` slicing fed to the Q40
+matmul `/root/reference/src/funcs.cpp:267-385`). XLA cannot auto-partition a
+``pallas_call``, so the quantized forward runs under ``shard_map``: every
+device executes the fused dequant-matmul kernels on its *local* weight shard
+and the activations move with explicit collectives.
+
+Sharding scheme — **output-axis only**:
+
+Every quantized matrix (and each of its planes: packed bits ``w``, scale
+planes ``s``/``s2``) is sharded on its OUT axis; the packed K axis is never
+split. Two reasons this beats K-slicing for quant blocks:
+
+* K is padded to ``K_MULTIPLE`` at pack time (ops.qmatmul); a K-split of the
+  padded planes would misalign superblock boundaries per shard (e.g. 7B's
+  11264-padded K / 8 devices = 1408, not a multiple of 512) and force
+  per-shard repadding. O-splitting leaves every plane's K layout intact, so
+  any tp degree that divides O yields a shard with exactly the same
+  Mosaic-valid tiling as the unsharded tensor.
+* The matmul result for each output element is computed from the full K on
+  one device — no f32 partial-sum psum; the only collectives are small
+  activation all-gathers (4 per layer), mirroring the reference's 4 wire
+  trips per layer (`SURVEY.md` §3.3) but over ICI.
+
+The attention out-projection ``wo`` and FFN down-projection ``w2`` therefore
+consume *gathered* inputs instead of producing psum partials — see
+``models.llama._gather``.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from dllama_tpu.models.config import ModelConfig
+from dllama_tpu.ops.qmatmul import QuantTensor
+from dllama_tpu.parallel.mesh import TP
+from dllama_tpu.parallel.sharding import cache_spec, check_tp_compatible
+
+try:  # jax >= 0.6 moved shard_map out of experimental
+    from jax import shard_map
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map
+
+
+def has_quant_leaves(params) -> bool:
+    is_qt = lambda x: isinstance(x, QuantTensor)  # noqa: E731
+    return any(is_qt(leaf) for leaf in jax.tree.leaves(params, is_leaf=is_qt))
+
+
+def _out_shard_spec(arr) -> P:
+    """Shard the last (output) axis over tp; empty placeholders replicate."""
+    if arr.ndim == 0 or arr.shape[-1] == 0:
+        return P(*([None] * arr.ndim))
+    return P(*([None] * (arr.ndim - 1)), TP)
+
+
+def _replicated_spec(arr) -> P:
+    return P(*([None] * arr.ndim))
+
+
+def quant_param_specs(params: dict, cfg: ModelConfig, n_tp: int) -> dict:
+    """Leaf-level PartitionSpec tree matching ``params`` (QuantTensor fields
+    get their own specs). Quantized matrices and the dense big matrices are
+    output-sharded; norms/embedding are replicated (the root holds them whole
+    in the reference too). ``wcls`` is sharded only when tp divides vocab."""
+    check_tp_compatible(cfg, n_tp)
+    if cfg.dim % n_tp or cfg.kv_dim % n_tp:
+        raise ValueError(f"tp={n_tp} must divide dim={cfg.dim} and kv_dim={cfg.kv_dim}")
+
+    shard_wcls = cfg.vocab_size % n_tp == 0
+
+    def leaf_specs(name: str, leaf, sharded: bool):
+        mk = _out_shard_spec if sharded else _replicated_spec
+        if isinstance(leaf, QuantTensor):
+            return QuantTensor(
+                w=mk(leaf.w), s=mk(leaf.s), s2=mk(leaf.s2),
+                kind=leaf.kind, k_logical=leaf.k_logical,
+            )
+        return mk(leaf)
+
+    sharded_names = {"wq", "wk", "wv", "wo", "w1", "w2", "w3"}
+    specs: dict = {
+        "embedding": _replicated_spec(params["embedding"]),
+        "rms_final": _replicated_spec(params["rms_final"]),
+        "wcls": leaf_specs("wcls", params["wcls"], shard_wcls),
+        "layers": {
+            name: leaf_specs(name, leaf, name in sharded_names)
+            for name, leaf in params["layers"].items()
+        },
+    }
+    return specs
+
+
+def shard_quant_params(params: dict, mesh, cfg: ModelConfig) -> dict:
+    """Place a (possibly quantized) param pytree onto the mesh output-sharded."""
+    specs = quant_param_specs(params, cfg, mesh.shape[TP])
+    return jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), params, specs
+    )
+
+
+def make_tp_forward(cfg: ModelConfig, mesh, params: dict):
+    """Build ``fwd(params, rope, cache, tokens, pos) -> (logits, cache)``:
+    the quantized-TP decode/prefill forward as one shard_map program.
+
+    Activations/logits are replicated in and out; params carry output shards;
+    the KV cache is sharded by kv-head (axis 2). Jit-able and scannable —
+    the Engine wraps it exactly like the single-chip ``llama.forward``.
+    """
+    from dllama_tpu.models import llama
+
+    n_tp = mesh.shape[TP]
+    pspecs = quant_param_specs(params, cfg, n_tp)
+    gather_logits = cfg.vocab_size % n_tp == 0
+    cspec = {"k": cache_spec(), "v": cache_spec()}
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(pspecs, P(), cspec, P(), P()),
+        out_specs=(P(), cspec),
+        check_vma=False,
+    )
+    def fwd(params, rope, cache, tokens, pos):
+        return llama.forward(
+            cfg, params, rope, tokens, cache, pos,
+            tp_axis=TP, gather_logits=gather_logits,
+        )
+
+    return fwd

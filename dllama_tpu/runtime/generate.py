@@ -82,11 +82,23 @@ class Engine:
         self.cfg = cfg
         self.sampler_cfg = sampler_cfg
         self.mesh = mesh
+        fwd = llama.forward
         if mesh is not None:
-            from dllama_tpu.parallel import sharding as _sh
+            from dllama_tpu.parallel import quant_tp, sharding as _sh
             from jax.sharding import NamedSharding
 
-            self.params = _sh.shard_params(params, mesh, cfg)
+            if quant_tp.has_quant_leaves(params):
+                # quantized weights x TP: pallas kernels don't auto-partition
+                # under pjit, so the forward runs as a shard_map program over
+                # output-sharded quant planes (parallel.quant_tp)
+                self.params = quant_tp.shard_quant_params(params, mesh, cfg)
+                tp_fwd = quant_tp.make_tp_forward(cfg, mesh, self.params)
+
+                def fwd(cfg_, params_, rope_, tokens_, cache_, pos_):
+                    return tp_fwd(params_, rope_, cache_, tokens_, pos_)
+
+            else:
+                self.params = _sh.shard_params(params, mesh, cfg)
             self._cache_sharding = NamedSharding(mesh, _sh.cache_spec())
         else:
             self.params = jax.tree.map(jnp.asarray, params)
@@ -102,7 +114,7 @@ class Engine:
         # compile serves every per-request sampler setting.
         @partial(jax.jit, donate_argnums=(2,))
         def _decode_step(params, rope, cache, token, pos, key, temp, topp):
-            logits, cache = llama.forward(cfg, params, rope, token[None], cache, pos)
+            logits, cache = fwd(cfg, params, rope, token[None], cache, pos)
             nxt = sample_dynamic(logits[0], key, temp, topp)
             return nxt, cache
 
@@ -110,7 +122,7 @@ class Engine:
         def _prefill(params, rope, cache, padded_tokens, n_tokens, pos):
             # n_tokens is traced (dynamic index) so one compile serves every
             # prompt length within a bucket
-            logits, cache = llama.forward(cfg, params, rope, padded_tokens, cache, pos)
+            logits, cache = fwd(cfg, params, rope, padded_tokens, cache, pos)
             return jax.lax.dynamic_index_in_dim(logits, n_tokens - 1, keepdims=False), cache
 
         @partial(jax.jit, donate_argnums=(2,), static_argnames=("n_steps",))
@@ -123,7 +135,7 @@ class Engine:
             def body(carry, _):
                 cache, token, pos, key = carry
                 key, sub = jax.random.split(key)
-                logits, cache = llama.forward(cfg, params, rope, token[None], cache, pos)
+                logits, cache = fwd(cfg, params, rope, token[None], cache, pos)
                 nxt = sample_dynamic(logits[0], sub, temp, topp)
                 return (cache, nxt, pos + 1, key), nxt
 

@@ -1,0 +1,102 @@
+"""Quantized weights x tensor parallelism (parallel.quant_tp).
+
+The reference's production configuration is Q40 weights on every node of a
+multi-node run (`/root/reference/src/transformer.cpp:454-493` +
+`/root/reference/src/funcs.cpp:267-385`). The TPU equivalent runs the fused
+dequant-matmul kernels under shard_map with output-sharded quant planes.
+These tests assert the distributed result equals the single-device result on
+the 8-virtual-device CPU mesh — the sharding-invariance pattern of
+`/root/reference/src/transformer-test.cpp:6-84`, applied to the quant path
+the reference never automates.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dllama_tpu.models import llama
+from dllama_tpu.models.config import ModelConfig
+from dllama_tpu.parallel import quant_tp
+from dllama_tpu.parallel.mesh import tp_mesh
+from dllama_tpu.runtime.generate import Engine
+from dllama_tpu.runtime.sampler import SamplerConfig
+
+CFG = ModelConfig(
+    arch="llama", dim=256, hidden_dim=512, n_layers=2, n_heads=8, n_kv_heads=8,
+    vocab_size=512, seq_len=64, head_size=32, kv_dim=256, dtype="float32",
+)
+
+
+def _quant_params(kind="q40", seed=0):
+    dense = llama.random_params(CFG, seed=seed, dtype=np.float32)
+    return llama.quantize_params(dense, kind)
+
+
+@pytest.mark.parametrize("tp", [2, 8])
+@pytest.mark.parametrize("kind", ["q40", "q80"])
+def test_tp_forward_matches_single_device(tp, kind):
+    """One forward step: shard_map quant-TP logits == single-device logits."""
+    qp = _quant_params(kind)
+    rope = llama.rope_tables(CFG)
+    tokens = jnp.asarray([5], jnp.int32)
+
+    cache1 = llama.init_cache(CFG)
+    ref_logits, _ = jax.jit(
+        lambda p, r, c, t: llama.forward(CFG, p, r, t, c, jnp.int32(0))
+    )(jax.tree.map(jnp.asarray, qp), rope, cache1, tokens)
+
+    mesh = tp_mesh(tp)
+    sharded = quant_tp.shard_quant_params(qp, mesh, CFG)
+    fwd = quant_tp.make_tp_forward(CFG, mesh, sharded)
+    cache2 = llama.init_cache(CFG)
+    tp_logits, _ = jax.jit(fwd)(sharded, rope, cache2, tokens, jnp.int32(0))
+
+    np.testing.assert_allclose(
+        np.asarray(tp_logits), np.asarray(ref_logits), rtol=1e-4, atol=1e-4
+    )
+
+
+def test_tp_engine_greedy_decode_invariance():
+    """Engine-level: greedy tokens from the quant-TP engine == single-device."""
+    qp = _quant_params("q40")
+    e1 = Engine(CFG, qp, SamplerConfig(temperature=0.0))
+    t1, _, _ = e1.generate_fused([3, 7, 11], steps=8)
+
+    e2 = Engine(CFG, qp, SamplerConfig(temperature=0.0), mesh=tp_mesh(8))
+    t2, _, _ = e2.generate_fused([3, 7, 11], steps=8)
+    assert t1 == t2
+
+
+def test_quant_specs_shard_every_plane():
+    """Every quant plane of the big matrices must actually shard (no silent
+    replication — the failure mode that keeps the 4x HBM win from being real)."""
+    qp = _quant_params("q40")
+    specs = quant_tp.quant_param_specs(qp, CFG, 8)
+    for name in ("wq", "wk", "wv", "wo", "w1", "w2", "w3"):
+        qt = specs["layers"][name]
+        assert qt.w[-1] == "tp" and qt.s[-1] == "tp" and qt.s2[-1] == "tp", name
+    assert specs["wcls"].w[-1] == "tp"  # 512 % 8 == 0
+
+
+def test_quant_tp_indivisible_vocab_replicates_wcls():
+    cfg = ModelConfig(
+        arch="llama", dim=256, hidden_dim=512, n_layers=1, n_heads=8, n_kv_heads=8,
+        vocab_size=500, seq_len=32, head_size=32, kv_dim=256, dtype="float32",
+    )
+    dense = llama.random_params(cfg, seed=1, dtype=np.float32)
+    qp = llama.quantize_params(dense, "q40")
+    specs = quant_tp.quant_param_specs(qp, cfg, 8)
+    assert all(s is None for s in specs["wcls"].w)
+
+    mesh = tp_mesh(8)
+    sharded = quant_tp.shard_quant_params(qp, mesh, cfg)
+    fwd = quant_tp.make_tp_forward(cfg, mesh, sharded)
+    rope = llama.rope_tables(cfg)
+    logits, _ = jax.jit(fwd)(
+        sharded, rope, llama.init_cache(cfg), jnp.asarray([2], jnp.int32), jnp.int32(0)
+    )
+    ref, _ = jax.jit(
+        lambda p, r, c, t: llama.forward(cfg, p, r, t, c, jnp.int32(0))
+    )(jax.tree.map(jnp.asarray, qp), rope, llama.init_cache(cfg), jnp.asarray([2], jnp.int32))
+    np.testing.assert_allclose(np.asarray(logits), np.asarray(ref), rtol=1e-4, atol=1e-4)
