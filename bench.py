@@ -53,8 +53,16 @@ def _probe_quant_kernels(kind: str = "q40", timeout_s: int = 240) -> bool:
     import subprocess
     import sys as _sys
 
+    # honor the same platform override the bench itself uses: probing the TPU
+    # while the bench is forced elsewhere (or vice versa) validates nothing
+    forced = os.environ.get("DLLAMA_PLATFORM")
+    if forced and forced != "tpu":
+        return False  # quant kernels only earn their keep on real TPU
+
     code = (
-        "import jax, jax.numpy as jnp\n"
+        "import jax\n"
+        + (f"jax.config.update('jax_platforms', {forced!r})\n" if forced else "")
+        + "import jax.numpy as jnp\n"
         "assert jax.default_backend() == 'tpu', jax.default_backend()\n"
         "from dllama_tpu.ops import qmatmul\n"
         f"qt = qmatmul.quantize_tensor(__import__('numpy').ones((128, 128), 'float32'), {kind!r})\n"

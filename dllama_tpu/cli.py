@@ -128,7 +128,7 @@ def load_engine(args):
         print(f"💡 nHeads: {cfg.n_heads}  nKvHeads: {cfg.n_kv_heads}")
         print(f"💡 vocabSize: {cfg.vocab_size}  seqLen: {cfg.seq_len}")
         wft = args.weights_float_type
-        if wft is None and not cfg.is_moe and jax.default_backend() == "tpu":
+        if wft is None and jax.default_backend() == "tpu":
             # default to the file's own quantized format: the fused Pallas
             # kernels read 4x fewer HBM bytes/token than bf16 weights. Only
             # on TPU — elsewhere the kernels run in (slow) interpret mode, so
@@ -147,14 +147,11 @@ def load_engine(args):
 
             mesh = tp_mesh(n_tp)
         if wft in ("q40", "q80"):
-            if cfg.is_moe:
-                raise SystemExit(
-                    "--weights-float-type q40/q80 currently requires a dense "
-                    "arch (quantized MoE expert stacks are on the roadmap)"
-                )
             tp_note = f" x tp={n_tp} (shard_map)" if n_tp > 1 else ""
             print(f"🧮 weights resident as {wft} (fused dequant-matmul kernels){tp_note}")
-            params = llama.quant_params_from_reader(reader, cfg, wft)
+            # with a mesh, each stacked tensor streams straight into its TP
+            # sharding — no device ever holds the whole quantized model
+            params = llama.quant_params_from_reader(reader, cfg, wft, mesh=mesh)
         else:
             # bf16/f16/f32 request a dense on-device dtype for the weights
             # (dequantized at load when the file is q40/q80)
@@ -202,6 +199,7 @@ def run_generate(args, show_stats: bool) -> None:
         jax.profiler.start_trace(profile_dir)
 
     gen_ms = []
+    inf_ms = []
     prev = tokens[-1]
     produced = list()
     try:
@@ -214,8 +212,13 @@ def run_generate(args, show_stats: bool) -> None:
             prev = tok_id
             produced.append(tok_id)
             gen_ms.append(stats.generation_ms)
+            inf_ms.append(stats.inference_ms)
             if show_stats:
-                sys.stdout.write(f"  🔶 G {stats.generation_ms:7.2f} ms I {stats.inference_ms:7.2f} ms\n")
+                sys.stdout.write(
+                    f"  🔶 G {stats.generation_ms:7.2f} ms "
+                    f"I {stats.inference_ms:7.2f} ms "
+                    f"T {stats.transfer_ms:7.2f} ms\n"
+                )
         sys.stdout.write(utf8.decode(b"", True))  # dangling incomplete char -> U+FFFD
         print()
     finally:
@@ -229,10 +232,14 @@ def run_generate(args, show_stats: bool) -> None:
         # skip the first token (prefill) in the average, like the reference
         # averages steady-state decode (`dllama.cpp:86-91`)
         steady = gen_ms[1:] if len(gen_ms) > 1 else gen_ms
+        steady_inf = inf_ms[1:] if len(inf_ms) > 1 else inf_ms
         avg = sum(steady) / len(steady)
+        avg_inf = sum(steady_inf) / len(steady_inf)
         print(f"Generated tokens:    {len(produced)}")
         print(f"Avg tokens / second: {1000.0 / avg:.2f}")
         print(f"Avg generation time: {avg:.2f} ms")
+        print(f"Avg inference time:  {avg_inf:.2f} ms (device)")
+        print(f"Avg transfer time:   {avg - avg_inf:.2f} ms (host+dispatch)")
         print(f"Prefill time:        {engine.prefill_ms:.2f} ms ({len(tokens)} tokens)")
 
 

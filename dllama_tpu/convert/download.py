@@ -83,3 +83,7 @@ def main(argv: list) -> None:
     print("To run, execute:\n")
     print(command)
     print(f"\n🌻 Created {run_path}")
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

@@ -92,8 +92,12 @@ def plugin_options() -> tuple:
                     )
             if opts:
                 break
-    except Exception:
-        pass
+    except (ImportError, AttributeError) as e:
+        # jax internals moved (xla_bridge is private API): fall back to a
+        # bare client, but say so — silent loss of plugin options produces
+        # a native client that can't reach the device
+        print(f"⚠️  could not read PJRT plugin options from jax ({e}); "
+              "native client will be created with defaults")
     return plugin, opts
 
 

@@ -145,7 +145,7 @@ def quantize_params(params: dict, kind: str, quantize_wcls: bool = True) -> dict
 
 
 def quant_params_from_reader(reader: WeightFileReader, cfg: ModelConfig,
-                             kind: str = "q40") -> dict:
+                             kind: str = "q40", mesh=None) -> dict:
     """Load a `.m` file with the big matrices kept block-quantized for the
     fused kernels. When the file's own float type matches ``kind``, the file
     bits are repacked losslessly (no dequant->requant roundtrip), so decode
@@ -154,7 +154,15 @@ def quant_params_from_reader(reader: WeightFileReader, cfg: ModelConfig,
     (`/root/reference/src/funcs.cpp:267-385`). MoE archs load their expert
     stacks as per-expert QuantTensors (the reference runs Q40 Grok-1 314B —
     `/root/reference/src/transformer.cpp:479-487` — a model class that cannot
-    exist unquantized)."""
+    exist unquantized).
+
+    Streaming: planes stay host numpy until one whole stacked tensor is
+    assembled, then that tensor is placed — with ``mesh``, straight into its
+    TP sharding (``parallel.quant_tp`` output-axis specs), so peak host RAM
+    is one stacked tensor and no single device ever holds the full model
+    (the quantized twin of ``parallel.sharding.sharded_params_from_reader``,
+    matching the reference's never-materialize-everything slice streaming,
+    `/root/reference/src/transformer.cpp:569-598`)."""
     from dllama_tpu.ops import qmatmul as qm
     from dllama_tpu.quants import blocks
 
@@ -168,20 +176,48 @@ def quant_params_from_reader(reader: WeightFileReader, cfg: ModelConfig,
     # (64 for the q40 nibble pairs, 32 = one block for q80)
     kernel_multiple = 64 if kind == "q40" else 32
 
+    if mesh is not None:
+        from jax.sharding import NamedSharding
+
+        from dllama_tpu.parallel import quant_tp
+        from dllama_tpu.parallel.mesh import TP
+
+        n_tp = mesh.shape[TP]
+        quant_tp.validate_quant_tp(cfg, n_tp)
+
+        def place(leaf, sharded: bool):
+            specs = quant_tp.leaf_specs(leaf, sharded)
+            return jax.tree.map(
+                lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), leaf, specs
+            )
+
+        shard_wcls = cfg.vocab_size % n_tp == 0
+    else:
+        def place(leaf, sharded: bool):
+            return jax.tree.map(jnp.asarray, leaf)
+
+        shard_wcls = False
+
     def load_matrix(name: str):
+        """Host-side (numpy-plane) QuantTensor or dense array for one matrix."""
         e = reader.entry(name)
         if e.n % kernel_multiple != 0:
             # valid in the file format (blocks are 32-wide) but not packable
             # for the kernel: keep this matrix dense instead of crashing
-            return jnp.asarray(reader.read_tensor(name, cfg.jax_dtype).T)
+            return reader.read_tensor(name, cfg.jax_dtype).T
         if lossless:
-            return repack(reader.read_raw(name), e.d, e.n)
-        return quantize_tensor(reader.read_tensor(name, np.float32).T, kind)
+            return repack(reader.read_raw(name), e.d, e.n, to_device=False)
+        return quantize_tensor(
+            reader.read_tensor(name, np.float32).T, kind, to_device=False
+        )
+
+    def np_stack(items):
+        return jax.tree.map(lambda *xs: np.stack(xs), *items)
 
     p = {
-        "embedding": reader.read_tensor("token_embedding", np.float32),
-        "rms_final": reader.read_tensor("rms_final", np.float32),
-        "wcls": load_matrix("wcls"),
+        "embedding": place(reader.read_tensor("token_embedding", np.float32), False),
+        "rms_final": place(reader.read_tensor("rms_final", np.float32), False),
+        "wcls": place(load_matrix("wcls"), shard_wcls),
     }
     mat_names = ("wq", "wk", "wv", "wo") if cfg.is_moe else QUANTIZABLE
     vec_names = ["rms_att", "rms_ffn"] + (
@@ -193,23 +229,22 @@ def quant_params_from_reader(reader: WeightFileReader, cfg: ModelConfig,
         for n in mat_names:
             layers.setdefault(n, []).append(load_matrix(pre + n))
         for n in vec_names:
-            layers.setdefault(n, []).append(
-                jnp.asarray(reader.read_tensor(pre + n, np.float32))
-            )
+            layers.setdefault(n, []).append(reader.read_tensor(pre + n, np.float32))
         if cfg.is_moe:
             layers.setdefault("moe_router", []).append(
-                jnp.asarray(reader.read_tensor(pre + "moe_router", cfg.jax_dtype).T)
+                reader.read_tensor(pre + "moe_router", cfg.jax_dtype).T
             )
             for kind_ in ("up", "gate", "down"):
-                experts = [
-                    load_matrix(f"{pre}experts.{e}.{kind_}")
-                    for e in range(cfg.n_experts)
-                ]
                 layers.setdefault(f"moe_{kind_}", []).append(
-                    jax.tree.map(lambda *xs: jnp.stack(xs), *experts)
+                    np_stack([
+                        load_matrix(f"{pre}experts.{e}.{kind_}")
+                        for e in range(cfg.n_experts)
+                    ])
                 )
+    from dllama_tpu.parallel.quant_tp import SHARDED_MATRICES
+
     p["layers"] = {
-        k: jax.tree.map(lambda *xs: jnp.stack(xs), *v) for k, v in layers.items()
+        k: place(np_stack(v), k in SHARDED_MATRICES) for k, v in layers.items()
     }
     return p
 

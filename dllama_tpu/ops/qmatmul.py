@@ -285,11 +285,17 @@ def matmul_any(x: jnp.ndarray, w) -> jnp.ndarray:
 # Packing (host-side, numpy)
 # ---------------------------------------------------------------------------
 
-def pack_q40(quants: np.ndarray, deltas: np.ndarray) -> QuantTensor:
+def pack_q40(quants: np.ndarray, deltas: np.ndarray,
+             to_device: bool = True) -> QuantTensor:
     """Build the kernel layout from unpacked quants ``int [K, O]`` in -8..7
     and per-block deltas ``[K/32, O]`` (block = 32 consecutive input rows).
     K is padded up to ``K_MULTIPLE['q40']`` (zero quants + zero scales) so the
-    kernel's scale-plane blocks always satisfy Mosaic's 8-sublane tiling."""
+    kernel's scale-plane blocks always satisfy Mosaic's 8-sublane tiling.
+
+    ``to_device=False`` keeps the planes as host numpy arrays — the streaming
+    sharded loader stacks layers on host and places the stacked tensor
+    directly into its mesh sharding, so no single device ever holds the whole
+    model (parallel.quant_tp)."""
     K, O = quants.shape
     assert K % 64 == 0, f"q40 kernel needs in_features % 64 == 0, got {K}"
     kp = _pad_up(K, K_MULTIPLE["q40"])
@@ -304,13 +310,15 @@ def pack_q40(quants: np.ndarray, deltas: np.ndarray) -> QuantTensor:
     ur = u.reshape(kp // 64, 2, QK, O)
     packed = (ur[:, 0] | (ur[:, 1] << 4)).reshape(kp // 2, O)
     d = deltas.astype(np.float32).reshape(kp // 64, 2, O)
+    put = jnp.asarray if to_device else np.ascontiguousarray
     return QuantTensor(
-        w=jnp.asarray(packed), s=jnp.asarray(d[:, 0].copy()),
-        s2=jnp.asarray(d[:, 1].copy()), kind="q40", k_logical=K,
+        w=put(packed), s=put(d[:, 0].copy()),
+        s2=put(d[:, 1].copy()), kind="q40", k_logical=K,
     )
 
 
-def pack_q80(quants: np.ndarray, deltas: np.ndarray) -> QuantTensor:
+def pack_q80(quants: np.ndarray, deltas: np.ndarray,
+             to_device: bool = True) -> QuantTensor:
     """int8 quants [K, O] + per-block deltas [K/32, O] -> kernel layout.
     K is padded up to ``K_MULTIPLE['q80']`` like ``pack_q40``."""
     K, O = quants.shape
@@ -323,14 +331,15 @@ def pack_q80(quants: np.ndarray, deltas: np.ndarray) -> QuantTensor:
         deltas = np.concatenate(
             [deltas, np.zeros(((kp - K) // QK, O), np.float32)], axis=0
         )
+    put = jnp.asarray if to_device else np.ascontiguousarray
     return QuantTensor(
-        w=jnp.asarray(quants.astype(np.int8)),
-        s=jnp.asarray(deltas.astype(np.float32)),
-        s2=jnp.zeros((0,), jnp.float32), kind="q80", k_logical=K,
+        w=put(quants.astype(np.int8)),
+        s=put(deltas.astype(np.float32)),
+        s2=put(np.zeros((0,), np.float32)), kind="q80", k_logical=K,
     )
 
 
-def quantize_tensor(w: np.ndarray, kind: str) -> QuantTensor:
+def quantize_tensor(w: np.ndarray, kind: str, to_device: bool = True) -> QuantTensor:
     """Quantize a dense ``[K, O]`` f32 matrix with the reference's block math
     (`/root/reference/converter/writer.py:26-75`), blocks along K."""
     w = np.ascontiguousarray(w, np.float32)
@@ -342,27 +351,27 @@ def quantize_tensor(w: np.ndarray, kind: str) -> QuantTensor:
         q, d = blocks.unpack_q40(raw)  # [O*K/32, 32], [O*K/32]
         q = q.reshape(O, K).T  # [K, O]
         d = d.reshape(O, K // QK).T  # [K/32, O]
-        return pack_q40(q, d)
+        return pack_q40(q, d, to_device)
     if kind == "q80":
         raw = blocks.quantize_q80(flat)
         q, d = blocks.unpack_q80(raw)
-        return pack_q80(q.reshape(O, K).T, d.reshape(O, K // QK).T)
+        return pack_q80(q.reshape(O, K).T, d.reshape(O, K // QK).T, to_device)
     raise ValueError(f"unknown quant kind {kind!r}")
 
 
-def repack_q40(raw: np.ndarray, d: int, n: int) -> QuantTensor:
+def repack_q40(raw: np.ndarray, d: int, n: int, to_device: bool = True) -> QuantTensor:
     """Losslessly repack a reference-format Q40 tensor (``d`` rows of ``n``
     values, blocks along n — `/root/reference/src/quants.hpp:16-19`) into the
     kernel layout for the transposed ``[n, d]`` kernel matrix."""
     q, deltas = blocks.unpack_q40(raw)  # [d*n/32, 32] in -8..7, [d*n/32]
     q = q.reshape(d, n).T  # [n, d] = [K, O]
     deltas = deltas.reshape(d, n // QK).T  # [K/32, O]
-    return pack_q40(q, deltas)
+    return pack_q40(q, deltas, to_device)
 
 
-def repack_q80(raw: np.ndarray, d: int, n: int) -> QuantTensor:
+def repack_q80(raw: np.ndarray, d: int, n: int, to_device: bool = True) -> QuantTensor:
     q, deltas = blocks.unpack_q80(raw)
-    return pack_q80(q.reshape(d, n).T, deltas.reshape(d, n // QK).T)
+    return pack_q80(q.reshape(d, n).T, deltas.reshape(d, n // QK).T, to_device)
 
 
 def dequantize(qt: QuantTensor) -> np.ndarray:

@@ -63,33 +63,42 @@ def _replicated_spec(arr) -> P:
     return P(*([None] * arr.ndim))
 
 
+#: per-layer matrices that shard their output axis over tp (MoE expert
+#: stacks stay replicated for now — per-expert O-sharding is a follow-up)
+SHARDED_MATRICES = frozenset({"wq", "wk", "wv", "wo", "w1", "w2", "w3"})
+
+
+def validate_quant_tp(cfg: ModelConfig, n_tp: int) -> None:
+    check_tp_compatible(cfg, n_tp)
+    if cfg.dim % n_tp or cfg.kv_dim % n_tp:
+        raise ValueError(f"tp={n_tp} must divide dim={cfg.dim} and kv_dim={cfg.kv_dim}")
+
+
+def leaf_specs(leaf, sharded: bool):
+    """PartitionSpec(s) for one param leaf — a QuantTensor gets a spec per
+    plane (same treedef), a plain array a single spec."""
+    mk = _out_shard_spec if sharded else _replicated_spec
+    if isinstance(leaf, QuantTensor):
+        return QuantTensor(
+            w=mk(leaf.w), s=mk(leaf.s), s2=mk(leaf.s2),
+            kind=leaf.kind, k_logical=leaf.k_logical,
+        )
+    return mk(leaf)
+
+
 def quant_param_specs(params: dict, cfg: ModelConfig, n_tp: int) -> dict:
     """Leaf-level PartitionSpec tree matching ``params`` (QuantTensor fields
     get their own specs). Quantized matrices and the dense big matrices are
     output-sharded; norms/embedding are replicated (the root holds them whole
     in the reference too). ``wcls`` is sharded only when tp divides vocab."""
-    check_tp_compatible(cfg, n_tp)
-    if cfg.dim % n_tp or cfg.kv_dim % n_tp:
-        raise ValueError(f"tp={n_tp} must divide dim={cfg.dim} and kv_dim={cfg.kv_dim}")
-
+    validate_quant_tp(cfg, n_tp)
     shard_wcls = cfg.vocab_size % n_tp == 0
-
-    def leaf_specs(name: str, leaf, sharded: bool):
-        mk = _out_shard_spec if sharded else _replicated_spec
-        if isinstance(leaf, QuantTensor):
-            return QuantTensor(
-                w=mk(leaf.w), s=mk(leaf.s), s2=mk(leaf.s2),
-                kind=leaf.kind, k_logical=leaf.k_logical,
-            )
-        return mk(leaf)
-
-    sharded_names = {"wq", "wk", "wv", "wo", "w1", "w2", "w3"}
     specs: dict = {
         "embedding": _replicated_spec(params["embedding"]),
         "rms_final": _replicated_spec(params["rms_final"]),
-        "wcls": leaf_specs("wcls", params["wcls"], shard_wcls),
+        "wcls": leaf_specs(params["wcls"], shard_wcls),
         "layers": {
-            name: leaf_specs(name, leaf, name in sharded_names)
+            name: leaf_specs(leaf, name in SHARDED_MATRICES)
             for name, leaf in params["layers"].items()
         },
     }

@@ -124,6 +124,14 @@ class ServerState:
             # leave generate() with no input at all
             if suffix or session.pending_token is not None:
                 return session, suffix
+        if session is not None:
+            # mismatch: free the stale KV cache's device buffers NOW — the
+            # from-scratch prefill below allocates a fresh cache, and waiting
+            # for GC would transiently double the cache HBM footprint
+            import jax
+
+            for leaf in jax.tree.leaves(session.cache):
+                leaf.delete()
         return None, prompt_tokens
 
     def store_prefix_session(self, tokens: list, session) -> None:

@@ -11,8 +11,28 @@
 #
 # Usage: examples/macbeth.sh [model.m tokenizer.t]
 # Set DLLAMA_PLATFORM=cpu to force the CPU backend (e.g. no TPU attached).
+#
+# Published-checkpoint mode (network required — this build environment is
+# zero-egress, so it only works where HuggingFace is reachable):
+#   MACBETH_DOWNLOAD=tinyllama examples/macbeth.sh
+# downloads the published TinyLlama-1.1B Q40 checkpoint via
+# dllama_tpu.convert.download (same files the reference's launcher fetches)
+# and runs the determinism check against the real model; with
+# MACBETH_EXPECT set, the continuation must also start with that string
+# (the reference pins an expected Macbeth continuation the same way).
 set -e
 cd "$(dirname "$0")/.."
+
+if [ -n "$MACBETH_DOWNLOAD" ]; then
+  # e.g. MACBETH_DOWNLOAD=tinylama_1.1b_3t_q40 (see convert/download.py MODELS)
+  python - <<PYEOF
+from dllama_tpu.convert.download import download_model
+download_model("$MACBETH_DOWNLOAD", "/tmp/dllama_models")
+PYEOF
+  NAME=$(python -c "from dllama_tpu.convert.download import ALIASES; n='$MACBETH_DOWNLOAD'.replace('-','_'); print(ALIASES.get(n, n))")
+  MODEL="/tmp/dllama_models/$NAME/dllama_model_$NAME.m"
+  TOKENIZER="/tmp/dllama_models/$NAME/dllama_tokenizer_$NAME.t"
+fi
 
 MODEL=${1:-/tmp/dllama_macbeth_demo.m}
 TOKENIZER=${2:-/tmp/dllama_macbeth_demo.t}
@@ -55,3 +75,15 @@ if [ "$A" != "$B" ]; then
   exit 1
 fi
 echo "✅ deterministic: two greedy runs produced identical continuations"
+
+if [ -n "$MACBETH_EXPECT" ]; then
+  case "$A" in
+    "$MACBETH_EXPECT"*)
+      echo "✅ continuation matches the pinned expectation" ;;
+    *)
+      echo "❌ continuation diverged from the pinned expectation"
+      echo "expected prefix: $MACBETH_EXPECT"
+      echo "got: $A"
+      exit 1 ;;
+  esac
+fi

@@ -200,11 +200,15 @@ int Generate(const Args& args) {
     int next;
     if (pos + 1 < n_prompt) {
       next = prompt_tokens[pos + 1];  // forced prompt token
-    } else {
+    } else if (generated < args.steps) {
       next = sampler.Sample(logits);
       ++generated;
       infer_ms_total += t_infer;
       gen_ms_total += NowMs() - t0;
+    } else {
+      // --steps 0: the final prompt position still runs (cache warm-up) but
+      // no token is sampled or emitted
+      break;
     }
 
     if (pos + 1 >= n_prompt) {

@@ -38,13 +38,16 @@ Manifest LoadManifest(const std::string& dir) {
     std::string key;
     ss >> key;
     if (key == "dllama_native") {
-      ss >> m.version;
+      if (!(ss >> m.version))
+        throw std::runtime_error("manifest: bad version line: " + line);
     } else if (key == "model") {
       ss >> m.model_name;
     } else if (key == "vocab_size") {
-      ss >> m.vocab_size;
+      if (!(ss >> m.vocab_size) || m.vocab_size <= 0)
+        throw std::runtime_error("manifest: bad vocab_size line: " + line);
     } else if (key == "seq_len") {
-      ss >> m.seq_len;
+      if (!(ss >> m.seq_len) || m.seq_len <= 0)
+        throw std::runtime_error("manifest: bad seq_len line: " + line);
     } else if (key == "plugin") {
       ss >> m.plugin_path;
     } else if (key == "option") {

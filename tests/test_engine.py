@@ -196,3 +196,21 @@ def test_cli_parser_worker_and_multihost_flags():
     # no topology flags -> single host, no jax.distributed call
     plain = p.parse_args(["generate", "--model", "m.m", "--tokenizer", "t.t"])
     assert cli.maybe_init_distributed(plain) == 0
+
+
+def test_token_stats_split_inference_from_transfer():
+    """The I/T split must be real: inference (device-wait) + transfer
+    (host+dispatch) partition generation time, and inference is not just a
+    copy of G (the round-2 verdict's cosmetic-split finding). Reference
+    surface: `/root/reference/src/apps/dllama/dllama.cpp:74-75`."""
+    eng, _ = make_engine()
+    stats = [s for _, s in eng.generate([1, 2, 3], steps=6)]
+    decode_stats = stats[1:]  # first entry reports the prefill
+    assert decode_stats
+    for s in decode_stats:
+        assert s.inference_ms >= 0 and s.transfer_ms >= 0
+        assert abs((s.inference_ms + s.transfer_ms) - s.generation_ms) < 0.5
+    # dispatch overhead exists on every backend: at least one token must show
+    # a nonzero transfer component distinct from generation time
+    assert any(s.transfer_ms > 0 for s in decode_stats)
+    assert any(abs(s.inference_ms - s.generation_ms) > 1e-9 for s in decode_stats)

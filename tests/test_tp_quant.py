@@ -100,3 +100,43 @@ def test_quant_tp_indivisible_vocab_replicates_wcls():
         lambda p, r, c, t: llama.forward(cfg, p, r, t, c, jnp.int32(0))
     )(jax.tree.map(jnp.asarray, qp), rope, llama.init_cache(cfg), jnp.asarray([2], jnp.int32))
     np.testing.assert_allclose(np.asarray(logits), np.asarray(ref), rtol=1e-4, atol=1e-4)
+
+
+def test_quant_reader_streams_onto_mesh(tmp_path):
+    """quant_params_from_reader(mesh=...) must place every big-matrix plane
+    sharded (never whole on one device — the 70B-class load path) and decode
+    identically to the host-loaded single-device engine."""
+    from dllama_tpu.formats.spec import ArchType, ModelSpec
+    from dllama_tpu.formats.weights import tensor_plan, write_model, WeightFileReader
+    from dllama_tpu.quants import blocks
+
+    spec = ModelSpec(
+        arch=ArchType.LLAMA, dim=CFG.dim, hidden_dim=CFG.hidden_dim,
+        n_layers=CFG.n_layers, n_heads=CFG.n_heads, n_kv_heads=CFG.n_kv_heads,
+        vocab_size=CFG.vocab_size, seq_len=CFG.seq_len,
+        weights_float_type=blocks.Q40,
+    )
+    rng = np.random.default_rng(9)
+    path = str(tmp_path / "stream_q40.m")
+    write_model(
+        path, spec,
+        {e.name: 0.05 * rng.standard_normal(e.d * e.n).astype(np.float32)
+         for e in tensor_plan(spec)},
+    )
+    mesh = tp_mesh(8)
+    with WeightFileReader(path) as reader:
+        sharded = llama.quant_params_from_reader(reader, CFG, "q40", mesh=mesh)
+    with WeightFileReader(path) as reader:
+        host = llama.quant_params_from_reader(reader, CFG, "q40")
+
+    wq = sharded["layers"]["wq"]
+    # packed plane sharded on its output axis: a single device holds 1/8
+    assert wq.w.sharding.spec[-1] == "tp"
+    local = wq.w.addressable_shards[0].data.shape
+    assert local[-1] == CFG.dim // 8
+
+    e_tp = Engine(CFG, sharded, SamplerConfig(temperature=0.0), mesh=mesh)
+    t_tp, _, _ = e_tp.generate_fused([3, 7, 11], steps=6)
+    e_host = Engine(CFG, host, SamplerConfig(temperature=0.0))
+    t_host, _, _ = e_host.generate_fused([3, 7, 11], steps=6)
+    assert t_tp == t_host
