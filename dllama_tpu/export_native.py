@@ -101,6 +101,10 @@ def plugin_options() -> tuple:
     return plugin, opts
 
 
+#: decode steps fused into one device program in the native chunked loop
+LOOP_STEPS = 32
+
+
 def export_model(
     cfg,
     params: dict,
@@ -111,10 +115,23 @@ def export_model(
     model_name: str = "llama",
     aot: bool = True,
 ) -> str:
-    """Export ``llama.forward`` as a native decode step. Returns ``out_dir``."""
+    """Export ``llama.forward`` for the native runtime. Two programs:
+
+    * ``model.mlir`` — one decode step (token in, logits out); used for
+      prompt feeding and the tail of a generation.
+    * ``model_loop.mlir`` — ``LOOP_STEPS`` decode steps fused into ONE device
+      program (lax.scan, sampling on device via runtime.sampler), so the
+      native loop dispatches once per chunk and pulls ``LOOP_STEPS`` token
+      ids (4 bytes each) instead of a full f32 logits vector per token —
+      the north star's "no per-token host round-trips" for the C++ path,
+      matching the Python engine's fused ``_decode_loop``.
+
+    Returns ``out_dir``.
+    """
     from jax import export as jax_export
 
     from dllama_tpu.models import llama
+    from dllama_tpu.runtime.sampler import sample_dynamic
 
     os.makedirs(out_dir, exist_ok=True)
     rope = llama.rope_tables(cfg)
@@ -136,21 +153,50 @@ def export_model(
         )
         return logits[0], new_cache["k"], new_cache["v"]
 
-    token = jnp.zeros((1,), jnp.int32)
-    pos = jnp.int32(0)
-    jitted = jax.jit(step, donate_argnums=(1, 2))
-    exp = jax_export.export(jitted)(leaves, cache["k"], cache["v"], token, pos)
-
-    n_args = len(leaves) + 4
-    kept = getattr(exp, "module_kept_var_idx", None)
-    if kept is not None and len(kept) != n_args:
-        raise RuntimeError(
-            f"exported module dropped arguments ({len(kept)}/{n_args} kept); "
-            "the manifest arg order would be wrong"
+    def loop(weight_leaves, k_cache, v_cache, token, pos, temp, topp, seed):
+        wts = jax.tree_util.tree_unflatten(
+            jax.tree_util.tree_structure(weights), weight_leaves
         )
 
+        def body(carry, _):
+            k_c, v_c, tok, p, key = carry
+            key, sub = jax.random.split(key)
+            logits, new_cache = llama.forward(
+                cfg, wts["params"], wts["rope"], tok, {"k": k_c, "v": v_c}, p
+            )
+            nxt = sample_dynamic(logits[0], sub, temp, topp)
+            return (new_cache["k"], new_cache["v"], nxt[None], p + 1, key), nxt
+
+        key0 = jax.random.PRNGKey(seed)
+        (k_c, v_c, _, _, _), toks = jax.lax.scan(
+            body, (k_cache, v_cache, token, pos, key0), length=LOOP_STEPS
+        )
+        return toks, k_c, v_c
+
+    token = jnp.zeros((1,), jnp.int32)
+    pos = jnp.int32(0)
+    temp, topp, seed = jnp.float32(0.8), jnp.float32(0.9), jnp.int32(1)
+
+    def check_kept(exp, n_args, what):
+        kept = getattr(exp, "module_kept_var_idx", None)
+        if kept is not None and len(kept) != n_args:
+            raise RuntimeError(
+                f"exported {what} dropped arguments ({len(kept)}/{n_args} "
+                "kept); the manifest arg order would be wrong"
+            )
+
+    jitted = jax.jit(step, donate_argnums=(1, 2))
+    exp = jax_export.export(jitted)(leaves, cache["k"], cache["v"], token, pos)
+    check_kept(exp, len(leaves) + 4, "step module")
     with open(os.path.join(out_dir, "model.mlir"), "wb") as f:
         f.write(exp.mlir_module_serialized)
+
+    jitted_loop = jax.jit(loop, donate_argnums=(1, 2))
+    loop_args = (leaves, cache["k"], cache["v"], token, pos, temp, topp, seed)
+    exp_loop = jax_export.export(jitted_loop)(*loop_args)
+    check_kept(exp_loop, len(leaves) + 7, "loop module")
+    with open(os.path.join(out_dir, "model_loop.mlir"), "wb") as f:
+        f.write(exp_loop.mlir_module_serialized)
 
     from jax._src.lib import xla_client as xc
 
@@ -158,6 +204,7 @@ def export_model(
         f.write(xc.CompileOptions().SerializeAsString())
 
     executable_file = ""
+    loop_executable_file = ""
     if aot:
         try:
             compiled = jitted.lower(
@@ -167,6 +214,12 @@ def export_model(
             with open(os.path.join(out_dir, "executable.bin"), "wb") as f:
                 f.write(ser)
             executable_file = "executable.bin"
+            ser_loop = (
+                jitted_loop.lower(*loop_args).compile().runtime_executable().serialize()
+            )
+            with open(os.path.join(out_dir, "executable_loop.bin"), "wb") as f:
+                f.write(ser_loop)
+            loop_executable_file = "executable_loop.bin"
         except Exception as e:  # serialization is backend-dependent
             print(f"⚠️  AOT executable serialization unavailable: {e}")
 
@@ -188,6 +241,13 @@ def export_model(
     ]
     if executable_file:
         lines.append(f"executable_file {executable_file}")
+    # loop program args = the step program's inputs (same order) followed by
+    # temp f32[], topp f32[], seed i32[]; outputs = tokens i32[loop_steps]
+    # then the caches (same order as the cache inputs)
+    lines.append("loop_mlir_file model_loop.mlir")
+    lines.append(f"loop_steps {LOOP_STEPS}")
+    if loop_executable_file:
+        lines.append(f"loop_executable_file {loop_executable_file}")
 
     def dtype_name(arr) -> str:
         return _DTYPE_NAMES[str(arr.dtype)]

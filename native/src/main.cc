@@ -113,6 +113,31 @@ int Generate(const Args& args) {
     exec = client.Compile(ReadFile(m.path(m.mlir_file)),
                           ReadFile(m.path(m.compile_options_file)));
   }
+
+  // Fused decode-loop program: one Execute = loop_steps tokens, sampled on
+  // device (the Python engine's _decode_loop for the native path) — the host
+  // pulls loop_steps token ids instead of a logits vector per token.
+  Executable loop_exec;
+  bool have_loop = false;
+  if (!m.loop_mlir_file.empty() && m.loop_steps > 0) {
+    bool loop_loaded = false;
+    if (!m.loop_executable_file.empty()) {
+      try {
+        loop_exec = client.Deserialize(ReadFile(m.path(m.loop_executable_file)));
+        loop_loaded = true;
+      } catch (const std::exception& e) {
+        std::fprintf(stderr, "⚠️  loop deserialize failed (%s), compiling\n",
+                     e.what());
+      }
+    }
+    if (!loop_loaded) {
+      loop_exec = client.Compile(ReadFile(m.path(m.loop_mlir_file)),
+                                 ReadFile(m.path(m.compile_options_file)));
+    }
+    have_loop = true;
+    std::fprintf(stderr, "⏩ fused %lld-step decode loop ready\n",
+                 static_cast<long long>(m.loop_steps));
+  }
   std::fprintf(stderr, "🕒 program ready in %lld ms\n",
                static_cast<long long>(NowMs() - t_compile0));
 
@@ -167,64 +192,121 @@ int Generate(const Args& args) {
   Sampler sampler(args.temperature, args.topp, args.seed);
   std::vector<int> prompt_tokens = tok.Encode(args.prompt, /*add_bos=*/true);
   const int n_prompt = static_cast<int>(prompt_tokens.size());
-  // sampling happens at positions n_prompt-1 .. total-1, one sampled token
-  // per position: total = n_prompt + steps - 1 emits exactly `steps` tokens
-  // (matching the Python engine's steps = generated-token count)
-  const int total = std::min<int>(n_prompt + std::max(args.steps - 1, 0),
-                                  static_cast<int>(m.seq_len));
+  if (n_prompt > static_cast<int>(m.seq_len))
+    throw std::runtime_error(
+        "prompt of " + std::to_string(n_prompt) +
+        " tokens exceeds seq_len " + std::to_string(m.seq_len));
 
   std::vector<float> logits(static_cast<size_t>(m.vocab_size));
   int token = prompt_tokens.empty() ? tok.bos_id() : prompt_tokens[0];
   int64_t infer_ms_total = 0, gen_ms_total = 0;
   int generated = 0;
+  int pos = 0;
 
-  for (int pos = 0; pos < total; ++pos) {
-    const int64_t t0 = NowMs();
-    // Host-fed scalars for this step.
+  auto run_step = [&](bool pull_logits) {
     const int32_t tok_host[1] = {token};
     const int32_t pos_host = pos;
     bufs[token_idx] = client.ToDevice(tok_host, PJRT_Buffer_Type_S32, {1});
     bufs[pos_idx] = client.ToDevice(&pos_host, PJRT_Buffer_Type_S32, {});
-
     std::vector<PJRT_Buffer*> arglist(bufs.size());
     for (size_t i = 0; i < bufs.size(); ++i) arglist[i] = bufs[i].get();
     std::vector<Buffer> outs = exec.Execute(arglist);
-
-    // Donated cache inputs were consumed; adopt the aliased outputs.
     for (size_t c = 0; c < cache_idx.size(); ++c)
       bufs[cache_idx[c]] = std::move(outs[1 + c]);
+    if (pull_logits) outs[0].ToHost(logits.data(), logits.size() * sizeof(float));
+  };
 
-    outs[0].ToHost(logits.data(), logits.size() * sizeof(float));
-    const int64_t t_infer = NowMs() - t0;
+  // Prompt phase: feed positions 0..n_prompt-2 (forced tokens, logits never
+  // read — the reference feeds the prompt the same one-position-at-a-time
+  // way, /root/reference/src/apps/dllama/dllama.cpp:43-55).
+  for (; pos + 1 < n_prompt; ++pos) {
+    run_step(/*pull_logits=*/false);
+    token = prompt_tokens[pos + 1];
+  }
 
-    int next;
-    if (pos + 1 < n_prompt) {
-      next = prompt_tokens[pos + 1];  // forced prompt token
-    } else if (generated < args.steps) {
-      next = sampler.Sample(logits);
-      ++generated;
+  // Decode phase: fused chunks when the loop program fits, per-step tail
+  // otherwise. A chunk always runs loop_steps positions; unconsumed tail
+  // slots in the KV cache are overwritten before any later query can attend
+  // them (same argument as the Python engine's bucketed overshoot).
+  const int N = static_cast<int>(m.loop_steps);
+  int remaining = std::min<int>(args.steps,
+                                static_cast<int>(m.seq_len) - n_prompt);
+  std::vector<int32_t> chunk(static_cast<size_t>(N > 0 ? N : 1));
+  int n_chunks = 0;
+  bool eos = false;
+
+  while (remaining > 0 && !eos && pos < static_cast<int>(m.seq_len)) {
+    const int64_t t0 = NowMs();
+    // chunk only when a full chunk's tokens are wanted AND it fits in the
+    // context; short tails take the cheaper single-step path
+    if (have_loop && remaining >= N && pos + N <= static_cast<int>(m.seq_len)) {
+      const int32_t tok_host[1] = {token};
+      const int32_t pos_host = pos;
+      const float temp_host = args.temperature;
+      const float topp_host = args.topp;
+      const int32_t seed_host = static_cast<int32_t>(
+          (args.seed + 1000003ull * static_cast<uint64_t>(n_chunks)) & 0x7fffffff);
+      bufs[token_idx] = client.ToDevice(tok_host, PJRT_Buffer_Type_S32, {1});
+      bufs[pos_idx] = client.ToDevice(&pos_host, PJRT_Buffer_Type_S32, {});
+      Buffer temp_b = client.ToDevice(&temp_host, PJRT_Buffer_Type_F32, {});
+      Buffer topp_b = client.ToDevice(&topp_host, PJRT_Buffer_Type_F32, {});
+      Buffer seed_b = client.ToDevice(&seed_host, PJRT_Buffer_Type_S32, {});
+
+      std::vector<PJRT_Buffer*> arglist(bufs.size() + 3);
+      for (size_t i = 0; i < bufs.size(); ++i) arglist[i] = bufs[i].get();
+      arglist[bufs.size()] = temp_b.get();
+      arglist[bufs.size() + 1] = topp_b.get();
+      arglist[bufs.size() + 2] = seed_b.get();
+
+      std::vector<Buffer> outs = loop_exec.Execute(arglist);
+      for (size_t c = 0; c < cache_idx.size(); ++c)
+        bufs[cache_idx[c]] = std::move(outs[1 + c]);
+      outs[0].ToHost(chunk.data(), static_cast<size_t>(N) * sizeof(int32_t));
+      const int64_t t_infer = NowMs() - t0;
+      ++n_chunks;
+
+      const int take = std::min<int>(N, remaining);
+      int consumed = 0;
+      for (int i = 0; i < take; ++i) {
+        const int next = chunk[static_cast<size_t>(i)];
+        const std::string piece = tok.DecodePiece(token, next);
+        std::fwrite(piece.data(), 1, piece.size(), stdout);
+        token = next;
+        ++consumed;
+        if (token == tok.eos_id()) { eos = true; break; }
+      }
+      std::fflush(stdout);
+      generated += consumed;
+      remaining -= consumed;
+      pos += consumed;
       infer_ms_total += t_infer;
       gen_ms_total += NowMs() - t0;
+      std::fprintf(stderr,
+                   "🔶 chunk %d: %d tok, G %4lld ms I %4lld ms "
+                   "(%.2f ms/token)\n",
+                   n_chunks, consumed, static_cast<long long>(NowMs() - t0),
+                   static_cast<long long>(t_infer),
+                   consumed > 0 ? static_cast<double>(NowMs() - t0) / consumed
+                                : 0.0);
     } else {
-      // --steps 0: the final prompt position still runs (cache warm-up) but
-      // no token is sampled or emitted
-      break;
-    }
-
-    if (pos + 1 >= n_prompt) {
+      run_step(/*pull_logits=*/true);
+      const int64_t t_infer = NowMs() - t0;
+      const int next = sampler.Sample(logits);
+      ++generated;
+      --remaining;
+      infer_ms_total += t_infer;
+      gen_ms_total += NowMs() - t0;
       const std::string piece = tok.DecodePiece(token, next);
       std::fwrite(piece.data(), 1, piece.size(), stdout);
       std::fflush(stdout);
+      std::fprintf(stderr, "🔶 G %4lld ms I %4lld ms T %4lld ms | pos %d\n",
+                   static_cast<long long>(NowMs() - t0),
+                   static_cast<long long>(t_infer),
+                   static_cast<long long>(NowMs() - t0 - t_infer), pos);
+      token = next;
+      ++pos;
+      if (token == tok.eos_id()) eos = true;
     }
-    std::fprintf(stderr, "🔶 G %4lld ms I %4lld ms T %4lld ms | pos %d\n",
-                 static_cast<long long>(NowMs() - t0),
-                 static_cast<long long>(t_infer),
-                 static_cast<long long>(NowMs() - t0 - t_infer),
-                 pos);
-    token = next;
-    // stop only on a SAMPLED eos — a prompt may legitimately contain eos
-    // tokens (e.g. multi-turn chat transcripts with turn separators)
-    if (pos + 1 >= n_prompt && token == tok.eos_id()) break;
   }
 
   std::printf("\n");

@@ -65,6 +65,13 @@ Manifest LoadManifest(const std::string& dir) {
       ss >> m.compile_options_file;
     } else if (key == "executable_file") {
       ss >> m.executable_file;
+    } else if (key == "loop_mlir_file") {
+      ss >> m.loop_mlir_file;
+    } else if (key == "loop_executable_file") {
+      ss >> m.loop_executable_file;
+    } else if (key == "loop_steps") {
+      if (!(ss >> m.loop_steps) || m.loop_steps <= 0)
+        throw std::runtime_error("manifest: bad loop_steps line: " + line);
     } else if (key == "input") {
       // input <name> <kind> <dtype> <offset> <nbytes> <ndims> <dims...>
       ArgSpec a;

@@ -51,6 +51,14 @@ struct Manifest {
   std::string mlir_file;
   std::string compile_options_file;
   std::string executable_file;  // "" if absent
+  // Fused decode-loop program (optional; "" / 0 if absent). Its argument
+  // list is the step program's inputs in the same order, followed by three
+  // host-fed scalars: temperature f32[], topp f32[], seed i32[]. Outputs are
+  // tokens i32[loop_steps] followed by the caches (same order as the cache
+  // inputs). One Execute decodes loop_steps tokens with on-device sampling.
+  std::string loop_mlir_file;
+  std::string loop_executable_file;
+  int64_t loop_steps = 0;
   std::vector<ArgSpec> inputs;
   std::vector<OutSpec> outputs;
   std::string dir;  // directory the manifest was loaded from
