@@ -203,16 +203,25 @@ int Generate(const Args& args) {
   int generated = 0;
   int pos = 0;
 
-  auto run_step = [&](bool pull_logits) {
+  // Stage token/pos (+ any extra trailing scalars), execute, adopt the
+  // donated caches; returns the outputs (outs[0] = logits or tokens).
+  auto run_program = [&](Executable& program,
+                         const std::vector<Buffer*>& extra) {
     const int32_t tok_host[1] = {token};
     const int32_t pos_host = pos;
     bufs[token_idx] = client.ToDevice(tok_host, PJRT_Buffer_Type_S32, {1});
     bufs[pos_idx] = client.ToDevice(&pos_host, PJRT_Buffer_Type_S32, {});
-    std::vector<PJRT_Buffer*> arglist(bufs.size());
+    std::vector<PJRT_Buffer*> arglist(bufs.size() + extra.size());
     for (size_t i = 0; i < bufs.size(); ++i) arglist[i] = bufs[i].get();
-    std::vector<Buffer> outs = exec.Execute(arglist);
+    for (size_t i = 0; i < extra.size(); ++i)
+      arglist[bufs.size() + i] = extra[i]->get();
+    std::vector<Buffer> outs = program.Execute(arglist);
     for (size_t c = 0; c < cache_idx.size(); ++c)
       bufs[cache_idx[c]] = std::move(outs[1 + c]);
+    return outs;
+  };
+  auto run_step = [&](bool pull_logits) {
+    std::vector<Buffer> outs = run_program(exec, {});
     if (pull_logits) outs[0].ToHost(logits.data(), logits.size() * sizeof(float));
   };
 
@@ -229,38 +238,36 @@ int Generate(const Args& args) {
   // slots in the KV cache are overwritten before any later query can attend
   // them (same argument as the Python engine's bucketed overshoot).
   const int N = static_cast<int>(m.loop_steps);
+  // the first sample comes from position n_prompt-1, the last usable one
+  // from seq_len-1: at most seq_len - n_prompt + 1 tokens
   int remaining = std::min<int>(args.steps,
-                                static_cast<int>(m.seq_len) - n_prompt);
+                                static_cast<int>(m.seq_len) - n_prompt + 1);
   std::vector<int32_t> chunk(static_cast<size_t>(N > 0 ? N : 1));
   int n_chunks = 0;
   bool eos = false;
+
+  if (remaining <= 0 && pos < static_cast<int>(m.seq_len)) {
+    // --steps 0: still feed the final prompt position (KV warm-up), just
+    // never sample
+    run_step(/*pull_logits=*/false);
+    ++pos;
+  }
 
   while (remaining > 0 && !eos && pos < static_cast<int>(m.seq_len)) {
     const int64_t t0 = NowMs();
     // chunk only when a full chunk's tokens are wanted AND it fits in the
     // context; short tails take the cheaper single-step path
     if (have_loop && remaining >= N && pos + N <= static_cast<int>(m.seq_len)) {
-      const int32_t tok_host[1] = {token};
-      const int32_t pos_host = pos;
       const float temp_host = args.temperature;
       const float topp_host = args.topp;
       const int32_t seed_host = static_cast<int32_t>(
           (args.seed + 1000003ull * static_cast<uint64_t>(n_chunks)) & 0x7fffffff);
-      bufs[token_idx] = client.ToDevice(tok_host, PJRT_Buffer_Type_S32, {1});
-      bufs[pos_idx] = client.ToDevice(&pos_host, PJRT_Buffer_Type_S32, {});
       Buffer temp_b = client.ToDevice(&temp_host, PJRT_Buffer_Type_F32, {});
       Buffer topp_b = client.ToDevice(&topp_host, PJRT_Buffer_Type_F32, {});
       Buffer seed_b = client.ToDevice(&seed_host, PJRT_Buffer_Type_S32, {});
 
-      std::vector<PJRT_Buffer*> arglist(bufs.size() + 3);
-      for (size_t i = 0; i < bufs.size(); ++i) arglist[i] = bufs[i].get();
-      arglist[bufs.size()] = temp_b.get();
-      arglist[bufs.size() + 1] = topp_b.get();
-      arglist[bufs.size() + 2] = seed_b.get();
-
-      std::vector<Buffer> outs = loop_exec.Execute(arglist);
-      for (size_t c = 0; c < cache_idx.size(); ++c)
-        bufs[cache_idx[c]] = std::move(outs[1 + c]);
+      std::vector<Buffer> outs =
+          run_program(loop_exec, {&temp_b, &topp_b, &seed_b});
       outs[0].ToHost(chunk.data(), static_cast<size_t>(N) * sizeof(int32_t));
       const int64_t t_infer = NowMs() - t0;
       ++n_chunks;

@@ -1,5 +1,6 @@
 #include "manifest.h"
 
+#include <cstdio>
 #include <fstream>
 #include <sstream>
 #include <stdexcept>
@@ -93,7 +94,9 @@ Manifest LoadManifest(const std::string& dir) {
       if (!ss) throw std::runtime_error("manifest: bad output line: " + line);
       m.outputs.push_back(std::move(o));
     } else {
-      throw std::runtime_error("manifest: unknown key " + key);
+      // forward compatibility: a newer exporter may add optional sections
+      // (the loop_* keys were added this way) — warn, don't abort
+      std::fprintf(stderr, "manifest: ignoring unknown key %s\n", key.c_str());
     }
   }
   if (m.version != 1)

@@ -1,0 +1,46 @@
+// xorshift64* float stream generator for golden cross-check tests.
+//
+// The reference's integration tests seed their synthetic weights from the
+// public xorshift64* PRNG (Wikipedia "Xorshift#xorshift*"; the reference
+// uses it at /root/reference/src/utils.cpp:53-64) and pin spot values of the
+// resulting forward pass. To validate THIS framework against those same
+// pinned numbers, the test needs the identical float stream — hundreds of
+// millions of sequential values, far too slow to produce in Python. This
+// tool writes n raw floats ((u32 >> 8) / 2^24, in [0,1)) to a file.
+//
+// Usage: xorshift-gen <seed> <count> <out_path>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <string>
+#include <vector>
+
+int main(int argc, char** argv) {
+  if (argc != 4) {
+    std::fprintf(stderr, "usage: xorshift-gen <seed> <count> <out_path>\n");
+    return 2;
+  }
+  uint64_t state = std::strtoull(argv[1], nullptr, 10);
+  const int64_t count = std::strtoll(argv[2], nullptr, 10);
+  FILE* f = std::fopen(argv[3], "wb");
+  if (!f) {
+    std::fprintf(stderr, "cannot open %s\n", argv[3]);
+    return 1;
+  }
+  std::vector<float> buf;
+  buf.reserve(1 << 20);
+  for (int64_t i = 0; i < count; ++i) {
+    state ^= state >> 12;
+    state ^= state << 25;
+    state ^= state >> 27;
+    const uint32_t u = static_cast<uint32_t>((state * 0x2545F4914F6CDD1Dull) >> 32);
+    buf.push_back(static_cast<float>(u >> 8) / 16777216.0f);
+    if (buf.size() == (1 << 20)) {
+      std::fwrite(buf.data(), sizeof(float), buf.size(), f);
+      buf.clear();
+    }
+  }
+  if (!buf.empty()) std::fwrite(buf.data(), sizeof(float), buf.size(), f);
+  std::fclose(f);
+  return 0;
+}
