@@ -185,7 +185,8 @@ def quant_params_from_reader(reader: WeightFileReader, cfg: ModelConfig,
         n_tp = mesh.shape[TP]
         quant_tp.validate_quant_tp(cfg, n_tp)
 
-        def place(leaf, sharded: bool):
+        def place(name: str, leaf, sharded: bool):
+            leaf = quant_tp.prepare_quant_leaf(name, leaf, cfg, n_tp)
             specs = quant_tp.leaf_specs(leaf, sharded)
             return jax.tree.map(
                 lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), leaf, specs
@@ -193,7 +194,7 @@ def quant_params_from_reader(reader: WeightFileReader, cfg: ModelConfig,
 
         shard_wcls = cfg.vocab_size % n_tp == 0
     else:
-        def place(leaf, sharded: bool):
+        def place(name: str, leaf, sharded: bool):
             return jax.tree.map(jnp.asarray, leaf)
 
         shard_wcls = False
@@ -215,9 +216,9 @@ def quant_params_from_reader(reader: WeightFileReader, cfg: ModelConfig,
         return jax.tree.map(lambda *xs: np.stack(xs), *items)
 
     p = {
-        "embedding": place(reader.read_tensor("token_embedding", np.float32), False),
-        "rms_final": place(reader.read_tensor("rms_final", np.float32), False),
-        "wcls": place(load_matrix("wcls"), shard_wcls),
+        "embedding": place("embedding", reader.read_tensor("token_embedding", np.float32), False),
+        "rms_final": place("rms_final", reader.read_tensor("rms_final", np.float32), False),
+        "wcls": place("wcls", load_matrix("wcls"), shard_wcls),
     }
     mat_names = ("wq", "wk", "wv", "wo") if cfg.is_moe else QUANTIZABLE
     vec_names = ["rms_att", "rms_ffn"] + (
@@ -244,7 +245,7 @@ def quant_params_from_reader(reader: WeightFileReader, cfg: ModelConfig,
     from dllama_tpu.parallel.quant_tp import SHARDED_MATRICES
 
     p["layers"] = {
-        k: place(np_stack(v), k in SHARDED_MATRICES) for k, v in layers.items()
+        k: place(k, np_stack(v), k in SHARDED_MATRICES) for k, v in layers.items()
     }
     return p
 
@@ -427,7 +428,14 @@ def _gather(x: jnp.ndarray, tp_axis) -> jnp.ndarray:
 def _dense_ffn(cfg: ModelConfig, lp: dict, xb: jnp.ndarray, tp_axis=None) -> jnp.ndarray:
     act = ACTIVATIONS[cfg.hidden_act]
     h = act(matmul_any(xb, lp["w1"])) * matmul_any(xb, lp["w3"])
-    return _gather(matmul_any(_gather(h, tp_axis), lp["w2"]), tp_axis)
+    h = _gather(h, tp_axis)
+    w2 = lp["w2"]
+    w2_in = w2.k_padded if isinstance(w2, QuantTensor) else w2.shape[-2]
+    if h.shape[-1] > w2_in:
+        # w1/w3 were lane-padded but w2 took the dense fallback (its hidden
+        # input not packable): the pad columns are exact zeros, slice them off
+        h = h[..., :w2_in]
+    return _gather(matmul_any(h, w2), tp_axis)
 
 
 def _ffn_residual(cfg: ModelConfig, lp: dict, x: jnp.ndarray, att_out: jnp.ndarray,
@@ -522,7 +530,9 @@ def forward(
     x = rmsnorm(x, params["rms_final"], cfg.norm_eps)
     logits = matmul_any(x, params["wcls"]).astype(jnp.float32)
     if tp_axis is not None and gather_logits:
-        logits = _gather(logits, tp_axis)
+        # slice off any lane-alignment vocab padding (zero logits there would
+        # beat real negative logits in an argmax) — no-op when unpadded
+        logits = _gather(logits, tp_axis)[..., : cfg.vocab_size]
     if cfg.logit_scale != 1.0:
         logits = logits * cfg.logit_scale
     return logits, {"k": new_k, "v": new_v}

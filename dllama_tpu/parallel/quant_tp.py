@@ -31,13 +31,16 @@ consume *gathered* inputs instead of producing psum partials — see
 
 from __future__ import annotations
 
+import math
 from functools import partial
 
 import jax
+import jax.numpy as jnp
+import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from dllama_tpu.models.config import ModelConfig
-from dllama_tpu.ops.qmatmul import QuantTensor
+from dllama_tpu.ops.qmatmul import K_MULTIPLE, QuantTensor, _pad_up
 from dllama_tpu.parallel.mesh import TP
 from dllama_tpu.parallel.sharding import cache_spec, check_tp_compatible
 
@@ -74,6 +77,77 @@ def validate_quant_tp(cfg: ModelConfig, n_tp: int) -> None:
         raise ValueError(f"tp={n_tp} must divide dim={cfg.dim} and kv_dim={cfg.kv_dim}")
 
 
+# ---------------------------------------------------------------------------
+# Lane-alignment padding.
+#
+# On real TPUs every Mosaic block's lane (last) dim must be a multiple of
+# 128, so a *local* shard of an output axis must be 128-aligned. Head-carrying
+# axes (dim, kv_dim) can't be padded (the pad would land inside a head's
+# columns), so those must be 128*tp-aligned by the model itself — true for
+# every published model at any tp the kv-head constraint allows. The FFN
+# hidden axis and the vocab CAN be padded:
+#
+# * w1/w3 output and w2 input pad to the SAME lcm(K_MULTIPLE, 128*tp) width,
+#   so the gathered hidden activation feeds w2 with no slicing; the pad
+#   columns/rows carry zero scales and contribute exactly 0.
+# * sharded wcls pads its vocab axis; the forward slices logits back to
+#   vocab_size after the gather (zero logits in the pad would otherwise win
+#   an argmax over negative real logits).
+# ---------------------------------------------------------------------------
+
+
+def ffn_padded_width(cfg: ModelConfig, kind: str, n_tp: int) -> int:
+    return _pad_up(cfg.hidden_dim, math.lcm(K_MULTIPLE[kind], 128 * n_tp))
+
+
+def _pad_axis(arr, axis: int, target: int):
+    if arr.ndim == 0 or arr.shape[axis] in (0, target):
+        return arr
+    xp = np if isinstance(arr, np.ndarray) else jnp
+    pad = [(0, 0)] * arr.ndim
+    pad[axis] = (0, target - arr.shape[axis])
+    return xp.pad(arr, pad)
+
+
+def _pad_last(arr, target: int):
+    return _pad_axis(arr, -1, target)
+
+
+def _pad_qt_out(qt: QuantTensor, target_o: int) -> QuantTensor:
+    return QuantTensor(
+        w=_pad_last(qt.w, target_o), s=_pad_last(qt.s, target_o),
+        s2=_pad_last(qt.s2, target_o), kind=qt.kind, k_logical=qt.k_logical,
+    )
+
+
+def _pad_qt_in(qt: QuantTensor, target_k: int) -> QuantTensor:
+    """Extend the packed K axis with zero-scale rows (inert: zero scales x
+    anything = 0), e.g. w2's input to the padded FFN width."""
+    if qt.kind == "q40":
+        w = _pad_axis(qt.w, -2, target_k // 2)
+        s = _pad_axis(qt.s, -2, target_k // 64)
+        s2 = _pad_axis(qt.s2, -2, target_k // 64)
+    else:
+        w = _pad_axis(qt.w, -2, target_k)
+        s = _pad_axis(qt.s, -2, target_k // 32)
+        s2 = qt.s2
+    return QuantTensor(w=w, s=s, s2=s2, kind=qt.kind, k_logical=qt.k_logical)
+
+
+def prepare_quant_leaf(name: str, leaf, cfg: ModelConfig, n_tp: int):
+    """Lane-align one param leaf for tp-sharded Pallas execution (see above).
+    Identity for dense arrays, unsharded matrices, and already-aligned dims."""
+    if not isinstance(leaf, QuantTensor) or n_tp <= 1:
+        return leaf
+    if name in ("w1", "w3"):
+        return _pad_qt_out(leaf, ffn_padded_width(cfg, leaf.kind, n_tp))
+    if name == "w2":
+        return _pad_qt_in(leaf, ffn_padded_width(cfg, leaf.kind, n_tp))
+    if name == "wcls" and cfg.vocab_size % n_tp == 0:
+        return _pad_qt_out(leaf, _pad_up(cfg.vocab_size, 128 * n_tp))
+    return leaf
+
+
 def leaf_specs(leaf, sharded: bool):
     """PartitionSpec(s) for one param leaf — a QuantTensor gets a spec per
     plane (same treedef), a plain array a single spec."""
@@ -105,9 +179,25 @@ def quant_param_specs(params: dict, cfg: ModelConfig, n_tp: int) -> dict:
     return specs
 
 
+def prepare_quant_params(params: dict, cfg: ModelConfig, n_tp: int) -> dict:
+    """Lane-align every leaf (idempotent: already-padded leaves pass through)."""
+    return {
+        "embedding": params["embedding"],
+        "rms_final": params["rms_final"],
+        "wcls": prepare_quant_leaf("wcls", params["wcls"], cfg, n_tp),
+        "layers": {
+            k: prepare_quant_leaf(k, v, cfg, n_tp)
+            for k, v in params["layers"].items()
+        },
+    }
+
+
 def shard_quant_params(params: dict, mesh, cfg: ModelConfig) -> dict:
-    """Place a (possibly quantized) param pytree onto the mesh output-sharded."""
-    specs = quant_param_specs(params, cfg, mesh.shape[TP])
+    """Place a (possibly quantized) param pytree onto the mesh output-sharded,
+    lane-aligning shardable axes first (see the padding notes above)."""
+    n_tp = mesh.shape[TP]
+    params = prepare_quant_params(params, cfg, n_tp)
+    specs = quant_param_specs(params, cfg, n_tp)
     return jax.tree.map(
         lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), params, specs
     )

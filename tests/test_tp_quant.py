@@ -140,3 +140,52 @@ def test_quant_reader_streams_onto_mesh(tmp_path):
     e_host = Engine(CFG, host, SamplerConfig(temperature=0.0))
     t_host, _, _ = e_host.generate_fused([3, 7, 11], steps=6)
     assert t_tp == t_host
+
+
+def test_lane_alignment_padding_preserves_logits():
+    """Misaligned hidden/vocab dims (320, 384) get lane-padded for tp — the
+    padded columns/rows carry zero scales, so the distributed logits still
+    equal the unpadded single-device ones exactly."""
+    cfg = ModelConfig(
+        arch="llama", dim=256, hidden_dim=320, n_layers=2, n_heads=8, n_kv_heads=8,
+        vocab_size=384, seq_len=64, head_size=32, kv_dim=256, dtype="float32",
+    )
+    qp = llama.quantize_params(llama.random_params(cfg, seed=5, dtype=np.float32), "q40")
+    mesh = tp_mesh(8)
+    sharded = quant_tp.shard_quant_params(qp, mesh, cfg)
+
+    # w1 output and w2 packed input pad to the same lcm(512, 128*8) width...
+    target = quant_tp.ffn_padded_width(cfg, "q40", 8)
+    assert target % (128 * 8) == 0 and target % 512 == 0
+    assert sharded["layers"]["w1"].w.shape[-1] == target
+    assert sharded["layers"]["w2"].k_padded == target
+    # ...and every local lane count is 128-aligned
+    for name in ("w1", "w3", "wcls"):
+        leaf = sharded["layers"][name] if name != "wcls" else sharded["wcls"]
+        local = leaf.w.addressable_shards[0].data.shape[-1]
+        assert local % 128 == 0, (name, local)
+
+    e_tp = Engine(cfg, sharded, SamplerConfig(temperature=0.0), mesh=mesh)
+    t_tp, _, _ = e_tp.generate_fused([3, 5], steps=6)
+    e_host = Engine(cfg, qp, SamplerConfig(temperature=0.0))
+    t_host, _, _ = e_host.generate_fused([3, 5], steps=6)
+    assert t_tp == t_host
+
+
+@pytest.mark.parametrize("tp", [2, 4, 8])
+@pytest.mark.parametrize("hidden", [5632, 11008, 13824, 14336])
+def test_real_model_ffn_lanes_align(tp, hidden):
+    """For every published model's hidden dim and tp degree, the padded FFN
+    width must make the local shard 128-lane aligned AND stay a valid packed
+    K for the quant kernels — the (deeper) twin of the round-2 K-axis bug."""
+    cfg = ModelConfig(
+        arch="llama", dim=4096, hidden_dim=hidden, n_layers=1, n_heads=32,
+        n_kv_heads=32, vocab_size=32000, seq_len=64, head_size=128,
+        kv_dim=4096, dtype="float32",
+    )
+    for kind in ("q40", "q80"):
+        w = quant_tp.ffn_padded_width(cfg, kind, tp)
+        assert w % tp == 0 and (w // tp) % 128 == 0
+        from dllama_tpu.ops.qmatmul import K_MULTIPLE
+        assert w % K_MULTIPLE[kind] == 0
+        assert w - hidden < K_MULTIPLE[kind] + 128 * tp  # padding stays small
