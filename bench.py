@@ -122,6 +122,9 @@ def run_decode_bench(cfg_dict: dict, bench_steps: int = 64, quant_ok: bool = Fal
     jax.block_until_ready(params)
     eng = Engine(cfg, params, SamplerConfig(temperature=0.0), cache_dtype=jnp.bfloat16,
                  mesh=mesh)
+    # Engine may have fused the projection matrices into new buffers; drop
+    # this frame's reference so the unfused originals free immediately
+    del params
 
     log(f"warmup ({bench_steps} fused steps, incl. compile)...")
     t0 = time.perf_counter()

@@ -145,7 +145,8 @@ def quantize_params(params: dict, kind: str, quantize_wcls: bool = True) -> dict
 
 
 def quant_params_from_reader(reader: WeightFileReader, cfg: ModelConfig,
-                             kind: str = "q40", mesh=None) -> dict:
+                             kind: str = "q40", mesh=None,
+                             fuse: bool = True) -> dict:
     """Load a `.m` file with the big matrices kept block-quantized for the
     fused kernels. When the file's own float type matches ``kind``, the file
     bits are repacked losslessly (no dequant->requant roundtrip), so decode
@@ -244,10 +245,54 @@ def quant_params_from_reader(reader: WeightFileReader, cfg: ModelConfig,
                 )
     from dllama_tpu.parallel.quant_tp import SHARDED_MATRICES
 
+    if mesh is None and fuse:
+        # single-device: fuse shared-input projections ON HOST (numpy planes)
+        # before placement, so the unfused originals never reach HBM —
+        # fusing after device placement would double weight residency
+        p["layers"] = {k: np_stack(v) for k, v in layers.items()}
+        p = fuse_qkv_ffn(p)
+        p["layers"] = {k: place(k, v, False) for k, v in p["layers"].items()}
+        return p
+
     p["layers"] = {
         k: place(k, np_stack(v), k in SHARDED_MATRICES) for k, v in layers.items()
     }
     return p
+
+
+def fuse_qkv_ffn(params: dict) -> dict:
+    """Concatenate quantized projection matrices that share an input into one
+    kernel call each: wq|wk|wv -> ``wqkv`` [D, D+2KV], w1|w3 -> ``w13``
+    [D, 2H], moe_up|moe_gate -> ``moe_upgate`` [E, D, 2H].
+
+    Single-device decode win: 7 fused dequant-matmul launches per layer drop
+    to 4, each with a larger grid that amortizes pipeline warm-up — the same
+    bytes move, in fewer better-overlapped kernels. The forward recognizes
+    the fused names and slices the outputs (slices on [T, O] activations are
+    free next to the matmul). Quant concat is exact: planes are concatenated
+    along the output axis, per-column scales travel with their columns.
+
+    Only for unsharded (mesh-less) params: under TP each part must shard on
+    its own output axis, so fusion would put shard boundaries inside the
+    wrong matrix. The TP engine keeps the unfused layout.
+    """
+    out = dict(params)
+    out["layers"] = layers = dict(params["layers"])
+
+    def cat(*qts):
+        def concat(*xs):
+            xp = np if all(isinstance(x, np.ndarray) for x in xs) else jnp
+            return xp.concatenate(xs, axis=-1)
+
+        return jax.tree.map(concat, *qts)
+
+    if all(isinstance(layers.get(n), QuantTensor) for n in ("wq", "wk", "wv")):
+        layers["wqkv"] = cat(layers.pop("wq"), layers.pop("wk"), layers.pop("wv"))
+    if all(isinstance(layers.get(n), QuantTensor) for n in ("w1", "w3")):
+        layers["w13"] = cat(layers.pop("w1"), layers.pop("w3"))
+    if all(isinstance(layers.get(n), QuantTensor) for n in ("moe_up", "moe_gate")):
+        layers["moe_upgate"] = cat(layers.pop("moe_up"), layers.pop("moe_gate"))
+    return out
 
 
 def device_random_quant_params(cfg: ModelConfig, kind: str = "q40", seed: int = 0) -> dict:
@@ -427,6 +472,11 @@ def _gather(x: jnp.ndarray, tp_axis) -> jnp.ndarray:
 
 def _dense_ffn(cfg: ModelConfig, lp: dict, xb: jnp.ndarray, tp_axis=None) -> jnp.ndarray:
     act = ACTIVATIONS[cfg.hidden_act]
+    if "w13" in lp:  # fused single-kernel up|gate projection (fuse_qkv_ffn)
+        u = matmul_any(xb, lp["w13"])
+        half = u.shape[-1] // 2
+        h = act(u[..., :half]) * u[..., half:]
+        return matmul_any(h, lp["w2"])
     h = act(matmul_any(xb, lp["w1"])) * matmul_any(xb, lp["w3"])
     h = _gather(h, tp_axis)
     w2 = lp["w2"]
@@ -475,9 +525,19 @@ def _attn_block(cfg: ModelConfig, lp: dict, rope: dict, x, k_cache, v_cache, pos
     T = x.shape[0]
     xb = rmsnorm(x, lp["rms_att"], cfg.norm_eps)
 
-    q = matmul_any(xb, lp["wq"]).reshape(T, -1, cfg.head_size)
-    k = matmul_any(xb, lp["wk"]).reshape(T, -1, cfg.head_size)
-    v = matmul_any(xb, lp["wv"]).reshape(T, -1, cfg.head_size)
+    if "wqkv" in lp:  # fused single-kernel projection (fuse_qkv_ffn; no TP)
+        qkv = matmul_any(xb, lp["wqkv"])
+        d, kv = cfg.dim, cfg.kv_dim
+        q = qkv[:, :d]
+        k = qkv[:, d : d + kv]
+        v = qkv[:, d + kv :]
+    else:
+        q = matmul_any(xb, lp["wq"])
+        k = matmul_any(xb, lp["wk"])
+        v = matmul_any(xb, lp["wv"])
+    q = q.reshape(T, -1, cfg.head_size)
+    k = k.reshape(T, -1, cfg.head_size)
+    v = v.reshape(T, -1, cfg.head_size)
 
     cos = jax.lax.dynamic_slice_in_dim(rope["cos"], pos, T)[:, None, :]
     sin = jax.lax.dynamic_slice_in_dim(rope["sin"], pos, T)[:, None, :]

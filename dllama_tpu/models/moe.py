@@ -87,8 +87,13 @@ def moe_ffn(cfg: ModelConfig, lp: dict, xb: jnp.ndarray) -> jnp.ndarray:
     act = ACTIVATIONS[cfg.hidden_act]
     combine = route(cfg, lp["moe_router"], xb).astype(xb.dtype)  # [..., E]
 
-    up = _expert_up(xb, lp["moe_up"])
-    gate = _expert_up(xb, lp["moe_gate"])
-    h = up * act(gate)
+    if "moe_upgate" in lp:  # fused up|gate expert stacks (llama.fuse_qkv_ffn)
+        ug = _expert_up(xb, lp["moe_upgate"])
+        half = ug.shape[-1] // 2
+        h = ug[..., :half] * act(ug[..., half:])
+    else:
+        up = _expert_up(xb, lp["moe_up"])
+        gate = _expert_up(xb, lp["moe_gate"])
+        h = up * act(gate)
     down = _expert_down(h, lp["moe_down"])
     return jnp.einsum("...ed,...e->...d", down, combine)

@@ -111,6 +111,11 @@ class Engine:
                 self.params = _sh.shard_params(params, mesh, cfg)
             self._cache_sharding = NamedSharding(mesh, _sh.cache_spec())
         else:
+            from dllama_tpu.parallel.quant_tp import has_quant_leaves
+
+            if has_quant_leaves(params):
+                # fewer, larger fused kernels per layer (exact same math)
+                params = llama.fuse_qkv_ffn(params)
             self.params = jax.tree.map(jnp.asarray, params)
             self._cache_sharding = None
         self.rope = llama.rope_tables(cfg)

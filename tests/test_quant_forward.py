@@ -145,7 +145,7 @@ def test_quant_reader_loads_moe(tmp_path):
          for e in tensor_plan(spec)},
     )
     with WeightFileReader(path) as reader:
-        qp = llama.quant_params_from_reader(reader, cfg, "q40")
+        qp = llama.quant_params_from_reader(reader, cfg, "q40", fuse=False)
         up_file = reader.read_tensor("layers.0.experts.1.up", np.float32).T
     up = qp["layers"]["moe_up"]
     from dllama_tpu.ops.qmatmul import QuantTensor
@@ -190,7 +190,7 @@ def test_quant_reader_lossless_repack(tmp_path):
                 w.write_next(name, t.T if t.ndim == 2 else t)
 
     with WeightFileReader(path) as reader:
-        qp = llama.quant_params_from_reader(reader, cfg, "q40")
+        qp = llama.quant_params_from_reader(reader, cfg, "q40", fuse=False)
         # dequantized kernel weights == file's decoded tensors, bit for bit
         w1_file = reader.read_tensor("layers.0.w1", np.float32).T  # [in, out]
     from dllama_tpu.ops import qmatmul
@@ -203,3 +203,58 @@ def _layer0(qt):
     import jax
 
     return jax.tree.map(lambda x: x[0], qt)
+
+
+@pytest.mark.parametrize("kind", ["q40", "q80"])
+def test_fused_qkv_ffn_matches_unfused(kind):
+    """fuse_qkv_ffn (wq|wk|wv -> wqkv, w1|w3 -> w13) must be numerically
+    identical: the concat moves whole output columns with their scales."""
+    cfg = tiny_cfg()
+    qparams = llama.quantize_params(llama.random_params(cfg, seed=6), kind)
+    fused = llama.fuse_qkv_ffn(qparams)
+    assert "wqkv" in fused["layers"] and "wq" not in fused["layers"]
+    assert "w13" in fused["layers"] and "w1" not in fused["layers"]
+
+    rope = llama.rope_tables(cfg)
+    tokens = jnp.asarray([1, 5, 9], jnp.int32)
+    a, _ = llama.forward(cfg, qparams, rope, tokens, llama.init_cache(cfg), 0)
+    b, _ = llama.forward(cfg, fused, rope, tokens, llama.init_cache(cfg), 0)
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-5)
+
+
+def test_fused_moe_upgate_matches_unfused():
+    cfg = moe_cfg()
+    qparams = llama.quantize_params(llama.random_params(cfg, seed=7), "q40")
+    fused = llama.fuse_qkv_ffn(qparams)
+    assert "moe_upgate" in fused["layers"] and "moe_up" not in fused["layers"]
+    rope = llama.rope_tables(cfg)
+    tokens = jnp.asarray([2, 4], jnp.int32)
+    a, _ = llama.forward(cfg, qparams, rope, tokens, llama.init_cache(cfg), 0)
+    b, _ = llama.forward(cfg, fused, rope, tokens, llama.init_cache(cfg), 0)
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-5)
+
+
+def test_engine_autofuses_quant_params_single_device():
+    cfg = tiny_cfg()
+    qparams = llama.quantize_params(llama.random_params(cfg, seed=8), "q40")
+    eng = Engine(cfg, qparams, SamplerConfig(temperature=0.0))
+    assert "wqkv" in eng.params["layers"]
+    toks, _, _ = eng.generate_fused([1, 2, 3], steps=5)
+
+    # independent unfused baseline: greedy-decode by hand through
+    # llama.forward on the ORIGINAL (unfused) params
+    rope = llama.rope_tables(cfg)
+    cache = llama.init_cache(cfg)
+    prms = jax.tree.map(jnp.asarray, qparams)
+    logits, cache = llama.forward(cfg, prms, rope, jnp.asarray([1, 2, 3], jnp.int32), cache, 0)
+    want = []
+    tok = int(np.argmax(np.asarray(logits[-1])))
+    pos = 3
+    for _ in range(5):
+        want.append(tok)
+        logits, cache = llama.forward(
+            cfg, prms, rope, jnp.asarray([tok], jnp.int32), cache, jnp.int32(pos)
+        )
+        tok = int(np.argmax(np.asarray(logits[0])))
+        pos += 1
+    assert toks == want
