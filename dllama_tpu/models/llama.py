@@ -245,17 +245,14 @@ def quant_params_from_reader(reader: WeightFileReader, cfg: ModelConfig,
                 )
     from dllama_tpu.parallel.quant_tp import SHARDED_MATRICES
 
+    p["layers"] = {k: np_stack(v) for k, v in layers.items()}
     if mesh is None and fuse:
         # single-device: fuse shared-input projections ON HOST (numpy planes)
         # before placement, so the unfused originals never reach HBM —
         # fusing after device placement would double weight residency
-        p["layers"] = {k: np_stack(v) for k, v in layers.items()}
         p = fuse_qkv_ffn(p)
-        p["layers"] = {k: place(k, v, False) for k, v in p["layers"].items()}
-        return p
-
     p["layers"] = {
-        k: place(k, np_stack(v), k in SHARDED_MATRICES) for k, v in layers.items()
+        k: place(k, v, k in SHARDED_MATRICES) for k, v in p["layers"].items()
     }
     return p
 

@@ -84,6 +84,7 @@ class Engine:
         sampler_cfg: SamplerConfig = SamplerConfig(),
         cache_dtype=jnp.float32,
         mesh=None,
+        fuse_quant: bool = True,
     ):
         """``mesh``: a 1-D ``tp`` Mesh (see parallel.mesh.tp_mesh) to run
         tensor-parallel — params are placed with the reference's row/col
@@ -113,8 +114,12 @@ class Engine:
         else:
             from dllama_tpu.parallel.quant_tp import has_quant_leaves
 
-            if has_quant_leaves(params):
-                # fewer, larger fused kernels per layer (exact same math)
+            if fuse_quant and has_quant_leaves(params):
+                # fewer, larger fused kernels per layer (exact same math).
+                # NOTE: if the leaves are already device-resident, the concat
+                # transiently holds originals + fused copies; models near HBM
+                # capacity should load pre-fused on host instead
+                # (llama.quant_params_from_reader fuse=True does exactly that)
                 params = llama.fuse_qkv_ffn(params)
             self.params = jax.tree.map(jnp.asarray, params)
             self._cache_sharding = None

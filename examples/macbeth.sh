@@ -57,6 +57,10 @@ write_tokenizer(sys.argv[2], TokenizerData(vocab=vocab, scores=[0.0]*259, bos_id
 EOF
 fi
 
+if [ -n "$MACBETH_BUILD_ONLY" ]; then
+  exit 0  # multihost.sh reuses the model builder above
+fi
+
 PROMPT="Tomorrow, and tomorrow, and tomorrow, creeps in this petty pace from day to day, \
 to the last syllable of recorded time; and all our yesterdays have lighted fools the way \
 to dusty death."

@@ -24,4 +24,42 @@
 # * Each host loads only its own weight shards — no host ever streams
 #   weights to another, unlike the reference's startup distribution
 #   (/root/reference/src/transformer.cpp:569-598).
-echo "This script documents the multi-host launch pattern; read its comments."
+#
+# DEMO MODE (default when run without arguments): launches the pattern above
+# as two LOCAL processes on the CPU backend — a real jax.distributed job on
+# one machine, same flags, so the bootstrap is demonstrably runnable without
+# a cluster (the two-process variant of tests/test_multihost.py).
+set -e
+cd "$(dirname "$0")/.."
+
+PORT=${MULTIHOST_PORT:-8476}
+MODEL=${1:-/tmp/dllama_macbeth_demo.m}
+TOKENIZER=${2:-/tmp/dllama_macbeth_demo.t}
+
+if [ ! -f "$MODEL" ]; then
+  # reuse macbeth.sh's synthetic model builder
+  MACBETH_BUILD_ONLY=1 bash examples/macbeth.sh "$MODEL" "$TOKENIZER" || true
+fi
+if [ ! -f "$MODEL" ]; then
+  echo "no model available; run examples/macbeth.sh first"; exit 1
+fi
+
+run_host() {
+  JAX_PLATFORMS=cpu DLLAMA_PLATFORM=cpu python -m dllama_tpu.cli "$2" \
+    --model "$MODEL" --tokenizer "$TOKENIZER" \
+    --prompt "Tomorrow, and tomorrow" --steps 8 --temperature 0 --seed 1 \
+    --coordinator "127.0.0.1:$PORT" --num-hosts 2 --host-id "$1" \
+    > "/tmp/multihost_demo_$1.log" 2>&1 &
+}
+
+echo "launching 2-process jax.distributed demo (CPU backend)..."
+run_host 1 worker; P1=$!
+run_host 0 generate; P0=$!
+FAIL=0
+wait "$P0" || FAIL=1
+wait "$P1" || FAIL=1
+if [ "$FAIL" != 0 ]; then
+  echo "❌ demo failed"; tail -n 5 /tmp/multihost_demo_0.log /tmp/multihost_demo_1.log; exit 1
+fi
+echo "✅ two-host SPMD demo completed; host 0 output:"
+grep -v "^💡\|^🧮\|^⏩" /tmp/multihost_demo_0.log | tail -6
