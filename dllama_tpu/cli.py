@@ -47,9 +47,15 @@ def build_parser() -> argparse.ArgumentParser:
         sp.add_argument(
             "--chat-template", default="llama2", choices=["llama2", "llama3"]
         )
-        # accepted for reference-flag compatibility; activations never cross a
-        # wire in SPMD, so there is nothing to requantize (see SURVEY.md §2.4)
-        sp.add_argument("--buffer-float-type", default=None, help=argparse.SUPPRESS)
+        # the reference's wire-compression switch, mapped to ICI collectives
+        sp.add_argument(
+            "--buffer-float-type",
+            default=None,
+            choices=["q80", "f32", "bf16"],
+            help="q80: move TP activation gathers as int8 blocks + f32 block "
+            "scales over ICI (the reference's Q80 wire compression); "
+            "f32/bf16/unset: plain gathers",
+        )
         sp.add_argument(
             "--weights-float-type",
             default=None,
@@ -180,9 +186,18 @@ def load_engine(args):
     sampler_cfg = SamplerConfig(temperature=args.temperature, topp=args.topp, seed=seed)
     cache_dtype = jnp.dtype(args.cache_dtype) if args.cache_dtype else jnp.dtype(args.dtype)
 
-    engine = Engine(cfg, params, sampler_cfg, cache_dtype=cache_dtype, mesh=mesh)
+    tp_compress = getattr(args, "buffer_float_type", None) == "q80"
+    # compression lives in the shard_map quant forward; the dense-weight TP
+    # path is pjit (XLA owns its collectives) and cannot honor it
+    compress_active = tp_compress and mesh is not None and wft in ("q40", "q80")
+    if tp_compress and mesh is not None and not compress_active:
+        print("⚠️  --buffer-float-type q80 only applies to quantized weights "
+              "(q40/q80) under --tp; running plain gathers")
+    engine = Engine(cfg, params, sampler_cfg, cache_dtype=cache_dtype, mesh=mesh,
+                    tp_compress=compress_active)
     if mesh is not None:
-        print(f"🔗 tensor-parallel over {n_tp} devices (ICI mesh)")
+        wire = "q80-compressed" if compress_active else "plain"
+        print(f"🔗 tensor-parallel over {n_tp} devices (ICI mesh, {wire} gathers)")
     return engine, tok, cfg
 
 

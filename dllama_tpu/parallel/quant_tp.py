@@ -203,13 +203,17 @@ def shard_quant_params(params: dict, mesh, cfg: ModelConfig) -> dict:
     )
 
 
-def make_tp_forward(cfg: ModelConfig, mesh, params: dict):
+def make_tp_forward(cfg: ModelConfig, mesh, params: dict, compress: bool = False):
     """Build ``fwd(params, rope, cache, tokens, pos) -> (logits, cache)``:
     the quantized-TP decode/prefill forward as one shard_map program.
 
     Activations/logits are replicated in and out; params carry output shards;
     the KV cache is sharded by kv-head (axis 2). Jit-able and scannable —
     the Engine wraps it exactly like the single-chip ``llama.forward``.
+
+    ``compress=True`` moves the per-layer activation gathers as int8 blocks
+    with f32 block scales — the reference's Q80 wire compression
+    (``--buffer-float-type q80``) applied to the ICI collectives.
     """
     from dllama_tpu.models import llama
 
@@ -228,7 +232,7 @@ def make_tp_forward(cfg: ModelConfig, mesh, params: dict):
     def fwd(params, rope, cache, tokens, pos):
         return llama.forward(
             cfg, params, rope, tokens, cache, pos,
-            tp_axis=TP, gather_logits=gather_logits,
+            tp_axis=TP, gather_logits=gather_logits, tp_compress=compress,
         )
 
     return fwd

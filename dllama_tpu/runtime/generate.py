@@ -85,6 +85,7 @@ class Engine:
         cache_dtype=jnp.float32,
         mesh=None,
         fuse_quant: bool = True,
+        tp_compress: bool = False,
     ):
         """``mesh``: a 1-D ``tp`` Mesh (see parallel.mesh.tp_mesh) to run
         tensor-parallel — params are placed with the reference's row/col
@@ -103,7 +104,9 @@ class Engine:
                 # under pjit, so the forward runs as a shard_map program over
                 # output-sharded quant planes (parallel.quant_tp)
                 self.params = quant_tp.shard_quant_params(params, mesh, cfg)
-                tp_fwd = quant_tp.make_tp_forward(cfg, mesh, self.params)
+                tp_fwd = quant_tp.make_tp_forward(
+                    cfg, mesh, self.params, compress=tp_compress
+                )
 
                 def fwd(cfg_, params_, rope_, tokens_, cache_, pos_):
                     return tp_fwd(params_, rope_, cache_, tokens_, pos_)

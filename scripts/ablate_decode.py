@@ -67,9 +67,9 @@ def matmuls_only(cfg, params, steps):
                 if h13 is not None:
                     h = matmul_any(x, h13)
                     half = h.shape[-1] // 2
-                    h = h[:, :half]
+                    h = h[:, :half] + h[:, half:]
                 else:
-                    h = matmul_any(x, lp["w1"])
+                    h = matmul_any(x, lp["w1"]) + matmul_any(x, lp["w3"])
                 d = matmul_any(h, lp["w2"])
                 return x + (o + d) * 0.0 + acc * 0.0, None
 
