@@ -51,10 +51,11 @@ def build_parser() -> argparse.ArgumentParser:
         sp.add_argument(
             "--buffer-float-type",
             default=None,
-            choices=["q80", "f32", "bf16"],
+            choices=["q80", "f32", "f16", "bf16"],
             help="q80: move TP activation gathers as int8 blocks + f32 block "
             "scales over ICI (the reference's Q80 wire compression); "
-            "f32/bf16/unset: plain gathers",
+            "f32/f16/bf16/unset: plain gathers (f16 accepted for reference "
+            "command-line compatibility)",
         )
         sp.add_argument(
             "--weights-float-type",
@@ -190,7 +191,7 @@ def load_engine(args):
     # compression lives in the shard_map quant forward; the dense-weight TP
     # path is pjit (XLA owns its collectives) and cannot honor it
     compress_active = tp_compress and mesh is not None and wft in ("q40", "q80")
-    if tp_compress and mesh is not None and not compress_active:
+    if tp_compress and not compress_active:
         print("⚠️  --buffer-float-type q80 only applies to quantized weights "
               "(q40/q80) under --tp; running plain gathers")
     engine = Engine(cfg, params, sampler_cfg, cache_dtype=cache_dtype, mesh=mesh,

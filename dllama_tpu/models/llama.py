@@ -499,14 +499,14 @@ def _gather(x: jnp.ndarray, tp_axis, compress: bool = False) -> jnp.ndarray:
 
 
 def _dense_ffn(cfg: ModelConfig, lp: dict, xb: jnp.ndarray, tp_axis=None,
-               tp_compress: bool = False) -> jnp.ndarray:
+               tp_compress: bool = False, layer=None) -> jnp.ndarray:
     act = ACTIVATIONS[cfg.hidden_act]
     if "w13" in lp:  # fused single-kernel up|gate projection (fuse_qkv_ffn)
-        u = matmul_any(xb, lp["w13"])
+        u = matmul_any(xb, lp["w13"], layer)
         half = u.shape[-1] // 2
         h = act(u[..., :half]) * u[..., half:]
-        return matmul_any(h, lp["w2"])
-    h = act(matmul_any(xb, lp["w1"])) * matmul_any(xb, lp["w3"])
+        return matmul_any(h, lp["w2"], layer)
+    h = act(matmul_any(xb, lp["w1"], layer)) * matmul_any(xb, lp["w3"], layer)
     h = _gather(h, tp_axis, tp_compress)
     w2 = lp["w2"]
     w2_in = w2.k_padded if isinstance(w2, QuantTensor) else w2.shape[-2]
@@ -514,11 +514,11 @@ def _dense_ffn(cfg: ModelConfig, lp: dict, xb: jnp.ndarray, tp_axis=None,
         # w1/w3 were lane-padded but w2 took the dense fallback (its hidden
         # input not packable): the pad columns are exact zeros, slice them off
         h = h[..., :w2_in]
-    return _gather(matmul_any(h, w2), tp_axis, tp_compress)
+    return _gather(matmul_any(h, w2, layer), tp_axis, tp_compress)
 
 
 def _ffn_residual(cfg: ModelConfig, lp: dict, x: jnp.ndarray, att_out: jnp.ndarray,
-                  tp_axis=None, tp_compress: bool = False):
+                  tp_axis=None, tp_compress: bool = False, layer=None):
     """Post-attention half of a layer, all three arch variants:
 
     * llama: ``x += att; x += dense_ffn(rmsnorm(x, rms_ffn))``
@@ -539,32 +539,37 @@ def _ffn_residual(cfg: ModelConfig, lp: dict, x: jnp.ndarray, att_out: jnp.ndarr
     x = x + att_out
     xb = rmsnorm(x, lp["rms_ffn"], cfg.norm_eps)
     return x + (moe_ffn(cfg, lp, xb) if cfg.is_moe
-                else _dense_ffn(cfg, lp, xb, tp_axis, tp_compress))
+                else _dense_ffn(cfg, lp, xb, tp_axis, tp_compress, layer))
 
 
 def _attn_block(cfg: ModelConfig, lp: dict, rope: dict, x, k_cache, v_cache, pos,
-                tp_axis=None, tp_compress: bool = False):
-    """One attention sub-block. Returns (attn output [T, dim], new k/v cache [S,...]).
+                tp_axis=None, tp_compress: bool = False, layer=None):
+    """One attention sub-block. Returns (attn output [T, dim], new k/v cache).
 
     With ``tp_axis`` (inside shard_map, quantized TP): the projections are
     output-sharded, so head counts are *local* — derived from the array
     shapes, never from cfg — and the attention runs on this device's heads
     against its kv-head slice of the cache (the reference's
     ``MultiHeadAttSlice``/``KvCacheSlice`` head split,
-    `/root/reference/src/transformer.cpp:161-181`)."""
+    `/root/reference/src/transformer.cpp:161-181`).
+
+    With ``layer`` (the scalar-prefetch scan path): quant matrices in ``lp``
+    are layer-stacked and k_cache/v_cache are the FULL [L, S, kv, hd] caches;
+    the update touches only (layer, pos..pos+T) and the attention reads the
+    layer's slab. Without it, k_cache/v_cache are this layer's [S, kv, hd]."""
     T = x.shape[0]
     xb = rmsnorm(x, lp["rms_att"], cfg.norm_eps)
 
     if "wqkv" in lp:  # fused single-kernel projection (fuse_qkv_ffn; no TP)
-        qkv = matmul_any(xb, lp["wqkv"])
+        qkv = matmul_any(xb, lp["wqkv"], layer)
         d, kv = cfg.dim, cfg.kv_dim
         q = qkv[:, :d]
         k = qkv[:, d : d + kv]
         v = qkv[:, d + kv :]
     else:
-        q = matmul_any(xb, lp["wq"])
-        k = matmul_any(xb, lp["wk"])
-        v = matmul_any(xb, lp["wv"])
+        q = matmul_any(xb, lp["wq"], layer)
+        k = matmul_any(xb, lp["wk"], layer)
+        v = matmul_any(xb, lp["wv"], layer)
     q = q.reshape(T, -1, cfg.head_size)
     k = k.reshape(T, -1, cfg.head_size)
     v = v.reshape(T, -1, cfg.head_size)
@@ -574,12 +579,24 @@ def _attn_block(cfg: ModelConfig, lp: dict, rope: dict, x, k_cache, v_cache, pos
     q = apply_rope(q, cos, sin, cfg.rope_style)
     k = apply_rope(k, cos, sin, cfg.rope_style)
 
-    k_cache = jax.lax.dynamic_update_slice_in_dim(k_cache, k.astype(k_cache.dtype), pos, axis=0)
-    v_cache = jax.lax.dynamic_update_slice_in_dim(v_cache, v.astype(v_cache.dtype), pos, axis=0)
+    if layer is None:
+        k_cache = jax.lax.dynamic_update_slice_in_dim(
+            k_cache, k.astype(k_cache.dtype), pos, axis=0)
+        v_cache = jax.lax.dynamic_update_slice_in_dim(
+            v_cache, v.astype(v_cache.dtype), pos, axis=0)
+        k_slab, v_slab = k_cache, v_cache
+    else:
+        zero = jnp.int32(0)
+        k_cache = jax.lax.dynamic_update_slice(
+            k_cache, k.astype(k_cache.dtype)[None], (layer, pos, zero, zero))
+        v_cache = jax.lax.dynamic_update_slice(
+            v_cache, v.astype(v_cache.dtype)[None], (layer, pos, zero, zero))
+        k_slab = jax.lax.dynamic_index_in_dim(k_cache, layer, 0, keepdims=False)
+        v_slab = jax.lax.dynamic_index_in_dim(v_cache, layer, 0, keepdims=False)
 
-    out = gqa_attention(q, k_cache, v_cache, pos)
+    out = gqa_attention(q, k_slab, v_slab, pos)
     out = _gather(out.reshape(T, -1), tp_axis, tp_compress)  # local heads -> full
-    return _gather(matmul_any(out, lp["wo"]), tp_axis, tp_compress), k_cache, v_cache
+    return _gather(matmul_any(out, lp["wo"], layer), tp_axis, tp_compress), k_cache, v_cache
 
 
 def forward(
@@ -605,18 +622,50 @@ def forward(
     by tp) and the final gather is skipped.
     """
     x = embed(cfg, params, tokens)
+    layers = params["layers"]
 
-    def layer_step(x, layer):
-        lp, k_cache, v_cache = layer
-        att_out, k_cache, v_cache = _attn_block(
-            cfg, lp, rope, x, k_cache, v_cache, pos, tp_axis, tp_compress
-        )
-        x = _ffn_residual(cfg, lp, x, att_out, tp_axis, tp_compress)
-        return x, (k_cache, v_cache)
-
-    x, (new_k, new_v) = jax.lax.scan(
-        layer_step, x, (params["layers"], cache["k"], cache["v"])
+    quant_scan = (not cfg.is_moe) and any(
+        isinstance(v, QuantTensor) for v in layers.values()
     )
+    if quant_scan:
+        # Scan over a layer INDEX with the stacked quant planes closed over
+        # as scan constants. Slicing the planes in the body (`w[idx]`) would
+        # make XLA materialize a full copy of every layer's weights each
+        # step (a Pallas custom-call operand can't fuse a dynamic-slice) —
+        # ~3x the per-token HBM traffic of reading the weights once. Instead
+        # a scalar-prefetched idx steers each kernel's own DMA straight into
+        # the stacked plane (qmatmul.*_stacked) and the KV cache is updated
+        # in place at (idx, pos).
+        def layer_step(carry, idx):
+            x, k_cache, v_cache = carry
+            lp = {
+                name: (leaf if isinstance(leaf, QuantTensor)
+                       else jax.lax.dynamic_index_in_dim(leaf, idx, 0, keepdims=False))
+                for name, leaf in layers.items()
+            }
+            att_out, k_cache, v_cache = _attn_block(
+                cfg, lp, rope, x, k_cache, v_cache, pos, tp_axis, tp_compress,
+                layer=idx,
+            )
+            x = _ffn_residual(cfg, lp, x, att_out, tp_axis, tp_compress, layer=idx)
+            return (x, k_cache, v_cache), None
+
+        (x, new_k, new_v), _ = jax.lax.scan(
+            layer_step, (x, cache["k"], cache["v"]),
+            jnp.arange(cfg.n_layers, dtype=jnp.int32),
+        )
+    else:
+        def layer_step(x, layer):
+            lp, k_cache, v_cache = layer
+            att_out, k_cache, v_cache = _attn_block(
+                cfg, lp, rope, x, k_cache, v_cache, pos, tp_axis, tp_compress
+            )
+            x = _ffn_residual(cfg, lp, x, att_out, tp_axis, tp_compress)
+            return x, (k_cache, v_cache)
+
+        x, (new_k, new_v) = jax.lax.scan(
+            layer_step, x, (layers, cache["k"], cache["v"])
+        )
 
     x = rmsnorm(x, params["rms_final"], cfg.norm_eps)
     logits = matmul_any(x, params["wcls"]).astype(jnp.float32)

@@ -47,12 +47,13 @@ def _interpret_default() -> bool:
     return jax.default_backend() != "tpu"
 
 
-def _pick_tile(n: int, candidates: tuple[int, ...]) -> int:
-    for c in candidates:
-        if n % c == 0:
-            return c
-    # tiny dims (unit-test / toy models): one tile spanning the whole axis
-    return n
+#: cap on bk*bo cells per tile. The binding constraint is not the ~0.625 B/cell
+#: the packed tile + scales occupy in HBM but the kernel's scoped VMEM: the
+#: uint8 tile widens to int32 and dequantizes through f32 intermediates, which
+#: Mosaic stack-allocates at ~3 B/cell (measured: a 5.77M-cell tile asked for
+#: 17.9 MB of scoped VMEM against the 16 MB limit). 2M cells ≈ 6.5 MB scoped,
+#: leaving room for the rest of the decode program's kernels.
+_TILE_CELL_CAP = 2 * 2**20
 
 
 #: input-dim padding unit per kind. Mosaic requires the second-to-minor dim of
@@ -89,6 +90,17 @@ def _pad_cols(x: jnp.ndarray, k_padded: int) -> jnp.ndarray:
 def tile_plan(kind: str, k_padded: int, out_features: int) -> tuple[int, int]:
     """The (bk, bo) grid block sizes the kernels use for a packed matrix.
 
+    The O grid is ragged — ``ceil(O / bo)`` blocks with Mosaic masking the
+    boundary block's stores — so bo never shrinks to fit an awkward O. This
+    matters enormously for decode throughput: Llama-2-7B's hidden dim 11008
+    only divides by 256, and a (43, 4)-step grid of tiny tiles ran the kernel
+    at ~280 GB/s effective; full 1024-lane tiles reach ~500+ GB/s on the same
+    shape (measured on v5e, scripts/kernel_bench.py). Raggedness is safe on
+    the O axis only: each output column depends on exactly its own weight
+    column, so boundary-block garbage lands in masked-out columns. The K axis
+    by contrast is contracted, so bk MUST divide k_padded exactly (pack_q40 /
+    pack_q80 pad K to K_MULTIPLE, and every candidate here divides it).
+
     Invariant (asserted by tests/test_qmatmul.py over the real model shapes):
     every operand block satisfies Mosaic's (8, 128) tiling — in particular the
     scale planes, whose sublane count is bk/64 (q40) or bk/32 (q80)."""
@@ -98,28 +110,41 @@ def tile_plan(kind: str, k_padded: int, out_features: int) -> tuple[int, int]:
             f"{K_MULTIPLE[kind]} — build QuantTensors via pack_q40/pack_q80, "
             "which pad K so every Mosaic block satisfies (8, 128) tiling"
         )
-    if kind == "q40":
-        bk = _pick_tile(k_padded, (1024, 512))
+    if out_features < 128:
+        bo = out_features  # toy dims (interpret-mode tests): one lane tile
     else:
-        bk = _pick_tile(k_padded, (512, 256))
-    bo = _pick_tile(out_features, (1024, 512, 256, 128))
-    return bk, bo
+        bo = min(1024, _pad_up(out_features, 128))
+    align = K_MULTIPLE[kind]  # keeps the scale planes at >= 8 sublanes
+    for bk in sorted({k_padded, k_padded // 2, 8192, 4096, 2048, 1024,
+                      512, 256}, reverse=True):
+        if bk and k_padded % bk == 0 and bk % align == 0 \
+                and bk * bo <= _TILE_CELL_CAP:
+            return bk, bo
+    # unreachable: bk = K_MULTIPLE[kind] always divides k_padded (the
+    # precondition above), is self-aligned, and 512 * 1024 < _TILE_CELL_CAP
+    raise AssertionError(f"no valid bk for {kind} k_padded={k_padded} bo={bo}")
 
 
 # ---------------------------------------------------------------------------
 # Q80: int8 weights, one f32 scale per 32 input rows
 # ---------------------------------------------------------------------------
 
-def _q80_kernel(x_ref, w_ref, s_ref, o_ref, *, acc_dtype):
+def _q80_kernel(*refs, acc_dtype, stacked=False):
     from jax.experimental import pallas as pl
+
+    if stacked:  # scalar-prefetch layout: leading layer axis, idx_ref first
+        _idx_ref, x_ref, w_ref, s_ref, o_ref = refs
+        wq, s = w_ref[0], s_ref[0]
+    else:
+        x_ref, w_ref, s_ref, o_ref = refs
+        wq, s = w_ref[...], s_ref[...]
 
     @pl.when(pl.program_id(1) == 0)
     def _init():
         o_ref[...] = jnp.zeros_like(o_ref)
 
-    w = w_ref[...].astype(jnp.int32).astype(jnp.float32)  # [bk, bo]
+    w = wq.astype(jnp.int32).astype(jnp.float32)  # [bk, bo]
     bk, bo = w.shape
-    s = s_ref[...]  # [bk//QK, bo]
     scale = jnp.reshape(
         jnp.broadcast_to(s[:, None, :], (bk // QK, QK, bo)), (bk, bo)
     )
@@ -142,7 +167,7 @@ def q80_matmul(x: jnp.ndarray, w: jnp.ndarray, scales: jnp.ndarray,
     bk, bo = tile_plan("q80", K, O)
     out = pl.pallas_call(
         functools.partial(_q80_kernel, acc_dtype=jnp.float32),
-        grid=(O // bo, K // bk),
+        grid=(pl.cdiv(O, bo), K // bk),
         in_specs=[
             pl.BlockSpec((T, bk), lambda o, k: (0, k)),
             pl.BlockSpec((bk, bo), lambda o, k: (k, o)),
@@ -158,27 +183,80 @@ def q80_matmul(x: jnp.ndarray, w: jnp.ndarray, scales: jnp.ndarray,
     return out[:t]
 
 
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def q80_matmul_stacked(x: jnp.ndarray, w: jnp.ndarray, scales: jnp.ndarray,
+                       layer: jnp.ndarray,
+                       interpret: bool | None = None) -> jnp.ndarray:
+    """Layer-indexed ``x [T, K] @ dequant(w[layer])`` over STACKED planes
+    ``w int8 [L, K, O]``, ``scales [L, K/32, O]``, with a traced ``layer``.
+
+    Why this exists: the decode forward scans over layers. If the scan body
+    sliced the stacked planes (``w[idx]``) before calling the kernel, XLA
+    would have to MATERIALIZE each layer's slice every step — a Pallas
+    custom-call operand can't fuse a dynamic-slice — tripling the per-token
+    HBM traffic (read + write the copy, then read it again in the kernel).
+    Instead the whole stacked plane is the operand and a scalar-prefetched
+    layer index steers the kernel's own DMA via the BlockSpec index_map, so
+    each layer's bytes are read from HBM exactly once, in place."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    if interpret is None:
+        interpret = _interpret_default()
+    _, K, O = w.shape
+    xp, t = _pad_rows(_pad_cols(x.astype(jnp.bfloat16), K))
+    T = xp.shape[0]
+    bk, bo = tile_plan("q80", K, O)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(pl.cdiv(O, bo), K // bk),
+        in_specs=[
+            pl.BlockSpec((T, bk), lambda o, k, idx: (0, k)),
+            pl.BlockSpec((1, bk, bo), lambda o, k, idx: (idx[0], k, o)),
+            pl.BlockSpec((1, bk // QK, bo), lambda o, k, idx: (idx[0], k, o)),
+        ],
+        out_specs=pl.BlockSpec((T, bo), lambda o, k, idx: (0, o)),
+    )
+    out = pl.pallas_call(
+        functools.partial(_q80_kernel, acc_dtype=jnp.float32, stacked=True),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((T, O), jnp.float32),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")
+        ),
+        interpret=interpret,
+    )(jnp.asarray(layer, jnp.int32).reshape(1), xp, w, scales)
+    return out[:t]
+
+
 # ---------------------------------------------------------------------------
 # Q40: packed nibbles, two scale planes (even/odd 32-blocks)
 # ---------------------------------------------------------------------------
 
-def _q40_kernel(xlo_ref, xhi_ref, w_ref, slo_ref, shi_ref, o_ref, *, acc_dtype):
+def _q40_kernel(*refs, acc_dtype, stacked=False):
     from jax.experimental import pallas as pl
+
+    if stacked:  # scalar-prefetch layout: leading layer axis, idx_ref first
+        _idx_ref, xlo_ref, xhi_ref, w_ref, slo_ref, shi_ref, o_ref = refs
+        pk8, slo, shi = w_ref[0], slo_ref[0], shi_ref[0]
+    else:
+        xlo_ref, xhi_ref, w_ref, slo_ref, shi_ref, o_ref = refs
+        pk8, slo, shi = w_ref[...], slo_ref[...], shi_ref[...]
 
     @pl.when(pl.program_id(1) == 0)
     def _init():
         o_ref[...] = jnp.zeros_like(o_ref)
 
-    pk = w_ref[...].astype(jnp.int32)  # [bk/2, bo]
+    pk = pk8.astype(jnp.int32)  # [bk/2, bo]
     hk, bo = pk.shape
     lo = (pk & 0xF).astype(jnp.float32) - 8.0
     hi = ((pk >> 4) & 0xF).astype(jnp.float32) - 8.0
-    nsb = slo_ref.shape[0]  # bk/64 superblocks in this tile
+    nsb = slo.shape[0]  # bk/64 superblocks in this tile
     s_lo = jnp.reshape(
-        jnp.broadcast_to(slo_ref[...][:, None, :], (nsb, QK, bo)), (hk, bo)
+        jnp.broadcast_to(slo[:, None, :], (nsb, QK, bo)), (hk, bo)
     )
     s_hi = jnp.reshape(
-        jnp.broadcast_to(shi_ref[...][:, None, :], (nsb, QK, bo)), (hk, bo)
+        jnp.broadcast_to(shi[:, None, :], (nsb, QK, bo)), (hk, bo)
     )
     w_lo = (lo * s_lo).astype(jnp.bfloat16)
     w_hi = (hi * s_hi).astype(jnp.bfloat16)
@@ -206,7 +284,7 @@ def q40_matmul(x: jnp.ndarray, packed: jnp.ndarray, s_lo: jnp.ndarray,
     bk, bo = tile_plan("q40", K, O)
     out = pl.pallas_call(
         functools.partial(_q40_kernel, acc_dtype=jnp.float32),
-        grid=(O // bo, K // bk),
+        grid=(pl.cdiv(O, bo), K // bk),
         in_specs=[
             pl.BlockSpec((T, bk // 2), lambda o, k: (0, k)),
             pl.BlockSpec((T, bk // 2), lambda o, k: (0, k)),
@@ -221,6 +299,50 @@ def q40_matmul(x: jnp.ndarray, packed: jnp.ndarray, s_lo: jnp.ndarray,
         ),
         interpret=interpret,
     )(x_lo, x_hi, packed, s_lo, s_hi)
+    return out[:t]
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def q40_matmul_stacked(x: jnp.ndarray, packed: jnp.ndarray, s_lo: jnp.ndarray,
+                       s_hi: jnp.ndarray, layer: jnp.ndarray,
+                       interpret: bool | None = None) -> jnp.ndarray:
+    """Layer-indexed q40 matmul over STACKED planes ``packed uint8 [L, K/2,
+    O]`` with a traced ``layer`` — see ``q80_matmul_stacked`` for why the
+    layer selection must happen inside the kernel's index_map."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    if interpret is None:
+        interpret = _interpret_default()
+    O = packed.shape[2]
+    K = packed.shape[1] * 2
+    xp, t = _pad_rows(_pad_cols(x.astype(jnp.bfloat16), K))
+    T = xp.shape[0]
+    xr = xp.reshape(T, K // 64, 64)
+    x_lo = xr[:, :, :QK].reshape(T, K // 2)
+    x_hi = xr[:, :, QK:].reshape(T, K // 2)
+    bk, bo = tile_plan("q40", K, O)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(pl.cdiv(O, bo), K // bk),
+        in_specs=[
+            pl.BlockSpec((T, bk // 2), lambda o, k, idx: (0, k)),
+            pl.BlockSpec((T, bk // 2), lambda o, k, idx: (0, k)),
+            pl.BlockSpec((1, bk // 2, bo), lambda o, k, idx: (idx[0], k, o)),
+            pl.BlockSpec((1, bk // 64, bo), lambda o, k, idx: (idx[0], k, o)),
+            pl.BlockSpec((1, bk // 64, bo), lambda o, k, idx: (idx[0], k, o)),
+        ],
+        out_specs=pl.BlockSpec((T, bo), lambda o, k, idx: (0, o)),
+    )
+    out = pl.pallas_call(
+        functools.partial(_q40_kernel, acc_dtype=jnp.float32, stacked=True),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((T, O), jnp.float32),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")
+        ),
+        interpret=interpret,
+    )(jnp.asarray(layer, jnp.int32).reshape(1), x_lo, x_hi, packed, s_lo, s_hi)
     return out[:t]
 
 
@@ -262,22 +384,34 @@ class QuantTensor:
         return self.w.shape[-1]
 
 
-def qmatmul(x: jnp.ndarray, qt: QuantTensor) -> jnp.ndarray:
+def qmatmul(x: jnp.ndarray, qt: QuantTensor, layer=None) -> jnp.ndarray:
     """Dispatch ``x @ dequant(qt)`` to the right fused kernel. Output dtype
-    follows ``x`` (the caller's activation dtype), accumulation is f32."""
+    follows ``x`` (the caller's activation dtype), accumulation is f32.
+
+    ``layer``: a traced int32 selecting one layer of a layer-STACKED
+    QuantTensor (planes with a leading L axis) — the scalar-prefetch path
+    used by the scan-over-layers forward. None = qt is a single matrix."""
     if qt.kind == "q40":
-        out = q40_matmul(x, qt.w, qt.s, qt.s2)
+        if layer is None:
+            out = q40_matmul(x, qt.w, qt.s, qt.s2)
+        else:
+            out = q40_matmul_stacked(x, qt.w, qt.s, qt.s2, layer)
     elif qt.kind == "q80":
-        out = q80_matmul(x, qt.w, qt.s)
+        if layer is None:
+            out = q80_matmul(x, qt.w, qt.s)
+        else:
+            out = q80_matmul_stacked(x, qt.w, qt.s, layer)
     else:
         raise ValueError(f"unknown QuantTensor kind {qt.kind!r}")
     return out.astype(x.dtype)
 
 
-def matmul_any(x: jnp.ndarray, w) -> jnp.ndarray:
-    """``x @ w`` where w is a plain array or a QuantTensor."""
+def matmul_any(x: jnp.ndarray, w, layer=None) -> jnp.ndarray:
+    """``x @ w`` where w is a plain array or a QuantTensor. ``layer`` selects
+    a layer of a stacked QuantTensor (ignored for plain arrays, which the
+    caller indexes itself — XLA fuses a dense dynamic-slice into the dot)."""
     if isinstance(w, QuantTensor):
-        return qmatmul(x, w)
+        return qmatmul(x, w, layer)
     return x @ w
 
 

@@ -1,0 +1,81 @@
+"""Microbench the fused dequant-matmul kernels at decode shapes.
+
+The axon tunnel makes naive timing lie twice: dispatch is async (so
+``block_until_ready`` on a device buffer can return before execution), and a
+real host sync (pulling bytes) costs a fixed ~70 ms round trip. So this
+bench times ``iters`` and ``2*iters`` chained kernel calls inside one jitted
+``lax.scan`` each, with a host pull at the end, and reports the DIFFERENCE —
+the fixed round trip and compile-cached dispatch cancel, leaving pure
+device time per call. Effective GB/s is against the bytes the kernel must
+stream (weights + scales; activations are noise at T=1).
+
+Usage: python scripts/kernel_bench.py [q40|q80|bf16|all] [K] [O] [iters]
+"""
+
+import functools
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, __import__("os").path.dirname(__import__("os").path.dirname(
+    __import__("os").path.abspath(__file__))))
+
+from dllama_tpu.ops import qmatmul  # noqa: E402
+
+
+def _timed_host_sync(run, *args, reps=3):
+    float(np.asarray(run(*args)))  # compile + warm
+    best = 1e9
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        float(np.asarray(run(*args)))
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def bench(kind, K, O, iters=256, T=1):
+    rng = np.random.default_rng(0)
+    if kind == "bf16":
+        w = jnp.asarray(rng.standard_normal((K, O)).astype(np.float32)).astype(jnp.bfloat16)
+        nbytes = w.nbytes
+        mm = lambda x, w: x @ w
+        wargs = (w,)
+    else:
+        qt = qmatmul.quantize_tensor(
+            rng.standard_normal((K, O)).astype(np.float32), kind)
+        nbytes = qt.w.nbytes + qt.s.nbytes + qt.s2.nbytes
+        mm = lambda x, qt: qmatmul.qmatmul(x, qt)
+        wargs = (qt,)
+
+    @functools.partial(jax.jit, static_argnames=("n",))
+    def run(x, *w, n):
+        def step(x, _):
+            y = mm(x, *w)
+            y = y[:, :K] if O >= K else jnp.pad(y, ((0, 0), (0, K - O)))
+            return (y * 1e-2).astype(x.dtype), ()
+        x, _ = jax.lax.scan(step, x, None, length=n)
+        return jnp.sum(x.astype(jnp.float32))
+
+    x = jnp.asarray(rng.standard_normal((T, K)).astype(np.float32)).astype(jnp.bfloat16)
+    t1 = _timed_host_sync(functools.partial(run, n=iters), x, *wargs)
+    t2 = _timed_host_sync(functools.partial(run, n=2 * iters), x, *wargs)
+    ms = max(t2 - t1, 1e-9) * 1e3 / iters
+    gbs = nbytes / (ms * 1e-3) / 1e9
+    print(f"{kind:5s} K={K} O={O} T={T}: {ms:7.3f} ms/call  "
+          f"{nbytes/1e6:8.1f} MB streamed  -> {gbs:7.1f} GB/s effective"
+          f"   [t({iters})={t1*1e3:.0f}ms t({2*iters})={t2*1e3:.0f}ms]",
+          flush=True)
+    return ms, gbs
+
+
+if __name__ == "__main__":
+    kind = sys.argv[1] if len(sys.argv) > 1 else "all"
+    K = int(sys.argv[2]) if len(sys.argv) > 2 else 4096
+    O = int(sys.argv[3]) if len(sys.argv) > 3 else 11008
+    iters = int(sys.argv[4]) if len(sys.argv) > 4 else 256
+    kinds = ("q40", "q80", "bf16") if kind == "all" else (kind,)
+    for k in kinds:
+        bench(k, K, O, iters)
