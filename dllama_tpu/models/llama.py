@@ -535,10 +535,10 @@ def _ffn_residual(cfg: ModelConfig, lp: dict, x: jnp.ndarray, att_out: jnp.ndarr
     if cfg.is_moe and cfg.post_norms:  # grok1
         x = x + rmsnorm(att_out, lp["rms_ffn"], cfg.norm_eps)
         xb = rmsnorm(x, lp["rms_moe"], cfg.norm_eps)
-        return x + rmsnorm(moe_ffn(cfg, lp, xb), lp["rms_ffn2"], cfg.norm_eps)
+        return x + rmsnorm(moe_ffn(cfg, lp, xb, layer), lp["rms_ffn2"], cfg.norm_eps)
     x = x + att_out
     xb = rmsnorm(x, lp["rms_ffn"], cfg.norm_eps)
-    return x + (moe_ffn(cfg, lp, xb) if cfg.is_moe
+    return x + (moe_ffn(cfg, lp, xb, layer) if cfg.is_moe
                 else _dense_ffn(cfg, lp, xb, tp_axis, tp_compress, layer))
 
 
@@ -624,9 +624,7 @@ def forward(
     x = embed(cfg, params, tokens)
     layers = params["layers"]
 
-    quant_scan = (not cfg.is_moe) and any(
-        isinstance(v, QuantTensor) for v in layers.values()
-    )
+    quant_scan = any(isinstance(v, QuantTensor) for v in layers.values())
     if quant_scan:
         # Scan over a layer INDEX with the stacked quant planes closed over
         # as scan constants. Slicing the planes in the body (`w[idx]`) would

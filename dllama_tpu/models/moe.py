@@ -12,10 +12,22 @@ communication is needed. An optional ``ep`` mesh axis can additionally shard
 the leading expert dim of the stacked tensors (expert parallelism — beyond
 the reference's capabilities).
 
-Compute note: this evaluates all E experts and combines with a [.., E] weight
-matrix that is zero off the top-k — dense and MXU-friendly, exact same math.
-For small E (8) that trades <=E/k extra FLOPs for zero gather/scatter; a
-megablocks-style grouped kernel is the later optimization for big-E models.
+Compute paths:
+
+* Dense stacks / no layer index: evaluate all E experts, combine with a
+  [.., E] weight matrix that is zero off the top-k — dense and MXU-friendly,
+  exact same math. For small E (8) that trades <=E/k extra FLOPs for zero
+  gather/scatter.
+* Quantized stacks under the scalar-prefetch layer scan (``layer`` given):
+  the expert planes stay layer-stacked ([L, E, ...] folded to [L*E, ...], a
+  free bitcast) and a traced ``layer * E + e`` steers each fused kernel's
+  DMA. At decode (T == 1) only the top-k SELECTED experts are computed, so
+  the kernel reads k/E of the expert bytes per token — the bandwidth
+  win that makes Q40 Grok-1-class models decode at quantized speed, the
+  analog of the reference running only active experts
+  (`/root/reference/src/grok1-tasks.cpp:128-143`). For batched prefill every
+  expert runs once (different rows pick different experts) with the same
+  zero-copy indexing.
 """
 
 from __future__ import annotations
@@ -28,40 +40,71 @@ from dllama_tpu.ops.activations import ACTIVATIONS
 from dllama_tpu.ops.qmatmul import QuantTensor, matmul_any
 
 
-def route(cfg: ModelConfig, router_kernel: jnp.ndarray, xb: jnp.ndarray) -> jnp.ndarray:
-    """Top-k routing -> dense combine weights [..., E] (zeros off the top-k).
+def route_topk(cfg: ModelConfig, router_kernel: jnp.ndarray,
+               xb: jnp.ndarray) -> tuple:
+    """Top-k routing -> (indices [..., k], renormalized weights [..., k]).
 
     Router math runs in f32 like the reference (router matmul outputs F32,
-    `/root/reference/src/grok1-tasks.cpp:56-60`).
+    `/root/reference/src/grok1-tasks.cpp:56-60`); selected probabilities are
+    renormalized to sum 1 (`:99-114`). Single source of truth for BOTH the
+    dense-combine path and the T==1 selected-experts decode path — they must
+    agree exactly or decode would diverge from prefill on the same weights.
     """
     logits = xb.astype(jnp.float32) @ router_kernel.astype(jnp.float32)  # [..., E]
     probs = jax.nn.softmax(logits, axis=-1)
     topv, topi = jax.lax.top_k(probs, cfg.n_active_experts)
     weights = topv / topv.sum(axis=-1, keepdims=True)  # renormalize over selected
+    return topi, weights
+
+
+def route(cfg: ModelConfig, router_kernel: jnp.ndarray, xb: jnp.ndarray) -> jnp.ndarray:
+    """Top-k routing -> dense combine weights [..., E] (zeros off the top-k)."""
+    topi, weights = route_topk(cfg, router_kernel, xb)
     one_hot = jax.nn.one_hot(topi, cfg.n_experts, dtype=jnp.float32)  # [..., k, E]
-    return jnp.einsum("...ke,...k->...e", one_hot, weights)
+    return jnp.einsum("...ke,...k->...e", one_hot, weights.astype(jnp.float32))
 
 
-def _expert_up(xb: jnp.ndarray, w) -> jnp.ndarray:
+def _flat_experts(qt: QuantTensor) -> QuantTensor:
+    """Fold a layer-stacked expert stack [L, E, ...] (or a per-layer stack
+    [E, ...]) to a flat [n, ...] stack for index-steered kernels. Leading-axis
+    reshapes are bitcasts — no copy, the planes stay in place in HBM."""
+    return QuantTensor(
+        w=qt.w.reshape(-1, *qt.w.shape[-2:]),
+        s=qt.s.reshape(-1, *qt.s.shape[-2:]),
+        s2=(qt.s2.reshape(-1, *qt.s2.shape[-2:]) if qt.kind == "q40"
+            else qt.s2.reshape(-1)),
+        kind=qt.kind, k_logical=qt.k_logical,
+    )
+
+
+def _expert_up(xb: jnp.ndarray, w, base=None) -> jnp.ndarray:
     """``xb [..., D] x w [E, D, H] -> [..., E, H]``; ``w`` is a dense stack or
-    an expert-stacked QuantTensor (leading E axis on every plane). Quantized
-    experts run one fused dequant-matmul per expert via lax.scan over the
-    stack — the per-expert twin of the reference's sliced expert matmuls
-    (`/root/reference/src/grok1-tasks.cpp:128-143`, Q40 weights per
-    `/root/reference/src/transformer.cpp:479-487`)."""
+    an expert-stacked QuantTensor. Quantized experts run one fused
+    dequant-matmul per expert; with ``base`` (= layer * E, the scalar-prefetch
+    path) the planes are layer-stacked and indexed in the kernel, otherwise
+    the scan slices the per-layer stack."""
     if not isinstance(w, QuantTensor):
         return jnp.einsum("...d,edh->...eh", xb, w)
     lead = xb.shape[:-1]
     x2 = xb.reshape(-1, xb.shape[-1])  # [N, D]
 
-    def step(_, qt_e):
-        return None, matmul_any(x2, qt_e)
+    if base is not None:
+        flat = _flat_experts(w)
+        n_e = w.w.shape[1]
 
-    _, outs = jax.lax.scan(step, None, w)  # [E, N, H]
+        def step(_, e):
+            return None, matmul_any(x2, flat, base + e)
+
+        _, outs = jax.lax.scan(step, None, jnp.arange(n_e, dtype=jnp.int32))
+    else:
+        def step(_, qt_e):
+            return None, matmul_any(x2, qt_e)
+
+        _, outs = jax.lax.scan(step, None, w)  # [E, N, H]
     return jnp.moveaxis(outs, 0, 1).reshape(*lead, outs.shape[0], outs.shape[-1])
 
 
-def _expert_down(h: jnp.ndarray, w) -> jnp.ndarray:
+def _expert_down(h: jnp.ndarray, w, base=None) -> jnp.ndarray:
     """``h [..., E, H] x w [E, H, D] -> [..., E, D]`` (dense or QuantTensor)."""
     if not isinstance(w, QuantTensor):
         return jnp.einsum("...eh,ehd->...ed", h, w)
@@ -69,31 +112,88 @@ def _expert_down(h: jnp.ndarray, w) -> jnp.ndarray:
     E, H = h.shape[-2], h.shape[-1]
     hm = jnp.moveaxis(h.reshape(-1, E, H), 1, 0)  # [E, N, H]
 
-    def step(_, eh):
-        h_e, qt_e = eh
-        return None, matmul_any(h_e, qt_e)
+    if base is not None:
+        flat = _flat_experts(w)
 
-    _, outs = jax.lax.scan(step, None, (hm, w))  # [E, N, D]
+        def step(_, eh):
+            e, h_e = eh
+            return None, matmul_any(h_e, flat, base + e)
+
+        _, outs = jax.lax.scan(
+            step, None, (jnp.arange(E, dtype=jnp.int32), hm))
+    else:
+        def step(_, eh):
+            h_e, qt_e = eh
+            return None, matmul_any(h_e, qt_e)
+
+        _, outs = jax.lax.scan(step, None, (hm, w))  # [E, N, D]
     return jnp.moveaxis(outs, 0, 1).reshape(*lead, E, outs.shape[-1])
 
 
-def moe_ffn(cfg: ModelConfig, lp: dict, xb: jnp.ndarray) -> jnp.ndarray:
+def _moe_decode_selected(cfg: ModelConfig, lp: dict, xb: jnp.ndarray,
+                         layer) -> jnp.ndarray:
+    """T==1 decode with layer-stacked quantized experts: run ONLY the top-k
+    selected experts, each kernel DMA-ing just that expert's planes. Exact
+    same math as the dense combine (the combine weights are zero elsewhere)."""
+    act = ACTIVATIONS[cfg.hidden_act]
+    E, k = cfg.n_experts, cfg.n_active_experts
+    topi, weights = route_topk(cfg, lp["moe_router"], xb)  # [1, k] each
+    wsel = weights.astype(xb.dtype)
+    base = layer * E
+
+    fused = "moe_upgate" in lp
+    up_flat = _flat_experts(lp["moe_upgate" if fused else "moe_up"])
+    gate_flat = None if fused else _flat_experts(lp["moe_gate"])
+    down_flat = _flat_experts(lp["moe_down"])
+
+    def expert_step(acc, j):
+        idx = base + topi[0, j]
+        if fused:
+            ug = matmul_any(xb, up_flat, idx)
+            half = ug.shape[-1] // 2
+            h = ug[..., :half] * act(ug[..., half:])
+        else:
+            h = matmul_any(xb, up_flat, idx) * act(matmul_any(xb, gate_flat, idx))
+        d = matmul_any(h, down_flat, idx)
+        return acc + d * wsel[0, j], None
+
+    acc, _ = jax.lax.scan(
+        expert_step, jnp.zeros_like(xb), jnp.arange(k, dtype=jnp.int32))
+    return acc
+
+
+def moe_ffn(cfg: ModelConfig, lp: dict, xb: jnp.ndarray, layer=None) -> jnp.ndarray:
     """MoE FFN over xb [..., dim] -> [..., dim].
 
     lp holds: moe_router [dim, E], moe_up/moe_gate [E, dim, hidden],
     moe_down [E, hidden, dim] — each expert stack a dense array or a
-    quantized (QuantTensor) stack.
+    quantized (QuantTensor) stack. With ``layer`` (the scalar-prefetch scan),
+    quantized stacks carry a leading layer axis and dense leaves arrive
+    already layer-indexed.
     """
     act = ACTIVATIONS[cfg.hidden_act]
+    up_names = ("moe_upgate",) if "moe_upgate" in lp else ("moe_up", "moe_gate")
+    quant_experts = all(
+        isinstance(lp.get(n), QuantTensor) for n in up_names + ("moe_down",)
+    )
+    if layer is not None and quant_experts and xb.shape[0] == 1 and xb.ndim == 2:
+        return _moe_decode_selected(cfg, lp, xb, layer)
+
+    # Under the layer scan, EVERY QuantTensor stack is layer-stacked and needs
+    # index-steered kernels — even if a sibling stack fell back to dense (the
+    # hidden_dim % 64 != 0 load fallback), which arrives already layer-indexed
+    # and ignores base. A global quant_experts gate here would feed a 4D
+    # [L, E, ...] stack into the per-expert slicing scan below.
+    base = layer * cfg.n_experts if layer is not None else None
     combine = route(cfg, lp["moe_router"], xb).astype(xb.dtype)  # [..., E]
 
     if "moe_upgate" in lp:  # fused up|gate expert stacks (llama.fuse_qkv_ffn)
-        ug = _expert_up(xb, lp["moe_upgate"])
+        ug = _expert_up(xb, lp["moe_upgate"], base)
         half = ug.shape[-1] // 2
         h = ug[..., :half] * act(ug[..., half:])
     else:
-        up = _expert_up(xb, lp["moe_up"])
-        gate = _expert_up(xb, lp["moe_gate"])
+        up = _expert_up(xb, lp["moe_up"], base)
+        gate = _expert_up(xb, lp["moe_gate"], base)
         h = up * act(gate)
-    down = _expert_down(h, lp["moe_down"])
+    down = _expert_down(h, lp["moe_down"], base)
     return jnp.einsum("...ed,...e->...d", down, combine)

@@ -122,6 +122,53 @@ def test_engine_decodes_quantized_moe():
     assert len(toks) == 4 and all(0 <= t < cfg.vocab_size for t in toks)
 
 
+@pytest.mark.parametrize("arch", ["mixtral", "grok1"])
+def test_moe_decode_selected_matches_dense_combine(arch):
+    """T==1 quantized MoE runs only the top-k selected experts
+    (moe._moe_decode_selected, index-steered kernels); a T==2 batch with the
+    same row duplicated takes the all-experts dense-combine path through the
+    SAME kernels. Row 0 must agree — the combine weights are zero off the
+    top-k, so the selected path drops only exact-zero terms."""
+    from dllama_tpu.models import moe
+
+    cfg = moe_cfg(arch)
+    qlayers = llama.quantize_params(llama.random_params(cfg, seed=5), "q40")["layers"]
+    lp = {
+        k: (v if hasattr(v, "kind") else v[0]) for k, v in qlayers.items()
+    }  # the layer-0 view the scalar-prefetch scan builds
+    xb = jnp.asarray(np.random.default_rng(6).standard_normal((1, cfg.dim)),
+                     jnp.float32)
+
+    sel = moe.moe_ffn(cfg, lp, xb, layer=jnp.int32(0))          # selected path
+    both = moe.moe_ffn(cfg, lp, jnp.concatenate([xb, xb]), layer=jnp.int32(0))
+    np.testing.assert_allclose(
+        np.asarray(sel[0]), np.asarray(both[0]), rtol=2e-4, atol=2e-4
+    )
+
+
+def test_moe_mixed_dense_quant_experts_under_layer_scan():
+    """A quant MoE checkpoint can have SOME expert stacks fall back to dense
+    (hidden_dim % 64 != 0 path) while others quantize. Under the layer scan
+    the dense stack arrives layer-indexed and the quant stacks layer-stacked;
+    each must be handled per-leaf (regression: a global quant gate fed the
+    4D [L, E, ...] quant stack into the per-layer expert scan)."""
+    cfg = moe_cfg()
+    qparams = llama.quantize_params(llama.random_params(cfg, seed=8), "q40")
+    mixed = dict(qparams)
+    mixed["layers"] = dict(qparams["layers"])
+    mixed["layers"]["moe_down"] = _deq(qparams["layers"]["moe_down"])  # dense
+    rope = llama.rope_tables(cfg)
+
+    for tokens in (jnp.asarray([3], jnp.int32), jnp.asarray([3, 4, 5], jnp.int32)):
+        mixed_logits, _ = llama.forward(
+            cfg, mixed, rope, tokens, llama.init_cache(cfg), 0)
+        q_logits, _ = llama.forward(
+            cfg, qparams, rope, tokens, llama.init_cache(cfg), 0)
+        np.testing.assert_allclose(
+            np.asarray(mixed_logits), np.asarray(q_logits), rtol=0.05, atol=0.05
+        )
+
+
 def test_quant_reader_loads_moe(tmp_path):
     """quant_params_from_reader on a Q40 Mixtral file: expert stacks arrive as
     per-expert QuantTensors whose dequantized bits equal the file's."""
