@@ -120,8 +120,11 @@ def run_decode_bench(cfg_dict: dict, bench_steps: int = 64, quant_ok: bool = Fal
     else:
         params = llama.device_random_params(cfg, seed=0, mesh=mesh)
     jax.block_until_ready(params)
+    # decode_chunk=bench_steps: ONE device dispatch + host sync for the whole
+    # timed run — the tunnel's host round trip (~70 ms on the axon box) would
+    # otherwise smear ~1 ms/token into a 64-chunk measurement
     eng = Engine(cfg, params, SamplerConfig(temperature=0.0), cache_dtype=jnp.bfloat16,
-                 mesh=mesh)
+                 mesh=mesh, decode_chunk=bench_steps)
     # Engine may have fused the projection matrices into new buffers; drop
     # this frame's reference so the unfused originals free immediately
     del params

@@ -80,6 +80,15 @@ def _pad_rows(x: jnp.ndarray, multiple: int = 8) -> tuple[jnp.ndarray, int]:
     return x, t
 
 
+#: token-row block: decode (T <= 8) runs one t-block; big prefill batches tile
+#: so the x / out tiles stay a bounded slice of VMEM (a 2048-token prefill
+#: with whole-T blocks would need ~16 MB for x + out alone). t is OUTERMOST in
+#: the grid so the out block still accumulates over the innermost k sweep;
+#: weights re-stream once per t-block, which large-T prefill (MXU-bound)
+#: amortizes. The t grid is ragged like o: token rows are independent.
+T_BLOCK = 256
+
+
 def _pad_cols(x: jnp.ndarray, k_padded: int) -> jnp.ndarray:
     """Zero-pad the input-feature dim of activations up to the packed K."""
     if x.shape[1] != k_padded:
@@ -139,7 +148,7 @@ def _q80_kernel(*refs, acc_dtype, stacked=False):
         x_ref, w_ref, s_ref, o_ref = refs
         wq, s = w_ref[...], s_ref[...]
 
-    @pl.when(pl.program_id(1) == 0)
+    @pl.when(pl.program_id(2) == 0)  # grid (t, o, k): init at each k sweep
     def _init():
         o_ref[...] = jnp.zeros_like(o_ref)
 
@@ -165,18 +174,19 @@ def q80_matmul(x: jnp.ndarray, w: jnp.ndarray, scales: jnp.ndarray,
     xp, t = _pad_rows(_pad_cols(x.astype(jnp.bfloat16), K))
     T = xp.shape[0]
     bk, bo = tile_plan("q80", K, O)
+    bt = min(T, T_BLOCK)
     out = pl.pallas_call(
         functools.partial(_q80_kernel, acc_dtype=jnp.float32),
-        grid=(pl.cdiv(O, bo), K // bk),
+        grid=(pl.cdiv(T, bt), pl.cdiv(O, bo), K // bk),
         in_specs=[
-            pl.BlockSpec((T, bk), lambda o, k: (0, k)),
-            pl.BlockSpec((bk, bo), lambda o, k: (k, o)),
-            pl.BlockSpec((bk // QK, bo), lambda o, k: (k, o)),
+            pl.BlockSpec((bt, bk), lambda t_, o, k: (t_, k)),
+            pl.BlockSpec((bk, bo), lambda t_, o, k: (k, o)),
+            pl.BlockSpec((bk // QK, bo), lambda t_, o, k: (k, o)),
         ],
-        out_specs=pl.BlockSpec((T, bo), lambda o, k: (0, o)),
+        out_specs=pl.BlockSpec((bt, bo), lambda t_, o, k: (t_, o)),
         out_shape=jax.ShapeDtypeStruct((T, O), jnp.float32),
         compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "arbitrary")
+            dimension_semantics=("parallel", "parallel", "arbitrary")
         ),
         interpret=interpret,
     )(xp, w, scales)
@@ -207,22 +217,24 @@ def q80_matmul_stacked(x: jnp.ndarray, w: jnp.ndarray, scales: jnp.ndarray,
     xp, t = _pad_rows(_pad_cols(x.astype(jnp.bfloat16), K))
     T = xp.shape[0]
     bk, bo = tile_plan("q80", K, O)
+    bt = min(T, T_BLOCK)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
-        grid=(pl.cdiv(O, bo), K // bk),
+        grid=(pl.cdiv(T, bt), pl.cdiv(O, bo), K // bk),
         in_specs=[
-            pl.BlockSpec((T, bk), lambda o, k, idx: (0, k)),
-            pl.BlockSpec((1, bk, bo), lambda o, k, idx: (idx[0], k, o)),
-            pl.BlockSpec((1, bk // QK, bo), lambda o, k, idx: (idx[0], k, o)),
+            pl.BlockSpec((bt, bk), lambda t_, o, k, idx: (t_, k)),
+            pl.BlockSpec((1, bk, bo), lambda t_, o, k, idx: (idx[0], k, o)),
+            pl.BlockSpec((1, bk // QK, bo),
+                         lambda t_, o, k, idx: (idx[0], k, o)),
         ],
-        out_specs=pl.BlockSpec((T, bo), lambda o, k, idx: (0, o)),
+        out_specs=pl.BlockSpec((bt, bo), lambda t_, o, k, idx: (t_, o)),
     )
     out = pl.pallas_call(
         functools.partial(_q80_kernel, acc_dtype=jnp.float32, stacked=True),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((T, O), jnp.float32),
         compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "arbitrary")
+            dimension_semantics=("parallel", "parallel", "arbitrary")
         ),
         interpret=interpret,
     )(jnp.asarray(layer, jnp.int32).reshape(1), xp, w, scales)
@@ -243,7 +255,7 @@ def _q40_kernel(*refs, acc_dtype, stacked=False):
         xlo_ref, xhi_ref, w_ref, slo_ref, shi_ref, o_ref = refs
         pk8, slo, shi = w_ref[...], slo_ref[...], shi_ref[...]
 
-    @pl.when(pl.program_id(1) == 0)
+    @pl.when(pl.program_id(2) == 0)  # grid (t, o, k): init at each k sweep
     def _init():
         o_ref[...] = jnp.zeros_like(o_ref)
 
@@ -282,20 +294,21 @@ def q40_matmul(x: jnp.ndarray, packed: jnp.ndarray, s_lo: jnp.ndarray,
     x_lo = xr[:, :, :QK].reshape(T, K // 2)
     x_hi = xr[:, :, QK:].reshape(T, K // 2)
     bk, bo = tile_plan("q40", K, O)
+    bt = min(T, T_BLOCK)
     out = pl.pallas_call(
         functools.partial(_q40_kernel, acc_dtype=jnp.float32),
-        grid=(pl.cdiv(O, bo), K // bk),
+        grid=(pl.cdiv(T, bt), pl.cdiv(O, bo), K // bk),
         in_specs=[
-            pl.BlockSpec((T, bk // 2), lambda o, k: (0, k)),
-            pl.BlockSpec((T, bk // 2), lambda o, k: (0, k)),
-            pl.BlockSpec((bk // 2, bo), lambda o, k: (k, o)),
-            pl.BlockSpec((bk // 64, bo), lambda o, k: (k, o)),
-            pl.BlockSpec((bk // 64, bo), lambda o, k: (k, o)),
+            pl.BlockSpec((bt, bk // 2), lambda t_, o, k: (t_, k)),
+            pl.BlockSpec((bt, bk // 2), lambda t_, o, k: (t_, k)),
+            pl.BlockSpec((bk // 2, bo), lambda t_, o, k: (k, o)),
+            pl.BlockSpec((bk // 64, bo), lambda t_, o, k: (k, o)),
+            pl.BlockSpec((bk // 64, bo), lambda t_, o, k: (k, o)),
         ],
-        out_specs=pl.BlockSpec((T, bo), lambda o, k: (0, o)),
+        out_specs=pl.BlockSpec((bt, bo), lambda t_, o, k: (t_, o)),
         out_shape=jax.ShapeDtypeStruct((T, O), jnp.float32),
         compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "arbitrary")
+            dimension_semantics=("parallel", "parallel", "arbitrary")
         ),
         interpret=interpret,
     )(x_lo, x_hi, packed, s_lo, s_hi)
@@ -322,24 +335,25 @@ def q40_matmul_stacked(x: jnp.ndarray, packed: jnp.ndarray, s_lo: jnp.ndarray,
     x_lo = xr[:, :, :QK].reshape(T, K // 2)
     x_hi = xr[:, :, QK:].reshape(T, K // 2)
     bk, bo = tile_plan("q40", K, O)
+    bt = min(T, T_BLOCK)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
-        grid=(pl.cdiv(O, bo), K // bk),
+        grid=(pl.cdiv(T, bt), pl.cdiv(O, bo), K // bk),
         in_specs=[
-            pl.BlockSpec((T, bk // 2), lambda o, k, idx: (0, k)),
-            pl.BlockSpec((T, bk // 2), lambda o, k, idx: (0, k)),
-            pl.BlockSpec((1, bk // 2, bo), lambda o, k, idx: (idx[0], k, o)),
-            pl.BlockSpec((1, bk // 64, bo), lambda o, k, idx: (idx[0], k, o)),
-            pl.BlockSpec((1, bk // 64, bo), lambda o, k, idx: (idx[0], k, o)),
+            pl.BlockSpec((bt, bk // 2), lambda t_, o, k, idx: (t_, k)),
+            pl.BlockSpec((bt, bk // 2), lambda t_, o, k, idx: (t_, k)),
+            pl.BlockSpec((1, bk // 2, bo), lambda t_, o, k, idx: (idx[0], k, o)),
+            pl.BlockSpec((1, bk // 64, bo), lambda t_, o, k, idx: (idx[0], k, o)),
+            pl.BlockSpec((1, bk // 64, bo), lambda t_, o, k, idx: (idx[0], k, o)),
         ],
-        out_specs=pl.BlockSpec((T, bo), lambda o, k, idx: (0, o)),
+        out_specs=pl.BlockSpec((bt, bo), lambda t_, o, k, idx: (t_, o)),
     )
     out = pl.pallas_call(
         functools.partial(_q40_kernel, acc_dtype=jnp.float32, stacked=True),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((T, O), jnp.float32),
         compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "arbitrary")
+            dimension_semantics=("parallel", "parallel", "arbitrary")
         ),
         interpret=interpret,
     )(jnp.asarray(layer, jnp.int32).reshape(1), x_lo, x_hi, packed, s_lo, s_hi)

@@ -86,14 +86,21 @@ class Engine:
         mesh=None,
         fuse_quant: bool = True,
         tp_compress: bool = False,
+        decode_chunk: int = DECODE_CHUNK,
     ):
         """``mesh``: a 1-D ``tp`` Mesh (see parallel.mesh.tp_mesh) to run
         tensor-parallel — params are placed with the reference's row/col
         slicing as NamedShardings and XLA emits the AllReduces the reference
         hand-rolls as broadcast+gather+root-sum."""
+        if decode_chunk < 1:
+            raise ValueError(f"decode_chunk must be >= 1, got {decode_chunk}")
         self.cfg = cfg
         self.sampler_cfg = sampler_cfg
         self.mesh = mesh
+        # fused-loop chunk: one host round trip per chunk of tokens. Bigger
+        # chunks amortize dispatch/sync latency (dominant on tunneled or
+        # remote-PJRT setups) at the cost of coarser streaming granularity.
+        self.decode_chunk = decode_chunk
         fwd = llama.forward
         if mesh is not None:
             from dllama_tpu.parallel import quant_tp, sharding as _sh
@@ -344,8 +351,9 @@ class Engine:
         t1 = time.perf_counter()
         toks: list = []
         remaining = steps
+        chunk_size = self.decode_chunk
         while remaining > 0:
-            n = DECODE_CHUNK if remaining >= DECODE_CHUNK else prefill_bucket(remaining)
+            n = chunk_size if remaining >= chunk_size else prefill_bucket(remaining)
             n = min(n, self.cfg.seq_len - pos)  # never write cache out of range
             chunk, cache = self._decode_loop(
                 cache, token, jnp.int32(pos), self.next_key(), temp, topp, n_steps=n

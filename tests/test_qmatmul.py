@@ -53,6 +53,23 @@ def test_kernel_matches_dense_matmul(kind, t):
     assert err <= 0.02 * np.abs(ref).max() + 1e-4, err
 
 
+@pytest.mark.parametrize("kind", ["q40", "q80"])
+def test_kernel_prefill_sized_t_blocks(kind):
+    """T > T_BLOCK tiles the token rows (ragged t grid, masked boundary) so
+    big prefill batches bound their x/out VMEM tiles — whole-T blocks would
+    need ~16 MB for a 2048-token prefill's x + out alone."""
+    K, O = 256, 384
+    t = qmatmul.T_BLOCK + 70  # 2 t-blocks, ragged second block
+    w = _rand((K, O), seed=12, scale=0.1)
+    x = jnp.asarray(_rand((t, K), seed=13))
+    qt = qmatmul.quantize_tensor(w, kind)
+    out = qmatmul.qmatmul(x, qt)
+    assert out.shape == (t, O)
+    ref = np.asarray(x, np.float32) @ qmatmul.dequantize(qt)
+    err = np.abs(np.asarray(out, np.float32) - ref).max()
+    assert err <= 0.02 * np.abs(ref).max() + 1e-4, err
+
+
 def test_repack_q40_bit_exact_with_file_format():
     """Repacking file-format Q40 bytes must preserve every quant + delta —
     the path that loads published checkpoints without requantization noise."""
