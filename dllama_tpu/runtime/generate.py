@@ -353,7 +353,11 @@ class Engine:
         remaining = steps
         chunk_size = self.decode_chunk
         while remaining > 0:
-            n = chunk_size if remaining >= chunk_size else prefill_bucket(remaining)
+            # tail chunks reuse prefill buckets for compile sharing, but never
+            # exceed the caller's chunk size (it bounds program size/latency)
+            n = min(chunk_size, prefill_bucket(remaining))
+            if remaining >= chunk_size:
+                n = chunk_size
             n = min(n, self.cfg.seq_len - pos)  # never write cache out of range
             chunk, cache = self._decode_loop(
                 cache, token, jnp.int32(pos), self.next_key(), temp, topp, n_steps=n

@@ -57,16 +57,25 @@ def test_kernel_matches_dense_matmul(kind, t):
 def test_kernel_prefill_sized_t_blocks(kind):
     """T > T_BLOCK tiles the token rows (ragged t grid, masked boundary) so
     big prefill batches bound their x/out VMEM tiles — whole-T blocks would
-    need ~16 MB for a 2048-token prefill's x + out alone."""
-    K, O = 256, 384
+    need ~16 MB for a 2048-token prefill's x + out alone. Covers BOTH the
+    plain kernels and the layer-stacked scalar-prefetch kernels (the
+    production prefill path: llama.forward's layer scan passes ``layer``)."""
+    K, O, L = 256, 384, 3
     t = qmatmul.T_BLOCK + 70  # 2 t-blocks, ragged second block
-    w = _rand((K, O), seed=12, scale=0.1)
     x = jnp.asarray(_rand((t, K), seed=13))
-    qt = qmatmul.quantize_tensor(w, kind)
-    out = qmatmul.qmatmul(x, qt)
+    per_layer = [
+        qmatmul.quantize_tensor(_rand((K, O), seed=12 + i, scale=0.1), kind)
+        for i in range(L)
+    ]
+    out = qmatmul.qmatmul(x, per_layer[1])
     assert out.shape == (t, O)
-    ref = np.asarray(x, np.float32) @ qmatmul.dequantize(qt)
+    ref = np.asarray(x, np.float32) @ qmatmul.dequantize(per_layer[1])
     err = np.abs(np.asarray(out, np.float32) - ref).max()
+    assert err <= 0.02 * np.abs(ref).max() + 1e-4, err
+
+    stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *per_layer)
+    out_s = qmatmul.qmatmul(x, stacked, layer=jnp.int32(1))
+    err = np.abs(np.asarray(out_s, np.float32) - ref).max()
     assert err <= 0.02 * np.abs(ref).max() + 1e-4, err
 
 
