@@ -230,11 +230,17 @@ def run_generate(args, show_stats: bool) -> None:
             gen_ms.append(stats.generation_ms)
             inf_ms.append(stats.inference_ms)
             if show_stats:
-                sys.stdout.write(
+                line = (
                     f"  🔶 G {stats.generation_ms:7.2f} ms "
                     f"I {stats.inference_ms:7.2f} ms "
-                    f"T {stats.transfer_ms:7.2f} ms\n"
+                    f"T {stats.transfer_ms:7.2f} ms"
                 )
+                if stats.sent_kb:
+                    # the reference's S/R socket-counter columns
+                    # (dllama.cpp:74-75); static SPMD schedule -> analytic
+                    line += (f" S {stats.sent_kb:7.1f} kB"
+                             f" R {stats.recv_kb:7.1f} kB")
+                sys.stdout.write(line + "\n")
         sys.stdout.write(utf8.decode(b"", True))  # dangling incomplete char -> U+FFFD
         print()
     finally:

@@ -41,8 +41,9 @@ def prefill_bucket(n: int) -> int:
 
 @dataclasses.dataclass
 class TokenStats:
-    """Per-token timing — the reference's G/I/T split
-    (`/root/reference/src/utils.cpp:179-182`, printed at
+    """Per-token timing — the reference's G/I/T/S/R line
+    (`/root/reference/src/utils.cpp:179-182`, socket counters
+    `/root/reference/src/socket.cpp:266-271`, printed at
     `/root/reference/src/apps/dllama/dllama.cpp:74-75`), re-based on what the
     boundaries actually are on TPU:
 
@@ -51,11 +52,18 @@ class TokenStats:
       on-chip compute (including, under TP, the ICI collectives XLA fused in).
     * ``transfer_ms`` (T): G - I — host work + dispatch/launch latency, the
       host<->device round trip that replaces the reference's Ethernet hops.
+    * ``sent_kb`` / ``recv_kb`` (S/R): per-device ICI bytes this token's
+      collectives move. The reference reads socket counters; under SPMD the
+      collective schedule is static, so these are computed analytically
+      (ring all-gather: each device sends and receives (tp-1)/tp of every
+      gathered feature vector — see Engine._wire_bytes_per_token).
     """
 
     generation_ms: float
     inference_ms: float
     transfer_ms: float = 0.0
+    sent_kb: float = 0.0
+    recv_kb: float = 0.0
 
 
 @dataclasses.dataclass
@@ -97,6 +105,7 @@ class Engine:
         self.cfg = cfg
         self.sampler_cfg = sampler_cfg
         self.mesh = mesh
+        self._tp_compress = tp_compress
         # fused-loop chunk: one host round trip per chunk of tokens. Bigger
         # chunks amortize dispatch/sync latency (dominant on tunneled or
         # remote-PJRT setups) at the cost of coarser streaming granularity.
@@ -189,6 +198,58 @@ class Engine:
         else:
             self._init_cache = jax.jit(lambda: llama.init_cache(cfg, cache_dtype))
 
+        #: per-device ICI kB one decode step moves (the reference's S/R line)
+        self.wire_kb_per_token = self._wire_bytes_per_token() / 1024.0
+
+    def _wire_bytes_per_token(self) -> float:
+        """Per-device ICI bytes one decode step's collectives move (0 without
+        a mesh). The reference counts wire bytes at its sockets; here the
+        collective schedule is static so the count is analytic:
+
+        * quantized TP (shard_map, parallel.quant_tp): 4 ring all-gathers per
+          layer — attention heads (dim), wo output (dim), FFN hidden
+          (lane-padded H'), w2 output (dim) — plus the logits gather when the
+          vocab shards. A ring all-gather moves (tp-1)/tp of the full vector
+          through each device, in each direction. Q80 wire compression
+          (tp_compress) ships 1 byte + 1/8 byte of scale per feature instead
+          of 2 (bf16) — the reference's 4.06x table compresses f32, ours
+          compresses bf16, hence 1.78x.
+        * dense TP (pjit): XLA emits ~2 all-reduces per layer (attention out,
+          FFN out), each ~2x(tp-1)/tp of dim in bf16 per device per
+          direction (reduce-scatter + all-gather decomposition).
+        """
+        if self.mesh is None:
+            return 0.0
+        from dllama_tpu.parallel.mesh import TP
+        from dllama_tpu.parallel.quant_tp import ffn_padded_width, has_quant_leaves
+
+        tp = self.mesh.shape[TP]
+        if tp <= 1:
+            return 0.0
+        cfg = self.cfg
+        frac = (tp - 1) / tp
+        if has_quant_leaves(self.params):
+            from dllama_tpu.ops.qmatmul import _pad_up
+
+            per_feat = 1.125 if self._tp_compress else 2.0
+            kind = "q40"
+            for leaf in jax.tree.leaves(
+                self.params, is_leaf=lambda x: hasattr(x, "kind")
+            ):
+                if hasattr(leaf, "kind"):
+                    kind = leaf.kind
+                    break
+            hidden = ffn_padded_width(cfg, kind, tp)
+            layer_feats = cfg.n_layers * (3 * cfg.dim + hidden)
+            bytes_ = layer_feats * per_feat
+            if cfg.vocab_size % tp == 0:
+                # the logits gather moves the lane-PADDED vocab (sliced back
+                # after the gather, models/llama.py) and is never compressed
+                bytes_ += _pad_up(cfg.vocab_size, 128 * tp) * 2.0
+            return bytes_ * frac
+        # dense pjit path: estimated from XLA's canonical all-reduce lowering
+        return cfg.n_layers * 2 * cfg.dim * 2.0 * 2 * frac
+
     def new_cache(self) -> dict:
         return self._init_cache()
 
@@ -211,6 +272,7 @@ class Engine:
         # dynamic_update_slice start would be silently clamped by XLA, writing
         # K/V into wrong slots with wrong rope angles
         bucket = min(prefill_bucket(len(tokens)), self.cfg.seq_len - pos)
+        self._last_prefill_bucket = bucket
         padded = np.zeros(bucket, np.int32)
         padded[: len(tokens)] = tokens
         return self._prefill(cache, jnp.asarray(padded), len(tokens), jnp.int32(pos))
@@ -276,7 +338,10 @@ class Engine:
             # abandons the generator mid-stream (stop-string hit, client
             # disconnect) still observes the state matching what it received
             self.final_session = Session(cache, pos, pending_token=tok_int)
-            yield tok_int, TokenStats(self.prefill_ms, self.prefill_ms)
+            # prefill gathers move `bucket` rows of every collective at once
+            pf_kb = self.wire_kb_per_token * getattr(self, "_last_prefill_bucket", 1)
+            yield tok_int, TokenStats(self.prefill_ms, self.prefill_ms,
+                                      sent_kb=pf_kb, recv_kb=pf_kb)
             steps -= 1
             if tok_int in stop_tokens:
                 return
@@ -300,6 +365,8 @@ class Engine:
                 generation_ms=dt,
                 inference_ms=(t3 - t2) * 1000.0,
                 transfer_ms=(t2 - t1 + t4 - t3) * 1000.0,
+                sent_kb=self.wire_kb_per_token,
+                recv_kb=self.wire_kb_per_token,
             )
             if tok_int in stop_tokens:
                 break
@@ -354,10 +421,9 @@ class Engine:
         chunk_size = self.decode_chunk
         while remaining > 0:
             # tail chunks reuse prefill buckets for compile sharing, but never
-            # exceed the caller's chunk size (it bounds program size/latency)
+            # exceed the caller's chunk size (it bounds program size/latency);
+            # prefill_bucket(r) >= r, so full chunks resolve to chunk_size
             n = min(chunk_size, prefill_bucket(remaining))
-            if remaining >= chunk_size:
-                n = chunk_size
             n = min(n, self.cfg.seq_len - pos)  # never write cache out of range
             chunk, cache = self._decode_loop(
                 cache, token, jnp.int32(pos), self.next_key(), temp, topp, n_steps=n

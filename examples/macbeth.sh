@@ -32,10 +32,10 @@ PYEOF
   NAME=$(python -c "from dllama_tpu.convert.download import ALIASES; n='$MACBETH_DOWNLOAD'.replace('-','_'); print(ALIASES.get(n, n))")
   MODEL="/tmp/dllama_models/$NAME/dllama_model_$NAME.m"
   TOKENIZER="/tmp/dllama_models/$NAME/dllama_tokenizer_$NAME.t"
+else
+  MODEL=${1:-/tmp/dllama_macbeth_demo.m}
+  TOKENIZER=${2:-/tmp/dllama_macbeth_demo.t}
 fi
-
-MODEL=${1:-/tmp/dllama_macbeth_demo.m}
-TOKENIZER=${2:-/tmp/dllama_macbeth_demo.t}
 
 if [ ! -f "$MODEL" ]; then
   echo "building synthetic demo model at $MODEL"

@@ -221,3 +221,34 @@ def test_compressed_engine_decodes():
                  tp_compress=True)
     toks, _, _ = eng.generate_fused([3, 7, 11], steps=6)
     assert len(toks) == 6 and all(0 <= t < CFG.vocab_size for t in toks)
+
+
+def test_wire_stats_analytic_bytes():
+    """TokenStats S/R: the analytic per-token ICI byte count matches the
+    collective schedule — 4 all-gathers per layer (3*dim + padded hidden)
+    plus the logits gather, each moving (tp-1)/tp per device (the reference's
+    socket counters, surfaced at dllama.cpp:74-75)."""
+    qp = _quant_params("q40")
+    mesh = tp_mesh(8)
+    eng = Engine(CFG, qp, SamplerConfig(temperature=0.0), mesh=mesh)
+    hidden = quant_tp.ffn_padded_width(CFG, "q40", 8)
+    layer_feats = CFG.n_layers * (3 * CFG.dim + hidden)
+    # the logits gather moves the lane-PADDED vocab (512 -> 1024 at tp=8),
+    # uncompressed, exactly what the shard_map program ships
+    vocab_bytes = ((CFG.vocab_size + 1023) // 1024) * 1024 * 2.0
+    want_kb = (layer_feats * 2.0 + vocab_bytes) * (7 / 8) / 1024.0
+    assert abs(eng.wire_kb_per_token - want_kb) < 1e-9
+    stats = [s for _, s in eng.generate([1, 2], steps=2)]
+    assert stats[-1].sent_kb == stats[-1].recv_kb == eng.wire_kb_per_token
+    # prefill row: bucket x per-token bytes
+    assert stats[0].sent_kb == eng.wire_kb_per_token * 8  # bucket(2) == 8
+
+    # q80 wire compression: 1.125 B/feature on the per-layer gathers only
+    # (the logits gather stays plain bf16)
+    engc = Engine(CFG, qp, SamplerConfig(temperature=0.0), mesh=mesh,
+                  tp_compress=True)
+    want_c = (layer_feats * 1.125 + vocab_bytes) * (7 / 8) / 1024.0
+    assert abs(engc.wire_kb_per_token - want_c) < 1e-9
+
+    # no mesh -> no wire traffic
+    assert Engine(CFG, qp, SamplerConfig(temperature=0.0)).wire_kb_per_token == 0.0
