@@ -206,17 +206,19 @@ class Engine:
         a mesh). The reference counts wire bytes at its sockets; here the
         collective schedule is static so the count is analytic:
 
-        * quantized TP (shard_map, parallel.quant_tp): 4 ring all-gathers per
-          layer — attention heads (dim), wo output (dim), FFN hidden
-          (lane-padded H'), w2 output (dim) — plus the logits gather when the
-          vocab shards. A ring all-gather moves (tp-1)/tp of the full vector
-          through each device, in each direction. Q80 wire compression
-          (tp_compress) ships 1 byte + 1/8 byte of scale per feature instead
-          of 2 (bf16) — the reference's 4.06x table compresses f32, ours
-          compresses bf16, hence 1.78x.
+        * quantized TP (shard_map, parallel.quant_tp): dense archs run 4 ring
+          all-gathers per layer — attention heads (dim), wo output (dim), FFN
+          hidden (lane-padded H'), w2 output (dim); MoE archs only the two
+          attention gathers (experts are replicated). Plus the f32 logits
+          gather when the vocab shards. A ring all-gather moves (tp-1)/tp of
+          the full vector through each device, in each direction. Activations
+          travel in cfg dtype; Q80 wire compression (tp_compress) ships
+          1 byte + 1/8 byte of scale per feature instead — 1.78x less than
+          bf16, 3.56x less than f32 (the reference's 4.06x table is f32 with
+          slightly different framing overheads).
         * dense TP (pjit): XLA emits ~2 all-reduces per layer (attention out,
-          FFN out), each ~2x(tp-1)/tp of dim in bf16 per device per
-          direction (reduce-scatter + all-gather decomposition).
+          FFN out), each ~2x(tp-1)/tp of dim per device per direction
+          (reduce-scatter + all-gather decomposition).
         """
         if self.mesh is None:
             return 0.0
@@ -228,10 +230,14 @@ class Engine:
             return 0.0
         cfg = self.cfg
         frac = (tp - 1) / tp
+        act_bytes = float(jnp.dtype(cfg.jax_dtype).itemsize)
         if has_quant_leaves(self.params):
             from dllama_tpu.ops.qmatmul import _pad_up
 
-            per_feat = 1.125 if self._tp_compress else 2.0
+            # q80 wire compression ships 1 int8 + 1/8 B of f32 scale per
+            # feature regardless of the activation dtype; plain gathers move
+            # activations as-is (bf16 or f32 per --dtype)
+            per_feat = 1.125 if self._tp_compress else act_bytes
             kind = "q40"
             for leaf in jax.tree.leaves(
                 self.params, is_leaf=lambda x: hasattr(x, "kind")
@@ -239,16 +245,22 @@ class Engine:
                 if hasattr(leaf, "kind"):
                     kind = leaf.kind
                     break
-            hidden = ffn_padded_width(cfg, kind, tp)
-            layer_feats = cfg.n_layers * (3 * cfg.dim + hidden)
+            if cfg.is_moe:
+                # MoE layers gather only around attention (heads out + wo
+                # out); expert stacks are replicated (parallel.quant_tp), so
+                # the FFN runs gather-free
+                layer_feats = cfg.n_layers * 2 * cfg.dim
+            else:
+                hidden = ffn_padded_width(cfg, kind, tp)
+                layer_feats = cfg.n_layers * (3 * cfg.dim + hidden)
             bytes_ = layer_feats * per_feat
             if cfg.vocab_size % tp == 0:
                 # the logits gather moves the lane-PADDED vocab (sliced back
-                # after the gather, models/llama.py) and is never compressed
-                bytes_ += _pad_up(cfg.vocab_size, 128 * tp) * 2.0
+                # after the gather), already cast to f32 and never compressed
+                bytes_ += _pad_up(cfg.vocab_size, 128 * tp) * 4.0
             return bytes_ * frac
         # dense pjit path: estimated from XLA's canonical all-reduce lowering
-        return cfg.n_layers * 2 * cfg.dim * 2.0 * 2 * frac
+        return cfg.n_layers * 2 * cfg.dim * act_bytes * 2 * frac
 
     def new_cache(self) -> dict:
         return self._init_cache()

@@ -233,10 +233,11 @@ def test_wire_stats_analytic_bytes():
     eng = Engine(CFG, qp, SamplerConfig(temperature=0.0), mesh=mesh)
     hidden = quant_tp.ffn_padded_width(CFG, "q40", 8)
     layer_feats = CFG.n_layers * (3 * CFG.dim + hidden)
-    # the logits gather moves the lane-PADDED vocab (512 -> 1024 at tp=8),
-    # uncompressed, exactly what the shard_map program ships
-    vocab_bytes = ((CFG.vocab_size + 1023) // 1024) * 1024 * 2.0
-    want_kb = (layer_feats * 2.0 + vocab_bytes) * (7 / 8) / 1024.0
+    # activations move in cfg dtype (CFG is float32 -> 4 B/feature); the
+    # logits gather moves the lane-PADDED vocab (512 -> 1024 at tp=8) in f32
+    # (forward casts before gathering) — exactly what the shard_map ships
+    vocab_bytes = ((CFG.vocab_size + 1023) // 1024) * 1024 * 4.0
+    want_kb = (layer_feats * 4.0 + vocab_bytes) * (7 / 8) / 1024.0
     assert abs(eng.wire_kb_per_token - want_kb) < 1e-9
     stats = [s for _, s in eng.generate([1, 2], steps=2)]
     assert stats[-1].sent_kb == stats[-1].recv_kb == eng.wire_kb_per_token
@@ -244,7 +245,7 @@ def test_wire_stats_analytic_bytes():
     assert stats[0].sent_kb == eng.wire_kb_per_token * 8  # bucket(2) == 8
 
     # q80 wire compression: 1.125 B/feature on the per-layer gathers only
-    # (the logits gather stays plain bf16)
+    # (the logits gather stays plain f32)
     engc = Engine(CFG, qp, SamplerConfig(temperature=0.0), mesh=mesh,
                   tp_compress=True)
     want_c = (layer_feats * 1.125 + vocab_bytes) * (7 / 8) / 1024.0
