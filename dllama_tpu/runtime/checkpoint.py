@@ -1,0 +1,69 @@
+"""Training checkpoint save/restore (orbax) — the train-side counterpart of
+the ``.m`` weight files.
+
+The reference's only checkpoint artifact is the inference weight file
+(`/root/reference/src/transformer.cpp:194-246`; SURVEY.md §5 "no state
+saving"). This framework has a training step (runtime.train), so it also
+needs resumable training state: params + optimizer state + step counter,
+saved atomically and restored **sharded** — each host/device reads its own
+shard of a mesh-sharded pytree directly (orbax restores to the sharding of
+the provided abstract target), never materializing the full state in one
+place, matching how parallel.sharding streams the inference weights.
+
+QuantTensor leaves round-trip like any other pytree node (registered
+dataclass: array planes are leaves, kind/k_logical are static aux data) —
+but training state is normally the dense bf16/f32 params.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+
+
+def _checkpointer():
+    import orbax.checkpoint as ocp
+
+    return ocp.PyTreeCheckpointer()
+
+
+def save(path: str, params, opt_state, step: int) -> str:
+    """Write one atomic checkpoint at ``path`` (a directory). Overwrites an
+    existing checkpoint at the same path (the caller owns rotation policy —
+    e.g. ``.../step_000100``)."""
+    import orbax.checkpoint as ocp
+
+    path = os.path.abspath(path)
+    state = {"params": params, "opt_state": opt_state, "step": step}
+    _checkpointer().save(path, state, force=True)
+    return path
+
+
+def restore(path: str, params_like, opt_state_like):
+    """Restore ``(params, opt_state, step)`` from ``path``.
+
+    ``params_like`` / ``opt_state_like`` are matching pytrees of arrays OR
+    ShapeDtypeStructs giving the target structure; their shardings (if any)
+    are applied on restore, so a dp/tp/sp-sharded training job resumes with
+    every leaf laid out exactly as the train step expects — no host-side
+    full-state staging.
+    """
+    import orbax.checkpoint as ocp
+
+    path = os.path.abspath(path)
+
+    def as_restore_type(leaf):
+        if isinstance(leaf, jax.ShapeDtypeStruct):
+            return ocp.utils.to_shape_dtype_struct(leaf) if hasattr(
+                ocp.utils, "to_shape_dtype_struct") else leaf
+        return jax.ShapeDtypeStruct(leaf.shape, leaf.dtype,
+                                    sharding=getattr(leaf, "sharding", None))
+
+    target = {
+        "params": jax.tree.map(as_restore_type, params_like),
+        "opt_state": jax.tree.map(as_restore_type, opt_state_like),
+        "step": 0,
+    }
+    state = _checkpointer().restore(path, item=target)
+    return state["params"], state["opt_state"], int(state["step"])
