@@ -77,6 +77,18 @@ def build_parser() -> argparse.ArgumentParser:
                 "`/root/reference/src/utils.cpp:179-182` — open in XProf/"
                 "TensorBoard for per-op device timelines)",
             )
+            sp.add_argument(
+                "--spec-draft",
+                type=int,
+                default=0,
+                metavar="K",
+                help="greedy-only prompt-lookup speculative decoding: draft "
+                "up to K tokens from the context's own history and verify "
+                "them in one device step (emits multiple tokens per "
+                "weight-streaming pass on repetitive text; exact — the "
+                "stream is identical to plain greedy). Requires "
+                "--temperature 0",
+            )
         # multi-host topology (the reference's `--workers h:p ...` analog,
         # `/root/reference/src/app.cpp:60-80`): under SPMD every host runs the
         # SAME command with its own --host-id; JAX wires the hosts into one
@@ -203,6 +215,9 @@ def load_engine(args):
 
 
 def run_generate(args, show_stats: bool) -> None:
+    # flag-only validation BEFORE the (multi-GB) model load
+    if getattr(args, "spec_draft", 0) and args.temperature != 0.0:
+        raise SystemExit("--spec-draft requires --temperature 0 (greedy)")
     engine, tok, cfg = load_engine(args)
     prompt = args.prompt if args.prompt is not None else "Hello"
     tokens = tok.encode(prompt, add_bos=True)
@@ -214,6 +229,14 @@ def run_generate(args, show_stats: bool) -> None:
 
         jax.profiler.start_trace(profile_dir)
 
+    spec_k = getattr(args, "spec_draft", 0)
+    if spec_k:
+        stream = engine.generate_spec(
+            tokens, args.steps, stop_tokens=(tok.eos_id,), draft_len=spec_k
+        )
+    else:
+        stream = engine.generate(tokens, args.steps, stop_tokens=(tok.eos_id,))
+
     gen_ms = []
     inf_ms = []
     prev = tokens[-1]
@@ -221,7 +244,7 @@ def run_generate(args, show_stats: bool) -> None:
     try:
         # incremental decode: multi-byte chars can span byte-fallback tokens
         utf8 = codecs.getincrementaldecoder("utf-8")("replace")
-        for tok_id, stats in engine.generate(tokens, args.steps, stop_tokens=(tok.eos_id,)):
+        for tok_id, stats in stream:
             piece = tok.decode_piece(prev, tok_id)
             sys.stdout.write(utf8.decode(piece))
             sys.stdout.flush()

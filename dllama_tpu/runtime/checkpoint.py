@@ -21,19 +21,24 @@ import os
 
 import jax
 
+_CHECKPOINTER = None
+
 
 def _checkpointer():
-    import orbax.checkpoint as ocp
+    """One cached PyTreeCheckpointer: each instance owns background threads,
+    so per-call construction would leak across a long training loop."""
+    global _CHECKPOINTER
+    if _CHECKPOINTER is None:
+        import orbax.checkpoint as ocp
 
-    return ocp.PyTreeCheckpointer()
+        _CHECKPOINTER = ocp.PyTreeCheckpointer()
+    return _CHECKPOINTER
 
 
 def save(path: str, params, opt_state, step: int) -> str:
     """Write one atomic checkpoint at ``path`` (a directory). Overwrites an
     existing checkpoint at the same path (the caller owns rotation policy —
     e.g. ``.../step_000100``)."""
-    import orbax.checkpoint as ocp
-
     path = os.path.abspath(path)
     state = {"params": params, "opt_state": opt_state, "step": step}
     _checkpointer().save(path, state, force=True)
@@ -65,5 +70,9 @@ def restore(path: str, params_like, opt_state_like):
         "opt_state": jax.tree.map(as_restore_type, opt_state_like),
         "step": 0,
     }
-    state = _checkpointer().restore(path, item=target)
+    # restore_args carry the target shardings into orbax — without them the
+    # legacy item= API falls back to the sharding FILE (the saving run's
+    # topology), which breaks cross-topology resume
+    restore_args = ocp.checkpoint_utils.construct_restore_args(target)
+    state = _checkpointer().restore(path, item=target, restore_args=restore_args)
     return state["params"], state["opt_state"], int(state["step"])

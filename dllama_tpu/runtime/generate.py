@@ -183,9 +183,20 @@ class Engine:
             )
             return toks, cache
 
+        @partial(jax.jit, donate_argnums=(2,))
+        def _verify_step(params, rope, cache, tokens, pos):
+            """Speculative verify: feed [pending, draft_1..draft_k] at pos,
+            return every position's greedy next token. One device program
+            scores k+1 candidate continuations — the MXU sees a T=k+1 batch,
+            barely costlier than a single-token step on a bandwidth-bound
+            decode (the weights stream once either way)."""
+            logits, cache = fwd(cfg, params, rope, tokens, cache, pos)
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32), cache
+
         self._decode_step = partial(_decode_step, self.params, self.rope)
         self._prefill = partial(_prefill, self.params, self.rope)
         self._decode_loop = partial(_decode_loop, self.params, self.rope)
+        self._verify_step = partial(_verify_step, self.params, self.rope)
 
         # compiled once; materializes the cache already-sharded (allocate-then-
         # reshard would transiently put the FULL cache in one device's HBM,
@@ -455,3 +466,136 @@ class Engine:
             pending = prompt_tokens[0] if len(prompt_tokens) == 1 else None
         self.final_session = Session(cache, pos, pending_token=pending)
         return emitted, prefill_ms, decode_ms
+
+    def generate_spec(
+        self,
+        prompt_tokens: list,
+        steps: int,
+        session: Optional[Session] = None,
+        stop_tokens: tuple = (),
+        draft_len: int = 8,
+        ngram: int = 3,
+    ) -> Iterator[tuple]:
+        """Greedy decoding with prompt-lookup speculative drafting.
+
+        Drafts the next ``draft_len`` tokens by matching the trailing
+        ``ngram`` of the context against its own history (the continuation
+        that followed the same n-gram last time), then scores pending +
+        draft in ONE verify step and accepts the longest matching prefix —
+        m matched drafts emit m+1 tokens for one weight-streaming pass, a
+        pure win on bandwidth-bound decode whenever text repeats (quoting,
+        code, structured output). Exact: emitted tokens are identical to
+        plain greedy decode, token for token. Beyond the reference's
+        capabilities (single token per step, `src/tasks.cpp:199-210`).
+
+        Cache safety on rejection needs no rollback: rejected draft slots
+        hold garbage K/V, but every future step writes position p before any
+        query attends it — the same overwrite-before-attend invariant as
+        tail-padded prefill.
+
+        Only defined for greedy (the engine/sampler temperature is ignored);
+        yields (token_id, TokenStats) like ``generate``.
+        """
+        if session is None:
+            cache, pos = self.new_cache(), 0
+        else:
+            cache, pos = session.cache, session.pos
+            if session.pending_token is not None:
+                prompt_tokens = [session.pending_token] + list(prompt_tokens)
+        if not prompt_tokens:
+            raise ValueError(
+                "generate_spec needs at least one token to feed — an empty "
+                "prompt requires a session with a pending_token"
+            )
+        steps = min(steps, self.cfg.seq_len - pos - len(prompt_tokens))
+
+        t0 = time.perf_counter()
+        # context = tokens already consumed into the cache; the pending
+        # `token` joins it only when a verify step consumes it
+        if len(prompt_tokens) > 1:
+            context = list(prompt_tokens)
+            last_logits, cache = self.prefill(cache, prompt_tokens, pos)
+            token = int(jnp.argmax(last_logits))
+            pos += len(prompt_tokens)
+        else:
+            context = []
+            token = int(prompt_tokens[0])
+        self.prefill_ms = (time.perf_counter() - t0) * 1000.0
+
+        if steps <= 0:
+            pend = token if len(prompt_tokens) > 1 else prompt_tokens[0]
+            self.final_session = Session(cache, pos, pending_token=int(pend))
+            return
+
+        emitted = 0
+        first = len(prompt_tokens) > 1
+        while emitted < steps:
+            t1 = time.perf_counter()
+            if first:
+                # the prefill already produced one token "for free"; the
+                # prompt is consumed, so per-token pos below starts at pos-1
+                out, first, base = [token], False, pos - 1
+                batch_rows = getattr(self, "_last_prefill_bucket", 1)
+            else:
+                # fixed feed length -> ONE verify compile for the whole run;
+                # pad slots write garbage K/V at pos+m+1.. which every later
+                # step overwrites before attending (see docstring). Only the
+                # sequence tail shrinks the feed (at most one extra compile
+                # per distinct tail length).
+                L = min(draft_len + 1, self.cfg.seq_len - pos)
+                k = min(L - 1, max(steps - emitted - 1, 0))
+                draft = _ngram_draft(context + [token], ngram, k)
+                feed = [token] + draft + [0] * (L - 1 - len(draft))
+                g, cache = self._verify_step(
+                    cache, jnp.asarray(feed, jnp.int32), jnp.int32(pos))
+                g = [int(v) for v in np.asarray(g)]
+                # accept drafts while they match the model's own greedy choice
+                m = 0
+                while m < len(draft) and draft[m] == g[m]:
+                    m += 1
+                out = g[: m + 1]  # m matched drafts + the correcting token
+                context.append(token)
+                context.extend(draft[:m])
+                token = out[-1]
+                base = pos  # position before this batch's tokens
+                pos += len(out)
+                batch_rows = L
+            dt = (time.perf_counter() - t1) * 1000.0
+            # this batch's collectives gathered batch_rows rows, not one
+            # (cf. the prefill row's bucket multiplier in generate())
+            batch_kb = self.wire_kb_per_token * batch_rows
+            for i, tk in enumerate(out):
+                if emitted >= steps:
+                    break
+                emitted += 1
+                # per-token session pos: a consumer stopping at token i must
+                # resume as if only tokens 0..i were ever consumed — slots
+                # written beyond are overwritten before any resume attends
+                self.final_session = Session(cache, base + i + 1, pending_token=tk)
+                yield tk, TokenStats(
+                    generation_ms=dt if i == 0 else 0.0,
+                    inference_ms=dt if i == 0 else 0.0,
+                    sent_kb=batch_kb if i == 0 else 0.0,
+                    recv_kb=batch_kb if i == 0 else 0.0,
+                )
+                if tk in stop_tokens:
+                    return
+        # final_session is already exact: the last yield recorded (cache,
+        # pos-of-that-token, pending) — tokens speculated past the `steps`
+        # cap were never emitted and their cache slots will be overwritten
+        # before any resumed decode attends them
+
+
+def _ngram_draft(context: list, ngram: int, k: int) -> list:
+    """Propose up to k tokens: find the most recent earlier occurrence of the
+    trailing ``ngram`` of ``context`` and return what followed it then."""
+    if k <= 0 or len(context) <= ngram:
+        return []
+    tail = tuple(context[-ngram:])
+    # scan back over earlier positions (most recent first)
+    for j in range(len(context) - ngram - 1, -1, -1):
+        if tuple(context[j : j + ngram]) == tail:
+            cont = context[j + ngram : j + ngram + k]
+            if cont:
+                return list(cont)
+    return []
