@@ -67,6 +67,12 @@ def build_parser() -> argparse.ArgumentParser:
             "bf16/f16/f32 dequantize at load",
         )
         sp.add_argument("--nthreads", type=int, default=None, help=argparse.SUPPRESS)
+        if mode == "serve":
+            sp.add_argument(
+                "--spec-draft", type=int, default=0, metavar="K",
+                help="serve temperature==0 requests with prompt-lookup "
+                "speculative decoding (exact greedy; see generate mode)",
+            )
         if mode in ("inference", "generate"):
             sp.add_argument(
                 "--profile",

@@ -475,6 +475,7 @@ class Engine:
         stop_tokens: tuple = (),
         draft_len: int = 8,
         ngram: int = 3,
+        history: Optional[list] = None,
     ) -> Iterator[tuple]:
         """Greedy decoding with prompt-lookup speculative drafting.
 
@@ -495,6 +496,12 @@ class Engine:
 
         Only defined for greedy (the engine/sampler temperature is ignored);
         yields (token_id, TokenStats) like ``generate``.
+
+        ``history``: tokens already consumed into the session's cache before
+        this call (exclusive of its pending token) — resuming callers (e.g.
+        the API server's prefix cache) pass the prior conversation so the
+        n-gram lookup can draft from earlier turns, which is where the
+        repetition lives. Draft quality only; output is exact regardless.
         """
         if session is None:
             cache, pos = self.new_cache(), 0
@@ -512,13 +519,13 @@ class Engine:
         t0 = time.perf_counter()
         # context = tokens already consumed into the cache; the pending
         # `token` joins it only when a verify step consumes it
+        context = list(history) if history else []
         if len(prompt_tokens) > 1:
-            context = list(prompt_tokens)
+            context += list(prompt_tokens)
             last_logits, cache = self.prefill(cache, prompt_tokens, pos)
             token = int(jnp.argmax(last_logits))
             pos += len(prompt_tokens)
         else:
-            context = []
             token = int(prompt_tokens[0])
         self.prefill_ms = (time.perf_counter() - t0) * 1000.0
 

@@ -87,10 +87,14 @@ class ServerState:
 
     def __init__(self, engine, tokenizer, cfg, model_name: str, template: str = "llama3",
                  default_sampler: SamplerConfig = SamplerConfig(),
-                 default_seed: int = None):
+                 default_seed: int = None, spec_draft: int = 0):
         """``default_seed``: seed for requests that send none — None means a
         fresh time-based seed per request (the launch-flag --seed plumbs in
-        here so an operator can make the whole server reproducible)."""
+        here so an operator can make the whole server reproducible).
+        ``spec_draft`` > 0 serves temperature==0 requests with prompt-lookup
+        speculative decoding (Engine.generate_spec — exact greedy, multiple
+        tokens per device step on repetitive text); sampled requests are
+        unaffected."""
         self.engine = engine
         self.tokenizer = tokenizer
         self.cfg = cfg
@@ -98,6 +102,7 @@ class ServerState:
         self.template = template
         self.default_sampler = default_sampler
         self.default_seed = default_seed
+        self.spec_draft = spec_draft
         self.lock = threading.Lock()  # engine serves one request at a time
         # prefix cache: the KV state + token history of the last completion.
         # Multi-turn chats resend the whole conversation; when the new prompt
@@ -293,10 +298,22 @@ class OpenAIHandler(BaseHTTPRequestHandler):
                 stop_ids += (eot,)
             session, feed_tokens = st.take_prefix_session(prompt_tokens)
             history = list(prompt_tokens)
-            for tok_id, _stats in st.engine.generate(
-                feed_tokens, max_tokens, session=session,
-                stop_tokens=stop_ids, sampler=sampler,
-            ):
+            if st.spec_draft > 0 and sampler.temperature == 0.0:
+                # tokens already consumed into the claimed session's cache
+                # (the cached prefix minus its pending token): lets the
+                # n-gram draft match across earlier turns of the chat
+                n_consumed = len(prompt_tokens) - len(feed_tokens) - 1
+                stream_iter = st.engine.generate_spec(
+                    feed_tokens, max_tokens, session=session,
+                    stop_tokens=stop_ids, draft_len=st.spec_draft,
+                    history=prompt_tokens[:n_consumed] if session else None,
+                )
+            else:
+                stream_iter = st.engine.generate(
+                    feed_tokens, max_tokens, session=session,
+                    stop_tokens=stop_ids, sampler=sampler,
+                )
+            for tok_id, _stats in stream_iter:
                 n_generated += 1
                 history.append(tok_id)
                 if tok_id in stop_ids:
@@ -361,6 +378,7 @@ def serve(args) -> None:
         # comes from default_seed (single source of truth)
         default_sampler=SamplerConfig(temperature=args.temperature, topp=args.topp),
         default_seed=args.seed,
+        spec_draft=getattr(args, "spec_draft", 0),
     )
     srv = create_server(state, host=args.host, port=args.port)
     print(f"📡 listening on {args.host}:{args.port} "

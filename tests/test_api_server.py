@@ -279,3 +279,52 @@ def test_multi_turn_prefix_reuse_matches_fresh(server):
     assert status == 200
     cold = json.loads(data)["choices"][0]["message"]["content"]
     assert warm == cold
+
+
+def test_spec_draft_server_matches_plain_greedy():
+    """A --spec-draft server must return byte-identical greedy completions to
+    a plain server (speculative decoding is exact), including across the
+    prefix-cache multi-turn path."""
+    tok = make_tokenizer()
+    cfg = tiny_cfg(vocab_size=tok.vocab_size, seq_len=512, dim=32, kv_dim=16,
+                   head_size=8, hidden_dim=64)
+    params = llama.random_params(cfg, seed=13)
+
+    def run_server(spec):
+        engine = Engine(cfg, params, SamplerConfig(temperature=0.0, seed=1))
+        state = ServerState(engine, tok, cfg, model_name="tiny-test",
+                            template="llama3", spec_draft=spec)
+        srv = create_server(state, host="127.0.0.1", port=0)
+        port = srv.server_address[1]
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        return srv, port
+
+    srv_a, port_a = run_server(0)
+    srv_b, port_b = run_server(6)
+    try:
+        replies = {}
+        for port in (port_a, port_b):
+            # turn 1 (cold prefill), then a follow-up that EXTENDS it — the
+            # second request claims the prefix session, exercising the
+            # warm-resume spec branch (pending_token + history drafting)
+            first = [{"role": "user", "content": "hello world"}]
+            _, d1 = request(port, "POST", "/v1/chat/completions",
+                            chat_body(messages=first, max_tokens=12))
+            r1 = json.loads(d1)["choices"][0]["message"]["content"]
+            followup = first + [
+                {"role": "assistant", "content": r1},
+                {"role": "user", "content": "hello world hello world"},
+            ]
+            _, d2 = request(port, "POST", "/v1/chat/completions",
+                            chat_body(messages=followup, max_tokens=12))
+            r2 = json.loads(d2)["choices"][0]["message"]["content"]
+            replies[port] = (r1, r2)
+        assert replies[port_a] == replies[port_b], replies
+        # sampled requests bypass the spec path entirely (and still work)
+        st, d = request(port_b, "POST", "/v1/chat/completions",
+                        chat_body(temperature=0.9, seed=5))
+        assert st == 200 and isinstance(
+            json.loads(d)["choices"][0]["message"]["content"], str)
+    finally:
+        srv_a.shutdown()
+        srv_b.shutdown()
