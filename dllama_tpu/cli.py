@@ -67,12 +67,6 @@ def build_parser() -> argparse.ArgumentParser:
             "bf16/f16/f32 dequantize at load",
         )
         sp.add_argument("--nthreads", type=int, default=None, help=argparse.SUPPRESS)
-        if mode == "serve":
-            sp.add_argument(
-                "--spec-draft", type=int, default=0, metavar="K",
-                help="serve temperature==0 requests with prompt-lookup "
-                "speculative decoding (exact greedy; see generate mode)",
-            )
         if mode in ("inference", "generate"):
             sp.add_argument(
                 "--profile",
@@ -83,6 +77,7 @@ def build_parser() -> argparse.ArgumentParser:
                 "`/root/reference/src/utils.cpp:179-182` — open in XProf/"
                 "TensorBoard for per-op device timelines)",
             )
+        if mode in ("inference", "generate", "serve"):
             sp.add_argument(
                 "--spec-draft",
                 type=int,
@@ -92,8 +87,9 @@ def build_parser() -> argparse.ArgumentParser:
                 "up to K tokens from the context's own history and verify "
                 "them in one device step (emits multiple tokens per "
                 "weight-streaming pass on repetitive text; exact — the "
-                "stream is identical to plain greedy). Requires "
-                "--temperature 0",
+                "stream is identical to plain greedy). generate/inference: "
+                "requires --temperature 0; serve: applies to temperature==0 "
+                "requests only",
             )
         # multi-host topology (the reference's `--workers h:p ...` analog,
         # `/root/reference/src/app.cpp:60-80`): under SPMD every host runs the

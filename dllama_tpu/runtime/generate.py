@@ -517,11 +517,13 @@ class Engine:
         steps = min(steps, self.cfg.seq_len - pos - len(prompt_tokens))
 
         t0 = time.perf_counter()
-        # context = tokens already consumed into the cache; the pending
-        # `token` joins it only when a verify step consumes it
-        context = list(history) if history else []
+        # the index covers tokens already consumed into the cache; the
+        # pending `token` joins it only when a verify step consumes it
+        index = _NgramIndex(ngram)
+        if history:
+            index.extend(history)
         if len(prompt_tokens) > 1:
-            context += list(prompt_tokens)
+            index.extend(prompt_tokens)
             last_logits, cache = self.prefill(cache, prompt_tokens, pos)
             token = int(jnp.argmax(last_logits))
             pos += len(prompt_tokens)
@@ -551,7 +553,7 @@ class Engine:
                 # per distinct tail length).
                 L = min(draft_len + 1, self.cfg.seq_len - pos)
                 k = min(L - 1, max(steps - emitted - 1, 0))
-                draft = _ngram_draft(context + [token], ngram, k)
+                draft = index.draft(token, k)
                 feed = [token] + draft + [0] * (L - 1 - len(draft))
                 g, cache = self._verify_step(
                     cache, jnp.asarray(feed, jnp.int32), jnp.int32(pos))
@@ -561,8 +563,7 @@ class Engine:
                 while m < len(draft) and draft[m] == g[m]:
                     m += 1
                 out = g[: m + 1]  # m matched drafts + the correcting token
-                context.append(token)
-                context.extend(draft[:m])
+                index.extend([token] + draft[:m])
                 token = out[-1]
                 base = pos  # position before this batch's tokens
                 pos += len(out)
@@ -593,16 +594,31 @@ class Engine:
         # before any resumed decode attends them
 
 
-def _ngram_draft(context: list, ngram: int, k: int) -> list:
-    """Propose up to k tokens: find the most recent earlier occurrence of the
-    trailing ``ngram`` of ``context`` and return what followed it then."""
-    if k <= 0 or len(context) <= ngram:
-        return []
-    tail = tuple(context[-ngram:])
-    # scan back over earlier positions (most recent first)
-    for j in range(len(context) - ngram - 1, -1, -1):
-        if tuple(context[j : j + ngram]) == tail:
-            cont = context[j + ngram : j + ngram + k]
-            if cont:
-                return list(cont)
-    return []
+class _NgramIndex:
+    """Incremental n-gram -> latest-start-position index over the consumed
+    context: O(1) amortized per appended token, O(1) per draft lookup. A
+    naive backward scan is O(context) per verify step, which on a
+    near-context-limit chat burns milliseconds of host time per device
+    dispatch — eroding exactly the bandwidth win drafting exists to buy."""
+
+    def __init__(self, ngram: int):
+        self.ngram = ngram
+        self.ctx: list = []
+        self._pos: dict = {}
+
+    def extend(self, tokens) -> None:
+        for t in tokens:
+            self.ctx.append(t)
+            if len(self.ctx) >= self.ngram:
+                self._pos[tuple(self.ctx[-self.ngram:])] = len(self.ctx) - self.ngram
+
+    def draft(self, pending: int, k: int) -> list:
+        """Up to k proposed continuations of context + [pending]: what
+        followed the most recent earlier occurrence of its trailing n-gram."""
+        if k <= 0 or len(self.ctx) + 1 <= self.ngram:
+            return []
+        tail = tuple((self.ctx + [pending])[-self.ngram:])
+        j = self._pos.get(tail)
+        if j is None:
+            return []
+        return list(self.ctx[j + self.ngram : j + self.ngram + k])

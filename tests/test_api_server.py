@@ -290,35 +290,63 @@ def test_spec_draft_server_matches_plain_greedy():
                    head_size=8, hidden_dim=64)
     params = llama.random_params(cfg, seed=13)
 
+    class ForcedWarmEncoder:
+        """Tokenizer wrapper: a ``<<WARM>>`` prompt re-encodes to the exact
+        cached raw prefix + a fixed suffix, FORCING the prefix-cache warm
+        path (assistant text does not decode->encode round-trip through BPE,
+        so a natural follow-up may cold-miss and test nothing)."""
+
+        def __init__(self, tok, state_box):
+            self._tok, self._box = tok, state_box
+
+        def __getattr__(self, name):
+            return getattr(self._tok, name)
+
+        def encode(self, text, add_bos=True):
+            if "<<WARM>>" in text:
+                return list(self._box[0]._prefix_tokens) + [263, 264, 265]
+            return self._tok.encode(text, add_bos=add_bos)
+
     def run_server(spec):
         engine = Engine(cfg, params, SamplerConfig(temperature=0.0, seed=1))
-        state = ServerState(engine, tok, cfg, model_name="tiny-test",
-                            template="llama3", spec_draft=spec)
+        box = []
+        state = ServerState(engine, ForcedWarmEncoder(tok, box), cfg,
+                            model_name="tiny-test", template="llama3",
+                            spec_draft=spec)
+        box.append(state)
+        claims = []
+        orig = state.take_prefix_session
+
+        def spying_take(prompt_tokens):
+            session, feed = orig(prompt_tokens)
+            claims.append(session is not None)
+            return session, feed
+
+        state.take_prefix_session = spying_take
         srv = create_server(state, host="127.0.0.1", port=0)
         port = srv.server_address[1]
         threading.Thread(target=srv.serve_forever, daemon=True).start()
-        return srv, port
+        return srv, port, claims
 
-    srv_a, port_a = run_server(0)
-    srv_b, port_b = run_server(6)
+    srv_a, port_a, claims_a = run_server(0)
+    srv_b, port_b, claims_b = run_server(6)
     try:
         replies = {}
         for port in (port_a, port_b):
-            # turn 1 (cold prefill), then a follow-up that EXTENDS it — the
-            # second request claims the prefix session, exercising the
-            # warm-resume spec branch (pending_token + history drafting)
             first = [{"role": "user", "content": "hello world"}]
             _, d1 = request(port, "POST", "/v1/chat/completions",
                             chat_body(messages=first, max_tokens=12))
             r1 = json.loads(d1)["choices"][0]["message"]["content"]
-            followup = first + [
-                {"role": "assistant", "content": r1},
-                {"role": "user", "content": "hello world hello world"},
-            ]
+            # the forced-warm follow-up claims the session, exercising the
+            # warm-resume spec branch (pending_token + history drafting)
             _, d2 = request(port, "POST", "/v1/chat/completions",
-                            chat_body(messages=followup, max_tokens=12))
+                            chat_body(messages=[
+                                {"role": "user", "content": "<<WARM>>"}],
+                                max_tokens=12))
             r2 = json.loads(d2)["choices"][0]["message"]["content"]
             replies[port] = (r1, r2)
+        assert claims_a == [False, True], claims_a  # cold, then forced warm
+        assert claims_b == [False, True], claims_b
         assert replies[port_a] == replies[port_b], replies
         # sampled requests bypass the spec path entirely (and still work)
         st, d = request(port_b, "POST", "/v1/chat/completions",

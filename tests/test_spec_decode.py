@@ -6,7 +6,7 @@ import numpy as np
 
 from dllama_tpu.models import llama
 from dllama_tpu.models.config import ModelConfig
-from dllama_tpu.runtime.generate import Engine, _ngram_draft
+from dllama_tpu.runtime.generate import Engine, _NgramIndex
 from dllama_tpu.runtime.sampler import SamplerConfig
 
 CFG = ModelConfig(
@@ -22,11 +22,18 @@ def _engine(seed=0, kind=None):
     return Engine(CFG, params, SamplerConfig(temperature=0.0, seed=1))
 
 
-def test_ngram_draft_lookup():
-    ctx = [1, 2, 3, 9, 9, 1, 2, 3]
-    assert _ngram_draft(ctx, 3, 2) == [9, 9]  # last [1,2,3] matched earlier
-    assert _ngram_draft([1, 2, 3], 3, 2) == []  # no earlier occurrence
-    assert _ngram_draft(ctx, 3, 0) == []
+def test_ngram_index_draft_lookup():
+    idx = _NgramIndex(3)
+    idx.extend([1, 2, 3, 9, 9, 1, 2])
+    assert idx.draft(3, 2) == [9, 9]  # [1,2]+pending 3 matched at position 0
+    assert idx.draft(7, 2) == []      # tail [2,7] ... no such n-gram
+    assert idx.draft(3, 0) == []
+    fresh = _NgramIndex(3)
+    fresh.extend([1, 2])
+    assert fresh.draft(3, 2) == []    # no earlier occurrence yet
+    # incremental extension keeps the LATEST occurrence
+    idx.extend([3, 5, 1, 2])
+    assert idx.draft(3, 2) == [5, 1]  # now matches the more recent [1,2,3]
 
 
 def test_spec_matches_plain_greedy():
@@ -66,6 +73,19 @@ def test_spec_session_resume_matches_uninterrupted():
     part1 = [t for t, _ in eng.generate_spec([1, 5, 9], steps=10)]
     sess = eng.final_session
     part2 = [t for t, _ in eng.generate_spec([], steps=10, session=sess)]
+    full = [t for t, _ in _engine().generate_spec([1, 5, 9], steps=20)]
+    assert part1 + part2 == full
+
+
+def test_spec_resume_with_history_stays_exact():
+    """history= feeds the prior conversation to the n-gram index (better
+    drafts on warm resumes); the emitted stream must be unchanged by it."""
+    eng = _engine()
+    part1 = [t for t, _ in eng.generate_spec([1, 5, 9], steps=10)]
+    sess = eng.final_session
+    consumed = [1, 5, 9] + part1[:-1]  # pending = part1[-1], not yet consumed
+    part2 = [t for t, _ in eng.generate_spec(
+        [], steps=10, session=sess, history=consumed)]
     full = [t for t, _ in _engine().generate_spec([1, 5, 9], steps=20)]
     assert part1 + part2 == full
 
