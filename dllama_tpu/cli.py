@@ -77,7 +77,7 @@ def build_parser() -> argparse.ArgumentParser:
                 "`/root/reference/src/utils.cpp:179-182` — open in XProf/"
                 "TensorBoard for per-op device timelines)",
             )
-        if mode in ("inference", "generate", "serve"):
+        if mode in ("inference", "generate", "serve", "chat"):
             sp.add_argument(
                 "--spec-draft",
                 type=int,
@@ -87,9 +87,9 @@ def build_parser() -> argparse.ArgumentParser:
                 "up to K tokens from the context's own history and verify "
                 "them in one device step (emits multiple tokens per "
                 "weight-streaming pass on repetitive text; exact — the "
-                "stream is identical to plain greedy). generate/inference: "
-                "requires --temperature 0; serve: applies to temperature==0 "
-                "requests only",
+                "stream is identical to plain greedy). generate/inference/"
+                "chat: requires --temperature 0; serve: applies to "
+                "temperature==0 requests only",
             )
         # multi-host topology (the reference's `--workers h:p ...` analog,
         # `/root/reference/src/app.cpp:60-80`): under SPMD every host runs the
@@ -293,11 +293,15 @@ def run_generate(args, show_stats: bool) -> None:
 def run_chat(args) -> None:
     from dllama_tpu.serving.templates import render_llama2_turn, render_llama3_chat
 
+    spec_k = getattr(args, "spec_draft", 0)
+    if spec_k and args.temperature != 0.0:
+        raise SystemExit("--spec-draft requires --temperature 0 (greedy)")
     engine, tok, cfg = load_engine(args)
     system = args.system_prompt
     if system is None:
         system = input("💻 Enter system prompt (optional): ")
     session = None
+    all_tokens: list = []  # every token fed or emitted; session pending last
     while True:
         try:
             user = input("👱 User: ")
@@ -320,10 +324,22 @@ def run_chat(args) -> None:
         print("🤖 Assistant: ", end="", flush=True)
         prev = tokens[-1]
         reply = []
+        emitted_ids = []
         utf8 = codecs.getincrementaldecoder("utf-8")("replace")
-        for tok_id, _ in engine.generate(
-            tokens, args.steps, session=session, stop_tokens=(tok.eos_id,)
-        ):
+        if spec_k:
+            # multi-turn chat is where text repeats; the n-gram index drafts
+            # from the whole conversation so far (exact greedy either way)
+            stream = engine.generate_spec(
+                tokens, args.steps, session=session, stop_tokens=(tok.eos_id,),
+                draft_len=spec_k,
+                history=all_tokens[:-1] if session else None,
+            )
+        else:
+            stream = engine.generate(
+                tokens, args.steps, session=session, stop_tokens=(tok.eos_id,)
+            )
+        for tok_id, _ in stream:
+            emitted_ids.append(tok_id)
             if tok_id == tok.eos_id:
                 continue  # generator stops itself after yielding a stop token
             piece = utf8.decode(tok.decode_piece(prev, tok_id))
@@ -331,6 +347,8 @@ def run_chat(args) -> None:
             prev = tok_id
             reply.append(piece)
         print(utf8.decode(b"", True))
+        all_tokens.extend(tokens)
+        all_tokens.extend(emitted_ids)
         session = engine.final_session
         if session.pos >= cfg.seq_len - 1:
             print("(context window exhausted)")

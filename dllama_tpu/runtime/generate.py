@@ -605,20 +605,30 @@ class _NgramIndex:
         self.ngram = ngram
         self.ctx: list = []
         self._pos: dict = {}
+        self._prev: dict = {}  # the occurrence before the latest, per n-gram
 
     def extend(self, tokens) -> None:
         for t in tokens:
             self.ctx.append(t)
             if len(self.ctx) >= self.ngram:
-                self._pos[tuple(self.ctx[-self.ngram:])] = len(self.ctx) - self.ngram
+                key = tuple(self.ctx[-self.ngram:])
+                if key in self._pos:
+                    self._prev[key] = self._pos[key]
+                self._pos[key] = len(self.ctx) - self.ngram
 
     def draft(self, pending: int, k: int) -> list:
         """Up to k proposed continuations of context + [pending]: what
-        followed the most recent earlier occurrence of its trailing n-gram."""
+        followed the most recent earlier occurrence of its trailing n-gram.
+        If the latest occurrence ends flush at the end of the context (its
+        continuation is empty — the norm on repeated-token runs, the most
+        draftable text there is), fall back to the one before it, whose
+        continuation is never empty."""
         if k <= 0 or len(self.ctx) + 1 <= self.ngram:
             return []
         tail = tuple((self.ctx + [pending])[-self.ngram:])
-        j = self._pos.get(tail)
-        if j is None:
-            return []
-        return list(self.ctx[j + self.ngram : j + self.ngram + k])
+        for j in (self._pos.get(tail), self._prev.get(tail)):
+            if j is not None:
+                cont = self.ctx[j + self.ngram : j + self.ngram + k]
+                if cont:
+                    return list(cont)
+        return []

@@ -36,6 +36,19 @@ def test_ngram_index_draft_lookup():
     assert idx.draft(3, 2) == [5, 1]  # now matches the more recent [1,2,3]
 
 
+def test_ngram_index_repeated_token_runs_still_draft():
+    """Degenerate repetition (ctx [5,5,5,5], pending 5): the LATEST [5,5,5]
+    ends flush at the context end with an empty continuation — the index
+    must fall back to the prior occurrence and still draft (regression:
+    returning [] here degrades spec decoding to 1 token/step on exactly the
+    most draftable text)."""
+    idx = _NgramIndex(3)
+    idx.extend([5, 5, 5, 5])
+    assert idx.draft(5, 4) == [5]  # prior occurrence's 1-token continuation
+    idx.extend([5, 5])
+    assert idx.draft(5, 3) == [5]  # same as the old backward scan drafted
+
+
 def test_spec_matches_plain_greedy():
     """Speculative greedy must emit EXACTLY the plain greedy stream — same
     tokens, same count — for multi-token and single-token prompts."""
