@@ -253,3 +253,15 @@ def test_wire_stats_analytic_bytes():
 
     # no mesh -> no wire traffic
     assert Engine(CFG, qp, SamplerConfig(temperature=0.0)).wire_kb_per_token == 0.0
+
+
+def test_spec_decode_under_tp_matches_single_device():
+    """generate_spec rides the same shard_map forward: the speculative
+    greedy stream on an 8-device quant-TP mesh must equal the single-device
+    one (and plain generate's)."""
+    qp = _quant_params("q40")
+    single = Engine(CFG, qp, SamplerConfig(temperature=0.0))
+    want = [t for t, _ in single.generate([1, 2, 3], steps=16)]
+    tp_eng = Engine(CFG, qp, SamplerConfig(temperature=0.0), mesh=tp_mesh(8))
+    got = [t for t, _ in tp_eng.generate_spec([1, 2, 3], steps=16)]
+    assert got == want
