@@ -193,10 +193,24 @@ class Engine:
             logits, cache = fwd(cfg, params, rope, tokens, cache, pos)
             return jnp.argmax(logits, axis=-1).astype(jnp.int32), cache
 
+        @partial(jax.jit, donate_argnums=(2,))
+        def _verify_sampled(params, rope, cache, tokens, pos, keys, temp, topp):
+            """Sampled speculative verify: position i gets the token that
+            sequential decoding would have SAMPLED with keys[i] — so the
+            host-side acceptance (draft matches the sampled choice) yields a
+            stream bit-identical to plain sampled decode as long as the key
+            chain is replayed faithfully (see generate_spec)."""
+            logits, cache = fwd(cfg, params, rope, tokens, cache, pos)
+            toks = jax.vmap(
+                lambda l, k: sample_dynamic(l, k, temp, topp)
+            )(logits, keys)
+            return toks.astype(jnp.int32), cache
+
         self._decode_step = partial(_decode_step, self.params, self.rope)
         self._prefill = partial(_prefill, self.params, self.rope)
         self._decode_loop = partial(_decode_loop, self.params, self.rope)
         self._verify_step = partial(_verify_step, self.params, self.rope)
+        self._verify_sampled = partial(_verify_sampled, self.params, self.rope)
 
         # compiled once; materializes the cache already-sharded (allocate-then-
         # reshard would transiently put the FULL cache in one device's HBM,
@@ -476,8 +490,10 @@ class Engine:
         draft_len: int = 8,
         ngram: int = 3,
         history: Optional[list] = None,
+        sampler: Optional[SamplerConfig] = None,
     ) -> Iterator[tuple]:
-        """Greedy decoding with prompt-lookup speculative drafting.
+        """Decoding with prompt-lookup speculative drafting — greedy or
+        sampled, both EXACT.
 
         Drafts the next ``draft_len`` tokens by matching the trailing
         ``ngram`` of the context against its own history (the continuation
@@ -485,17 +501,25 @@ class Engine:
         draft in ONE verify step and accepts the longest matching prefix —
         m matched drafts emit m+1 tokens for one weight-streaming pass, a
         pure win on bandwidth-bound decode whenever text repeats (quoting,
-        code, structured output). Exact: emitted tokens are identical to
-        plain greedy decode, token for token. Beyond the reference's
-        capabilities (single token per step, `src/tasks.cpp:199-210`).
+        code, structured output). Beyond the reference's capabilities
+        (single token per step, `src/tasks.cpp:199-210`).
+
+        Exactness: at temperature 0 the verify compares against per-position
+        argmax. At temperature > 0 it compares against the token sequential
+        decoding would have SAMPLED — the verify step evaluates position i
+        with the i-th key of the same per-token key chain ``generate`` walks
+        (``sampler`` given: a fresh chain from its seed, as in generate;
+        otherwise the engine chain) — so the emitted stream is identical to
+        plain decode with the same sampler, batch boundaries and all.
+        Acceptance just happens less often as temperature rises. The chain
+        advances exactly once per EMITTED token — a stop token or the steps
+        cap truncating a batch truncates the advancement with it, keeping
+        later turns on the engine chain aligned with plain decode.
 
         Cache safety on rejection needs no rollback: rejected draft slots
         hold garbage K/V, but every future step writes position p before any
         query attends it — the same overwrite-before-attend invariant as
         tail-padded prefill.
-
-        Only defined for greedy (the engine/sampler temperature is ignored);
-        yields (token_id, TokenStats) like ``generate``.
 
         ``history``: tokens already consumed into the session's cache before
         this call (exclusive of its pending token) — resuming callers (e.g.
@@ -503,6 +527,27 @@ class Engine:
         n-gram lookup can draft from earlier turns, which is where the
         repetition lives. Draft quality only; output is exact regardless.
         """
+        scfg = sampler if sampler is not None else self.sampler_cfg
+        temp, topp = jnp.float32(scfg.temperature), jnp.float32(scfg.topp)
+        sampled = scfg.temperature > 0.0
+        chain = jax.random.PRNGKey(scfg.seed) if sampler is not None else self._key
+
+        def peek(n):
+            """n per-token keys + the chain state after each — the caller
+            commits to a prefix of them via commit(states[i])."""
+            c, subs, states = chain, [], []
+            for _ in range(n):
+                c, sub = jax.random.split(c)
+                subs.append(sub)
+                states.append(c)
+            return subs, states
+
+        def commit(state):
+            nonlocal chain
+            chain = state
+            if sampler is None:
+                self._key = chain  # mirror next_key()'s engine-chain use
+
         if session is None:
             cache, pos = self.new_cache(), 0
         else:
@@ -525,7 +570,12 @@ class Engine:
         if len(prompt_tokens) > 1:
             index.extend(prompt_tokens)
             last_logits, cache = self.prefill(cache, prompt_tokens, pos)
-            token = int(jnp.argmax(last_logits))
+            if sampled:
+                subs, states = peek(1)
+                commit(states[0])
+                token = int(sample_dynamic(last_logits, subs[0], temp, topp))
+            else:
+                token = int(jnp.argmax(last_logits))
             pos += len(prompt_tokens)
         else:
             token = int(prompt_tokens[0])
@@ -554,27 +604,44 @@ class Engine:
                 L = min(draft_len + 1, self.cfg.seq_len - pos)
                 k = min(L - 1, max(steps - emitted - 1, 0))
                 draft = index.draft(token, k)
-                feed = [token] + draft + [0] * (L - 1 - len(draft))
-                g, cache = self._verify_step(
-                    cache, jnp.asarray(feed, jnp.int32), jnp.int32(pos))
+                feed = jnp.asarray(
+                    [token] + draft + [0] * (L - 1 - len(draft)), jnp.int32)
+                if sampled:
+                    subs, states = peek(L)
+                    g, cache = self._verify_sampled(
+                        cache, feed, jnp.int32(pos), jnp.stack(subs), temp, topp)
+                else:
+                    g, cache = self._verify_step(cache, feed, jnp.int32(pos))
                 g = [int(v) for v in np.asarray(g)]
-                # accept drafts while they match the model's own greedy choice
+                # accept drafts while they match the model's own (greedy or
+                # key-chain-sampled) choice
                 m = 0
                 while m < len(draft) and draft[m] == g[m]:
                     m += 1
                 out = g[: m + 1]  # m matched drafts + the correcting token
+                # how many of them will actually be EMITTED (steps cap, stop
+                # tokens) — the key chain must advance by exactly that many,
+                # or later turns on the engine chain diverge from plain decode
+                take = min(len(out), steps - emitted)
+                for j in range(take):
+                    if out[j] in stop_tokens:
+                        take = j + 1
+                        break
+                out = out[:take]
+                if sampled:
+                    commit(states[take - 1])
                 index.extend([token] + draft[:m])
+                # (on a truncated batch the generator is about to return /
+                # exit, so the pending token is never fed again)
                 token = out[-1]
                 base = pos  # position before this batch's tokens
-                pos += len(out)
+                pos += m + 1
                 batch_rows = L
             dt = (time.perf_counter() - t1) * 1000.0
             # this batch's collectives gathered batch_rows rows, not one
             # (cf. the prefill row's bucket multiplier in generate())
             batch_kb = self.wire_kb_per_token * batch_rows
             for i, tk in enumerate(out):
-                if emitted >= steps:
-                    break
                 emitted += 1
                 # per-token session pos: a consumer stopping at token i must
                 # resume as if only tokens 0..i were ever consumed — slots

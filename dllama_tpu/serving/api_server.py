@@ -91,10 +91,11 @@ class ServerState:
         """``default_seed``: seed for requests that send none — None means a
         fresh time-based seed per request (the launch-flag --seed plumbs in
         here so an operator can make the whole server reproducible).
-        ``spec_draft`` > 0 serves temperature==0 requests with prompt-lookup
-        speculative decoding (Engine.generate_spec — exact greedy, multiple
-        tokens per device step on repetitive text); sampled requests are
-        unaffected."""
+        ``spec_draft`` > 0 serves requests with prompt-lookup speculative
+        decoding (Engine.generate_spec — multiple tokens per device step on
+        repetitive text). Responses are byte-identical to the plain path at
+        any temperature: greedy verifies against argmax, sampled against the
+        same per-request key chain."""
         self.engine = engine
         self.tokenizer = tokenizer
         self.cfg = cfg
@@ -298,15 +299,18 @@ class OpenAIHandler(BaseHTTPRequestHandler):
                 stop_ids += (eot,)
             session, feed_tokens = st.take_prefix_session(prompt_tokens)
             history = list(prompt_tokens)
-            if st.spec_draft > 0 and sampler.temperature == 0.0:
+            if st.spec_draft > 0:
                 # tokens already consumed into the claimed session's cache
                 # (the cached prefix minus its pending token): lets the
-                # n-gram draft match across earlier turns of the chat
+                # n-gram draft match across earlier turns of the chat.
+                # Sampled requests replay the same per-request key chain the
+                # plain path walks, so responses are identical either way.
                 n_consumed = len(prompt_tokens) - len(feed_tokens) - 1
                 stream_iter = st.engine.generate_spec(
                     feed_tokens, max_tokens, session=session,
                     stop_tokens=stop_ids, draft_len=st.spec_draft,
                     history=prompt_tokens[:n_consumed] if session else None,
+                    sampler=sampler,
                 )
             else:
                 stream_iter = st.engine.generate(

@@ -348,11 +348,13 @@ def test_spec_draft_server_matches_plain_greedy():
         assert claims_a == [False, True], claims_a  # cold, then forced warm
         assert claims_b == [False, True], claims_b
         assert replies[port_a] == replies[port_b], replies
-        # sampled requests bypass the spec path entirely (and still work)
-        st, d = request(port_b, "POST", "/v1/chat/completions",
-                        chat_body(temperature=0.9, seed=5))
-        assert st == 200 and isinstance(
-            json.loads(d)["choices"][0]["message"]["content"], str)
+        # sampled requests also go through the spec path on server B and must
+        # match server A byte for byte (same per-request key chain)
+        body = chat_body(temperature=0.9, seed=5)
+        _, da = request(port_a, "POST", "/v1/chat/completions", body)
+        _, db = request(port_b, "POST", "/v1/chat/completions", body)
+        assert (json.loads(da)["choices"][0]["message"]["content"]
+                == json.loads(db)["choices"][0]["message"]["content"])
     finally:
         srv_a.shutdown()
         srv_b.shutdown()

@@ -60,13 +60,22 @@ def test_chat_spec_matches_plain(demo_files):
     assert plain == spec
 
 
-def test_chat_spec_requires_greedy(demo_files):
+def test_chat_spec_sampled_matches_plain(demo_files):
+    """Sampled chat (same --seed) must transcript-match with and without
+    speculative drafting: the spec path replays the same engine key chain."""
     model, tok = demo_files
     env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
-    proc = subprocess.run(
-        [sys.executable, "-m", "dllama_tpu.cli", "chat", "--model", model,
-         "--tokenizer", tok, "--temperature", "0.5", "--spec-draft", "4"],
-        input="", capture_output=True, text=True, env=env, cwd=REPO, timeout=300,
-    )
-    assert proc.returncode != 0
-    assert "--temperature 0" in proc.stderr
+
+    def run(*extra):
+        proc = subprocess.run(
+            [sys.executable, "-m", "dllama_tpu.cli", "chat", "--model", model,
+             "--tokenizer", tok, "--steps", "6", "--temperature", "0.8",
+             "--seed", "42", "--tp", "1", "--system-prompt", "",
+             "--chat-template", "llama2", *extra],
+            input="hi\nhi again\n", capture_output=True, text=True,
+            env=env, cwd=REPO, timeout=600,
+        )
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        return proc.stdout
+
+    assert run() == run("--spec-draft", "4")

@@ -1,8 +1,10 @@
-"""Prompt-lookup speculative decoding (Engine.generate_spec): exact greedy
-equivalence, multi-token acceptance on repetitive output, session resume."""
+"""Prompt-lookup speculative decoding (Engine.generate_spec): exact
+greedy/sampled equivalence, multi-token acceptance on repetitive output,
+session resume."""
 
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from dllama_tpu.models import llama
 from dllama_tpu.models.config import ModelConfig
@@ -64,6 +66,19 @@ def test_spec_matches_plain_greedy_quantized():
     assert got == want
 
 
+@pytest.mark.parametrize("temp,topp", [(0.7, 1.0), (1.0, 0.9)])
+def test_spec_sampled_matches_plain_sampled(temp, topp):
+    """Sampled spec decoding replays generate()'s per-token key chain, so
+    the stream must be bit-identical to plain sampled decode with the same
+    SamplerConfig — acceptance rate changes, output never does."""
+    scfg = SamplerConfig(temperature=temp, topp=topp, seed=123)
+    for prompt in ([1, 5, 9], [7]):
+        want = [t for t, _ in _engine().generate(prompt, steps=32, sampler=scfg)]
+        got = [t for t, _ in _engine().generate_spec(
+            prompt, steps=32, sampler=scfg)]
+        assert got == want, (prompt, got, want)
+
+
 def test_spec_accepts_multi_token_batches():
     """Random tiny models collapse into repeating tokens under greedy decode;
     the n-gram draft must then accept >1 token per verify step (fewer device
@@ -114,3 +129,29 @@ def test_spec_stop_token_mid_batch():
     sess = eng.final_session
     cont = [t for t, _ in eng.generate_spec([], steps=5, session=sess)]
     assert cont == ref[ref.index(stop) + 1 : ref.index(stop) + 6]
+
+
+def test_spec_sampled_stop_keeps_engine_chain_aligned():
+    """A stop token truncating an accepted batch must truncate the key-chain
+    advancement with it: after the stop, a PLAIN generation on the same
+    engine must match an engine that never speculated (regression: advancing
+    the chain by the full batch desynced later turns)."""
+    def mk():
+        return Engine(CFG, llama.random_params(CFG, seed=0),
+                      SamplerConfig(temperature=0.8, seed=9))
+    probe = [t for t, _ in mk().generate([1, 5, 9], steps=24)]
+    stop = probe[12]  # a token known to occur mid-stream
+
+    e_plain, e_spec = mk(), mk()
+    a1 = [t for t, _ in e_plain.generate([1, 5, 9], steps=24,
+                                         stop_tokens=(stop,))]
+    b1 = [t for t, _ in e_spec.generate_spec([1, 5, 9], steps=24,
+                                             stop_tokens=(stop,))]
+    assert a1 == b1
+    # the engines' key chains must now be in the same state: continue PLAIN
+    # on both and compare
+    a2 = [t for t, _ in e_plain.generate([], steps=6,
+                                         session=e_plain.final_session)]
+    b2 = [t for t, _ in e_spec.generate([], steps=6,
+                                        session=e_spec.final_session)]
+    assert a2 == b2
