@@ -32,6 +32,12 @@ TINYLLAMA_1_1B = dict(
     arch="llama", dim=2048, hidden_dim=5632, n_layers=22, n_heads=32, n_kv_heads=4,
     vocab_size=32000, seq_len=1024, head_size=64, kv_dim=256, dtype="bfloat16",
 )
+# the north-star model (BASELINE.json: <=5 ms/token on v5e-8); GQA 8 kv heads
+LLAMA3_8B = dict(
+    arch="llama", dim=4096, hidden_dim=14336, n_layers=32, n_heads=32, n_kv_heads=8,
+    vocab_size=128256, seq_len=512, head_size=128, kv_dim=1024, dtype="bfloat16",
+    rope_theta=500000.0,
+)
 
 # reference's best published single-node Llama 2 7B avg token time (ms)
 BASELINE_7B_SINGLE_NODE_MS = 101.81
@@ -172,6 +178,10 @@ def main() -> None:
     choice = os.environ.get("BENCH_MODEL", "")
     if choice == "tiny" or (not choice and platform == "cpu"):
         name, cfg_dict = "tinyllama_1.1b", TINYLLAMA_1_1B
+    elif choice == "llama3":
+        # the north-star config (no published same-hardware baseline number;
+        # vs_baseline stays null — the 7B default is the comparable metric)
+        name, cfg_dict = "llama3_8b", LLAMA3_8B
     else:
         name, cfg_dict = "llama2_7b", LLAMA2_7B
 
