@@ -7,6 +7,8 @@ import numpy as np
 import optax
 import pytest
 
+pytest.importorskip("orbax.checkpoint")
+
 from dllama_tpu.models import llama
 from dllama_tpu.models.config import ModelConfig
 from dllama_tpu.parallel.mesh import make_mesh
