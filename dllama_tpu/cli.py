@@ -36,7 +36,14 @@ def build_parser() -> argparse.ArgumentParser:
         sp.add_argument("--topp", type=float, default=0.9)
         sp.add_argument("--seed", type=int, default=None)
         sp.add_argument("--dtype", default="bfloat16", choices=["float32", "bfloat16"])
-        sp.add_argument("--cache-dtype", default=None, choices=[None, "float32", "bfloat16"])
+        sp.add_argument(
+            "--cache-dtype", default=None,
+            choices=[None, "float32", "bfloat16", "f8"],
+            help="KV cache element type (default: --dtype). f8 = "
+            "float8_e4m3fn: half the cache HBM footprint and read traffic "
+            "of bf16 — double the context a chip can hold — at ~3 mantissa "
+            "bits of K/V precision (attention still accumulates in f32)",
+        )
         sp.add_argument(
             "--tp",
             type=int,
@@ -198,7 +205,10 @@ def load_engine(args):
     else:
         seed = int(time.time())
     sampler_cfg = SamplerConfig(temperature=args.temperature, topp=args.topp, seed=seed)
-    cache_dtype = jnp.dtype(args.cache_dtype) if args.cache_dtype else jnp.dtype(args.dtype)
+    cache_dtype = jnp.dtype(
+        {"f8": "float8_e4m3fn"}.get(args.cache_dtype, args.cache_dtype)
+        or args.dtype
+    )
 
     tp_compress = getattr(args, "buffer_float_type", None) == "q80"
     # compression lives in the shard_map quant forward; the dense-weight TP

@@ -1,0 +1,59 @@
+"""float8_e4m3fn KV cache: half the cache bytes of bf16 (double the context
+per chip), attention still accumulates f32. The cache dtype is a pure
+storage parameter — every path (decode, prefill, session resume, quant
+weights, TP) flows through the same astype sites."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from dllama_tpu.models import llama
+from dllama_tpu.models.config import ModelConfig
+from dllama_tpu.parallel.mesh import tp_mesh
+from dllama_tpu.runtime.generate import Engine
+from dllama_tpu.runtime.sampler import SamplerConfig
+
+CFG = ModelConfig(
+    arch="llama", dim=128, hidden_dim=256, n_layers=2, n_heads=4, n_kv_heads=4,
+    vocab_size=128, seq_len=64, head_size=32, kv_dim=128, dtype="float32",
+)
+
+F8 = jnp.float8_e4m3fn
+
+
+def test_f8_cache_logits_close_to_f32_cache():
+    params = llama.random_params(CFG, seed=0)
+    rope = llama.rope_tables(CFG)
+    tokens = jnp.asarray([1, 5, 9, 2], jnp.int32)
+    ref, _ = llama.forward(CFG, params, rope, tokens, llama.init_cache(CFG), 0)
+    got, cache = llama.forward(
+        CFG, params, rope, tokens, llama.init_cache(CFG, F8), 0)
+    assert cache["k"].dtype == F8
+    a = np.asarray(ref[-1], np.float64)
+    b = np.asarray(got[-1], np.float64)
+    corr = np.dot(a, b) / (np.linalg.norm(a) * np.linalg.norm(b))
+    assert corr > 0.999, corr  # e4m3 K/V: tiny perturbation, same ranking mass
+
+
+def test_f8_cache_engine_decode_and_resume():
+    params = llama.quantize_params(llama.random_params(CFG, seed=1), "q40")
+    eng = Engine(CFG, params, SamplerConfig(temperature=0.0), cache_dtype=F8)
+    toks = [t for t, _ in eng.generate([1, 2, 3], steps=6)]
+    assert len(toks) == 6
+    sess = eng.final_session
+    assert sess.cache["k"].dtype == F8
+    more = [t for t, _ in eng.generate([], steps=3, session=sess)]
+    assert len(more) == 3
+    # fused loop agrees with the host-stepped loop on the same f8 cache
+    eng2 = Engine(CFG, params, SamplerConfig(temperature=0.0), cache_dtype=F8)
+    fused, _, _ = eng2.generate_fused([1, 2, 3], steps=6)
+    assert fused == toks
+
+
+def test_f8_cache_under_tp():
+    params = llama.quantize_params(llama.random_params(CFG, seed=2), "q40")
+    single = Engine(CFG, params, SamplerConfig(temperature=0.0), cache_dtype=F8)
+    want, _, _ = single.generate_fused([4, 8], steps=6)
+    tp = Engine(CFG, params, SamplerConfig(temperature=0.0), cache_dtype=F8,
+                mesh=tp_mesh(4))
+    got, _, _ = tp.generate_fused([4, 8], steps=6)
+    assert got == want
