@@ -300,7 +300,10 @@ def main(argv=None) -> int:
     p.add_argument("--out", required=True, help="output directory")
     p.add_argument("--dtype", default="bfloat16", choices=["float32", "bfloat16"])
     p.add_argument(
-        "--cache-dtype", default="bfloat16", choices=["float32", "bfloat16"]
+        "--cache-dtype", default="bfloat16",
+        choices=["float32", "bfloat16", "f8"],
+        help="KV cache element type baked into the exported programs "
+        "(f8 = float8_e4m3fn, half the cache HBM of bf16)",
     )
     p.add_argument("--no-aot", action="store_true", help="skip executable.bin")
     args = p.parse_args(argv)
@@ -313,7 +316,9 @@ def main(argv=None) -> int:
         params,
         args.out,
         tokenizer_path=args.tokenizer,
-        cache_dtype=jnp.dtype(args.cache_dtype),
+        cache_dtype=jnp.dtype(
+            {"f8": "float8_e4m3fn"}.get(args.cache_dtype, args.cache_dtype)
+        ),
         aot=not args.no_aot,
     )
     print(f"📦 exported to {args.out}")
