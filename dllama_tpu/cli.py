@@ -205,10 +205,9 @@ def load_engine(args):
     else:
         seed = int(time.time())
     sampler_cfg = SamplerConfig(temperature=args.temperature, topp=args.topp, seed=seed)
-    cache_dtype = jnp.dtype(
-        {"f8": "float8_e4m3fn"}.get(args.cache_dtype, args.cache_dtype)
-        or args.dtype
-    )
+    from dllama_tpu.models.config import resolve_dtype
+
+    cache_dtype = resolve_dtype(args.cache_dtype, default=args.dtype)
 
     tp_compress = getattr(args, "buffer_float_type", None) == "q80"
     # compression lives in the shard_map quant forward; the dense-weight TP

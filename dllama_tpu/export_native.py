@@ -292,7 +292,7 @@ def main(argv=None) -> int:
 
     from dllama_tpu.formats.weights import WeightFileReader
     from dllama_tpu.models import llama
-    from dllama_tpu.models.config import ModelConfig
+    from dllama_tpu.models.config import ModelConfig, resolve_dtype
 
     p = argparse.ArgumentParser(prog="dllama_tpu.export_native")
     p.add_argument("--model", required=True, help=".m weight file")
@@ -316,9 +316,7 @@ def main(argv=None) -> int:
         params,
         args.out,
         tokenizer_path=args.tokenizer,
-        cache_dtype=jnp.dtype(
-            {"f8": "float8_e4m3fn"}.get(args.cache_dtype, args.cache_dtype)
-        ),
+        cache_dtype=resolve_dtype(args.cache_dtype, default="bfloat16"),
         aot=not args.no_aot,
     )
     print(f"📦 exported to {args.out}")

@@ -13,6 +13,14 @@ from dllama_tpu.ops import rope as rope_ops
 GROK_EMBEDDING_SCALE = 78.38367176906169
 GROK_LOGIT_SCALE = 0.5773502691896257
 
+#: user-facing dtype aliases (CLI / exporter flags -> numpy dtype names)
+DTYPE_ALIASES = {"f8": "float8_e4m3fn"}
+
+
+def resolve_dtype(name: str | None, default: str) -> jnp.dtype:
+    """Flag string (or None) -> jnp.dtype, honoring DTYPE_ALIASES."""
+    return jnp.dtype(DTYPE_ALIASES.get(name, name) or default)
+
 
 @dataclasses.dataclass(frozen=True)
 class ModelConfig:

@@ -145,6 +145,7 @@ class Engine:
         self.rope = llama.rope_tables(cfg)
         self.cache_dtype = cache_dtype
         self._key = jax.random.PRNGKey(sampler_cfg.seed)
+        self._last_prefill_bucket = 1  # rows the latest prefill's gathers moved
 
         # params/rope MUST be jit arguments, not closure captures: a closed-over
         # sharded array is inlined as a (replicated) constant, silently turning
@@ -376,7 +377,7 @@ class Engine:
             # disconnect) still observes the state matching what it received
             self.final_session = Session(cache, pos, pending_token=tok_int)
             # prefill gathers move `bucket` rows of every collective at once
-            pf_kb = self.wire_kb_per_token * getattr(self, "_last_prefill_bucket", 1)
+            pf_kb = self.wire_kb_per_token * self._last_prefill_bucket
             yield tok_int, TokenStats(self.prefill_ms, self.prefill_ms,
                                       sent_kb=pf_kb, recv_kb=pf_kb)
             steps -= 1
@@ -582,8 +583,8 @@ class Engine:
         self.prefill_ms = (time.perf_counter() - t0) * 1000.0
 
         if steps <= 0:
-            pend = token if len(prompt_tokens) > 1 else prompt_tokens[0]
-            self.final_session = Session(cache, pos, pending_token=int(pend))
+            # token is the pending next input in both branches above
+            self.final_session = Session(cache, pos, pending_token=token)
             return
 
         emitted = 0
@@ -594,7 +595,7 @@ class Engine:
                 # the prefill already produced one token "for free"; the
                 # prompt is consumed, so per-token pos below starts at pos-1
                 out, first, base = [token], False, pos - 1
-                batch_rows = getattr(self, "_last_prefill_bucket", 1)
+                batch_rows = self._last_prefill_bucket
             else:
                 # fixed feed length -> ONE verify compile for the whole run;
                 # pad slots write garbage K/V at pos+m+1.. which every later
@@ -602,7 +603,7 @@ class Engine:
                 # sequence tail shrinks the feed (at most one extra compile
                 # per distinct tail length).
                 L = min(draft_len + 1, self.cfg.seq_len - pos)
-                k = min(L - 1, max(steps - emitted - 1, 0))
+                k = min(L - 1, steps - emitted - 1)  # >= 0: emitted < steps
                 draft = index.draft(token, k)
                 feed = jnp.asarray(
                     [token] + draft + [0] * (L - 1 - len(draft)), jnp.int32)
