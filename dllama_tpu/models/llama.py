@@ -28,7 +28,9 @@ from dllama_tpu.models.config import ModelConfig
 from dllama_tpu.ops.activations import ACTIVATIONS
 from dllama_tpu.ops.attention import gqa_attention
 from dllama_tpu.ops.norms import rmsnorm
-from dllama_tpu.ops.qmatmul import QuantTensor, matmul_any, quantize_tensor
+from dllama_tpu.ops.qmatmul import (
+    QuantTensor, matmul_any, quantize_tensor, slice_to_in_features,
+)
 from dllama_tpu.ops.rope import apply_rope, rope_table
 
 
@@ -507,14 +509,8 @@ def _dense_ffn(cfg: ModelConfig, lp: dict, xb: jnp.ndarray, tp_axis=None,
         h = act(u[..., :half]) * u[..., half:]
         return matmul_any(h, lp["w2"], layer)
     h = act(matmul_any(xb, lp["w1"], layer)) * matmul_any(xb, lp["w3"], layer)
-    h = _gather(h, tp_axis, tp_compress)
-    w2 = lp["w2"]
-    w2_in = w2.k_padded if isinstance(w2, QuantTensor) else w2.shape[-2]
-    if h.shape[-1] > w2_in:
-        # w1/w3 were lane-padded but w2 took the dense fallback (its hidden
-        # input not packable): the pad columns are exact zeros, slice them off
-        h = h[..., :w2_in]
-    return _gather(matmul_any(h, w2, layer), tp_axis, tp_compress)
+    h = slice_to_in_features(_gather(h, tp_axis, tp_compress), lp["w2"])
+    return _gather(matmul_any(h, lp["w2"], layer), tp_axis, tp_compress)
 
 
 def _ffn_residual(cfg: ModelConfig, lp: dict, x: jnp.ndarray, att_out: jnp.ndarray,
@@ -535,10 +531,11 @@ def _ffn_residual(cfg: ModelConfig, lp: dict, x: jnp.ndarray, att_out: jnp.ndarr
     if cfg.is_moe and cfg.post_norms:  # grok1
         x = x + rmsnorm(att_out, lp["rms_ffn"], cfg.norm_eps)
         xb = rmsnorm(x, lp["rms_moe"], cfg.norm_eps)
-        return x + rmsnorm(moe_ffn(cfg, lp, xb, layer), lp["rms_ffn2"], cfg.norm_eps)
+        return x + rmsnorm(moe_ffn(cfg, lp, xb, layer, tp_axis, tp_compress),
+                           lp["rms_ffn2"], cfg.norm_eps)
     x = x + att_out
     xb = rmsnorm(x, lp["rms_ffn"], cfg.norm_eps)
-    return x + (moe_ffn(cfg, lp, xb, layer) if cfg.is_moe
+    return x + (moe_ffn(cfg, lp, xb, layer, tp_axis, tp_compress) if cfg.is_moe
                 else _dense_ffn(cfg, lp, xb, tp_axis, tp_compress, layer))
 
 

@@ -10,7 +10,10 @@ slices within experts, not across them — `/root/reference/src/transformer.cpp:
 so the expert einsums below shard exactly like w1/w2/w3 and no expert-routing
 communication is needed. An optional ``ep`` mesh axis can additionally shard
 the leading expert dim of the stacked tensors (expert parallelism — beyond
-the reference's capabilities).
+the reference's capabilities). Under *quantized* TP (shard_map,
+parallel.quant_tp) the expert planes carry output-axis shards and ``tp_axis``
+drives explicit per-expert hidden gathers, mirroring the dense FFN's
+gather-before-w2 (`models.llama._dense_ffn`).
 
 Compute paths:
 
@@ -21,10 +24,11 @@ Compute paths:
 * Quantized stacks under the scalar-prefetch layer scan (``layer`` given):
   the expert planes stay layer-stacked ([L, E, ...] folded to [L*E, ...], a
   free bitcast) and a traced ``layer * E + e`` steers each fused kernel's
-  DMA. At decode (T == 1) only the top-k SELECTED experts are computed, so
-  the kernel reads k/E of the expert bytes per token — the bandwidth
-  win that makes Q40 Grok-1-class models decode at quantized speed, the
-  analog of the reference running only active experts
+  DMA. For small T (decode T==1, speculative verify T==k_spec+1) only the
+  UNION of the rows' top-k selected experts is computed — at most
+  min(E, T*k) expert plane reads instead of E — the bandwidth win that
+  makes Q40 Grok-1-class models decode at quantized speed, the analog of
+  the reference running only active experts
   (`/root/reference/src/grok1-tasks.cpp:128-143`). For batched prefill every
   expert runs once (different rows pick different experts) with the same
   zero-copy indexing.
@@ -37,7 +41,13 @@ import jax.numpy as jnp
 
 from dllama_tpu.models.config import ModelConfig
 from dllama_tpu.ops.activations import ACTIVATIONS
-from dllama_tpu.ops.qmatmul import QuantTensor, matmul_any
+from dllama_tpu.ops.qmatmul import QuantTensor, matmul_any, slice_to_in_features
+
+
+def _gather(x, tp_axis, compress=False):
+    from dllama_tpu.models.llama import _gather as g
+
+    return g(x, tp_axis, compress)
 
 
 def route_topk(cfg: ModelConfig, router_kernel: jnp.ndarray,
@@ -130,54 +140,87 @@ def _expert_down(h: jnp.ndarray, w, base=None) -> jnp.ndarray:
     return jnp.moveaxis(outs, 0, 1).reshape(*lead, E, outs.shape[-1])
 
 
-def _moe_decode_selected(cfg: ModelConfig, lp: dict, xb: jnp.ndarray,
-                         layer) -> jnp.ndarray:
-    """T==1 decode with layer-stacked quantized experts: run ONLY the top-k
-    selected experts, each kernel DMA-ing just that expert's planes. Exact
-    same math as the dense combine (the combine weights are zero elsewhere)."""
+def _moe_decode_selected(cfg: ModelConfig, lp: dict, xb: jnp.ndarray, layer,
+                         tp_axis=None, tp_compress: bool = False) -> jnp.ndarray:
+    """Small-T decode/verify with layer-stacked quantized experts: run ONLY
+    the union of the rows' top-k selected experts, each kernel DMA-ing just
+    that expert's planes. T==1 is plain decode (the union is exactly the
+    top-k); T==k_spec+1 is a speculative verify step, which still reads at
+    most min(E, T*k) expert plane sets instead of all E. Exact same math as
+    the dense combine: every expert outside the union has zero combine
+    weight for every row, and union slots beyond the actually-selected set
+    (ties in the top-cap selection) multiply a zero weight.
+
+    Under quantized TP (``tp_axis``): the expert planes are output shards;
+    all selected experts' hidden activations are gathered in ONE collective
+    (decode payloads are latency-bound — collective count matters more than
+    bytes, see ``llama._gather``), then each feeds its down matmul and the
+    combined output — accumulated in output shards — is gathered at the end:
+    2 collectives per MoE FFN, like the dense FFN's pair.
+    """
     act = ACTIVATIONS[cfg.hidden_act]
     E, k = cfg.n_experts, cfg.n_active_experts
-    topi, weights = route_topk(cfg, lp["moe_router"], xb)  # [1, k] each
-    wsel = weights.astype(xb.dtype)
+    T = xb.shape[0]
+    cap = min(E, T * k)
+    combine = route(cfg, lp["moe_router"], xb)  # [T, E] f32, zero off top-k
+    # every expert any row selected has a positive combine weight somewhere,
+    # and there are at most T*k of them — the top `cap` column-maxima cover
+    # the whole union (extra slots carry zero weight and contribute nothing)
+    _, expert_ids = jax.lax.top_k(combine.max(axis=0), cap)  # [cap]
     base = layer * E
 
     fused = "moe_upgate" in lp
     up_flat = _flat_experts(lp["moe_upgate" if fused else "moe_up"])
     gate_flat = None if fused else _flat_experts(lp["moe_gate"])
     down_flat = _flat_experts(lp["moe_down"])
+    out_dim = down_flat.out_features  # local under tp, full otherwise
 
-    def expert_step(acc, j):
-        idx = base + topi[0, j]
+    def up_step(_, j):
+        idx = base + expert_ids[j]
         if fused:
             ug = matmul_any(xb, up_flat, idx)
             half = ug.shape[-1] // 2
             h = ug[..., :half] * act(ug[..., half:])
         else:
             h = matmul_any(xb, up_flat, idx) * act(matmul_any(xb, gate_flat, idx))
-        d = matmul_any(h, down_flat, idx)
-        return acc + d * wsel[0, j], None
+        return None, h
+
+    _, hs = jax.lax.scan(up_step, None, jnp.arange(cap, dtype=jnp.int32))
+    hs = _gather(hs, tp_axis, tp_compress)  # [cap, T, full hidden] in one hop
+
+    def down_step(acc, jh):
+        j, h = jh
+        e = expert_ids[j]
+        d = matmul_any(h, down_flat, base + e)  # [T, out_dim]
+        w_e = jax.lax.dynamic_index_in_dim(combine, e, axis=1)  # [T, 1]
+        return acc + d * w_e.astype(d.dtype), None
 
     acc, _ = jax.lax.scan(
-        expert_step, jnp.zeros_like(xb), jnp.arange(k, dtype=jnp.int32))
-    return acc
+        down_step, jnp.zeros((T, out_dim), xb.dtype),
+        (jnp.arange(cap, dtype=jnp.int32), hs))
+    return _gather(acc, tp_axis, tp_compress)
 
 
-def moe_ffn(cfg: ModelConfig, lp: dict, xb: jnp.ndarray, layer=None) -> jnp.ndarray:
+def moe_ffn(cfg: ModelConfig, lp: dict, xb: jnp.ndarray, layer=None,
+            tp_axis=None, tp_compress: bool = False) -> jnp.ndarray:
     """MoE FFN over xb [..., dim] -> [..., dim].
 
     lp holds: moe_router [dim, E], moe_up/moe_gate [E, dim, hidden],
     moe_down [E, hidden, dim] — each expert stack a dense array or a
     quantized (QuantTensor) stack. With ``layer`` (the scalar-prefetch scan),
     quantized stacks carry a leading layer axis and dense leaves arrive
-    already layer-indexed.
+    already layer-indexed. ``tp_axis`` (inside shard_map, quantized TP):
+    expert stacks are output shards; the hidden activation is gathered
+    before the down matmuls and the output once after the combine.
     """
     act = ACTIVATIONS[cfg.hidden_act]
     up_names = ("moe_upgate",) if "moe_upgate" in lp else ("moe_up", "moe_gate")
     quant_experts = all(
         isinstance(lp.get(n), QuantTensor) for n in up_names + ("moe_down",)
     )
-    if layer is not None and quant_experts and xb.shape[0] == 1 and xb.ndim == 2:
-        return _moe_decode_selected(cfg, lp, xb, layer)
+    if (layer is not None and quant_experts and xb.ndim == 2
+            and xb.shape[0] * cfg.n_active_experts < cfg.n_experts):
+        return _moe_decode_selected(cfg, lp, xb, layer, tp_axis, tp_compress)
 
     # Under the layer scan, EVERY QuantTensor stack is layer-stacked and needs
     # index-steered kernels — even if a sibling stack fell back to dense (the
@@ -195,5 +238,8 @@ def moe_ffn(cfg: ModelConfig, lp: dict, xb: jnp.ndarray, layer=None) -> jnp.ndar
         up = _expert_up(xb, lp["moe_up"], base)
         gate = _expert_up(xb, lp["moe_gate"], base)
         h = up * act(gate)
+    h = _gather(h, tp_axis, tp_compress)  # [..., E, full hidden] under tp
+    h = slice_to_in_features(h, lp["moe_down"])
     down = _expert_down(h, lp["moe_down"], base)
-    return jnp.einsum("...ed,...e->...d", down, combine)
+    out = jnp.einsum("...ed,...e->...d", down, combine)
+    return _gather(out, tp_axis, tp_compress)

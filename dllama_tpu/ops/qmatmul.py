@@ -429,6 +429,18 @@ def matmul_any(x: jnp.ndarray, w, layer=None) -> jnp.ndarray:
     return x @ w
 
 
+def slice_to_in_features(h: jnp.ndarray, w) -> jnp.ndarray:
+    """Trim a gathered activation down to ``w``'s (packed) input width.
+
+    Under quantized TP the up-projections lane-pad their output axis
+    (parallel.quant_tp); when the matching down-projection took the dense
+    fallback (its input not packable) the gathered hidden is wider than the
+    matrix expects — the pad columns are exact zeros, so dropping them is
+    exact. No-op when the widths already agree."""
+    w_in = w.k_padded if isinstance(w, QuantTensor) else w.shape[-2]
+    return h[..., :w_in] if h.shape[-1] > w_in else h
+
+
 # ---------------------------------------------------------------------------
 # Packing (host-side, numpy)
 # ---------------------------------------------------------------------------

@@ -66,9 +66,15 @@ def _replicated_spec(arr) -> P:
     return P(*([None] * arr.ndim))
 
 
-#: per-layer matrices that shard their output axis over tp (MoE expert
-#: stacks stay replicated for now — per-expert O-sharding is a follow-up)
-SHARDED_MATRICES = frozenset({"wq", "wk", "wv", "wo", "w1", "w2", "w3"})
+#: per-layer matrices that shard their output axis over tp. MoE expert stacks
+#: shard exactly like their dense twins — every device holds a 1/tp output
+#: slice of EVERY expert, the reference's TP-within-expert scheme
+#: (`/root/reference/src/transformer.cpp:479-487`, expert matmuls on slices at
+#: `/root/reference/src/grok1-tasks.cpp:128-143`) — which is what lets a Q40
+#: Grok-1/Mixtral fit: each chip stores n-th of the expert bytes.
+SHARDED_MATRICES = frozenset(
+    {"wq", "wk", "wv", "wo", "w1", "w2", "w3", "moe_up", "moe_gate", "moe_down"}
+)
 
 
 def validate_quant_tp(cfg: ModelConfig, n_tp: int) -> None:
@@ -139,9 +145,9 @@ def prepare_quant_leaf(name: str, leaf, cfg: ModelConfig, n_tp: int):
     Identity for dense arrays, unsharded matrices, and already-aligned dims."""
     if not isinstance(leaf, QuantTensor) or n_tp <= 1:
         return leaf
-    if name in ("w1", "w3"):
+    if name in ("w1", "w3", "moe_up", "moe_gate"):
         return _pad_qt_out(leaf, ffn_padded_width(cfg, leaf.kind, n_tp))
-    if name == "w2":
+    if name in ("w2", "moe_down"):
         return _pad_qt_in(leaf, ffn_padded_width(cfg, leaf.kind, n_tp))
     if name == "wcls" and cfg.vocab_size % n_tp == 0:
         return _pad_qt_out(leaf, _pad_up(cfg.vocab_size, 128 * n_tp))

@@ -234,9 +234,10 @@ class Engine:
 
         * quantized TP (shard_map, parallel.quant_tp): dense archs run 4 ring
           all-gathers per layer — attention heads (dim), wo output (dim), FFN
-          hidden (lane-padded H'), w2 output (dim); MoE archs only the two
-          attention gathers (experts are replicated). Plus the f32 logits
-          gather when the vocab shards. A ring all-gather moves (tp-1)/tp of
+          hidden (lane-padded H'), w2 output (dim); MoE archs swap the FFN
+          pair for one H' gather per selected expert (k at decode) plus one
+          combined-output gather (dim). Plus the f32 logits gather when the
+          vocab shards. A ring all-gather moves (tp-1)/tp of
           the full vector through each device, in each direction. Activations
           travel in cfg dtype; Q80 wire compression (tp_compress) ships
           1 byte + 1/8 byte of scale per feature instead — 1.78x less than
@@ -271,13 +272,17 @@ class Engine:
                 if hasattr(leaf, "kind"):
                     kind = leaf.kind
                     break
+            hidden = ffn_padded_width(cfg, kind, tp)
             if cfg.is_moe:
-                # MoE layers gather only around attention (heads out + wo
-                # out); expert stacks are replicated (parallel.quant_tp), so
-                # the FFN runs gather-free
-                layer_feats = cfg.n_layers * 2 * cfg.dim
+                # expert stacks carry output shards like w1/w2/w3; a T==1
+                # decode step runs the selected-experts path (models.moe):
+                # per layer, 2 attention gathers (dim each), one hidden
+                # gather per selected expert (k of them), and one combined-
+                # output gather (dim)
+                layer_feats = cfg.n_layers * (
+                    3 * cfg.dim + cfg.n_active_experts * hidden
+                )
             else:
-                hidden = ffn_padded_width(cfg, kind, tp)
                 layer_feats = cfg.n_layers * (3 * cfg.dim + hidden)
             bytes_ = layer_feats * per_feat
             if cfg.vocab_size % tp == 0:

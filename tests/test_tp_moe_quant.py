@@ -1,0 +1,183 @@
+"""Quantized MoE expert stacks x tensor parallelism.
+
+The reference's flagship configuration is Q40 Grok-1/Mixtral with every node
+holding a 1/n slice of EVERY expert (`/root/reference/src/transformer.cpp:479-487`,
+expert matmuls on slices at `/root/reference/src/grok1-tasks.cpp:128-143`).
+These tests assert the TPU equivalent — expert planes output-sharded under
+shard_map (parallel.quant_tp) — decodes identically to the single-device
+engine on the 8-virtual-device CPU mesh, and that the small-T
+selected-experts path (decode AND speculative verify) engages exactly when
+the union of routed experts is smaller than E.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dllama_tpu.models import llama, moe
+from dllama_tpu.models.config import (
+    GROK_EMBEDDING_SCALE,
+    GROK_LOGIT_SCALE,
+    ModelConfig,
+)
+from dllama_tpu.parallel import quant_tp
+from dllama_tpu.parallel.mesh import tp_mesh
+from dllama_tpu.runtime.generate import Engine
+from dllama_tpu.runtime.sampler import SamplerConfig
+
+MIXTRAL = ModelConfig(
+    arch="mixtral", dim=256, hidden_dim=512, n_layers=2, n_heads=8,
+    n_kv_heads=8, vocab_size=512, seq_len=64, head_size=32, kv_dim=256,
+    n_experts=8, n_active_experts=2, rope_style="half", dtype="float32",
+)
+
+GROK = ModelConfig(
+    arch="grok1", dim=256, hidden_dim=512, n_layers=2, n_heads=8,
+    n_kv_heads=8, vocab_size=512, seq_len=64, head_size=32, kv_dim=256,
+    n_experts=4, n_active_experts=2, hidden_act="gelu", rope_style="half",
+    embedding_scale=GROK_EMBEDDING_SCALE, logit_scale=GROK_LOGIT_SCALE,
+    post_norms=True, dtype="float32",
+)
+
+
+@pytest.fixture(scope="module")
+def qp():
+    dense = llama.random_params(MIXTRAL, seed=0, dtype=np.float32)
+    return llama.quantize_params(dense, "q40")
+
+
+def _single_device_logits(cfg, params, tokens):
+    rope = llama.rope_tables(cfg)
+    logits, _ = jax.jit(
+        lambda p, r, c, t: llama.forward(cfg, p, r, t, c, jnp.int32(0))
+    )(jax.tree.map(jnp.asarray, params), rope, llama.init_cache(cfg), tokens)
+    return logits
+
+
+@pytest.mark.parametrize("tp", [2, 8])
+def test_moe_tp_forward_matches_single_device(qp, tp):
+    """Decode (T=1, selected-experts path) and prefill (T=4, T*k >= E dense
+    combine) both produce single-device logits under expert-sharded TP."""
+    rope = llama.rope_tables(MIXTRAL)
+    mesh = tp_mesh(tp)
+    sharded = quant_tp.shard_quant_params(qp, mesh, MIXTRAL)
+    fwd = jax.jit(quant_tp.make_tp_forward(MIXTRAL, mesh, sharded))
+    for tokens in (jnp.asarray([5], jnp.int32),
+                   jnp.asarray([5, 9, 3, 1], jnp.int32)):
+        ref = _single_device_logits(MIXTRAL, qp, tokens)
+        got, _ = fwd(sharded, rope, llama.init_cache(MIXTRAL), tokens,
+                     jnp.int32(0))
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(ref), rtol=1e-4, atol=1e-4
+        )
+
+
+def test_grok_tp_forward_matches_single_device():
+    """Grok-1 variant: post-norms + gelu + embedding/logit scales survive the
+    shard_map expert sharding."""
+    params = llama.quantize_params(
+        llama.random_params(GROK, seed=3, dtype=np.float32), "q40"
+    )
+    tokens = jnp.asarray([7], jnp.int32)
+    ref = _single_device_logits(GROK, params, tokens)
+    mesh = tp_mesh(8)
+    sharded = quant_tp.shard_quant_params(params, mesh, GROK)
+    got, _ = jax.jit(quant_tp.make_tp_forward(GROK, mesh, sharded))(
+        sharded, llama.rope_tables(GROK), llama.init_cache(GROK), tokens,
+        jnp.int32(0),
+    )
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(ref), rtol=1e-4, atol=1e-4
+    )
+
+
+def test_moe_specs_shard_every_expert_plane(qp):
+    """Expert stacks must actually shard — replication is the failure mode
+    that kept a Q40 Mixtral from fitting (round-3 verdict's #1 gap)."""
+    specs = quant_tp.quant_param_specs(qp, MIXTRAL, 8)
+    for name in ("moe_up", "moe_gate", "moe_down"):
+        qt = specs["layers"][name]
+        assert qt.w[-1] == "tp" and qt.s[-1] == "tp" and qt.s2[-1] == "tp", name
+    # the router is tiny and replicated, like the root's copy in the reference
+    assert all(s is None for s in specs["layers"]["moe_router"])
+
+
+def test_moe_lane_padding_and_local_shards(qp):
+    """moe_up/moe_gate pad their hidden output axis and moe_down its packed
+    input to the same lane-aligned width (the w1/w3-vs-w2 contract), so the
+    gathered per-expert hidden feeds the down matmul with no slicing; each
+    device holds exactly 1/tp of every expert plane."""
+    mesh = tp_mesh(8)
+    sharded = quant_tp.shard_quant_params(qp, mesh, MIXTRAL)
+    target = quant_tp.ffn_padded_width(MIXTRAL, "q40", 8)
+    up = sharded["layers"]["moe_up"]
+    assert up.w.shape[-1] == target
+    assert up.w.addressable_shards[0].data.shape[-1] == target // 8
+    down = sharded["layers"]["moe_down"]
+    assert down.k_padded == target
+    assert down.w.addressable_shards[0].data.shape[-1] == MIXTRAL.dim // 8
+
+
+def test_moe_tp_engine_greedy_decode_invariance(qp):
+    """Engine-level: greedy tokens from the expert-sharded quant-TP engine ==
+    the single-device (fused moe_upgate) engine."""
+    e1 = Engine(MIXTRAL, qp, SamplerConfig(temperature=0.0))
+    t1, _, _ = e1.generate_fused([3, 7, 11], steps=8)
+    e2 = Engine(MIXTRAL, qp, SamplerConfig(temperature=0.0), mesh=tp_mesh(8))
+    t2, _, _ = e2.generate_fused([3, 7, 11], steps=8)
+    assert t1 == t2
+
+
+def test_verify_batch_uses_selected_experts_and_matches_dense(qp, monkeypatch):
+    """A small-T batch (speculative verify shape) must take the
+    selected-experts path — reading at most min(E, T*k) expert plane sets —
+    and produce exactly the dense-combine logits. T rows whose union could
+    cover every expert (T*k >= E) must take the dense path."""
+    calls = []
+    orig = moe._moe_decode_selected
+
+    def spy(cfg, lp, xb, layer, *a, **k):
+        calls.append(xb.shape[0])
+        return orig(cfg, lp, xb, layer, *a, **k)
+
+    monkeypatch.setattr(moe, "_moe_decode_selected", spy)
+
+    toks8 = jnp.asarray([5, 9, 3, 1, 2, 4, 6, 7], jnp.int32)
+    logits8 = _single_device_logits(MIXTRAL, qp, toks8)
+    assert calls == []  # T*k = 16 >= E -> dense combine
+
+    logits2 = _single_device_logits(MIXTRAL, qp, toks8[:2])
+    # the layer scan traces its body once -> one recorded call, T=2
+    assert calls == [2]
+    # causal attention: rows 0..1 are unaffected by rows 2..7, so the
+    # selected-experts path must reproduce the dense path's logits exactly
+    np.testing.assert_allclose(
+        np.asarray(logits2), np.asarray(logits8)[:2], rtol=1e-5, atol=1e-5
+    )
+
+
+def test_spec_decode_quant_moe_matches_plain(qp):
+    """generate_spec on a quantized MoE: verify steps (T=3 here) ride the
+    selected-experts path and the emitted stream equals plain decode."""
+    plain = Engine(MIXTRAL, qp, SamplerConfig(temperature=0.0))
+    want = [t for t, _ in plain.generate([1, 2, 3], steps=12)]
+    spec = Engine(MIXTRAL, qp, SamplerConfig(temperature=0.0))
+    got = [t for t, _ in spec.generate_spec([1, 2, 3], steps=12, draft_len=2)]
+    assert got == want
+
+
+def test_moe_wire_stats_analytic_bytes(qp):
+    """Decode-step S/R for an expert-sharded MoE: 2 attention gathers (dim) +
+    k hidden gathers (padded H') + 1 combined-output gather (dim) per layer,
+    plus the padded f32 logits gather."""
+    from dllama_tpu.ops.qmatmul import _pad_up
+
+    eng = Engine(MIXTRAL, qp, SamplerConfig(temperature=0.0), mesh=tp_mesh(8))
+    hidden = quant_tp.ffn_padded_width(MIXTRAL, "q40", 8)
+    layer_feats = MIXTRAL.n_layers * (
+        3 * MIXTRAL.dim + MIXTRAL.n_active_experts * hidden
+    )
+    vocab_bytes = _pad_up(MIXTRAL.vocab_size, 128 * 8) * 4.0
+    want_kb = (layer_feats * 4.0 + vocab_bytes) * (7 / 8) / 1024.0
+    assert abs(eng.wire_kb_per_token - want_kb) < 1e-9
