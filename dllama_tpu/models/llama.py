@@ -32,6 +32,7 @@ from dllama_tpu.ops.qmatmul import (
     QuantTensor, matmul_any, quantize_tensor, slice_to_in_features,
 )
 from dllama_tpu.ops.rope import apply_rope, rope_table
+from dllama_tpu.parallel.collectives import gather_columns as _gather
 
 
 # ---------------------------------------------------------------------------
@@ -457,48 +458,6 @@ def rope_tables(cfg: ModelConfig) -> dict:
 # ---------------------------------------------------------------------------
 # Forward pass
 # ---------------------------------------------------------------------------
-
-def _gather(x: jnp.ndarray, tp_axis, compress: bool = False) -> jnp.ndarray:
-    """Concatenate the feature (last) axis across the tp axis (identity when
-    tp_axis is None). The quantized-TP forward shards every matrix on its
-    *output* axis only — so each matmul's input must be gathered, but no
-    K-axis resharding of packed quant blocks is ever needed and every local
-    kernel keeps its Mosaic-valid tiling (see parallel.quant_tp).
-
-    ``compress=True`` moves the activation over the interconnect Q80-style:
-    int8 quants + one f32 scale per 32-value block (the reference's wire
-    compression, ``quantizeQ80Row`` -> TCP -> dequantize,
-    `/root/reference/src/tasks.cpp:124-163`), ~1.8x less ICI traffic than
-    bf16. Requires the local feature dim % 32 == 0 (always true for the
-    lane-aligned shards)."""
-    if tp_axis is None:
-        return x
-    if not compress:
-        return jax.lax.all_gather(x, tp_axis, axis=-1, tiled=True)
-    lead = x.shape[:-1]
-    f = x.shape[-1]
-    xf = x.astype(jnp.float32).reshape(*lead, f // 32, 32)
-    absmax = jnp.max(jnp.abs(xf), axis=-1, keepdims=True)
-    scale = absmax / 127.0
-    q = jnp.round(xf / jnp.where(scale == 0.0, 1.0, scale)).astype(jnp.int8)
-    # ONE collective like the reference's single packed Q80 buffer: bitcast
-    # the f32 scales to bytes and ship them appended to the int8 quants —
-    # at decode the payloads are latency-bound, so collective count matters
-    # more than the bytes
-    scale_bytes = jax.lax.bitcast_convert_type(
-        scale[..., 0], jnp.int8
-    ).reshape(*lead, f // 8)
-    payload = jnp.concatenate([q.reshape(*lead, f), scale_bytes], axis=-1)
-    pg = jax.lax.all_gather(payload, tp_axis, axis=-1, tiled=True)
-    tp = pg.shape[-1] // (f + f // 8)
-    pg = pg.reshape(*lead, tp, f + f // 8)
-    qg = pg[..., :f].astype(jnp.float32).reshape(*lead, tp, f // 32, 32)
-    sg = jax.lax.bitcast_convert_type(
-        pg[..., f:].reshape(*lead, tp, f // 32, 4), jnp.float32
-    )
-    deq = qg * sg[..., None]
-    return deq.reshape(*lead, tp * f).astype(x.dtype)
-
 
 def _dense_ffn(cfg: ModelConfig, lp: dict, xb: jnp.ndarray, tp_axis=None,
                tp_compress: bool = False, layer=None) -> jnp.ndarray:

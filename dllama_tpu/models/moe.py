@@ -13,7 +13,8 @@ the leading expert dim of the stacked tensors (expert parallelism — beyond
 the reference's capabilities). Under *quantized* TP (shard_map,
 parallel.quant_tp) the expert planes carry output-axis shards and ``tp_axis``
 drives explicit per-expert hidden gathers, mirroring the dense FFN's
-gather-before-w2 (`models.llama._dense_ffn`).
+gather-before-w2 (`models.llama._dense_ffn`); the gathers live
+in `parallel.collectives`.
 
 Compute paths:
 
@@ -42,12 +43,7 @@ import jax.numpy as jnp
 from dllama_tpu.models.config import ModelConfig
 from dllama_tpu.ops.activations import ACTIVATIONS
 from dllama_tpu.ops.qmatmul import QuantTensor, matmul_any, slice_to_in_features
-
-
-def _gather(x, tp_axis, compress=False):
-    from dllama_tpu.models.llama import _gather as g
-
-    return g(x, tp_axis, compress)
+from dllama_tpu.parallel.collectives import gather_columns as _gather
 
 
 def route_topk(cfg: ModelConfig, router_kernel: jnp.ndarray,
@@ -154,7 +150,7 @@ def _moe_decode_selected(cfg: ModelConfig, lp: dict, xb: jnp.ndarray, layer,
     Under quantized TP (``tp_axis``): the expert planes are output shards;
     all selected experts' hidden activations are gathered in ONE collective
     (decode payloads are latency-bound — collective count matters more than
-    bytes, see ``llama._gather``), then each feeds its down matmul and the
+    bytes, see ``parallel.collectives``), then each feeds its down matmul and the
     combined output — accumulated in output shards — is gathered at the end:
     2 collectives per MoE FFN, like the dense FFN's pair.
     """

@@ -26,7 +26,7 @@ split. Two reasons this beats K-slicing for quant blocks:
 
 The attention out-projection ``wo`` and FFN down-projection ``w2`` therefore
 consume *gathered* inputs instead of producing psum partials — see
-``models.llama._gather``.
+``parallel.collectives.gather_columns``.
 """
 
 from __future__ import annotations

@@ -225,12 +225,20 @@ class Engine:
             self._init_cache = jax.jit(lambda: llama.init_cache(cfg, cache_dtype))
 
         #: per-device ICI kB one decode step moves (the reference's S/R line)
-        self.wire_kb_per_token = self._wire_bytes_per_token() / 1024.0
+        self.wire_kb_per_token = self._wire_bytes(1) / 1024.0
 
-    def _wire_bytes_per_token(self) -> float:
-        """Per-device ICI bytes one decode step's collectives move (0 without
-        a mesh). The reference counts wire bytes at its sockets; here the
-        collective schedule is static so the count is analytic:
+    def wire_kb(self, rows: int) -> float:
+        """Per-device ICI kB a T=rows forward (prefill bucket, spec verify
+        batch) moves. NOT simply rows x the decode number: an MoE batch whose
+        row union can cover every expert (rows*k >= E) takes the dense-combine
+        path and gathers E hidden vectors per row instead of k."""
+        return self._wire_bytes(rows) / 1024.0
+
+    def _wire_bytes(self, rows: int) -> float:
+        """Per-device ICI bytes a T=rows forward's collectives move (0
+        without a mesh; rows=1 is a decode step). The reference counts wire
+        bytes at its sockets; here the collective schedule is static so the
+        count is analytic:
 
         * quantized TP (shard_map, parallel.quant_tp): dense archs run 4 ring
           all-gathers per layer — attention heads (dim), wo output (dim), FFN
@@ -274,13 +282,16 @@ class Engine:
                     break
             hidden = ffn_padded_width(cfg, kind, tp)
             if cfg.is_moe:
-                # expert stacks carry output shards like w1/w2/w3; a T==1
-                # decode step runs the selected-experts path (models.moe):
-                # per layer, 2 attention gathers (dim each), one hidden
-                # gather per selected expert (k of them), and one combined-
-                # output gather (dim)
+                # expert stacks carry output shards like w1/w2/w3. Per layer
+                # and per row: 2 attention gathers (dim each), the hidden
+                # gather, one combined-output gather (dim). The hidden
+                # gather moves min(E, rows*k) expert hiddens for EVERY row —
+                # small batches (rows*k < E) run the selected-experts path
+                # whose union caps at rows*k experts, each computed for all
+                # rows; bigger batches take the dense combine over all E.
+                E, k = cfg.n_experts, cfg.n_active_experts
                 layer_feats = cfg.n_layers * (
-                    3 * cfg.dim + cfg.n_active_experts * hidden
+                    3 * cfg.dim + min(E, rows * k) * hidden
                 )
             else:
                 layer_feats = cfg.n_layers * (3 * cfg.dim + hidden)
@@ -289,9 +300,9 @@ class Engine:
                 # the logits gather moves the lane-PADDED vocab (sliced back
                 # after the gather), already cast to f32 and never compressed
                 bytes_ += _pad_up(cfg.vocab_size, 128 * tp) * 4.0
-            return bytes_ * frac
+            return bytes_ * frac * rows
         # dense pjit path: estimated from XLA's canonical all-reduce lowering
-        return cfg.n_layers * 2 * cfg.dim * act_bytes * 2 * frac
+        return cfg.n_layers * 2 * cfg.dim * act_bytes * 2 * frac * rows
 
     def new_cache(self) -> dict:
         return self._init_cache()
@@ -382,7 +393,7 @@ class Engine:
             # disconnect) still observes the state matching what it received
             self.final_session = Session(cache, pos, pending_token=tok_int)
             # prefill gathers move `bucket` rows of every collective at once
-            pf_kb = self.wire_kb_per_token * self._last_prefill_bucket
+            pf_kb = self.wire_kb(self._last_prefill_bucket)
             yield tok_int, TokenStats(self.prefill_ms, self.prefill_ms,
                                       sent_kb=pf_kb, recv_kb=pf_kb)
             steps -= 1
@@ -518,9 +529,13 @@ class Engine:
         otherwise the engine chain) — so the emitted stream is identical to
         plain decode with the same sampler, batch boundaries and all.
         Acceptance just happens less often as temperature rises. The chain
-        advances exactly once per EMITTED token — a stop token or the steps
-        cap truncating a batch truncates the advancement with it, keeping
-        later turns on the engine chain aligned with plain decode.
+        advances exactly once per EMITTED token — at temperature 0 too
+        (plain generate() burns one key per token via next_key() even when
+        greedy ignores it, so the greedy path here must consume identically
+        or a later sampled call on the same engine chain would diverge) —
+        and a stop token or the steps cap truncating a batch truncates the
+        advancement with it, keeping later turns on the engine chain
+        aligned with plain decode.
 
         Cache safety on rejection needs no rollback: rejected draft slots
         hold garbage K/V, but every future step writes position p before any
@@ -576,9 +591,9 @@ class Engine:
         if len(prompt_tokens) > 1:
             index.extend(prompt_tokens)
             last_logits, cache = self.prefill(cache, prompt_tokens, pos)
+            subs, states = peek(1)
+            commit(states[0])
             if sampled:
-                subs, states = peek(1)
-                commit(states[0])
                 token = int(sample_dynamic(last_logits, subs[0], temp, topp))
             else:
                 token = int(jnp.argmax(last_logits))
@@ -596,9 +611,12 @@ class Engine:
         first = len(prompt_tokens) > 1
         while emitted < steps:
             t1 = time.perf_counter()
+            from_prefill = first
             if first:
                 # the prefill already produced one token "for free"; the
-                # prompt is consumed, so per-token pos below starts at pos-1
+                # prompt is consumed, so per-token pos below starts at pos-1.
+                # Its stats report the prefill cost (like generate()'s first
+                # token) — the loop did no work for it
                 out, first, base = [token], False, pos - 1
                 batch_rows = self._last_prefill_bucket
             else:
@@ -612,8 +630,8 @@ class Engine:
                 draft = index.draft(token, k)
                 feed = jnp.asarray(
                     [token] + draft + [0] * (L - 1 - len(draft)), jnp.int32)
+                subs, states = peek(L)
                 if sampled:
-                    subs, states = peek(L)
                     g, cache = self._verify_sampled(
                         cache, feed, jnp.int32(pos), jnp.stack(subs), temp, topp)
                 else:
@@ -634,8 +652,7 @@ class Engine:
                         take = j + 1
                         break
                 out = out[:take]
-                if sampled:
-                    commit(states[take - 1])
+                commit(states[take - 1])
                 index.extend([token] + draft[:m])
                 # (on a truncated batch the generator is about to return /
                 # exit, so the pending token is never fed again)
@@ -643,10 +660,10 @@ class Engine:
                 base = pos  # position before this batch's tokens
                 pos += m + 1
                 batch_rows = L
-            dt = (time.perf_counter() - t1) * 1000.0
+            dt = self.prefill_ms if from_prefill else (time.perf_counter() - t1) * 1000.0
             # this batch's collectives gathered batch_rows rows, not one
-            # (cf. the prefill row's bucket multiplier in generate())
-            batch_kb = self.wire_kb_per_token * batch_rows
+            # (cf. the prefill row's accounting in generate())
+            batch_kb = self.wire_kb(batch_rows)
             for i, tk in enumerate(out):
                 emitted += 1
                 # per-token session pos: a consumer stopping at token i must

@@ -301,11 +301,13 @@ class OpenAIHandler(BaseHTTPRequestHandler):
             history = list(prompt_tokens)
             if st.spec_draft > 0:
                 # tokens already consumed into the claimed session's cache
-                # (the cached prefix minus its pending token): lets the
-                # n-gram draft match across earlier turns of the chat.
-                # Sampled requests replay the same per-request key chain the
-                # plain path walks, so responses are identical either way.
-                n_consumed = len(prompt_tokens) - len(feed_tokens) - 1
+                # (the cached prefix minus its pending token, when it has
+                # one): lets the n-gram draft match across earlier turns of
+                # the chat. Sampled requests replay the same per-request key
+                # chain the plain path walks, so responses are identical
+                # either way.
+                pending = 1 if session is not None and session.pending_token is not None else 0
+                n_consumed = len(prompt_tokens) - len(feed_tokens) - pending
                 stream_iter = st.engine.generate_spec(
                     feed_tokens, max_tokens, session=session,
                     stop_tokens=stop_ids, draft_len=st.spec_draft,

@@ -155,3 +155,25 @@ def test_spec_sampled_stop_keeps_engine_chain_aligned():
     b2 = [t for t, _ in e_spec.generate([], steps=6,
                                         session=e_spec.final_session)]
     assert a2 == b2
+
+
+def test_greedy_spec_advances_engine_key_chain_like_plain():
+    """At temperature 0 plain generate() still consumes one engine key per
+    emitted token; generate_spec must consume identically, so a later
+    SAMPLED call on the same engine chain is bit-identical whether the
+    earlier greedy call was speculated or not (ADVICE r3)."""
+    plain, spec = _engine(), _engine()
+    n = len([t for t, _ in plain.generate([1, 5, 9], steps=10)])
+    m = len([t for t, _ in spec.generate_spec([1, 5, 9], steps=10)])
+    assert n == m
+    assert np.array_equal(np.asarray(plain._key), np.asarray(spec._key))
+
+
+def test_spec_first_token_stats_report_prefill():
+    """The first (prefill-produced) token's stats carry the prefill cost,
+    exactly like plain generate()'s first token (ADVICE r3: spec runs must
+    not silently exclude prefill from per-token averages)."""
+    eng = _engine()
+    stats = [s for _, s in eng.generate_spec([1, 5, 9], steps=4)]
+    assert stats[0].generation_ms == eng.prefill_ms > 0.0
+    assert stats[0].inference_ms == eng.prefill_ms

@@ -181,3 +181,13 @@ def test_moe_wire_stats_analytic_bytes(qp):
     vocab_bytes = _pad_up(MIXTRAL.vocab_size, 128 * 8) * 4.0
     want_kb = (layer_feats * 4.0 + vocab_bytes) * (7 / 8) / 1024.0
     assert abs(eng.wire_kb_per_token - want_kb) < 1e-9
+    # a 9-row batch (spec verify / prefill): 9*k >= E routes the dense
+    # combine, which gathers ALL E expert hiddens per row — wire_kb(rows)
+    # must price E, not k (stats-accuracy finding, r4 review)
+    feats9 = MIXTRAL.n_layers * (3 * MIXTRAL.dim + MIXTRAL.n_experts * hidden)
+    want9 = (feats9 * 4.0 + vocab_bytes) * (7 / 8) / 1024.0 * 9
+    assert abs(eng.wire_kb(9) - want9) < 1e-9
+    # a 2-row batch stays on the selected path: union caps at 2*k experts
+    feats2 = MIXTRAL.n_layers * (3 * MIXTRAL.dim + 4 * hidden)
+    want2 = (feats2 * 4.0 + vocab_bytes) * (7 / 8) / 1024.0 * 2
+    assert abs(eng.wire_kb(2) - want2) < 1e-9
