@@ -28,6 +28,15 @@ def build_parser() -> argparse.ArgumentParser:
         if mode == "serve":  # the dllama-api surface (`src/apps/dllama-api`)
             sp.add_argument("--host", default="0.0.0.0")
             sp.add_argument("--port", type=int, default=9990)
+            sp.add_argument(
+                "--session-cache",
+                type=int,
+                default=2,
+                metavar="N",
+                help="conversation KV states kept resident (LRU): N "
+                "interleaved chats each reuse their own prefix instead of "
+                "re-prefilling; every slot holds a full KV cache in HBM",
+            )
         sp.add_argument("--model", required=True)
         sp.add_argument("--tokenizer", required=True)
         sp.add_argument("--prompt", default=None)

@@ -225,14 +225,20 @@ class Engine:
             self._init_cache = jax.jit(lambda: llama.init_cache(cfg, cache_dtype))
 
         #: per-device ICI kB one decode step moves (the reference's S/R line)
-        self.wire_kb_per_token = self._wire_bytes(1) / 1024.0
+        self._wire_kb_cache: dict = {}
+        self.wire_kb_per_token = self.wire_kb(1)
 
     def wire_kb(self, rows: int) -> float:
         """Per-device ICI kB a T=rows forward (prefill bucket, spec verify
         batch) moves. NOT simply rows x the decode number: an MoE batch whose
         row union can cover every expert (rows*k >= E) takes the dense-combine
-        path and gathers E hidden vectors per row instead of k."""
-        return self._wire_bytes(rows) / 1024.0
+        path and gathers E hidden vectors per row instead of k. Memoized —
+        _wire_bytes walks the params pytree, far too slow for the per-batch
+        dispatch loop."""
+        kb = self._wire_kb_cache.get(rows)
+        if kb is None:
+            kb = self._wire_kb_cache[rows] = self._wire_bytes(rows) / 1024.0
+        return kb
 
     def _wire_bytes(self, rows: int) -> float:
         """Per-device ICI bytes a T=rows forward's collectives move (0

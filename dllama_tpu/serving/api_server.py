@@ -87,7 +87,8 @@ class ServerState:
 
     def __init__(self, engine, tokenizer, cfg, model_name: str, template: str = "llama3",
                  default_sampler: SamplerConfig = SamplerConfig(),
-                 default_seed: int = None, spec_draft: int = 0):
+                 default_seed: int = None, spec_draft: int = 0,
+                 session_cache: int = 2):
         """``default_seed``: seed for requests that send none — None means a
         fresh time-based seed per request (the launch-flag --seed plumbs in
         here so an operator can make the whole server reproducible).
@@ -95,7 +96,9 @@ class ServerState:
         decoding (Engine.generate_spec — multiple tokens per device step on
         repetitive text). Responses are byte-identical to the plain path at
         any temperature: greedy verifies against argmax, sampled against the
-        same per-request key chain."""
+        same per-request key chain. ``session_cache``: how many conversation
+        KV states to keep resident (each holds a full KV cache in HBM —
+        size this against seq_len x n_layers x kv_dim x cache dtype)."""
         self.engine = engine
         self.tokenizer = tokenizer
         self.cfg = cfg
@@ -104,47 +107,62 @@ class ServerState:
         self.default_sampler = default_sampler
         self.default_seed = default_seed
         self.spec_draft = spec_draft
+        self.session_cache = max(1, session_cache)
         self.lock = threading.Lock()  # engine serves one request at a time
-        # prefix cache: the KV state + token history of the last completion.
-        # Multi-turn chats resend the whole conversation; when the new prompt
-        # extends the cached tokens, only the suffix is prefilled. The
-        # reference restarts pos=0 with no reuse every request
+        # prefix cache: KV state + token history of recent completions, LRU.
+        # Multi-turn chats resend the whole conversation; when a new prompt
+        # extends a cached history, only the suffix is prefilled — and with
+        # N slots, INTERLEAVED conversations each keep their own hot state.
+        # The reference restarts pos=0 with no reuse every request
         # (`/root/reference/src/apps/dllama-api/dllama-api.cpp:257`).
-        self._prefix_tokens: list = []
-        self._prefix_session = None
+        self._sessions: list = []  # [(tokens, session)], oldest first
 
     def take_prefix_session(self, prompt_tokens: list) -> tuple:
-        """Returns (session, tokens_to_feed). Claims (and clears) the cached
-        session when ``prompt_tokens`` extends the cached history; otherwise
-        (None, prompt_tokens) for a from-scratch prefill. Call under lock."""
-        session, cached = self._prefix_session, self._prefix_tokens
-        self._prefix_session, self._prefix_tokens = None, []
-        if (
-            session is not None
-            and 0 < len(cached) <= len(prompt_tokens)
-            and prompt_tokens[: len(cached)] == cached
-        ):
-            suffix = prompt_tokens[len(cached) :]
+        """Returns (session, tokens_to_feed). Claims (removes) the cached
+        session with the LONGEST history that ``prompt_tokens`` extends;
+        (None, prompt_tokens) when no entry matches (from-scratch prefill —
+        unmatched entries stay cached for their own conversations). Call
+        under lock."""
+        best, best_len = -1, 0
+        for i, (cached, session) in enumerate(self._sessions):
+            if not (0 < len(cached) <= len(prompt_tokens)):
+                continue
+            if prompt_tokens[: len(cached)] != cached:
+                continue
             # the cached session's pending token is cached[-1] (fed on the
             # next generate); an empty suffix with nothing pending would
             # leave generate() with no input at all
-            if suffix or session.pending_token is not None:
-                return session, suffix
-        if session is not None:
-            # mismatch: free the stale KV cache's device buffers NOW — the
-            # from-scratch prefill below allocates a fresh cache, and waiting
-            # for GC would transiently double the cache HBM footprint
-            import jax
+            if len(cached) == len(prompt_tokens) and session.pending_token is None:
+                continue
+            if len(cached) > best_len:
+                best, best_len = i, len(cached)
+        if best < 0:
+            # miss at capacity: evict the oldest entry BEFORE the caller
+            # allocates a fresh cache, or peak HBM would transiently hold
+            # session_cache + 1 full KV caches during the prefill
+            if len(self._sessions) >= self.session_cache:
+                _, old = self._sessions.pop(0)
+                import jax
 
-            for leaf in jax.tree.leaves(session.cache):
-                leaf.delete()
-        return None, prompt_tokens
+                for leaf in jax.tree.leaves(old.cache):
+                    leaf.delete()
+            return None, prompt_tokens
+        cached, session = self._sessions.pop(best)
+        return session, prompt_tokens[len(cached):]
 
     def store_prefix_session(self, tokens: list, session) -> None:
         """Cache the post-request state: ``tokens`` = every token fed or
-        sampled this request (the session's pending token last)."""
-        self._prefix_tokens = list(tokens)
-        self._prefix_session = session
+        sampled this request (the session's pending token last). Evicts the
+        least-recently-used entry beyond capacity, freeing its KV cache's
+        device buffers NOW — waiting for GC would transiently hold an extra
+        cache in HBM."""
+        self._sessions.append((list(tokens), session))
+        while len(self._sessions) > self.session_cache:
+            _, old = self._sessions.pop(0)
+            import jax
+
+            for leaf in jax.tree.leaves(old.cache):
+                leaf.delete()
 
     def build_prompt(self, messages: list) -> str:
         """Render a full conversation (the API is stateless: each request
@@ -385,6 +403,7 @@ def serve(args) -> None:
         default_sampler=SamplerConfig(temperature=args.temperature, topp=args.topp),
         default_seed=args.seed,
         spec_draft=getattr(args, "spec_draft", 0),
+        session_cache=getattr(args, "session_cache", 2),
     )
     srv = create_server(state, host=args.host, port=args.port)
     print(f"📡 listening on {args.host}:{args.port} "

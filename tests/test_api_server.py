@@ -222,8 +222,8 @@ def test_take_prefix_session_logic():
 
     class _S(ServerState):
         def __init__(self):  # no engine needed for the cache logic
-            self._prefix_tokens = []
-            self._prefix_session = None
+            self._sessions = []
+            self.session_cache = 2
 
     st = _S()
     sess = Session(cache={}, pos=3, pending_token=7)
@@ -304,7 +304,7 @@ def test_spec_draft_server_matches_plain_greedy():
 
         def encode(self, text, add_bos=True):
             if "<<WARM>>" in text:
-                return list(self._box[0]._prefix_tokens) + [263, 264, 265]
+                return list(self._box[0]._sessions[-1][0]) + [263, 264, 265]
             return self._tok.encode(text, add_bos=add_bos)
 
     def run_server(spec):
@@ -358,3 +358,85 @@ def test_spec_draft_server_matches_plain_greedy():
     finally:
         srv_a.shutdown()
         srv_b.shutdown()
+
+
+def test_lru_prefix_cache_serves_interleaved_conversations():
+    """Two conversations alternating requests must BOTH keep hitting the
+    prefix cache (the round-3 single-slot cache evicted on every switch),
+    with the LRU evicting only beyond capacity."""
+    from dllama_tpu.runtime.generate import Session
+
+    class _S(ServerState):
+        def __init__(self, n):
+            self._sessions = []
+            self.session_cache = n
+
+    st = _S(2)
+    sa = Session(cache={}, pos=4, pending_token=7)
+    sb = Session(cache={}, pos=4, pending_token=8)
+    st.store_prefix_session([1, 2, 3, 7], sa)
+    st.store_prefix_session([9, 8, 5, 8], sb)
+
+    # conversation A returns: hits ITS entry, B's stays cached
+    got, feed = st.take_prefix_session([1, 2, 3, 7, 4, 4])
+    assert got is sa and feed == [4, 4]
+    sa2 = Session(cache={}, pos=6, pending_token=5)
+    st.store_prefix_session([1, 2, 3, 7, 4, 4, 5], sa2)
+
+    # conversation B returns: still hits
+    got, feed = st.take_prefix_session([9, 8, 5, 8, 6])
+    assert got is sb and feed == [6]
+    sb2 = Session(cache={}, pos=7, pending_token=3)
+    st.store_prefix_session([9, 8, 5, 8, 6, 3], sb2)
+
+    # both advanced entries resident; longest-match selection picks the
+    # right one even when a shorter prefix also matches
+    st.store_prefix_session([1, 2], Session(cache={}, pos=1, pending_token=2))
+    # capacity 2: storing a third evicted the OLDEST (A's advanced entry)
+    got, feed = st.take_prefix_session([1, 2, 3, 7, 4, 4, 5, 1])
+    assert got is not sa2  # evicted
+    # B's entry survived the churn
+    got, feed = st.take_prefix_session([9, 8, 5, 8, 6, 3, 2])
+    assert got is sb2 and feed == [2]
+
+
+def test_lru_eviction_deletes_device_buffers():
+    """Evicted sessions free their KV cache buffers immediately (a leaked
+    cache is a seq_len x L x kv HBM slab per stale conversation)."""
+    import jax.numpy as jnp
+
+    from dllama_tpu.runtime.generate import Session
+
+    class _S(ServerState):
+        def __init__(self):
+            self._sessions = []
+            self.session_cache = 1
+
+    st = _S()
+    old_cache = {"k": jnp.zeros((4, 4)), "v": jnp.zeros((4, 4))}
+    st.store_prefix_session([1, 2, 3], Session(cache=old_cache, pos=3, pending_token=3))
+    st.store_prefix_session([5, 6, 7], Session(cache={}, pos=3, pending_token=7))
+    assert old_cache["k"].is_deleted() and old_cache["v"].is_deleted()
+    assert len(st._sessions) == 1
+
+
+def test_miss_at_capacity_evicts_before_fresh_prefill():
+    """A cache miss with all slots full must free the oldest cache BEFORE the
+    caller allocates a fresh one — otherwise peak HBM transiently holds
+    session_cache + 1 full KV caches (r4 review finding)."""
+    import jax.numpy as jnp
+
+    from dllama_tpu.runtime.generate import Session
+
+    class _S(ServerState):
+        def __init__(self):
+            self._sessions = []
+            self.session_cache = 1
+
+    st = _S()
+    old_cache = {"k": jnp.zeros((4, 4)), "v": jnp.zeros((4, 4))}
+    st.store_prefix_session([1, 2, 3], Session(cache=old_cache, pos=3, pending_token=3))
+    got, feed = st.take_prefix_session([9, 9, 9])  # miss, at capacity
+    assert got is None and feed == [9, 9, 9]
+    assert old_cache["k"].is_deleted() and old_cache["v"].is_deleted()
+    assert st._sessions == []
