@@ -104,6 +104,10 @@ def plugin_options() -> tuple:
 #: decode steps fused into one device program in the native chunked loop
 LOOP_STEPS = 32
 
+#: prompt positions one prefill Execute consumes (clamped to seq_len) — the
+#: native prompt phase costs ceil(T/bucket) dispatches instead of T
+PREFILL_BUCKET = 64
+
 
 def export_model(
     cfg,
@@ -125,6 +129,11 @@ def export_model(
       ids (4 bytes each) instead of a full f32 logits vector per token —
       the north star's "no per-token host round-trips" for the C++ path,
       matching the Python engine's fused ``_decode_loop``.
+    * ``model_prefill.mlir`` — a ``PREFILL_BUCKET``-token batched prompt
+      step (traced real count ``n``), the native twin of the Python
+      engine's bucketed prefill: long prompts cost ceil(T/bucket)
+      dispatches instead of one per position (the reference feeds prompts
+      one position at a time, `/root/reference/src/apps/dllama/dllama.cpp:43-55`).
 
     Returns ``out_dir``.
     """
@@ -173,6 +182,22 @@ def export_model(
         )
         return toks, k_c, v_c
 
+    prefill_bucket = min(PREFILL_BUCKET, cfg.seq_len)
+
+    def prefill(weight_leaves, k_cache, v_cache, tokens, pos, n_tokens):
+        wts = jax.tree_util.tree_unflatten(
+            jax.tree_util.tree_structure(weights), weight_leaves
+        )
+        logits, new_cache = llama.forward(
+            cfg, wts["params"], wts["rope"], tokens,
+            {"k": k_cache, "v": v_cache}, pos,
+        )
+        # only the last REAL position's logits are meaningful (pad rows are
+        # garbage); n_tokens is traced so one compile serves every prompt
+        # length within the bucket
+        last = jax.lax.dynamic_index_in_dim(logits, n_tokens - 1, keepdims=False)
+        return last, new_cache["k"], new_cache["v"]
+
     token = jnp.zeros((1,), jnp.int32)
     pos = jnp.int32(0)
     temp, topp, seed = jnp.float32(0.8), jnp.float32(0.9), jnp.int32(1)
@@ -198,6 +223,16 @@ def export_model(
     with open(os.path.join(out_dir, "model_loop.mlir"), "wb") as f:
         f.write(exp_loop.mlir_module_serialized)
 
+    jitted_prefill = jax.jit(prefill, donate_argnums=(1, 2))
+    prefill_args = (
+        leaves, cache["k"], cache["v"],
+        jnp.zeros((prefill_bucket,), jnp.int32), pos, jnp.int32(1),
+    )
+    exp_prefill = jax_export.export(jitted_prefill)(*prefill_args)
+    check_kept(exp_prefill, len(leaves) + 5, "prefill module")
+    with open(os.path.join(out_dir, "model_prefill.mlir"), "wb") as f:
+        f.write(exp_prefill.mlir_module_serialized)
+
     from jax._src.lib import xla_client as xc
 
     with open(os.path.join(out_dir, "compile_options.pb"), "wb") as f:
@@ -205,6 +240,7 @@ def export_model(
 
     executable_file = ""
     loop_executable_file = ""
+    prefill_executable_file = ""
     if aot:
         try:
             compiled = jitted.lower(
@@ -220,6 +256,13 @@ def export_model(
             with open(os.path.join(out_dir, "executable_loop.bin"), "wb") as f:
                 f.write(ser_loop)
             loop_executable_file = "executable_loop.bin"
+            ser_prefill = (
+                jitted_prefill.lower(*prefill_args).compile()
+                .runtime_executable().serialize()
+            )
+            with open(os.path.join(out_dir, "executable_prefill.bin"), "wb") as f:
+                f.write(ser_prefill)
+            prefill_executable_file = "executable_prefill.bin"
         except Exception as e:  # serialization is backend-dependent
             print(f"⚠️  AOT executable serialization unavailable: {e}")
 
@@ -248,6 +291,13 @@ def export_model(
     lines.append(f"loop_steps {LOOP_STEPS}")
     if loop_executable_file:
         lines.append(f"loop_executable_file {loop_executable_file}")
+    # prefill program args = the step program's inputs with the token slot
+    # widened to i32[prefill_bucket], plus one trailing scalar n i32[];
+    # outputs = last real position's logits then the caches
+    lines.append("prefill_mlir_file model_prefill.mlir")
+    lines.append(f"prefill_bucket {prefill_bucket}")
+    if prefill_executable_file:
+        lines.append(f"prefill_executable_file {prefill_executable_file}")
 
     def dtype_name(arr) -> str:
         return _DTYPE_NAMES[str(arr.dtype)]
@@ -287,6 +337,60 @@ def export_model(
     return out_dir
 
 
+def export_sharded_step(cfg, params: dict, mesh, out_path: str,
+                        cache_dtype=jnp.bfloat16) -> str:
+    """Multi-device export groundwork: serialize the TENSOR-PARALLEL decode
+    step over ``mesh`` with its shardings baked in (``jax.export`` records
+    per-argument HLO shardings and the device-count contract).
+
+    The native runtime does not execute multi-device programs yet — this is
+    the forward-half of that path: the serialized artifact deserializes with
+    ``jax.export.deserialize`` and runs on any ``n`` same-shape devices (the
+    dry-run test drives it on the virtual CPU mesh). The reference's
+    equivalent is the root/worker program pair streamed over sockets
+    (`/root/reference/src/transformer.cpp:569-728`); here one SPMD program
+    carries the partitioning in its sharding annotations.
+
+    Uses the dense pjit forward (XLA auto-partitions it; the shard_map quant
+    path needs per-device Pallas custom calls, which land with native
+    multi-device execution). Returns ``out_path``.
+    """
+    from jax import export as jax_export
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from dllama_tpu.models import llama
+    from dllama_tpu.parallel.sharding import cache_spec, shard_params
+
+    sharded = shard_params(params, mesh, cfg)
+    rope = llama.rope_tables(cfg)
+    cache_sh = NamedSharding(mesh, cache_spec())
+    cache = jax.jit(
+        lambda: llama.init_cache(cfg, cache_dtype),
+        out_shardings={"k": cache_sh, "v": cache_sh},
+    )()
+    repl = NamedSharding(mesh, P())
+
+    def step(params, rope, k_cache, v_cache, token, pos):
+        logits, new_cache = llama.forward(
+            cfg, params, rope, token, {"k": k_cache, "v": v_cache}, pos
+        )
+        return logits[0], new_cache["k"], new_cache["v"]
+
+    jitted = jax.jit(step, donate_argnums=(2, 3))
+    exp = jax_export.export(jitted)(
+        sharded, jax.device_put(rope, repl), cache["k"], cache["v"],
+        jax.device_put(jnp.zeros((1,), jnp.int32), repl),
+        jax.device_put(jnp.int32(0), repl),
+    )
+    if exp.nr_devices != mesh.size:
+        raise RuntimeError(
+            f"export recorded {exp.nr_devices} devices, mesh has {mesh.size}"
+        )
+    with open(out_path, "wb") as f:
+        f.write(exp.serialize())
+    return out_path
+
+
 def main(argv=None) -> int:
     import argparse
 
@@ -306,19 +410,37 @@ def main(argv=None) -> int:
         "(f8 = float8_e4m3fn, half the cache HBM of bf16)",
     )
     p.add_argument("--no-aot", action="store_true", help="skip executable.bin")
+    p.add_argument(
+        "--tp", type=int, default=1,
+        help="also export a tensor-parallel decode step over a tp-device "
+        "mesh (model_tpN.mlir; groundwork — the native runtime executes "
+        "single-device programs today)",
+    )
     args = p.parse_args(argv)
 
     with WeightFileReader(args.model) as reader:
         cfg = ModelConfig.from_spec(reader.spec, dtype=args.dtype)
         params = llama.params_from_reader(reader, cfg)
+    cache_dtype = resolve_dtype(args.cache_dtype, default="bfloat16")
     export_model(
         cfg,
         params,
         args.out,
         tokenizer_path=args.tokenizer,
-        cache_dtype=resolve_dtype(args.cache_dtype, default="bfloat16"),
+        cache_dtype=cache_dtype,
         aot=not args.no_aot,
     )
+    if args.tp > 1:
+        from dllama_tpu.parallel.mesh import tp_mesh
+
+        name = f"model_tp{args.tp}.mlir"
+        export_sharded_step(
+            cfg, params, tp_mesh(args.tp), os.path.join(args.out, name),
+            cache_dtype=cache_dtype,
+        )
+        with open(os.path.join(args.out, "manifest.txt"), "a") as f:
+            f.write(f"tp_mlir_file {name}\ntp_degree {args.tp}\n")
+        print(f"📦 wrote {name} (tp={args.tp} sharded step)")
     print(f"📦 exported to {args.out}")
     return 0
 

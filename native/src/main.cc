@@ -138,6 +138,32 @@ int Generate(const Args& args) {
     std::fprintf(stderr, "⏩ fused %lld-step decode loop ready\n",
                  static_cast<long long>(m.loop_steps));
   }
+
+  // Bucketed-prefill program: one Execute consumes up to prefill_bucket
+  // prompt positions (the Python engine's batched prefill for the C++ path;
+  // the reference feeds prompts one position per step).
+  Executable prefill_exec;
+  bool have_prefill = false;
+  if (!m.prefill_mlir_file.empty() && m.prefill_bucket > 0) {
+    bool pf_loaded = false;
+    if (!m.prefill_executable_file.empty()) {
+      try {
+        prefill_exec =
+            client.Deserialize(ReadFile(m.path(m.prefill_executable_file)));
+        pf_loaded = true;
+      } catch (const std::exception& e) {
+        std::fprintf(stderr, "⚠️  prefill deserialize failed (%s), compiling\n",
+                     e.what());
+      }
+    }
+    if (!pf_loaded) {
+      prefill_exec = client.Compile(ReadFile(m.path(m.prefill_mlir_file)),
+                                    ReadFile(m.path(m.compile_options_file)));
+    }
+    have_prefill = true;
+    std::fprintf(stderr, "⏩ %lld-token batched prefill ready\n",
+                 static_cast<long long>(m.prefill_bucket));
+  }
   std::fprintf(stderr, "🕒 program ready in %lld ms\n",
                static_cast<long long>(NowMs() - t_compile0));
 
@@ -203,13 +229,12 @@ int Generate(const Args& args) {
   int generated = 0;
   int pos = 0;
 
-  // Stage token/pos (+ any extra trailing scalars), execute, adopt the
+  // Stage a token span + pos (+ extra trailing scalars), execute, adopt the
   // donated caches; returns the outputs (outs[0] = logits or tokens).
-  auto run_program = [&](Executable& program,
-                         const std::vector<Buffer*>& extra) {
-    const int32_t tok_host[1] = {token};
-    const int32_t pos_host = pos;
-    bufs[token_idx] = client.ToDevice(tok_host, PJRT_Buffer_Type_S32, {1});
+  auto run_with = [&](Executable& program, const int32_t* toks, int64_t ntoks,
+                      int pos_val, const std::vector<Buffer*>& extra) {
+    const int32_t pos_host = pos_val;
+    bufs[token_idx] = client.ToDevice(toks, PJRT_Buffer_Type_S32, {ntoks});
     bufs[pos_idx] = client.ToDevice(&pos_host, PJRT_Buffer_Type_S32, {});
     std::vector<PJRT_Buffer*> arglist(bufs.size() + extra.size());
     for (size_t i = 0; i < bufs.size(); ++i) arglist[i] = bufs[i].get();
@@ -220,17 +245,80 @@ int Generate(const Args& args) {
       bufs[cache_idx[c]] = std::move(outs[1 + c]);
     return outs;
   };
+  auto run_program = [&](Executable& program,
+                         const std::vector<Buffer*>& extra) {
+    const int32_t tok_host[1] = {token};
+    return run_with(program, tok_host, 1, pos, extra);
+  };
   auto run_step = [&](bool pull_logits) {
     std::vector<Buffer> outs = run_program(exec, {});
     if (pull_logits) outs[0].ToHost(logits.data(), logits.size() * sizeof(float));
   };
 
-  // Prompt phase: feed positions 0..n_prompt-2 (forced tokens, logits never
-  // read — the reference feeds the prompt the same one-position-at-a-time
-  // way, /root/reference/src/apps/dllama/dllama.cpp:43-55).
-  for (; pos + 1 < n_prompt; ++pos) {
-    run_step(/*pull_logits=*/false);
-    token = prompt_tokens[pos + 1];
+  // the first sample comes from position n_prompt-1, the last usable one
+  // from seq_len-1: at most seq_len - n_prompt + 1 tokens
+  int remaining = std::min<int>(args.steps,
+                                static_cast<int>(m.seq_len) - n_prompt + 1);
+  bool eos = false;
+
+  // Prompt phase. With a prefill program: feed ALL n_prompt positions in
+  // ceil(n_prompt/bucket) dispatches and sample the FIRST generated token
+  // from the last bucket's logits (the exported program returns the last
+  // real position's row) — no extra decode dispatch for the prompt, the
+  // Python engine's exact scheme. Buckets near the context end restart at
+  // seq_len - bucket: re-fed positions rewrite identical K/V (same inputs,
+  // same program), so the overlap is free and every prompt costs
+  // ceil(T/bucket). Fallback: the reference's one-position-per-dispatch
+  // walk over 0..n_prompt-2 (/root/reference/src/apps/dllama/dllama.cpp:43-55).
+  const int64_t t_prompt0 = NowMs();
+  int n_prompt_dispatches = 0;
+  const int PB = static_cast<int>(m.prefill_bucket);
+  const bool use_prefill = have_prefill && n_prompt > 1 && remaining > 0 &&
+                           PB <= static_cast<int>(m.seq_len);
+  if (use_prefill) {
+    while (pos < n_prompt) {
+      const int start = std::min(pos, static_cast<int>(m.seq_len) - PB);
+      const int take = std::min(n_prompt - start, PB);
+      std::vector<int32_t> tok_host(static_cast<size_t>(PB), 0);
+      for (int i = 0; i < take; ++i) tok_host[i] = prompt_tokens[start + i];
+      const int32_t n_host = take;
+      Buffer n_b = client.ToDevice(&n_host, PJRT_Buffer_Type_S32, {});
+      std::vector<Buffer> outs =
+          run_with(prefill_exec, tok_host.data(), PB, start, {&n_b});
+      pos = start + take;
+      ++n_prompt_dispatches;
+      if (pos == n_prompt)
+        outs[0].ToHost(logits.data(), logits.size() * sizeof(float));
+    }
+  } else {
+    for (; pos + 1 < n_prompt; ++pos) {
+      run_step(/*pull_logits=*/false);
+      token = prompt_tokens[pos + 1];
+      ++n_prompt_dispatches;
+    }
+  }
+  if (n_prompt > 1)
+    std::fprintf(stderr, "📄 prompt: %d tokens in %d dispatches, %lld ms\n",
+                 n_prompt, n_prompt_dispatches,
+                 static_cast<long long>(NowMs() - t_prompt0));
+
+  if (use_prefill) {
+    // first token straight from the prefill logits; its stats carry the
+    // whole prompt phase, like the reference's first generated token
+    token = prompt_tokens[n_prompt - 1];
+    const int next = sampler.Sample(logits);
+    const std::string piece = tok.DecodePiece(token, next);
+    std::fwrite(piece.data(), 1, piece.size(), stdout);
+    std::fflush(stdout);
+    token = next;
+    ++generated;
+    --remaining;
+    const int64_t dt = NowMs() - t_prompt0;
+    gen_ms_total += dt;
+    infer_ms_total += dt;
+    std::fprintf(stderr, "🔶 first token from prefill logits (G %4lld ms)\n",
+                 static_cast<long long>(dt));
+    if (token == tok.eos_id()) eos = true;
   }
 
   // Decode phase: fused chunks when the loop program fits, per-step tail
@@ -238,15 +326,10 @@ int Generate(const Args& args) {
   // slots in the KV cache are overwritten before any later query can attend
   // them (same argument as the Python engine's bucketed overshoot).
   const int N = static_cast<int>(m.loop_steps);
-  // the first sample comes from position n_prompt-1, the last usable one
-  // from seq_len-1: at most seq_len - n_prompt + 1 tokens
-  int remaining = std::min<int>(args.steps,
-                                static_cast<int>(m.seq_len) - n_prompt + 1);
   std::vector<int32_t> chunk(static_cast<size_t>(N > 0 ? N : 1));
   int n_chunks = 0;
-  bool eos = false;
 
-  if (remaining <= 0 && pos < static_cast<int>(m.seq_len)) {
+  if (remaining <= 0 && !use_prefill && pos < static_cast<int>(m.seq_len)) {
     // --steps 0: still feed the final prompt position (KV warm-up), just
     // never sample
     run_step(/*pull_logits=*/false);

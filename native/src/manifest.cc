@@ -73,6 +73,13 @@ Manifest LoadManifest(const std::string& dir) {
     } else if (key == "loop_steps") {
       if (!(ss >> m.loop_steps) || m.loop_steps <= 0)
         throw std::runtime_error("manifest: bad loop_steps line: " + line);
+    } else if (key == "prefill_mlir_file") {
+      ss >> m.prefill_mlir_file;
+    } else if (key == "prefill_executable_file") {
+      ss >> m.prefill_executable_file;
+    } else if (key == "prefill_bucket") {
+      if (!(ss >> m.prefill_bucket) || m.prefill_bucket <= 0)
+        throw std::runtime_error("manifest: bad prefill_bucket line: " + line);
     } else if (key == "input") {
       // input <name> <kind> <dtype> <offset> <nbytes> <ndims> <dims...>
       ArgSpec a;

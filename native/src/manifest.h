@@ -59,6 +59,15 @@ struct Manifest {
   std::string loop_mlir_file;
   std::string loop_executable_file;
   int64_t loop_steps = 0;
+  // Bucketed-prefill program (optional). Arguments are the step program's
+  // inputs with the token slot widened to i32[prefill_bucket], followed by
+  // one host-fed scalar n i32[] (the real token count <= bucket). Outputs
+  // are the last real position's logits followed by the caches. One Execute
+  // consumes up to prefill_bucket prompt positions — the prompt phase costs
+  // ceil(T/bucket) dispatches instead of T.
+  std::string prefill_mlir_file;
+  std::string prefill_executable_file;
+  int64_t prefill_bucket = 0;
   std::vector<ArgSpec> inputs;
   std::vector<OutSpec> outputs;
   std::string dir;  // directory the manifest was loaded from

@@ -50,7 +50,9 @@ def main() -> int:
         [
             os.path.join(native, "build", "dllama-native"), "generate",
             "--export-dir", out_dir,
-            "--prompt", "hi",
+            # long enough that the bucketed prefill path engages (44 byte
+            # tokens land in ONE prefill dispatch instead of 43 steps)
+            "--prompt", "the quick brown fox jumps over the lazy dog",
             "--steps", "8",
             "--temperature", "0",
         ],
@@ -65,6 +67,20 @@ def main() -> int:
         return 1
     if "Generated tokens" not in stdout:
         print("❌ no generation stats in output")
+        return 1
+    stderr = proc.stderr.decode("utf-8", errors="replace")
+    # "📄 prompt: N tokens in D dispatches" MUST be present and show batching
+    # (this run's 44-token prompt fits one 64-token prefill dispatch); a
+    # missing line means the prefill path silently stopped engaging
+    import re
+
+    mt = re.search(r"prompt: (\d+) tokens in (\d+) dispatches", stderr)
+    if not mt:
+        print("❌ no prompt-dispatch stats line in stderr")
+        return 1
+    if int(mt.group(2)) >= int(mt.group(1)) - 1:
+        print("❌ prefill did not batch the prompt "
+              f"({mt.group(1)} tokens, {mt.group(2)} dispatches)")
         return 1
     print("✅ native e2e OK")
     return 0
