@@ -276,9 +276,13 @@ def run_generate(args, show_stats: bool) -> None:
                 )
                 if stats.sent_kb:
                     # the reference's S/R socket-counter columns
-                    # (dllama.cpp:74-75); static SPMD schedule -> analytic
-                    line += (f" S {stats.sent_kb:7.1f} kB"
-                             f" R {stats.recv_kb:7.1f} kB")
+                    # (dllama.cpp:74-75); static SPMD schedule -> analytic.
+                    # "~" marks the dense-pjit path, where the count is an
+                    # ESTIMATE of XLA's all-reduce lowering rather than our
+                    # own shard_map collective schedule
+                    est = "" if engine.wire_stats_exact else "~"
+                    line += (f" S{est} {stats.sent_kb:7.1f} kB"
+                             f" R{est} {stats.recv_kb:7.1f} kB")
                 sys.stdout.write(line + "\n")
         sys.stdout.write(utf8.decode(b"", True))  # dangling incomplete char -> U+FFFD
         print()

@@ -160,12 +160,15 @@ def quant_params_from_reader(reader: WeightFileReader, cfg: ModelConfig,
     `/root/reference/src/transformer.cpp:479-487` — a model class that cannot
     exist unquantized).
 
-    Streaming: planes stay host numpy until one whole stacked tensor is
-    assembled, then that tensor is placed — with ``mesh``, straight into its
-    TP sharding (``parallel.quant_tp`` output-axis specs), so peak host RAM
-    is one stacked tensor and no single device ever holds the full model
-    (the quantized twin of ``parallel.sharding.sharded_params_from_reader``,
-    matching the reference's never-materialize-everything slice streaming,
+    Streaming: without a mesh, planes stay host numpy until one whole
+    stacked tensor is assembled, then that tensor is placed. With ``mesh``,
+    the host never holds more than ONE LAYER of any stacked tensor: each
+    [L, ...] stack is preallocated straight into its TP sharding
+    (``parallel.quant_tp`` output-axis specs) and filled layer by layer with
+    donated in-place ``dynamic_update_slice`` writes. Peak host RAM is
+    model_bytes / n_layers — how a Grok-1-314B-class Q40 file loads through
+    an ordinary host — and no single device ever holds the full model
+    (matching the reference's never-materialize-everything slice streaming,
     `/root/reference/src/transformer.cpp:569-598`)."""
     from dllama_tpu.ops import qmatmul as qm
     from dllama_tpu.quants import blocks
@@ -228,28 +231,85 @@ def quant_params_from_reader(reader: WeightFileReader, cfg: ModelConfig,
     vec_names = ["rms_att", "rms_ffn"] + (
         ["rms_moe", "rms_ffn2"] if cfg.post_norms else []
     )
-    layers: dict = {}
-    for i in range(cfg.n_layers):
-        pre = f"layers.{i}."
-        for n in mat_names:
-            layers.setdefault(n, []).append(load_matrix(pre + n))
-        for n in vec_names:
-            layers.setdefault(n, []).append(reader.read_tensor(pre + n, np.float32))
-        if cfg.is_moe:
-            layers.setdefault("moe_router", []).append(
-                reader.read_tensor(pre + "moe_router", cfg.jax_dtype).T
-            )
-            for kind_ in ("up", "gate", "down"):
-                layers.setdefault(f"moe_{kind_}", []).append(
-                    np_stack([
-                        load_matrix(f"{pre}experts.{e}.{kind_}")
-                        for e in range(cfg.n_experts)
-                    ])
-                )
     from dllama_tpu.parallel.quant_tp import SHARDED_MATRICES
 
+    def load_layer_leaf(i: int, n: str):
+        pre = f"layers.{i}."
+        if n == "moe_router":
+            return reader.read_tensor(pre + "moe_router", cfg.jax_dtype).T
+        if n.startswith("moe_"):
+            return np_stack([
+                load_matrix(f"{pre}experts.{e}.{n[4:]}")
+                for e in range(cfg.n_experts)
+            ])
+        return load_matrix(pre + n)
+
+    moe_names = ["moe_router", "moe_up", "moe_gate", "moe_down"] if cfg.is_moe else []
+
+    if mesh is not None:
+        # Streamed stacked placement: read one layer of one matrix at a
+        # time, lane-align it, and write it into the preallocated SHARDED
+        # device stack in place (donated dynamic_update_slice). The host
+        # peak is a single layer's planes — for an MoE stack that is
+        # 1/n_layers of the expert bytes, not all of them.
+        # (quant_tp / NamedSharding are bound above in this mesh branch.)
+        from functools import partial
+
+        from jax.sharding import PartitionSpec as P
+
+        @partial(jax.jit, donate_argnums=0)
+        def insert(stack, leaf, idx):
+            return jax.tree.map(
+                lambda s, x: jax.lax.dynamic_update_slice(
+                    s, x[None], (idx,) + (0,) * x.ndim),
+                stack, leaf,
+            )
+
+        def stream_stack(name: str):
+            sharded = name in SHARDED_MATRICES
+            stack = None
+            per_specs = None
+            for i in range(cfg.n_layers):
+                leaf = quant_tp.prepare_quant_leaf(
+                    name, load_layer_leaf(i, name), cfg, n_tp)
+                if stack is None:
+                    per_specs = quant_tp.leaf_specs(leaf, sharded)
+                    out_sh = jax.tree.map(
+                        lambda x, s: NamedSharding(mesh, P(None, *tuple(s))),
+                        leaf, per_specs,
+                    )
+                    alloc = jax.jit(
+                        lambda l=leaf: jax.tree.map(
+                            lambda x: jnp.zeros((cfg.n_layers,) + x.shape, x.dtype), l
+                        ),
+                        out_shardings=out_sh,
+                    )
+                    stack = alloc()
+                leaf = jax.tree.map(
+                    lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+                    leaf, per_specs,
+                )
+                stack = insert(stack, leaf, jnp.int32(i))
+            return stack
+
+        p["layers"] = {n: stream_stack(n) for n in list(mat_names) + moe_names}
+        for n in vec_names:
+            vec = np.stack([
+                reader.read_tensor(f"layers.{i}.{n}", np.float32)
+                for i in range(cfg.n_layers)
+            ])
+            p["layers"][n] = place(n, vec, False)
+        return p
+
+    layers: dict = {}
+    for i in range(cfg.n_layers):
+        for n in list(mat_names) + moe_names:
+            layers.setdefault(n, []).append(load_layer_leaf(i, n))
+        for n in vec_names:
+            layers.setdefault(n, []).append(
+                reader.read_tensor(f"layers.{i}.{n}", np.float32))
     p["layers"] = {k: np_stack(v) for k, v in layers.items()}
-    if mesh is None and fuse:
+    if fuse:
         # single-device: fuse shared-input projections ON HOST (numpy planes)
         # before placement, so the unfused originals never reach HBM —
         # fusing after device placement would double weight residency

@@ -227,6 +227,15 @@ class Engine:
         #: per-device ICI kB one decode step moves (the reference's S/R line)
         self._wire_kb_cache: dict = {}
         self.wire_kb_per_token = self.wire_kb(1)
+        #: quant-TP counts ITS OWN collective schedule (exact); the dense
+        #: pjit path estimates from XLA's canonical all-reduce lowering —
+        #: surfaced so the CLI can mark estimated S/R columns as such
+        if mesh is None:
+            self.wire_stats_exact = True  # vacuous: no wire traffic at all
+        else:
+            from dllama_tpu.parallel.quant_tp import has_quant_leaves
+
+            self.wire_stats_exact = has_quant_leaves(self.params)
 
     def wire_kb(self, rows: int) -> float:
         """Per-device ICI kB a T=rows forward (prefill bucket, spec verify

@@ -339,3 +339,95 @@ def test_sharded_export_deserializes_and_runs(tmp_path):
         np.asarray(logits), np.asarray(ref)[0], rtol=2e-4, atol=2e-4
     )
     assert new_k.shape == cache["k"].shape
+
+
+def test_prefill_multi_dispatch_and_context_end_restart(tmp_path):
+    """Drive the exported prefill module with the EXACT bucket walk the C++
+    runtime uses (start = min(pos, seq_len - bucket), re-feeding overlapped
+    positions near the context end): a 90-token prompt against a 64-token
+    bucket takes 2 dispatches, the second restarting at 32 and rewriting
+    positions 32..63 with identical K/V. The first sampled token and the
+    continued greedy decode must match the Python engine exactly."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax._src import xla_bridge
+    from jax._src.lib import xla_client as xc
+    from jaxlib._jax import DeviceList
+
+    from dllama_tpu import export_native
+    from dllama_tpu.models import llama
+    from dllama_tpu.models.config import ModelConfig
+    from dllama_tpu.runtime.generate import Engine
+    from dllama_tpu.runtime.sampler import SamplerConfig
+
+    cfg = ModelConfig(
+        arch="llama", dim=64, hidden_dim=128, n_layers=2, n_heads=4,
+        n_kv_heads=4, vocab_size=128, seq_len=96, head_size=16, kv_dim=64,
+        dtype="float32",
+    )
+    params = llama.random_params(cfg, seed=4)
+    out = export_native.export_model(
+        cfg, params, str(tmp_path / "export"), cache_dtype=jnp.float32,
+        aot=False,
+    )
+    bucket = min(export_native.PREFILL_BUCKET, cfg.seq_len)
+    assert bucket == 64  # the test needs bucket < seq_len < 2*bucket
+
+    backend = xla_bridge.get_backend()
+
+    def load(name):
+        with open(os.path.join(out, name), "rb") as f:
+            return backend.compile_and_load(
+                f.read(), DeviceList(tuple(backend.local_devices()[:1])),
+                xc.CompileOptions(),
+            )
+
+    prefill_exe, step_exe = load("model_prefill.mlir"), load("model.mlir")
+
+    rng = np.random.default_rng(7)
+    prompt = [int(t) for t in rng.integers(3, cfg.vocab_size, 90)]
+
+    rope = llama.rope_tables(cfg)
+    weights = {"params": jax.tree.map(jnp.asarray, params), "rope": rope}
+    leaves = [np.asarray(x) for x in jax.tree.leaves(weights)]
+    cache = llama.init_cache(cfg, jnp.float32)
+    k_buf = backend.buffer_from_pyval(np.asarray(cache["k"]))
+    v_buf = backend.buffer_from_pyval(np.asarray(cache["v"]))
+
+    # the C++ prompt loop, verbatim arithmetic
+    pos, dispatches, logits = 0, 0, None
+    while pos < len(prompt):
+        start = min(pos, cfg.seq_len - bucket)
+        take = min(len(prompt) - start, bucket)
+        padded = np.zeros(bucket, np.int32)
+        padded[:take] = prompt[start : start + take]
+        args = leaves + [k_buf, v_buf, padded, np.asarray(start, np.int32),
+                         np.asarray(take, np.int32)]
+        bufs = [a if not isinstance(a, np.ndarray) else
+                backend.buffer_from_pyval(a) for a in args]
+        outs = prefill_exe.execute(bufs)
+        k_buf, v_buf = outs[1], outs[2]
+        pos = start + take
+        dispatches += 1
+        if pos == len(prompt):
+            logits = np.asarray(outs[0])
+    assert dispatches == 2  # 90 tokens / 64-bucket with restart at 32
+
+    first = int(np.argmax(logits))
+    want = [t for t, _ in Engine(cfg, params, SamplerConfig(temperature=0.0))
+            .generate(prompt, steps=3)]
+    assert first == want[0]
+
+    # continue decoding from the restart-rewritten caches
+    token, pos_i = first, len(prompt)
+    for want_next in want[1:]:
+        args = leaves + [k_buf, v_buf, np.asarray([token], np.int32),
+                         np.asarray(pos_i, np.int32)]
+        bufs = [a if not isinstance(a, np.ndarray) else
+                backend.buffer_from_pyval(a) for a in args]
+        outs = step_exe.execute(bufs)
+        nxt = int(np.argmax(np.asarray(outs[0])))
+        assert nxt == want_next
+        k_buf, v_buf, token = outs[1], outs[2], nxt
+        pos_i += 1
