@@ -105,11 +105,16 @@ def run_decode_bench(cfg_dict: dict, bench_steps: int = None, quant_ok: bool = F
     from dllama_tpu.runtime.sampler import SamplerConfig
 
     if bench_steps is None:
-        bench_steps = 256 if jax.default_backend() == "tpu" else 64
+        bench_steps = int(os.environ.get("BENCH_STEPS", "0") or 0) or (
+            256 if jax.default_backend() == "tpu" else 64
+        )
     cfg = ModelConfig(**cfg_dict)
     n_dev = len(jax.devices())
     mesh = None
-    if n_dev > 1 and cfg.n_kv_heads % n_dev == 0:
+    batch = int(os.environ.get("BENCH_BATCH", "0") or 0)
+    if batch > 1 and n_dev > 1:
+        log("BENCH_BATCH: batched decode is single-device; ignoring extra devices")
+    if n_dev > 1 and batch <= 1 and cfg.n_kv_heads % n_dev == 0:
         from dllama_tpu.parallel.mesh import tp_mesh
 
         mesh = tp_mesh(n_dev)
@@ -145,6 +150,28 @@ def run_decode_bench(cfg_dict: dict, bench_steps: int = None, quant_ok: bool = F
     # Engine may have fused the projection matrices into new buffers; drop
     # this frame's reference so the unfused originals free immediately
     del params
+
+    # BENCH_BATCH=N measures BATCHED decode: N sequences share one weight
+    # stream per step (Engine.generate_batch), so the reported value is the
+    # EFFECTIVE ms/token across the batch (wall / emitted / N) — decode is
+    # bandwidth-bound, so this is the throughput headline the reference's
+    # batch=1 design cannot post
+    if batch > 1:
+        log(f"warmup (batch={batch}, {bench_steps} fused steps, incl. compile)...")
+        t0 = time.perf_counter()
+        eng.generate_batch([[1]] * batch, steps=bench_steps)
+        log(f"warmup done in {time.perf_counter() - t0:.1f}s")
+        times = []
+        for rep in range(3):
+            t1 = time.perf_counter()
+            out = eng.generate_batch([[1]] * batch, steps=bench_steps)
+            wall_ms = (time.perf_counter() - t1) * 1000.0
+            emitted = len(out[0])  # generate_batch clamps to the context
+            eff = wall_ms / emitted / batch
+            times.append(eff)
+            log(f"rep {rep}: {wall_ms / emitted:.3f} ms/step over {emitted} "
+                f"steps, {eff:.3f} ms/token effective x{batch}")
+        return min(times), f"{weights}-batch{batch}"
 
     log(f"warmup ({bench_steps} fused steps, incl. compile)...")
     t0 = time.perf_counter()

@@ -692,6 +692,118 @@ def forward(
     return logits, {"k": new_k, "v": new_v}
 
 
+def init_batch_cache(cfg: ModelConfig, batch: int, cache_dtype=jnp.float32) -> dict:
+    """KV cache for ``batch`` independent sequences: [L, B, S, kv, hd]."""
+    shape = (cfg.n_layers, batch, cfg.seq_len, cfg.n_kv_heads, cfg.head_size)
+    return {"k": jnp.zeros(shape, cache_dtype), "v": jnp.zeros(shape, cache_dtype)}
+
+
+def _attn_block_batched(cfg: ModelConfig, lp: dict, rope: dict, x, k_cache,
+                        v_cache, pos, layer=None):
+    """Batched-decode attention: x [B, dim] carries B INDEPENDENT sequences,
+    each at its own position pos[b]. The projections are ordinary [B, K]
+    matmuls (identical to a T=B prefill row block — the quant kernels need
+    no batching rule); only rope/cache/attention are per-row, via gather and
+    vmap over the pure-jnp attention. Caches are [L, B, S, kv, hd] under the
+    layer scan (``layer`` given) or this layer's [B, S, kv, hd] slab."""
+    B = x.shape[0]
+    xb = rmsnorm(x, lp["rms_att"], cfg.norm_eps)
+    if "wqkv" in lp:
+        qkv = matmul_any(xb, lp["wqkv"], layer)
+        d, kv = cfg.dim, cfg.kv_dim
+        q, k, v = qkv[:, :d], qkv[:, d : d + kv], qkv[:, d + kv :]
+    else:
+        q = matmul_any(xb, lp["wq"], layer)
+        k = matmul_any(xb, lp["wk"], layer)
+        v = matmul_any(xb, lp["wv"], layer)
+    q = q.reshape(B, -1, cfg.head_size)
+    k = k.reshape(B, -1, cfg.head_size)
+    v = v.reshape(B, -1, cfg.head_size)
+
+    cos = rope["cos"][pos][:, None, :]  # per-row angle: [B, 1, hs/2]
+    sin = rope["sin"][pos][:, None, :]
+    q = apply_rope(q, cos, sin, cfg.rope_style)
+    k = apply_rope(k, cos, sin, cfg.rope_style)
+
+    if layer is None:
+        slab_k, slab_v = k_cache, v_cache
+    else:
+        slab_k = jax.lax.dynamic_index_in_dim(k_cache, layer, 0, keepdims=False)
+        slab_v = jax.lax.dynamic_index_in_dim(v_cache, layer, 0, keepdims=False)
+    write = jax.vmap(
+        lambda c, kk, p: jax.lax.dynamic_update_slice_in_dim(
+            c, kk[None].astype(c.dtype), p, axis=0))
+    slab_k = write(slab_k, k, pos)
+    slab_v = write(slab_v, v, pos)
+    if layer is None:
+        k_cache, v_cache = slab_k, slab_v
+    else:
+        zero = (0, 0, 0, 0)
+        k_cache = jax.lax.dynamic_update_slice(k_cache, slab_k[None], (layer, *zero))
+        v_cache = jax.lax.dynamic_update_slice(v_cache, slab_v[None], (layer, *zero))
+
+    out = jax.vmap(
+        lambda qb, ks, vs, p: gqa_attention(qb[None], ks, vs, p)[0]
+    )(q, slab_k, slab_v, pos)  # [B, n_heads, hs]
+    return matmul_any(out.reshape(B, -1), lp["wo"], layer), k_cache, v_cache
+
+
+def forward_batched(
+    cfg: ModelConfig,
+    params: dict,
+    rope: dict,
+    tokens: jnp.ndarray,  # [B] int32 — one pending token per sequence
+    cache: dict,  # {"k","v": [L, B, S, n_kv, hd]}
+    pos: jnp.ndarray,  # [B] int32 — each sequence's own position
+) -> tuple:
+    """One decode step for B independent sequences -> (logits [B, vocab], cache).
+
+    The TPU throughput move the reference's batch=1 design cannot make
+    (`/root/reference/src/tasks.cpp:199-210`): decode is weight-bandwidth
+    bound, and the [B, K] activation streams every weight from HBM ONCE for
+    all B sequences — ~B x aggregate tokens/s at nearly the single-stream
+    step latency. Row b's math is exactly ``forward`` at T=1, pos[b]
+    (greedy-tested per row); MoE routing/union selection is per-row already.
+    Single-device only (no tp_axis) — the batched server/bench path.
+    """
+    x = embed(cfg, params, tokens)
+    layers = params["layers"]
+    quant_scan = any(isinstance(v, QuantTensor) for v in layers.values())
+    if quant_scan:
+        def layer_step(carry, idx):
+            x, k_cache, v_cache = carry
+            lp = {
+                name: (leaf if isinstance(leaf, QuantTensor)
+                       else jax.lax.dynamic_index_in_dim(leaf, idx, 0, keepdims=False))
+                for name, leaf in layers.items()
+            }
+            att_out, k_cache, v_cache = _attn_block_batched(
+                cfg, lp, rope, x, k_cache, v_cache, pos, layer=idx)
+            x = _ffn_residual(cfg, lp, x, att_out, layer=idx)
+            return (x, k_cache, v_cache), None
+
+        (x, new_k, new_v), _ = jax.lax.scan(
+            layer_step, (x, cache["k"], cache["v"]),
+            jnp.arange(cfg.n_layers, dtype=jnp.int32),
+        )
+    else:
+        def layer_step(x, layer):
+            lp, k_cache, v_cache = layer
+            att_out, k_cache, v_cache = _attn_block_batched(
+                cfg, lp, rope, x, k_cache, v_cache, pos)
+            x = _ffn_residual(cfg, lp, x, att_out)
+            return x, (k_cache, v_cache)
+
+        x, (new_k, new_v) = jax.lax.scan(
+            layer_step, x, (layers, cache["k"], cache["v"])
+        )
+    x = rmsnorm(x, params["rms_final"], cfg.norm_eps)
+    logits = matmul_any(x, params["wcls"]).astype(jnp.float32)
+    if cfg.logit_scale != 1.0:
+        logits = logits * cfg.logit_scale
+    return logits, {"k": new_k, "v": new_v}
+
+
 def forward_train(
     cfg: ModelConfig,
     params: dict,

@@ -184,6 +184,28 @@ class Engine:
             )
             return toks, cache
 
+        @partial(jax.jit, donate_argnums=(2,), static_argnames=("n_steps",))
+        def _decode_loop_batch(params, rope, cache, tokens, pos, key, temp, topp, n_steps):
+            """N batched decode steps fused into one program: every step
+            streams the weights ONCE for all B sequences (llama.forward_batched)
+            and samples each row on device."""
+
+            def body(carry, _):
+                cache, toks, pos_, key = carry
+                key, sub = jax.random.split(key)
+                logits, cache = llama.forward_batched(
+                    cfg, params, rope, toks, cache, pos_)
+                subs = jax.random.split(sub, toks.shape[0])
+                nxt = jax.vmap(
+                    lambda l, k: sample_dynamic(l, k, temp, topp)
+                )(logits, subs).astype(jnp.int32)
+                return (cache, nxt, pos_ + 1, key), nxt
+
+            (cache, toks, pos, key), out = jax.lax.scan(
+                body, (cache, tokens, pos, key), length=n_steps
+            )
+            return out, cache  # out [n_steps, B]
+
         @partial(jax.jit, donate_argnums=(2,))
         def _verify_step(params, rope, cache, tokens, pos):
             """Speculative verify: feed [pending, draft_1..draft_k] at pos,
@@ -210,6 +232,7 @@ class Engine:
         self._decode_step = partial(_decode_step, self.params, self.rope)
         self._prefill = partial(_prefill, self.params, self.rope)
         self._decode_loop = partial(_decode_loop, self.params, self.rope)
+        self._decode_loop_batch = partial(_decode_loop_batch, self.params, self.rope)
         self._verify_step = partial(_verify_step, self.params, self.rope)
         self._verify_sampled = partial(_verify_sampled, self.params, self.rope)
 
@@ -512,6 +535,85 @@ class Engine:
             pending = prompt_tokens[0] if len(prompt_tokens) == 1 else None
         self.final_session = Session(cache, pos, pending_token=pending)
         return emitted, prefill_ms, decode_ms
+
+    def generate_batch(
+        self, prompts: list, steps: int, sampler: Optional[SamplerConfig] = None
+    ) -> list:
+        """Decode B independent prompts TOGETHER: one weight-streaming pass
+        per step serves every sequence (llama.forward_batched) — on
+        bandwidth-bound decode that is ~B x the aggregate tokens/s of B
+        sequential runs, a throughput mode the reference's batch=1 design
+        has no analog for. Returns a list of B token lists, ``steps`` tokens
+        each (clamped to the tightest row's remaining context; no early
+        stop — stop-token scanning is the caller's, as in generate_fused).
+
+        Greedy (temperature 0) rows are exactly the single-sequence greedy
+        streams. Sampled rows draw from a per-row key schedule derived from
+        one chain — valid samples of the same distributions, but not
+        bit-identical to B separate single-sequence runs.
+        """
+        if self.mesh is not None:
+            raise NotImplementedError(
+                "generate_batch is single-device (no tp mesh) for now"
+            )
+        if not prompts or any(not p for p in prompts):
+            raise ValueError("generate_batch needs non-empty prompts")
+        scfg = sampler if sampler is not None else self.sampler_cfg
+        temp, topp = jnp.float32(scfg.temperature), jnp.float32(scfg.topp)
+        B = len(prompts)
+
+        t0 = time.perf_counter()
+        # Per-row prefill of everything but the LAST prompt token (its feed
+        # is the uniform first batched step, so every row emits exactly
+        # `steps` tokens). Each prefilled single-sequence cache is written
+        # straight into the preallocated [L, B, S, kv, hd] batch cache
+        # (donated in-place update), so peak HBM is the batch cache plus ONE
+        # single cache — never B of them side by side.
+        cache = jax.jit(
+            lambda: llama.init_batch_cache(self.cfg, B, self.cache_dtype)
+        )()
+        insert = jax.jit(
+            lambda bc, c, b: jax.tree.map(
+                lambda s, x: jax.lax.dynamic_update_slice(
+                    s, x[:, None], (0, b, 0, 0, 0)), bc, c),
+            donate_argnums=0,
+        )
+        pend, poss = [], []
+        for b, p in enumerate(prompts):
+            if len(p) > 1:
+                single = self.new_cache()
+                _, single = self.prefill(single, list(p[:-1]), 0)
+                cache = insert(cache, single, jnp.int32(b))
+                del single  # row 0 slots stay zeros for 1-token prompts
+            pend.append(int(p[-1]))
+            poss.append(len(p) - 1)
+        tokens = jnp.asarray(pend, jnp.int32)
+        pos = jnp.asarray(poss, jnp.int32)
+        self.prefill_ms = (time.perf_counter() - t0) * 1000.0
+
+        steps = min(steps, self.cfg.seq_len - max(poss))
+        out: list = [[] for _ in range(B)]
+        if steps <= 0:
+            self.decode_ms = 0.0
+            return out
+        remaining = steps
+        t1 = time.perf_counter()
+        while remaining > 0:
+            n = min(self.decode_chunk, prefill_bucket(remaining))
+            n = min(n, self.cfg.seq_len - max(poss))
+            chunk, cache = self._decode_loop_batch(
+                cache, tokens, pos, self.next_key(), temp, topp, n_steps=n
+            )
+            take = min(n, remaining)
+            arr = np.asarray(chunk)  # [n, B]
+            for b in range(B):
+                out[b].extend(int(t) for t in arr[:take, b])
+            tokens = chunk[-1]
+            pos = pos + take
+            poss = [p + take for p in poss]
+            remaining -= take
+        self.decode_ms = (time.perf_counter() - t1) * 1000.0
+        return out
 
     def generate_spec(
         self,

@@ -1,0 +1,90 @@
+"""Batched multi-sequence decode (Engine.generate_batch / llama.forward_batched).
+
+The reference decodes one token for one sequence per step
+(`/root/reference/src/tasks.cpp:199-210`); on TPU a [B, K] activation streams
+the weights once for all B sequences. These tests pin the row-wise math to
+the single-sequence engine: every greedy row must equal its solo run exactly,
+across dense, quantized, and quantized-MoE models and mixed prompt lengths.
+"""
+
+import numpy as np
+import pytest
+
+from dllama_tpu.models import llama
+from dllama_tpu.models.config import ModelConfig
+from dllama_tpu.runtime.generate import Engine
+from dllama_tpu.runtime.sampler import SamplerConfig
+
+CFG = ModelConfig(
+    arch="llama", dim=64, hidden_dim=128, n_layers=2, n_heads=4, n_kv_heads=2,
+    vocab_size=96, seq_len=64, head_size=16, kv_dim=32, dtype="float32",
+)
+
+MOE_CFG = ModelConfig(
+    arch="mixtral", dim=64, hidden_dim=128, n_layers=2, n_heads=4, n_kv_heads=4,
+    vocab_size=96, seq_len=64, head_size=16, kv_dim=64, n_experts=8,
+    n_active_experts=2, rope_style="half", dtype="float32",
+)
+
+PROMPTS = [[5, 9, 3], [7], [1, 2, 3, 4, 5, 6, 11]]  # mixed lengths incl. 1
+
+
+def _solo_rows(cfg, params, prompts, steps):
+    rows = []
+    for p in prompts:
+        eng = Engine(cfg, params, SamplerConfig(temperature=0.0))
+        rows.append([t for t, _ in eng.generate(list(p), steps=steps)])
+    return rows
+
+
+@pytest.mark.parametrize("quant", [None, "q40"])
+def test_batched_greedy_rows_equal_solo(quant):
+    params = llama.random_params(CFG, seed=0, dtype=np.float32)
+    if quant:
+        params = llama.quantize_params(params, quant)
+    want = _solo_rows(CFG, params, PROMPTS, steps=10)
+    eng = Engine(CFG, params, SamplerConfig(temperature=0.0))
+    got = eng.generate_batch(PROMPTS, steps=10)
+    assert got == want
+
+
+def test_batched_moe_quant_rows_equal_solo():
+    """B rows through the quantized-MoE union path: per-row routing must not
+    leak across sequences."""
+    params = llama.quantize_params(
+        llama.random_params(MOE_CFG, seed=1, dtype=np.float32), "q40"
+    )
+    want = _solo_rows(MOE_CFG, params, PROMPTS, steps=8)
+    eng = Engine(MOE_CFG, params, SamplerConfig(temperature=0.0))
+    got = eng.generate_batch(PROMPTS, steps=8)
+    assert got == want
+
+
+def test_batched_steps_clamped_to_tightest_row():
+    params = llama.random_params(CFG, seed=2, dtype=np.float32)
+    eng = Engine(CFG, params, SamplerConfig(temperature=0.0))
+    long_prompt = list(range(1, CFG.seq_len - 3))  # 60 tokens -> pos 59
+    got = eng.generate_batch([[5], long_prompt], steps=50)
+    assert len(got[0]) == len(got[1]) == 5  # slots 59..63 = 5 feeds
+
+
+def test_batched_sampled_rows_are_valid_tokens():
+    params = llama.random_params(CFG, seed=3, dtype=np.float32)
+    eng = Engine(CFG, params, SamplerConfig(temperature=0.9, seed=7))
+    got = eng.generate_batch(PROMPTS, steps=6)
+    assert all(len(r) == 6 for r in got)
+    assert all(0 <= t < CFG.vocab_size for r in got for t in r)
+
+
+def test_batched_rejects_tp_mesh_and_empty():
+    from dllama_tpu.parallel.mesh import tp_mesh
+
+    params = llama.quantize_params(
+        llama.random_params(CFG, seed=0, dtype=np.float32), "q40"
+    )
+    eng = Engine(CFG, params, SamplerConfig(temperature=0.0), mesh=tp_mesh(2))
+    with pytest.raises(NotImplementedError):
+        eng.generate_batch([[1]], steps=2)
+    solo = Engine(CFG, params, SamplerConfig(temperature=0.0))
+    with pytest.raises(ValueError):
+        solo.generate_batch([[1], []], steps=2)
