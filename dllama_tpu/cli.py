@@ -37,6 +37,16 @@ def build_parser() -> argparse.ArgumentParser:
                 "interleaved chats each reuse their own prefix instead of "
                 "re-prefilling; every slot holds a full KV cache in HBM",
             )
+            sp.add_argument(
+                "--batch-window",
+                type=float,
+                default=0.0,
+                metavar="MS",
+                help="merge greedy non-streaming requests arriving within "
+                "MS milliseconds into ONE batched decode (they share every "
+                "weight-streaming pass — ~Kx throughput under K-way "
+                "concurrency, same tokens as solo runs); 0 disables",
+            )
         sp.add_argument("--model", required=True)
         sp.add_argument("--tokenizer", required=True)
         sp.add_argument("--prompt", default=None)

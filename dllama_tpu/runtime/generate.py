@@ -188,7 +188,10 @@ class Engine:
         def _decode_loop_batch(params, rope, cache, tokens, pos, key, temp, topp, n_steps):
             """N batched decode steps fused into one program: every step
             streams the weights ONCE for all B sequences (llama.forward_batched)
-            and samples each row on device."""
+            and samples each row on device. A row whose own context fills
+            before the batch's step budget pins at slot seq_len-1 (its later
+            tokens are garbage the caller discards); other rows are
+            unaffected — no cross-row truncation."""
 
             def body(carry, _):
                 cache, toks, pos_, key = carry
@@ -199,12 +202,24 @@ class Engine:
                 nxt = jax.vmap(
                     lambda l, k: sample_dynamic(l, k, temp, topp)
                 )(logits, subs).astype(jnp.int32)
-                return (cache, nxt, pos_ + 1, key), nxt
+                pos_ = jnp.minimum(pos_ + 1, jnp.int32(cfg.seq_len - 1))
+                return (cache, nxt, pos_, key), nxt
 
             (cache, toks, pos, key), out = jax.lax.scan(
                 body, (cache, tokens, pos, key), length=n_steps
             )
             return out, cache  # out [n_steps, B]
+
+        self._batch_cache_init = jax.jit(
+            lambda b: llama.init_batch_cache(cfg, b, cache_dtype),
+            static_argnums=0,
+        )
+        self._batch_cache_insert = jax.jit(
+            lambda bc, c, b: jax.tree.map(
+                lambda s, x: jax.lax.dynamic_update_slice(
+                    s, x[:, None], (0, b, 0, 0, 0)), bc, c),
+            donate_argnums=0,
+        )
 
         @partial(jax.jit, donate_argnums=(2,))
         def _verify_step(params, rope, cache, tokens, pos):
@@ -543,9 +558,11 @@ class Engine:
         per step serves every sequence (llama.forward_batched) — on
         bandwidth-bound decode that is ~B x the aggregate tokens/s of B
         sequential runs, a throughput mode the reference's batch=1 design
-        has no analog for. Returns a list of B token lists, ``steps`` tokens
-        each (clamped to the tightest row's remaining context; no early
-        stop — stop-token scanning is the caller's, as in generate_fused).
+        has no analog for. Returns a list of B token lists; each row carries
+        min(steps, its own remaining context) tokens — one near-full row
+        never truncates the others (it pins at its last slot while the rest
+        keep decoding). No early stop — stop-token scanning is the
+        caller's, as in generate_fused.
 
         Greedy (temperature 0) rows are exactly the single-sequence greedy
         streams. Sampled rows draw from a per-row key schedule derived from
@@ -564,26 +581,18 @@ class Engine:
 
         t0 = time.perf_counter()
         # Per-row prefill of everything but the LAST prompt token (its feed
-        # is the uniform first batched step, so every row emits exactly
-        # `steps` tokens). Each prefilled single-sequence cache is written
-        # straight into the preallocated [L, B, S, kv, hd] batch cache
-        # (donated in-place update), so peak HBM is the batch cache plus ONE
-        # single cache — never B of them side by side.
-        cache = jax.jit(
-            lambda: llama.init_batch_cache(self.cfg, B, self.cache_dtype)
-        )()
-        insert = jax.jit(
-            lambda bc, c, b: jax.tree.map(
-                lambda s, x: jax.lax.dynamic_update_slice(
-                    s, x[:, None], (0, b, 0, 0, 0)), bc, c),
-            donate_argnums=0,
-        )
+        # is the uniform first batched step, so a row emits min(steps, room)
+        # tokens). Each prefilled single-sequence cache is written straight
+        # into the preallocated [L, B, S, kv, hd] batch cache (donated
+        # in-place update), so peak HBM is the batch cache plus ONE single
+        # cache — never B of them side by side.
+        cache = self._batch_cache_init(B)
         pend, poss = [], []
         for b, p in enumerate(prompts):
             if len(p) > 1:
                 single = self.new_cache()
                 _, single = self.prefill(single, list(p[:-1]), 0)
-                cache = insert(cache, single, jnp.int32(b))
+                cache = self._batch_cache_insert(cache, single, jnp.int32(b))
                 del single  # row 0 slots stay zeros for 1-token prompts
             pend.append(int(p[-1]))
             poss.append(len(p) - 1)
@@ -591,7 +600,8 @@ class Engine:
         pos = jnp.asarray(poss, jnp.int32)
         self.prefill_ms = (time.perf_counter() - t0) * 1000.0
 
-        steps = min(steps, self.cfg.seq_len - max(poss))
+        rooms = [self.cfg.seq_len - p for p in poss]  # feeds each row allows
+        steps = min(steps, max(rooms))
         out: list = [[] for _ in range(B)]
         if steps <= 0:
             self.decode_ms = 0.0
@@ -600,17 +610,20 @@ class Engine:
         t1 = time.perf_counter()
         while remaining > 0:
             n = min(self.decode_chunk, prefill_bucket(remaining))
-            n = min(n, self.cfg.seq_len - max(poss))
             chunk, cache = self._decode_loop_batch(
                 cache, tokens, pos, self.next_key(), temp, topp, n_steps=n
             )
             take = min(n, remaining)
             arr = np.asarray(chunk)  # [n, B]
+            done = steps - remaining  # tokens every row was offered so far
             for b in range(B):
-                out[b].extend(int(t) for t in arr[:take, b])
+                # a context-exhausted row pinned at its last slot: its tokens
+                # past rooms[b] are garbage — keep only its own budget
+                keep = max(0, min(take, rooms[b] - done))
+                out[b].extend(int(t) for t in arr[:keep, b])
             tokens = chunk[-1]
-            pos = pos + take
-            poss = [p + take for p in poss]
+            # mirror the in-program per-row cap across chunk boundaries
+            pos = jnp.minimum(pos + take, jnp.int32(self.cfg.seq_len - 1))
             remaining -= take
         self.decode_ms = (time.perf_counter() - t1) * 1000.0
         return out

@@ -82,13 +82,91 @@ class StopDetector:
         return out
 
 
+class GreedyBatcher:
+    """Merges concurrent greedy non-streaming completions into ONE batched
+    decode step stream (``Engine.generate_batch``): requests arriving within
+    ``window_ms`` of each other share every weight-streaming pass, so K
+    concurrent greedy requests cost ~one request's wall time instead of K
+    (decode is weight-bandwidth-bound). Greedy rows are bit-identical to
+    solo runs. The reference serves strictly one request at a time
+    (`/root/reference/src/apps/dllama-api/dllama-api.cpp:324-355`).
+
+    Batched rows share a step budget (the max of the batch, clamped by the
+    tightest row's context), skip the prefix cache, and stop-truncate on the
+    host — the trade for the shared weight stream.
+    """
+
+    class _Slot:
+        __slots__ = ("prompt", "steps", "tokens", "error", "done")
+
+        def __init__(self, prompt, steps):
+            self.prompt, self.steps = prompt, steps
+            self.tokens = None
+            self.error = None
+            self.done = threading.Event()
+
+    def __init__(self, state, window_ms: float = 15.0, max_batch: int = 8):
+        self.state = state
+        self.window_s = window_ms / 1000.0
+        #: HBM bound: the batch KV cache is max_batch full-context caches
+        self.max_batch = max(1, max_batch)
+        self._lock = threading.Lock()
+        self._pending: list = []
+
+    def _serve(self, batch: list) -> None:
+        """Run one generate_batch for ``batch`` and resolve every slot.
+        The prompt list is padded to the next power of two (dummy [0] rows,
+        dropped after) so distinct arrival counts reuse a handful of
+        compiled batch sizes instead of compiling one program per B."""
+        from dllama_tpu.runtime.sampler import SamplerConfig as _SC
+
+        padded_b = 1 << (len(batch) - 1).bit_length()
+        prompts = [s.prompt for s in batch] + [[0]] * (padded_b - len(batch))
+        try:
+            rows = self.state.engine.generate_batch(
+                prompts, max(s.steps for s in batch),
+                sampler=_SC(temperature=0.0),
+            )
+            for s, row in zip(batch, rows):
+                s.tokens = row[: s.steps]
+                s.done.set()
+        except Exception as e:  # noqa: BLE001 — every waiter gets a 500
+            for s in batch:
+                s.error = RuntimeError(f"batched decode failed: {e!r}")
+                s.done.set()
+
+    def submit(self, prompt_tokens: list, max_tokens: int) -> list:
+        """Blocks until this request's greedy tokens are decoded (possibly
+        by another thread's batch run). Thread-safe; raises the batch's
+        failure as RuntimeError."""
+        slot = self._Slot(list(prompt_tokens), max_tokens)
+        with self._lock:
+            self._pending.append(slot)
+            leader = len(self._pending) == 1
+        if leader:
+            time.sleep(self.window_s)  # let concurrent requests join
+            with self.state.lock:  # the engine serves one batch at a time
+                while True:
+                    with self._lock:
+                        batch = self._pending[: self.max_batch]
+                        self._pending = self._pending[self.max_batch :]
+                    if not batch:
+                        break
+                    self._serve(batch)
+        else:
+            slot.done.wait()
+        if slot.error is not None:
+            raise slot.error
+        return slot.tokens
+
+
 class ServerState:
     """Everything the handler needs; one instance per server."""
 
     def __init__(self, engine, tokenizer, cfg, model_name: str, template: str = "llama3",
                  default_sampler: SamplerConfig = SamplerConfig(),
                  default_seed: int = None, spec_draft: int = 0,
-                 session_cache: int = 2):
+                 session_cache: int = 2, batch_window_ms: float = 0.0):
         """``default_seed``: seed for requests that send none — None means a
         fresh time-based seed per request (the launch-flag --seed plumbs in
         here so an operator can make the whole server reproducible).
@@ -109,6 +187,15 @@ class ServerState:
         self.spec_draft = spec_draft
         self.session_cache = max(1, session_cache)
         self.lock = threading.Lock()  # engine serves one request at a time
+        # --batch-window > 0: greedy non-streaming requests that arrive
+        # within the window run as ONE batched decode (GreedyBatcher).
+        # Off by default — batching adds up to window_ms latency per request
+        # and only pays off under concurrency.
+        self.batcher = (
+            GreedyBatcher(self, batch_window_ms)
+            if batch_window_ms > 0 and getattr(engine, "mesh", None) is None
+            else None
+        )
         # prefix cache: KV state + token history of recent completions, LRU.
         # Multi-turn chats resend the whole conversation; when a new prompt
         # extends a cached history, only the suffix is prefilled — and with
@@ -163,6 +250,13 @@ class ServerState:
 
             for leaf in jax.tree.leaves(old.cache):
                 leaf.delete()
+
+    def stop_token_ids(self) -> tuple:
+        """Hard stop ids: EOS plus the Llama-3 end-of-turn token when the
+        vocab carries one. Single source for the solo and batched paths."""
+        ids = tuple(i for i in (self.tokenizer.eos_id,) if i >= 0)
+        eot = self.tokenizer.piece_id(b"<|eot_id|>")
+        return ids + ((eot,) if eot >= 0 else ())
 
     def build_prompt(self, messages: list) -> str:
         """Render a full conversation (the API is stateless: each request
@@ -285,6 +379,51 @@ class OpenAIHandler(BaseHTTPRequestHandler):
         base = {"id": cid, "object": "chat.completion", "created": created,
                 "model": st.model_name}
 
+        if (st.batcher is not None and not stream
+                and sampler.temperature == 0.0 and st.spec_draft == 0):
+            # greedy non-streaming requests merge into one batched decode —
+            # same tokens as the solo path (greedy rows are exact), decoded
+            # and stop-truncated on the host after the batch returns
+            stop_ids = st.stop_token_ids()
+            try:
+                row = st.batcher.submit(prompt_tokens, max_tokens)
+            except RuntimeError as e:
+                # one poisoned batch must not reset K connections: every
+                # waiter gets its own 500
+                self._error(500, str(e))
+                return
+            detector = StopDetector(stops)
+            utf8 = codecs.getincrementaldecoder("utf-8")("replace")
+            prev = prompt_tokens[-1]
+            text_parts, finish_reason, n_generated = [], "length", 0
+            for t in row:
+                n_generated += 1
+                if t in stop_ids:
+                    finish_reason = "stop"
+                    break
+                piece = utf8.decode(tok.decode_piece(prev, t))
+                prev = t
+                out, hit = detector.feed(piece)
+                if out:
+                    text_parts.append(out)
+                if hit:
+                    finish_reason = "stop"
+                    break
+            if not detector.stopped:
+                tail = detector.flush() + utf8.decode(b"", True)
+                if tail:
+                    text_parts.append(tail)
+            self._json(200, dict(base, choices=[{
+                "index": 0,
+                "message": {"role": "assistant", "content": "".join(text_parts)},
+                "finish_reason": finish_reason,
+            }], usage={
+                "prompt_tokens": len(prompt_tokens),
+                "completion_tokens": n_generated,
+                "total_tokens": len(prompt_tokens) + n_generated,
+            }))
+            return
+
         if stream:
             self.send_response(200)
             self.send_header("Content-Type", "text/event-stream")
@@ -311,10 +450,7 @@ class OpenAIHandler(BaseHTTPRequestHandler):
         utf8 = codecs.getincrementaldecoder("utf-8")("replace")
         with st.lock:
             prev = prompt_tokens[-1]
-            stop_ids = tuple(i for i in (tok.eos_id,) if i >= 0)
-            eot = tok.piece_id(b"<|eot_id|>")
-            if eot >= 0:
-                stop_ids += (eot,)
+            stop_ids = st.stop_token_ids()
             session, feed_tokens = st.take_prefix_session(prompt_tokens)
             history = list(prompt_tokens)
             if st.spec_draft > 0:
@@ -404,6 +540,7 @@ def serve(args) -> None:
         default_seed=args.seed,
         spec_draft=getattr(args, "spec_draft", 0),
         session_cache=getattr(args, "session_cache", 2),
+        batch_window_ms=getattr(args, "batch_window", 0.0),
     )
     srv = create_server(state, host=args.host, port=args.port)
     print(f"📡 listening on {args.host}:{args.port} "

@@ -440,3 +440,65 @@ def test_miss_at_capacity_evicts_before_fresh_prefill():
     assert got is None and feed == [9, 9, 9]
     assert old_cache["k"].is_deleted() and old_cache["v"].is_deleted()
     assert st._sessions == []
+
+
+def test_concurrent_greedy_requests_batch_into_one_decode():
+    """K greedy non-streaming requests inside the batch window must run as
+    ONE Engine.generate_batch call (B >= 2) and return exactly the replies a
+    batching-disabled server gives for the same prompts."""
+    tok = make_tokenizer()
+    cfg = tiny_cfg(vocab_size=tok.vocab_size, seq_len=512, dim=32, kv_dim=16,
+                   head_size=8, hidden_dim=64)
+    params = llama.random_params(cfg, seed=13)
+
+    def run_server(window_ms):
+        engine = Engine(cfg, params, SamplerConfig(temperature=0.0, seed=1))
+        state = ServerState(engine, tok, cfg, model_name="tiny-test",
+                            template="llama3", batch_window_ms=window_ms)
+        sizes = []
+        if state.batcher is not None:
+            orig = engine.generate_batch
+
+            def spy(prompts, steps, sampler=None):
+                sizes.append(len(prompts))
+                return orig(prompts, steps, sampler=sampler)
+
+            engine.generate_batch = spy
+        srv = create_server(state, host="127.0.0.1", port=0)
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        return srv, srv.server_address[1], sizes
+
+    prompts = ["hello world", "the the cat", "world hello the"]
+
+    def ask_all(port):
+        replies = [None] * len(prompts)
+
+        def one(i):
+            _, d = request(port, "POST", "/v1/chat/completions",
+                           chat_body(messages=[{"role": "user",
+                                                "content": prompts[i]}],
+                                     max_tokens=6))
+            replies[i] = json.loads(d)["choices"][0]["message"]["content"]
+
+        threads = [threading.Thread(target=one, args=(i,))
+                   for i in range(len(prompts))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return replies
+
+    srv_plain, port_plain, _ = run_server(0)
+    srv_batch, port_batch, sizes = run_server(400.0)
+    try:
+        # warm the batched server's compile caches so the window isn't
+        # swamped by first-compile time when the concurrent burst lands
+        request(port_batch, "POST", "/v1/chat/completions",
+                chat_body(max_tokens=2))
+        want = ask_all(port_plain)
+        got = ask_all(port_batch)
+        assert got == want
+        assert sizes and max(sizes) >= 2, sizes  # requests actually merged
+    finally:
+        srv_plain.shutdown()
+        srv_batch.shutdown()

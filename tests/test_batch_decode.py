@@ -60,12 +60,19 @@ def test_batched_moe_quant_rows_equal_solo():
     assert got == want
 
 
-def test_batched_steps_clamped_to_tightest_row():
+def test_batched_steps_clamped_per_row():
+    """A near-full row exhausts ITS context without truncating the others
+    (it pins at its last cache slot; its surplus tokens are discarded)."""
     params = llama.random_params(CFG, seed=2, dtype=np.float32)
     eng = Engine(CFG, params, SamplerConfig(temperature=0.0))
     long_prompt = list(range(1, CFG.seq_len - 3))  # 60 tokens -> pos 59
     got = eng.generate_batch([[5], long_prompt], steps=50)
-    assert len(got[0]) == len(got[1]) == 5  # slots 59..63 = 5 feeds
+    assert len(got[0]) == 50  # the roomy row gets its full budget
+    assert len(got[1]) == 5   # slots 59..63 = 5 feeds for the full row
+    # the roomy row's stream equals its solo run despite the pinned sibling
+    solo = Engine(CFG, params, SamplerConfig(temperature=0.0))
+    want = [t for t, _ in solo.generate([5], steps=50)]
+    assert got[0] == want
 
 
 def test_batched_sampled_rows_are_valid_tokens():
