@@ -47,6 +47,15 @@ def build_parser() -> argparse.ArgumentParser:
                 "weight-streaming pass — ~Kx throughput under K-way "
                 "concurrency, same tokens as solo runs); 0 disables",
             )
+            sp.add_argument(
+                "--batch-max",
+                type=int,
+                default=8,
+                metavar="B",
+                help="largest merged batch (HBM bound: the batch KV cache "
+                "holds B full-context caches); overflow drains in "
+                "successive batches",
+            )
         sp.add_argument("--model", required=True)
         sp.add_argument("--tokenizer", required=True)
         sp.add_argument("--prompt", default=None)

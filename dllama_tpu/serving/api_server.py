@@ -91,9 +91,10 @@ class GreedyBatcher:
     solo runs. The reference serves strictly one request at a time
     (`/root/reference/src/apps/dllama-api/dllama-api.cpp:324-355`).
 
-    Batched rows share a step budget (the max of the batch, clamped by the
-    tightest row's context), skip the prefix cache, and stop-truncate on the
-    host — the trade for the shared weight stream.
+    Batched rows share a step budget (the max of the batch; a near-full-
+    context row pins at its last slot without truncating the others —
+    Engine.generate_batch clamps per row), skip the prefix cache, and
+    stop-truncate on the host — the trade for the shared weight stream.
     """
 
     class _Slot:
@@ -109,23 +110,24 @@ class GreedyBatcher:
         self.state = state
         self.window_s = window_ms / 1000.0
         #: HBM bound: the batch KV cache is max_batch full-context caches
+        #: (--batch-max; size against seq_len x n_layers x kv x cache dtype)
         self.max_batch = max(1, max_batch)
         self._lock = threading.Lock()
         self._pending: list = []
 
     def _serve(self, batch: list) -> None:
-        """Run one generate_batch for ``batch`` and resolve every slot.
-        The prompt list is padded to the next power of two (dummy [0] rows,
-        dropped after) so distinct arrival counts reuse a handful of
-        compiled batch sizes instead of compiling one program per B."""
-        from dllama_tpu.runtime.sampler import SamplerConfig as _SC
-
-        padded_b = 1 << (len(batch) - 1).bit_length()
-        prompts = [s.prompt for s in batch] + [[0]] * (padded_b - len(batch))
+        """Run one generate_batch for ``batch`` and resolve every slot —
+        ALWAYS (any failure resolves every waiter with an error; a follower
+        left waiting forever would hang its HTTP connection). The prompt
+        list is padded to the next power of two (dummy [0] rows, dropped
+        after) so distinct arrival counts reuse a handful of compiled batch
+        sizes instead of compiling one program per B."""
         try:
+            padded_b = 1 << (len(batch) - 1).bit_length()
+            prompts = [s.prompt for s in batch] + [[0]] * (padded_b - len(batch))
             rows = self.state.engine.generate_batch(
                 prompts, max(s.steps for s in batch),
-                sampler=_SC(temperature=0.0),
+                sampler=SamplerConfig(temperature=0.0),
             )
             for s, row in zip(batch, rows):
                 s.tokens = row[: s.steps]
@@ -166,7 +168,8 @@ class ServerState:
     def __init__(self, engine, tokenizer, cfg, model_name: str, template: str = "llama3",
                  default_sampler: SamplerConfig = SamplerConfig(),
                  default_seed: int = None, spec_draft: int = 0,
-                 session_cache: int = 2, batch_window_ms: float = 0.0):
+                 session_cache: int = 2, batch_window_ms: float = 0.0,
+                 batch_max: int = 8):
         """``default_seed``: seed for requests that send none — None means a
         fresh time-based seed per request (the launch-flag --seed plumbs in
         here so an operator can make the whole server reproducible).
@@ -191,11 +194,15 @@ class ServerState:
         # within the window run as ONE batched decode (GreedyBatcher).
         # Off by default — batching adds up to window_ms latency per request
         # and only pays off under concurrency.
-        self.batcher = (
-            GreedyBatcher(self, batch_window_ms)
-            if batch_window_ms > 0 and getattr(engine, "mesh", None) is None
-            else None
-        )
+        self.batcher = None
+        if batch_window_ms > 0:
+            if getattr(engine, "mesh", None) is None:
+                self.batcher = GreedyBatcher(
+                    self, batch_window_ms, max_batch=batch_max)
+            else:
+                print("⚠️  --batch-window ignored: batched decode is "
+                      "single-device (engine has a tp mesh); requests will "
+                      "serve one at a time")
         # prefix cache: KV state + token history of recent completions, LRU.
         # Multi-turn chats resend the whole conversation; when a new prompt
         # extends a cached history, only the suffix is prefilled — and with
@@ -541,6 +548,7 @@ def serve(args) -> None:
         spec_draft=getattr(args, "spec_draft", 0),
         session_cache=getattr(args, "session_cache", 2),
         batch_window_ms=getattr(args, "batch_window", 0.0),
+        batch_max=getattr(args, "batch_max", 8),
     )
     srv = create_server(state, host=args.host, port=args.port)
     print(f"📡 listening on {args.host}:{args.port} "

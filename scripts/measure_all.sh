@@ -1,0 +1,41 @@
+#!/bin/bash
+# One-shot measurement battery for a live TPU. Run from the repo root the
+# moment the axon tunnel is up; every result lands in results/ with a
+# timestamp so a flaky tunnel mid-run loses nothing already captured.
+#
+#   bash scripts/measure_all.sh [results_dir]
+#
+# Order is deliberate: the headline benches first (worth the most if the
+# tunnel dies again), then kernel experiments, then the slower e2e drives.
+set -u
+OUT=${1:-results}
+mkdir -p "$OUT"
+STAMP=$(date -u +%Y%m%dT%H%M%S)
+log() { echo "== $* ($(date -u +%H:%M:%S))" | tee -a "$OUT/measure_$STAMP.log"; }
+run() { # run <name> <cmd...>: capture stdout+stderr, never abort the battery
+  local name=$1; shift
+  log "$name: $*"
+  ( "$@" ) >"$OUT/${name}_$STAMP.out" 2>&1
+  local rc=$?
+  log "$name rc=$rc"
+  tail -3 "$OUT/${name}_$STAMP.out" | tee -a "$OUT/measure_$STAMP.log"
+}
+
+# 1. headline: Llama-2-7B q40 single-chip (the vs_baseline metric)
+run bench_7b python bench.py
+# 2. the north-star model shape
+run bench_8b env BENCH_MODEL=llama3 python bench.py
+# 3. batched-decode throughput headline (8 sequences per weight stream)
+run bench_7b_batch8 env BENCH_BATCH=8 python bench.py
+# 4. f8 KV cache variant
+run bench_7b_f8 env BENCH_CACHE=f8 python bench.py
+# 5. q40 kernel variant shootout (pick the winner for ops/qmatmul.py)
+run qkernel python scripts/qkernel_experiments.py all
+# 6. decode ablation (where the remaining ms go)
+run ablate python scripts/ablate_decode.py
+# 7. kernel microbench reference points
+run kernel_bench python scripts/kernel_bench.py
+# 8. native runtime end to end (exports, builds, drives dllama-native)
+run native_e2e python scripts/native_e2e.py /tmp/dllama_native_e2e_$STAMP
+
+log "battery done — results in $OUT/"
