@@ -552,7 +552,9 @@ class Engine:
         return emitted, prefill_ms, decode_ms
 
     def generate_batch(
-        self, prompts: list, steps: int, sampler: Optional[SamplerConfig] = None
+        self, prompts: list, steps: int,
+        sampler: Optional[SamplerConfig] = None, stop_tokens: tuple = (),
+        row_steps: Optional[list] = None,
     ) -> list:
         """Decode B independent prompts TOGETHER: one weight-streaming pass
         per step serves every sequence (llama.forward_batched) — on
@@ -561,8 +563,12 @@ class Engine:
         has no analog for. Returns a list of B token lists; each row carries
         min(steps, its own remaining context) tokens — one near-full row
         never truncates the others (it pins at its last slot while the rest
-        keep decoding). No early stop — stop-token scanning is the
-        caller's, as in generate_fused.
+        keep decoding). ``stop_tokens``: once EVERY row has emitted one (or
+        reached its own budget) the remaining decode chunks are skipped —
+        rows still carry tokens past their stop (the caller truncates, as
+        the server batcher does); a short-reply batch doesn't pay the full
+        step budget. ``row_steps``: per-row budgets for that done check
+        (the server's mixed max_tokens; defaults to ``steps`` for all).
 
         Greedy (temperature 0) rows are exactly the single-sequence greedy
         streams. Sampled rows draw from a per-row key schedule derived from
@@ -602,6 +608,10 @@ class Engine:
 
         rooms = [self.cfg.seq_len - p for p in poss]  # feeds each row allows
         steps = min(steps, max(rooms))
+        budgets = [
+            min(rooms[b], row_steps[b] if row_steps else steps)
+            for b in range(B)
+        ]
         out: list = [[] for _ in range(B)]
         if steps <= 0:
             self.decode_ms = 0.0
@@ -625,6 +635,12 @@ class Engine:
             # mirror the in-program per-row cap across chunk boundaries
             pos = jnp.minimum(pos + take, jnp.int32(self.cfg.seq_len - 1))
             remaining -= take
+            if stop_tokens and all(
+                len(out[b]) >= budgets[b]
+                or any(t in stop_tokens for t in out[b])
+                for b in range(B)
+            ):
+                break
         self.decode_ms = (time.perf_counter() - t1) * 1000.0
         return out
 

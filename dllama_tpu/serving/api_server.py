@@ -124,10 +124,16 @@ class GreedyBatcher:
         sizes instead of compiling one program per B."""
         try:
             padded_b = 1 << (len(batch) - 1).bit_length()
-            prompts = [s.prompt for s in batch] + [[0]] * (padded_b - len(batch))
+            pad_n = padded_b - len(batch)
+            prompts = [s.prompt for s in batch] + [[0]] * pad_n
             rows = self.state.engine.generate_batch(
                 prompts, max(s.steps for s in batch),
                 sampler=SamplerConfig(temperature=0.0),
+                stop_tokens=self.state.stop_token_ids(),
+                # per-row budgets drive the early exit: a 4-max_tokens row
+                # counts done after 4 tokens, pad rows after 1 — neither
+                # keeps the batch decoding to the whole envelope
+                row_steps=[s.steps for s in batch] + [1] * pad_n,
             )
             for s, row in zip(batch, rows):
                 s.tokens = row[: s.steps]

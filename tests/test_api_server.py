@@ -459,9 +459,9 @@ def test_concurrent_greedy_requests_batch_into_one_decode():
         if state.batcher is not None:
             orig = engine.generate_batch
 
-            def spy(prompts, steps, sampler=None):
+            def spy(prompts, steps, **kw):
                 sizes.append(len(prompts))
-                return orig(prompts, steps, sampler=sampler)
+                return orig(prompts, steps, **kw)
 
             engine.generate_batch = spy
         srv = create_server(state, host="127.0.0.1", port=0)

@@ -95,3 +95,34 @@ def test_batched_rejects_tp_mesh_and_empty():
     solo = Engine(CFG, params, SamplerConfig(temperature=0.0))
     with pytest.raises(ValueError):
         solo.generate_batch([[1], []], steps=2)
+
+
+def test_batched_stop_tokens_skip_remaining_chunks():
+    """Once every row has emitted a stop token, later decode chunks are
+    skipped — and the emitted prefixes still equal the no-stop run."""
+    params = llama.random_params(CFG, seed=5, dtype=np.float32)
+    eng = Engine(CFG, params, SamplerConfig(temperature=0.0), decode_chunk=4)
+    full = eng.generate_batch(PROMPTS, steps=32)
+    stops = tuple({row[2] for row in full})  # every row stops by chunk 1
+    got = eng.generate_batch(PROMPTS, steps=32, stop_tokens=stops)
+    for b in range(len(PROMPTS)):
+        assert len(got[b]) < 32  # early exit actually happened
+        assert got[b] == full[b][: len(got[b])]
+
+
+def test_batched_row_budgets_drive_early_exit():
+    """A row that never stops but has a tiny max_tokens budget counts as
+    done at its budget, so a co-batched stopping row isn't forced through
+    the whole step envelope (r4 review: mixed-max_tokens server batches)."""
+    params = llama.random_params(CFG, seed=6, dtype=np.float32)
+    eng = Engine(CFG, params, SamplerConfig(temperature=0.0), decode_chunk=4)
+    full = eng.generate_batch([[5, 9], [7, 3]], steps=32)
+    stop_b = full[1][2]  # row 1 stops in chunk 1; row 0's budget is 4
+    got = eng.generate_batch(
+        [[5, 9], [7, 3]], steps=32,
+        stop_tokens=(stop_b,) if stop_b not in full[0][:4] else (stop_b, full[0][0]),
+        row_steps=[4, 32],
+    )
+    assert len(got[0]) < 32 and len(got[1]) < 32  # early exit fired
+    assert got[0] == full[0][: len(got[0])]
+    assert got[1] == full[1][: len(got[1])]
