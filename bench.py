@@ -112,9 +112,7 @@ def run_decode_bench(cfg_dict: dict, bench_steps: int = None, quant_ok: bool = F
     n_dev = len(jax.devices())
     mesh = None
     batch = int(os.environ.get("BENCH_BATCH", "0") or 0)
-    if batch > 1 and n_dev > 1:
-        log("BENCH_BATCH: batched decode is single-device; ignoring extra devices")
-    if n_dev > 1 and batch <= 1 and cfg.n_kv_heads % n_dev == 0:
+    if n_dev > 1 and cfg.n_kv_heads % n_dev == 0:
         from dllama_tpu.parallel.mesh import tp_mesh
 
         mesh = tp_mesh(n_dev)

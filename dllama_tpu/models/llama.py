@@ -699,13 +699,16 @@ def init_batch_cache(cfg: ModelConfig, batch: int, cache_dtype=jnp.float32) -> d
 
 
 def _attn_block_batched(cfg: ModelConfig, lp: dict, rope: dict, x, k_cache,
-                        v_cache, pos, layer=None):
+                        v_cache, pos, layer=None, tp_axis=None,
+                        tp_compress: bool = False):
     """Batched-decode attention: x [B, dim] carries B INDEPENDENT sequences,
     each at its own position pos[b]. The projections are ordinary [B, K]
     matmuls (identical to a T=B prefill row block — the quant kernels need
     no batching rule); only rope/cache/attention are per-row, via gather and
     vmap over the pure-jnp attention. Caches are [L, B, S, kv, hd] under the
-    layer scan (``layer`` given) or this layer's [B, S, kv, hd] slab."""
+    layer scan (``layer`` given) or this layer's [B, S, kv, hd] slab.
+    ``tp_axis`` (inside shard_map): local heads + kv-shard cache, activation
+    gathers after the head concat and the wo matmul, exactly `_attn_block`."""
     B = x.shape[0]
     xb = rmsnorm(x, lp["rms_att"], cfg.norm_eps)
     if "wqkv" in lp:
@@ -744,8 +747,10 @@ def _attn_block_batched(cfg: ModelConfig, lp: dict, rope: dict, x, k_cache,
 
     out = jax.vmap(
         lambda qb, ks, vs, p: gqa_attention(qb[None], ks, vs, p)[0]
-    )(q, slab_k, slab_v, pos)  # [B, n_heads, hs]
-    return matmul_any(out.reshape(B, -1), lp["wo"], layer), k_cache, v_cache
+    )(q, slab_k, slab_v, pos)  # [B, local heads, hs]
+    out = _gather(out.reshape(B, -1), tp_axis, tp_compress)
+    return (_gather(matmul_any(out, lp["wo"], layer), tp_axis, tp_compress),
+            k_cache, v_cache)
 
 
 def forward_batched(
@@ -755,6 +760,9 @@ def forward_batched(
     tokens: jnp.ndarray,  # [B] int32 — one pending token per sequence
     cache: dict,  # {"k","v": [L, B, S, n_kv, hd]}
     pos: jnp.ndarray,  # [B] int32 — each sequence's own position
+    tp_axis: str | None = None,
+    gather_logits: bool = True,
+    tp_compress: bool = False,
 ) -> tuple:
     """One decode step for B independent sequences -> (logits [B, vocab], cache).
 
@@ -764,7 +772,8 @@ def forward_batched(
     all B sequences — ~B x aggregate tokens/s at nearly the single-stream
     step latency. Row b's math is exactly ``forward`` at T=1, pos[b]
     (greedy-tested per row); MoE routing/union selection is per-row already.
-    Single-device only (no tp_axis) — the batched server/bench path.
+    ``tp_axis``: inside shard_map over a tp mesh (quant-TP batched serving,
+    parallel.quant_tp.make_tp_forward_batched) — same gathers as ``forward``.
     """
     x = embed(cfg, params, tokens)
     layers = params["layers"]
@@ -778,8 +787,9 @@ def forward_batched(
                 for name, leaf in layers.items()
             }
             att_out, k_cache, v_cache = _attn_block_batched(
-                cfg, lp, rope, x, k_cache, v_cache, pos, layer=idx)
-            x = _ffn_residual(cfg, lp, x, att_out, layer=idx)
+                cfg, lp, rope, x, k_cache, v_cache, pos, layer=idx,
+                tp_axis=tp_axis, tp_compress=tp_compress)
+            x = _ffn_residual(cfg, lp, x, att_out, tp_axis, tp_compress, layer=idx)
             return (x, k_cache, v_cache), None
 
         (x, new_k, new_v), _ = jax.lax.scan(
@@ -790,8 +800,9 @@ def forward_batched(
         def layer_step(x, layer):
             lp, k_cache, v_cache = layer
             att_out, k_cache, v_cache = _attn_block_batched(
-                cfg, lp, rope, x, k_cache, v_cache, pos)
-            x = _ffn_residual(cfg, lp, x, att_out)
+                cfg, lp, rope, x, k_cache, v_cache, pos,
+                tp_axis=tp_axis, tp_compress=tp_compress)
+            x = _ffn_residual(cfg, lp, x, att_out, tp_axis, tp_compress)
             return x, (k_cache, v_cache)
 
         x, (new_k, new_v) = jax.lax.scan(
@@ -799,6 +810,9 @@ def forward_batched(
         )
     x = rmsnorm(x, params["rms_final"], cfg.norm_eps)
     logits = matmul_any(x, params["wcls"]).astype(jnp.float32)
+    if tp_axis is not None and gather_logits:
+        # slice off lane-alignment vocab padding, exactly like `forward`
+        logits = _gather(logits, tp_axis)[..., : cfg.vocab_size]
     if cfg.logit_scale != 1.0:
         logits = logits * cfg.logit_scale
     return logits, {"k": new_k, "v": new_v}

@@ -209,6 +209,41 @@ def shard_quant_params(params: dict, mesh, cfg: ModelConfig) -> dict:
     )
 
 
+def batch_cache_spec() -> P:
+    # [L, B, S, n_kv_heads, head_size] — shard kv heads, batch replicated
+    return P(None, None, None, TP, None)
+
+
+def make_tp_forward_batched(cfg: ModelConfig, mesh, params: dict,
+                            compress: bool = False):
+    """``fwd(params, rope, cache, tokens, pos) -> (logits, cache)`` for the
+    BATCHED decode step (``llama.forward_batched``: tokens/pos are [B]) as a
+    shard_map program over the same output-sharded quant planes as
+    ``make_tp_forward`` — multi-chip batched serving, B sequences sharing
+    every local weight stream AND every ICI gather."""
+    from dllama_tpu.models import llama
+
+    n_tp = mesh.shape[TP]
+    pspecs = quant_param_specs(params, cfg, n_tp)
+    gather_logits = cfg.vocab_size % n_tp == 0
+    cspec = {"k": batch_cache_spec(), "v": batch_cache_spec()}
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(pspecs, P(), cspec, P(), P()),
+        out_specs=(P(), cspec),
+        check_vma=False,
+    )
+    def fwd(params, rope, cache, tokens, pos):
+        return llama.forward_batched(
+            cfg, params, rope, tokens, cache, pos,
+            tp_axis=TP, gather_logits=gather_logits, tp_compress=compress,
+        )
+
+    return fwd
+
+
 def make_tp_forward(cfg: ModelConfig, mesh, params: dict, compress: bool = False):
     """Build ``fwd(params, rope, cache, tokens, pos) -> (logits, cache)``:
     the quantized-TP decode/prefill forward as one shard_map program.

@@ -111,6 +111,8 @@ class Engine:
         # remote-PJRT setups) at the cost of coarser streaming granularity.
         self.decode_chunk = decode_chunk
         fwd = llama.forward
+        fwd_b = llama.forward_batched
+        self._batch_cache_sharding = None
         if mesh is not None:
             from dllama_tpu.parallel import quant_tp, sharding as _sh
             from jax.sharding import NamedSharding
@@ -123,13 +125,23 @@ class Engine:
                 tp_fwd = quant_tp.make_tp_forward(
                     cfg, mesh, self.params, compress=tp_compress
                 )
+                tp_fwd_b = quant_tp.make_tp_forward_batched(
+                    cfg, mesh, self.params, compress=tp_compress
+                )
 
                 def fwd(cfg_, params_, rope_, tokens_, cache_, pos_):
                     return tp_fwd(params_, rope_, cache_, tokens_, pos_)
 
+                def fwd_b(cfg_, params_, rope_, tokens_, cache_, pos_):
+                    return tp_fwd_b(params_, rope_, cache_, tokens_, pos_)
+
             else:
+                # dense pjit: forward_batched partitions like forward (the
+                # per-row vmap'd attention shards by kv head unchanged)
                 self.params = _sh.shard_params(params, mesh, cfg)
             self._cache_sharding = NamedSharding(mesh, _sh.cache_spec())
+            self._batch_cache_sharding = NamedSharding(
+                mesh, quant_tp.batch_cache_spec())
         else:
             from dllama_tpu.parallel.quant_tp import has_quant_leaves
 
@@ -196,8 +208,7 @@ class Engine:
             def body(carry, _):
                 cache, toks, pos_, key = carry
                 key, sub = jax.random.split(key)
-                logits, cache = llama.forward_batched(
-                    cfg, params, rope, toks, cache, pos_)
+                logits, cache = fwd_b(cfg, params, rope, toks, cache, pos_)
                 subs = jax.random.split(sub, toks.shape[0])
                 nxt = jax.vmap(
                     lambda l, k: sample_dynamic(l, k, temp, topp)
@@ -210,9 +221,11 @@ class Engine:
             )
             return out, cache  # out [n_steps, B]
 
+        bsh = (None if self._batch_cache_sharding is None else
+               {"k": self._batch_cache_sharding, "v": self._batch_cache_sharding})
         self._batch_cache_init = jax.jit(
             lambda b: llama.init_batch_cache(cfg, b, cache_dtype),
-            static_argnums=0,
+            static_argnums=0, out_shardings=bsh,
         )
         self._batch_cache_insert = jax.jit(
             lambda bc, c, b: jax.tree.map(
@@ -575,10 +588,6 @@ class Engine:
         one chain — valid samples of the same distributions, but not
         bit-identical to B separate single-sequence runs.
         """
-        if self.mesh is not None:
-            raise NotImplementedError(
-                "generate_batch is single-device (no tp mesh) for now"
-            )
         if not prompts or any(not p for p in prompts):
             raise ValueError("generate_batch needs non-empty prompts")
         scfg = sampler if sampler is not None else self.sampler_cfg
@@ -635,9 +644,9 @@ class Engine:
             # mirror the in-program per-row cap across chunk boundaries
             pos = jnp.minimum(pos + take, jnp.int32(self.cfg.seq_len - 1))
             remaining -= take
-            if stop_tokens and all(
+            if (stop_tokens or row_steps) and all(
                 len(out[b]) >= budgets[b]
-                or any(t in stop_tokens for t in out[b])
+                or (stop_tokens and any(t in stop_tokens for t in out[b]))
                 for b in range(B)
             ):
                 break

@@ -197,18 +197,14 @@ class ServerState:
         self.session_cache = max(1, session_cache)
         self.lock = threading.Lock()  # engine serves one request at a time
         # --batch-window > 0: greedy non-streaming requests that arrive
-        # within the window run as ONE batched decode (GreedyBatcher).
-        # Off by default — batching adds up to window_ms latency per request
-        # and only pays off under concurrency.
-        self.batcher = None
-        if batch_window_ms > 0:
-            if getattr(engine, "mesh", None) is None:
-                self.batcher = GreedyBatcher(
-                    self, batch_window_ms, max_batch=batch_max)
-            else:
-                print("⚠️  --batch-window ignored: batched decode is "
-                      "single-device (engine has a tp mesh); requests will "
-                      "serve one at a time")
+        # within the window run as ONE batched decode (GreedyBatcher) —
+        # single-device or tensor-parallel alike. Off by default: batching
+        # adds up to window_ms latency per request and only pays off under
+        # concurrency.
+        self.batcher = (
+            GreedyBatcher(self, batch_window_ms, max_batch=batch_max)
+            if batch_window_ms > 0 else None
+        )
         # prefix cache: KV state + token history of recent completions, LRU.
         # Multi-turn chats resend the whole conversation; when a new prompt
         # extends a cached history, only the suffix is prefilled — and with

@@ -83,15 +83,33 @@ def test_batched_sampled_rows_are_valid_tokens():
     assert all(0 <= t < CFG.vocab_size for r in got for t in r)
 
 
-def test_batched_rejects_tp_mesh_and_empty():
+def test_batched_under_quant_tp_mesh_matches_solo():
+    """Multi-chip batched serving: expert... quant planes output-sharded,
+    B sequences share every local weight stream AND every ICI gather —
+    greedy rows must equal the single-device solo streams."""
     from dllama_tpu.parallel.mesh import tp_mesh
 
     params = llama.quantize_params(
         llama.random_params(CFG, seed=0, dtype=np.float32), "q40"
     )
+    want = _solo_rows(CFG, params, PROMPTS, steps=8)
     eng = Engine(CFG, params, SamplerConfig(temperature=0.0), mesh=tp_mesh(2))
-    with pytest.raises(NotImplementedError):
-        eng.generate_batch([[1]], steps=2)
+    got = eng.generate_batch(PROMPTS, steps=8)
+    assert got == want
+
+
+def test_batched_under_dense_tp_mesh_matches_solo():
+    from dllama_tpu.parallel.mesh import tp_mesh
+
+    params = llama.random_params(CFG, seed=0, dtype=np.float32)
+    want = _solo_rows(CFG, params, PROMPTS, steps=8)
+    eng = Engine(CFG, params, SamplerConfig(temperature=0.0), mesh=tp_mesh(2))
+    got = eng.generate_batch(PROMPTS, steps=8)
+    assert got == want
+
+
+def test_batched_rejects_empty():
+    params = llama.random_params(CFG, seed=0, dtype=np.float32)
     solo = Engine(CFG, params, SamplerConfig(temperature=0.0))
     with pytest.raises(ValueError):
         solo.generate_batch([[1], []], steps=2)
@@ -126,3 +144,26 @@ def test_batched_row_budgets_drive_early_exit():
     assert len(got[0]) < 32 and len(got[1]) < 32  # early exit fired
     assert got[0] == full[0][: len(got[0])]
     assert got[1] == full[1][: len(got[1])]
+
+
+def test_batched_moe_under_quant_tp_mesh_matches_solo():
+    """The full production matrix cell: quantized MoE expert shards x TP
+    mesh x batched rows — per-row routing on shared expert slices."""
+    from dllama_tpu.parallel.mesh import tp_mesh
+
+    params = llama.quantize_params(
+        llama.random_params(MOE_CFG, seed=1, dtype=np.float32), "q40"
+    )
+    want = _solo_rows(MOE_CFG, params, PROMPTS[:2], steps=6)
+    eng = Engine(MOE_CFG, params, SamplerConfig(temperature=0.0), mesh=tp_mesh(4))
+    got = eng.generate_batch(PROMPTS[:2], steps=6)
+    assert got == want
+
+
+def test_batched_row_budgets_early_exit_without_stop_tokens():
+    """row_steps alone (no stop tokens — e.g. a vocab with no EOS) must
+    still end the batch once every row reaches its own budget."""
+    params = llama.random_params(CFG, seed=7, dtype=np.float32)
+    eng = Engine(CFG, params, SamplerConfig(temperature=0.0), decode_chunk=4)
+    got = eng.generate_batch([[5, 9], [7]], steps=32, row_steps=[3, 4])
+    assert len(got[0]) == 4 and len(got[1]) == 4  # one 4-step chunk, then exit
