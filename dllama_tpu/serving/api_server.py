@@ -154,13 +154,15 @@ class GreedyBatcher:
         if leader:
             time.sleep(self.window_s)  # let concurrent requests join
             with self.state.lock:  # the engine serves one batch at a time
-                while True:
-                    with self._lock:
-                        batch = self._pending[: self.max_batch]
-                        self._pending = self._pending[self.max_batch :]
-                    if not batch:
-                        break
-                    self._serve(batch)
+                # snapshot ONCE: slots arriving during _serve belong to the
+                # new leader they spawned (it is already queued on
+                # state.lock) — re-reading here would keep this thread
+                # serving other leaders' batches and delay its own HTTP
+                # response unboundedly under sustained load
+                with self._lock:
+                    batch, self._pending = self._pending, []
+                for i in range(0, len(batch), self.max_batch):
+                    self._serve(batch[i : i + self.max_batch])
         else:
             slot.done.wait()
         if slot.error is not None:
@@ -237,28 +239,27 @@ class ServerState:
             # allocates a fresh cache, or peak HBM would transiently hold
             # session_cache + 1 full KV caches during the prefill
             if len(self._sessions) >= self.session_cache:
-                _, old = self._sessions.pop(0)
-                import jax
-
-                for leaf in jax.tree.leaves(old.cache):
-                    leaf.delete()
+                self._evict_oldest()
             return None, prompt_tokens
         cached, session = self._sessions.pop(best)
         return session, prompt_tokens[len(cached):]
 
+    def _evict_oldest(self) -> None:
+        """Drop the LRU session and free its KV cache's device buffers NOW —
+        waiting for GC would transiently hold an extra cache in HBM."""
+        import jax
+
+        _, old = self._sessions.pop(0)
+        for leaf in jax.tree.leaves(old.cache):
+            leaf.delete()
+
     def store_prefix_session(self, tokens: list, session) -> None:
         """Cache the post-request state: ``tokens`` = every token fed or
-        sampled this request (the session's pending token last). Evicts the
-        least-recently-used entry beyond capacity, freeing its KV cache's
-        device buffers NOW — waiting for GC would transiently hold an extra
-        cache in HBM."""
+        sampled this request (the session's pending token last); evicts
+        beyond capacity."""
         self._sessions.append((list(tokens), session))
         while len(self._sessions) > self.session_cache:
-            _, old = self._sessions.pop(0)
-            import jax
-
-            for leaf in jax.tree.leaves(old.cache):
-                leaf.delete()
+            self._evict_oldest()
 
     def stop_token_ids(self) -> tuple:
         """Hard stop ids: EOS plus the Llama-3 end-of-turn token when the
@@ -388,8 +389,11 @@ class OpenAIHandler(BaseHTTPRequestHandler):
         base = {"id": cid, "object": "chat.completion", "created": created,
                 "model": st.model_name}
 
-        if (st.batcher is not None and not stream
+        if (st.batcher is not None and not stream and not stops
                 and sampler.temperature == 0.0 and st.spec_draft == 0):
+            # stop STRINGS stay on the solo path: its host loop aborts at
+            # the string, while a batch would decode the row's whole budget
+            # on device before the host truncates
             # greedy non-streaming requests merge into one batched decode —
             # same tokens as the solo path (greedy rows are exact), decoded
             # and stop-truncated on the host after the batch returns
