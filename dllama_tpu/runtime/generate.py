@@ -586,13 +586,24 @@ class Engine:
         Greedy (temperature 0) rows are exactly the single-sequence greedy
         streams. Sampled rows draw from a per-row key schedule derived from
         one chain — valid samples of the same distributions, but not
-        bit-identical to B separate single-sequence runs.
+        bit-identical to B separate single-sequence runs. With ``sampler``
+        given, that chain starts from its seed (reproducible per request,
+        like generate()); otherwise the engine chain advances.
         """
         if not prompts or any(not p for p in prompts):
             raise ValueError("generate_batch needs non-empty prompts")
         scfg = sampler if sampler is not None else self.sampler_cfg
         temp, topp = jnp.float32(scfg.temperature), jnp.float32(scfg.topp)
         B = len(prompts)
+        if sampler is not None:
+            local_key = jax.random.PRNGKey(scfg.seed)
+
+            def next_key():
+                nonlocal local_key
+                local_key, sub = jax.random.split(local_key)
+                return sub
+        else:
+            next_key = self.next_key
 
         t0 = time.perf_counter()
         # Per-row prefill of everything but the LAST prompt token (its feed
@@ -602,15 +613,20 @@ class Engine:
         # in-place update), so peak HBM is the batch cache plus ONE single
         # cache — never B of them side by side.
         cache = self._batch_cache_init(B)
-        pend, poss = [], []
+        # rows sharing a prompt prefix (the OpenAI `n` case: n samples of
+        # one prompt) prefill ONCE and copy into each row
+        groups: dict = {}
         for b, p in enumerate(prompts):
             if len(p) > 1:
-                single = self.new_cache()
-                _, single = self.prefill(single, list(p[:-1]), 0)
+                groups.setdefault(tuple(p[:-1]), []).append(b)
+        for prefix, rows_b in groups.items():
+            single = self.new_cache()
+            _, single = self.prefill(single, list(prefix), 0)
+            for b in rows_b:
                 cache = self._batch_cache_insert(cache, single, jnp.int32(b))
-                del single  # row 0 slots stay zeros for 1-token prompts
-            pend.append(int(p[-1]))
-            poss.append(len(p) - 1)
+            del single  # 1-token-prompt rows keep their zero slots
+        pend = [int(p[-1]) for p in prompts]
+        poss = [len(p) - 1 for p in prompts]
         tokens = jnp.asarray(pend, jnp.int32)
         pos = jnp.asarray(poss, jnp.int32)
         self.prefill_ms = (time.perf_counter() - t0) * 1000.0
@@ -630,7 +646,7 @@ class Engine:
         while remaining > 0:
             n = min(self.decode_chunk, prefill_bucket(remaining))
             chunk, cache = self._decode_loop_batch(
-                cache, tokens, pos, self.next_key(), temp, topp, n_steps=n
+                cache, tokens, pos, next_key(), temp, topp, n_steps=n
             )
             take = min(n, remaining)
             arr = np.asarray(chunk)  # [n, B]

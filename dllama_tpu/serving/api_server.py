@@ -82,6 +82,44 @@ class StopDetector:
         return out
 
 
+def padded_batch(prompts: list, row_steps: list) -> tuple:
+    """Pad a prompt batch to the next power of two with dummy [0] rows of
+    budget 1 (dropped by the caller): distinct request counts reuse a
+    handful of compiled batch programs instead of one XLA compile per B."""
+    b = 1 << (len(prompts) - 1).bit_length()
+    pad = b - len(prompts)
+    return prompts + [[0]] * pad, row_steps + [1] * pad
+
+
+def decode_token_row(tok, prev: int, row: list, stop_ids: tuple,
+                     stops: list) -> tuple:
+    """Token ids -> (text, finish_reason, tokens_consumed) with the same
+    stop-token / stop-string / dangling-UTF-8 semantics as the streaming
+    loop. Shared by every batched response path (GreedyBatcher, `n`)."""
+    detector = StopDetector(stops)
+    utf8 = codecs.getincrementaldecoder("utf-8")("replace")
+    text_parts: list = []
+    finish, n_gen = "length", 0
+    for t in row:
+        n_gen += 1
+        if t in stop_ids:
+            finish = "stop"
+            break
+        piece = utf8.decode(tok.decode_piece(prev, t))
+        prev = t
+        out, hit = detector.feed(piece)
+        if out:
+            text_parts.append(out)
+        if hit:
+            finish = "stop"
+            break
+    if not detector.stopped:
+        tail = detector.flush() + utf8.decode(b"", True)
+        if tail:
+            text_parts.append(tail)
+    return "".join(text_parts), finish, n_gen
+
+
 class GreedyBatcher:
     """Merges concurrent greedy non-streaming completions into ONE batched
     decode step stream (``Engine.generate_batch``): requests arriving within
@@ -123,17 +161,16 @@ class GreedyBatcher:
         after) so distinct arrival counts reuse a handful of compiled batch
         sizes instead of compiling one program per B."""
         try:
-            padded_b = 1 << (len(batch) - 1).bit_length()
-            pad_n = padded_b - len(batch)
-            prompts = [s.prompt for s in batch] + [[0]] * pad_n
+            # per-row budgets drive the early exit: a 4-max_tokens row
+            # counts done after 4 tokens, pad rows after 1 — neither keeps
+            # the batch decoding to the whole envelope
+            prompts, row_steps = padded_batch(
+                [s.prompt for s in batch], [s.steps for s in batch])
             rows = self.state.engine.generate_batch(
                 prompts, max(s.steps for s in batch),
                 sampler=SamplerConfig(temperature=0.0),
                 stop_tokens=self.state.stop_token_ids(),
-                # per-row budgets drive the early exit: a 4-max_tokens row
-                # counts done after 4 tokens, pad rows after 1 — neither
-                # keeps the batch decoding to the whole envelope
-                row_steps=[s.steps for s in batch] + [1] * pad_n,
+                row_steps=row_steps,
             )
             for s, row in zip(batch, rows):
                 s.tokens = row[: s.steps]
@@ -197,6 +234,9 @@ class ServerState:
         self.default_seed = default_seed
         self.spec_draft = spec_draft
         self.session_cache = max(1, session_cache)
+        #: HBM bound shared by the batcher AND the `n` parameter: a batch's
+        #: KV cache holds this many full-context caches
+        self.batch_max = max(1, batch_max)
         self.lock = threading.Lock()  # engine serves one request at a time
         # --batch-window > 0: greedy non-streaming requests that arrive
         # within the window run as ONE batched decode (GreedyBatcher) —
@@ -370,8 +410,17 @@ class OpenAIHandler(BaseHTTPRequestHandler):
             stream = bool(req.get("stream", False))
             mt = req.get("max_tokens")
             max_tokens = None if mt is None else max(1, int(mt))
+            n_choices = max(1, int(req.get("n", 1) or 1))
         except (TypeError, ValueError) as e:
             self._error(400, f"bad request parameter: {e}")
+            return
+        if n_choices > st.batch_max:
+            self._error(400, f"n is capped at {st.batch_max} (--batch-max: "
+                             "each choice holds a full KV cache in device "
+                             "memory)")
+            return
+        if n_choices > 1 and stream:
+            self._error(400, "n > 1 with stream is not supported")
             return
 
         tok = st.tokenizer
@@ -389,15 +438,48 @@ class OpenAIHandler(BaseHTTPRequestHandler):
         base = {"id": cid, "object": "chat.completion", "created": created,
                 "model": st.model_name}
 
+        if n_choices > 1:
+            # n samples of one prompt decode as ONE batch: the shared
+            # prefix prefills once, every step streams the weights once for
+            # all n rows (generate_batch), each row sampling its own stream
+            try:
+                prompts, row_steps = padded_batch(
+                    [list(prompt_tokens)] * n_choices,
+                    [max_tokens] * n_choices)
+                with st.lock:
+                    rows = st.engine.generate_batch(
+                        prompts, max_tokens,
+                        sampler=sampler, stop_tokens=st.stop_token_ids(),
+                        row_steps=row_steps,
+                    )[:n_choices]
+            except Exception as e:  # noqa: BLE001
+                self._error(500, f"batched n-sampling failed: {e!r}")
+                return
+            choices, total = [], 0
+            for idx, row in enumerate(rows):
+                text, finish, n_gen = decode_token_row(
+                    tok, prompt_tokens[-1], row[:max_tokens],
+                    st.stop_token_ids(), stops)
+                total += n_gen
+                choices.append({
+                    "index": idx,
+                    "message": {"role": "assistant", "content": text},
+                    "finish_reason": finish,
+                })
+            self._json(200, dict(base, choices=choices, usage={
+                "prompt_tokens": len(prompt_tokens),
+                "completion_tokens": total,
+                "total_tokens": len(prompt_tokens) + total,
+            }))
+            return
+
         if (st.batcher is not None and not stream and not stops
                 and sampler.temperature == 0.0 and st.spec_draft == 0):
             # stop STRINGS stay on the solo path: its host loop aborts at
             # the string, while a batch would decode the row's whole budget
-            # on device before the host truncates
-            # greedy non-streaming requests merge into one batched decode —
-            # same tokens as the solo path (greedy rows are exact), decoded
-            # and stop-truncated on the host after the batch returns
-            stop_ids = st.stop_token_ids()
+            # on device before the host truncates; greedy non-streaming
+            # requests merge into one batched decode — same tokens as the
+            # solo path (greedy rows are exact)
             try:
                 row = st.batcher.submit(prompt_tokens, max_tokens)
             except RuntimeError as e:
@@ -405,30 +487,11 @@ class OpenAIHandler(BaseHTTPRequestHandler):
                 # waiter gets its own 500
                 self._error(500, str(e))
                 return
-            detector = StopDetector(stops)
-            utf8 = codecs.getincrementaldecoder("utf-8")("replace")
-            prev = prompt_tokens[-1]
-            text_parts, finish_reason, n_generated = [], "length", 0
-            for t in row:
-                n_generated += 1
-                if t in stop_ids:
-                    finish_reason = "stop"
-                    break
-                piece = utf8.decode(tok.decode_piece(prev, t))
-                prev = t
-                out, hit = detector.feed(piece)
-                if out:
-                    text_parts.append(out)
-                if hit:
-                    finish_reason = "stop"
-                    break
-            if not detector.stopped:
-                tail = detector.flush() + utf8.decode(b"", True)
-                if tail:
-                    text_parts.append(tail)
+            text, finish_reason, n_generated = decode_token_row(
+                tok, prompt_tokens[-1], row, st.stop_token_ids(), stops)
             self._json(200, dict(base, choices=[{
                 "index": 0,
-                "message": {"role": "assistant", "content": "".join(text_parts)},
+                "message": {"role": "assistant", "content": text},
                 "finish_reason": finish_reason,
             }], usage={
                 "prompt_tokens": len(prompt_tokens),

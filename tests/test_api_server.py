@@ -502,3 +502,39 @@ def test_concurrent_greedy_requests_batch_into_one_decode():
     finally:
         srv_plain.shutdown()
         srv_batch.shutdown()
+
+
+def test_n_sampled_choices_one_batch(server):
+    """n=3 sampled completions return 3 choices from ONE batched decode,
+    seed-reproducible; n>1 with stream is rejected."""
+    body = chat_body(temperature=0.9, seed=11, n=3, max_tokens=6)
+    status, data = request(server, "POST", "/v1/chat/completions", body)
+    assert status == 200
+    obj = json.loads(data)
+    assert [c["index"] for c in obj["choices"]] == [0, 1, 2]
+    texts = [c["message"]["content"] for c in obj["choices"]]
+    assert all(isinstance(t, str) for t in texts)
+    # the per-row key split must yield genuinely distinct samples — all-
+    # identical choices would mean every row got the same key (r4 review)
+    assert len(set(texts)) > 1, texts
+    assert obj["usage"]["completion_tokens"] <= 18
+
+    # same seed -> same 3 choices (per-request key chain)
+    _, data2 = request(server, "POST", "/v1/chat/completions", body)
+    assert [c["message"]["content"] for c in json.loads(data2)["choices"]] == texts
+
+    status, _ = request(server, "POST", "/v1/chat/completions",
+                        chat_body(n=3, stream=True))
+    assert status == 400
+    status, _ = request(server, "POST", "/v1/chat/completions",
+                        chat_body(n=99))
+    assert status == 400
+
+
+def test_n_greedy_choices_are_identical(server):
+    status, data = request(server, "POST", "/v1/chat/completions",
+                           chat_body(n=2, max_tokens=5))
+    assert status == 200
+    c = json.loads(data)["choices"]
+    assert len(c) == 2
+    assert c[0]["message"]["content"] == c[1]["message"]["content"]

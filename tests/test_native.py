@@ -31,7 +31,7 @@ def native_build():
 
 
 def test_cpp_unit_tests(native_build):
-    for binary in ("tokenizer-test", "sampler-test"):
+    for binary in ("tokenizer-test", "sampler-test", "manifest-test"):
         proc = subprocess.run(
             [os.path.join(native_build, binary)], capture_output=True, text=True
         )
