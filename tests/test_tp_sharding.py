@@ -149,3 +149,61 @@ def test_streaming_sharded_load_matches_full_load(tmp_path):
     # and the shardings themselves agree
     jax.tree.map(lambda a, b: (a.sharding == b.sharding) or (_ for _ in ()).throw(
         AssertionError((a.sharding, b.sharding))), streamed, full)
+
+
+def test_dense_tp_wire_estimate_matches_compiled_hlo_structure():
+    """The dense-pjit S/R estimate assumes XLA lowers each layer to 2
+    dim-payload all-reduces (attention out + FFN out). Audit the COMPILED
+    HLO: the layer scan's while-body must contain exactly that collective
+    pair and nothing weight-scale-sized beyond it — if XLA's lowering ever
+    changes shape, this fails and the estimate (marked '~' in the CLI)
+    must be rederived."""
+    import re
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from dllama_tpu.models import llama
+    from dllama_tpu.models.config import ModelConfig
+    from dllama_tpu.parallel.mesh import tp_mesh
+    from dllama_tpu.runtime.generate import Engine
+    from dllama_tpu.runtime.sampler import SamplerConfig
+
+    cfg = ModelConfig(
+        arch="llama", dim=256, hidden_dim=512, n_layers=2, n_heads=8,
+        n_kv_heads=8, vocab_size=384, seq_len=64, head_size=32, kv_dim=256,
+        dtype="float32",
+    )
+    eng = Engine(cfg, llama.random_params(cfg, seed=0, dtype=np.float32),
+                 SamplerConfig(temperature=0.0), mesh=tp_mesh(8))
+    assert not eng.wire_stats_exact  # dense path: estimate, marked '~'
+    cache = eng.new_cache()
+    txt = eng._decode_step.func.lower(
+        eng.params, eng.rope, cache, jnp.asarray(3, jnp.int32), jnp.int32(0),
+        jax.random.PRNGKey(0), jnp.float32(0.0), jnp.float32(0.9),
+    ).compile().as_text()
+
+    ops = re.findall(
+        r"=\s+\w+\[([^\]]*)\][^\n]*?\b(all-reduce|all-gather|reduce-scatter)\(",
+        txt,
+    )
+
+    def numel(dims: str) -> int:
+        ns = [int(d) for d in dims.split(",") if d.strip().isdigit()]
+        return int(np.prod(ns)) if ns else 1
+
+    # activation-scale collectives (>= dim elements); sampling/top-p emits
+    # only small or scalar ones
+    big = [(dims, op) for dims, op in ops if numel(dims) >= cfg.dim]
+    dim_reduces = [x for x in big if x[1] == "all-reduce"
+                   and numel(x[0]) == cfg.dim]
+    # the scan body appears ONCE in the HLO and executes n_layers times:
+    # exactly the 2-per-layer pair the analytic estimate prices
+    assert len(dim_reduces) == 2, big
+    # nothing bigger than dim moves per layer (a hidden-sized collective
+    # would mean the estimate undercounts ~2x)
+    leftovers = [x for x in big if x not in dim_reduces
+                 and numel(x[0]) > cfg.dim]
+    # the final logits all-gather (vocab-sized) is the one allowed big op
+    assert all(numel(d) <= cfg.vocab_size for d, _ in leftovers), leftovers
