@@ -47,7 +47,29 @@ def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
-def _probe_quant_kernels(kind: str = "q40", timeout_s: int = 240) -> bool:
+def _run_probe(code: str, sentinel: str, timeout_s: int) -> tuple:
+    """Run ``code`` in a subprocess -> (ok, failure_detail). The subprocess
+    matters: a down TPU tunnel makes backend init hang in native code,
+    un-timeout-able in-process."""
+    import subprocess
+    import sys as _sys
+
+    try:
+        proc = subprocess.run(
+            [_sys.executable, "-c", code],
+            capture_output=True,
+            text=True,
+            timeout=timeout_s,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+    except subprocess.TimeoutExpired:
+        return False, f"probe timed out after {timeout_s}s (TPU tunnel down?)"
+    if proc.returncode == 0 and sentinel in proc.stdout:
+        return True, ""
+    return False, (proc.stderr or proc.stdout).strip()[-400:]
+
+
+def _probe_quant_kernels(kind: str = "q40", timeout_s: int = 240) -> tuple:
     """Compile+run one tiny fused dequant-matmul in a subprocess.
 
     MUST run before this process touches the backend (some TPU runtimes are
@@ -56,14 +78,12 @@ def _probe_quant_kernels(kind: str = "q40", timeout_s: int = 240) -> bool:
     actually on TPU; any other platform, error, or hang returns False and the
     bench falls back to dense bf16 — slower but it always finishes.
     """
-    import subprocess
-    import sys as _sys
-
     # honor the same platform override the bench itself uses: probing the TPU
     # while the bench is forced elsewhere (or vice versa) validates nothing
     forced = os.environ.get("DLLAMA_PLATFORM")
     if forced and forced != "tpu":
-        return False  # quant kernels only earn their keep on real TPU
+        # quant kernels only earn their keep on real TPU
+        return False, "platform forced off-TPU"
 
     code = (
         "import jax\n"
@@ -76,17 +96,7 @@ def _probe_quant_kernels(kind: str = "q40", timeout_s: int = 240) -> bool:
         "jax.block_until_ready(y)\n"
         "print('QPROBE_OK')\n"
     )
-    try:
-        proc = subprocess.run(
-            [_sys.executable, "-c", code],
-            capture_output=True,
-            text=True,
-            timeout=timeout_s,
-            cwd=os.path.dirname(os.path.abspath(__file__)),
-        )
-        return proc.returncode == 0 and "QPROBE_OK" in proc.stdout
-    except subprocess.TimeoutExpired:
-        return False
+    return _run_probe(code, "QPROBE_OK", timeout_s)
 
 
 def run_decode_bench(cfg_dict: dict, bench_steps: int = None, quant_ok: bool = False):
@@ -186,19 +196,59 @@ def run_decode_bench(cfg_dict: dict, bench_steps: int = None, quant_ok: bool = F
     return min(times), weights
 
 
+def _backend_alive(timeout_s: int = 180) -> tuple:
+    """(alive, failure_detail) for the default backend, probed in a
+    subprocess — the driver's bench run must record a clean failure instead
+    of hanging its whole wall-clock budget on a dead tunnel."""
+    return _run_probe("import jax; jax.devices(); print('BK_OK')",
+                      "BK_OK", timeout_s)
+
+
 def main() -> None:
+    # metric name for the error path, resolvable without touching jax
+    choice = os.environ.get("BENCH_MODEL", "")
+    err_metric = {"tiny": "tinyllama_1.1b", "llama3": "llama3_8b"}.get(
+        choice, "llama2_7b") + "_decode_ms_per_token"
+
     if os.environ.get("DLLAMA_PLATFORM"):
         # same escape hatch as the CLI: force the backend via jax.config
         # (works even when a sitecustomize pinned another platform)
         import jax
 
         jax.config.update("jax_platforms", os.environ["DLLAMA_PLATFORM"])
-
-    # IMPORTANT: probe before anything initializes this process's backend —
-    # a child spawned after the parent holds an exclusive TPU would silently
-    # land on CPU and validate nothing
-    quant_ok = "BENCH_WEIGHTS" in os.environ or _probe_quant_kernels()
-    if not quant_ok:
+        quant_ok = ("BENCH_WEIGHTS" in os.environ
+                    or _probe_quant_kernels()[0])
+    else:
+        # IMPORTANT: probe before anything initializes this process's
+        # backend — a child spawned after the parent holds an exclusive TPU
+        # would silently land on CPU and validate nothing. A successful
+        # quant probe doubles as the backend-liveness check; a TIMED-OUT
+        # one is the tunnel-down signature (kernel bugs fail fast with a
+        # traceback), so only a fast failure pays the second probe that
+        # tells "kernels unusable" apart from "backend dead". Either way a
+        # dead backend exits cleanly instead of hanging in jax.devices().
+        if "BENCH_WEIGHTS" in os.environ:
+            probed, detail = False, ""
+            alive, bdetail = _backend_alive()
+        else:
+            probed, detail = _probe_quant_kernels()
+            if probed:
+                alive, bdetail = True, ""
+            elif "timed out" in detail:
+                alive, bdetail = False, detail
+            else:
+                alive, bdetail = _backend_alive()
+        if not alive:
+            print(json.dumps({
+                "metric": err_metric,
+                "value": None,
+                "unit": "ms/token",
+                "vs_baseline": None,
+                "error": f"backend unreachable: {bdetail}",
+            }), flush=True)
+            raise SystemExit(1)
+        quant_ok = probed or "BENCH_WEIGHTS" in os.environ
+    if not quant_ok and "BENCH_WEIGHTS" not in os.environ:
         log("q40 kernel probe failed/timed out; bench will use bf16 weights")
 
     import jax
