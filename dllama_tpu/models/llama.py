@@ -359,9 +359,11 @@ def device_random_quant_params(cfg: ModelConfig, kind: str = "q40", seed: int = 
     """Random *quantized* params built directly on device — the benchmark's
     7B-shape model with Q40/Q80 HBM residency and no host-side 7B pytree.
     The packed bits are random (valid nibbles/int8) with small scales; the
-    model is numerically plausible but meaningless, like device_random_params."""
-    if cfg.is_moe:
-        raise NotImplementedError("quantized random params cover dense archs only")
+    model is numerically plausible but meaningless, like device_random_params.
+    MoE configs get [L, E, ...] expert plane stacks (the loader's layout:
+    TP-within-expert, every chip a slice of every expert) with a dense f32
+    router, so Q40 Grok-1/Mixtral-shape decode is benchable without a
+    checkpoint."""
     L, D, H, KV = cfg.n_layers, cfg.dim, cfg.hidden_dim, cfg.kv_dim
     key = jax.random.PRNGKey(seed)
     ks = iter(jax.random.split(key, 32))
@@ -391,12 +393,22 @@ def device_random_quant_params(cfg: ModelConfig, kind: str = "q40", seed: int = 
         "wk": qrand(D, KV),
         "wv": qrand(D, KV),
         "wo": qrand(D, D),
-        "w1": qrand(D, H),
-        "w3": qrand(D, H),
-        "w2": qrand(H, D),
         "rms_att": jnp.ones((L, D), jnp.float32),
         "rms_ffn": jnp.ones((L, D), jnp.float32),
     }
+    if cfg.is_moe:
+        E = cfg.n_experts
+        layers.update(
+            moe_router=jax.random.normal(next(ks), (L, D, E), jnp.float32) * 0.02,
+            moe_up=qrand(D, H, prefix=(L, E)),
+            moe_gate=qrand(D, H, prefix=(L, E)),
+            moe_down=qrand(H, D, prefix=(L, E)),
+        )
+        if cfg.post_norms:
+            layers["rms_moe"] = jnp.ones((L, D), jnp.float32)
+            layers["rms_ffn2"] = jnp.ones((L, D), jnp.float32)
+    else:
+        layers.update(w1=qrand(D, H), w3=qrand(D, H), w2=qrand(H, D))
     return {
         "embedding": jax.random.normal(next(ks), (cfg.vocab_size, D), jnp.float32) * 0.02,
         "rms_final": jnp.ones(D, jnp.float32),
@@ -451,10 +463,7 @@ def device_random_params(
     """Random params generated ON DEVICE (one jitted program) — a 7B bf16
     pytree never exists in host RAM. With ``mesh``, the program writes each
     tensor directly into its TP sharding, so no chip ever holds the full
-    model. For benchmarks and dry-runs. Dense archs only (use random_params
-    for MoE test models)."""
-    if cfg.is_moe:
-        raise NotImplementedError("device_random_params covers dense archs only")
+    model. For benchmarks and dry-runs."""
     dtype = dtype or cfg.jax_dtype
     L, D, H, KV = cfg.n_layers, cfg.dim, cfg.hidden_dim, cfg.kv_dim
 
@@ -467,13 +476,25 @@ def device_random_params(
             "wk": ((L, D, KV), dtype),
             "wv": ((L, D, KV), dtype),
             "wo": ((L, D, D), dtype),
-            "w1": ((L, D, H), dtype),
-            "w2": ((L, H, D), dtype),
-            "w3": ((L, D, H), dtype),
             "rms_att": ((L, D), jnp.float32),
             "rms_ffn": ((L, D), jnp.float32),
         },
     }
+    if cfg.is_moe:
+        E = cfg.n_experts
+        shapes["layers"].update(
+            moe_router=((L, D, E), jnp.float32),
+            moe_up=((L, E, D, H), dtype),
+            moe_gate=((L, E, D, H), dtype),
+            moe_down=((L, E, H, D), dtype),
+        )
+        if cfg.post_norms:
+            shapes["layers"]["rms_moe"] = ((L, D), jnp.float32)
+            shapes["layers"]["rms_ffn2"] = ((L, D), jnp.float32)
+    else:
+        shapes["layers"].update(
+            w1=((L, D, H), dtype), w2=((L, H, D), dtype), w3=((L, D, H), dtype)
+        )
 
     def init(key):
         leaves, treedef = jax.tree.flatten(shapes, is_leaf=lambda x: isinstance(x, tuple) and isinstance(x[0], tuple))

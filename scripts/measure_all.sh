@@ -15,12 +15,22 @@ log() { echo "== $* ($(date -u +%H:%M:%S))" | tee -a "$OUT/measure_$STAMP.log"; 
 run() { # run <name> <cmd...>: capture stdout+stderr, never abort the battery
   local name=$1; shift
   log "$name: $*"
-  ( "$@" ) >"$OUT/${name}_$STAMP.out" 2>&1
+  # Hard per-command timeout: a wedged axon tunnel blocks forever otherwise.
+  # GNU timeout (non-foreground) runs the command in its own process group
+  # and signals the whole group, so grandchildren (native_e2e spawns make +
+  # dllama-native) die too and can't keep the single-session tunnel starved.
+  local T=${CMD_TIMEOUT:-1500}
+  timeout -k 30 "$T" "$@" >"$OUT/${name}_$STAMP.out" 2>&1
   local rc=$?
+  { [ $rc -eq 124 ] || [ $rc -eq 137 ]; } && log "$name TIMED OUT after ${T}s (rc=$rc)"
   log "$name rc=$rc"
   tail -3 "$OUT/${name}_$STAMP.out" | tee -a "$OUT/measure_$STAMP.log"
 }
 
+# 0. tunnel sanity + a guaranteed green number: TinyLlama shape is the
+#    cheapest end-to-end decode (r02's only green driver number); if the
+#    tunnel dies mid-battery, this one already banked a measurement
+CMD_TIMEOUT=900 run bench_tiny env BENCH_MODEL=tiny python bench.py
 # 1. headline: Llama-2-7B q40 single-chip (the vs_baseline metric)
 run bench_7b python bench.py
 # 2. the north-star model shape
@@ -29,6 +39,8 @@ run bench_8b env BENCH_MODEL=llama3 python bench.py
 run bench_7b_batch8 env BENCH_BATCH=8 python bench.py
 # 4. f8 KV cache variant
 run bench_7b_f8 env BENCH_CACHE=f8 python bench.py
+# 4b. Mixtral-shape MoE: the selected-experts q40 decode path
+run bench_moe env BENCH_MODEL=moe python bench.py
 # 5. q40 kernel variant shootout (pick the winner for ops/qmatmul.py)
 run qkernel python scripts/qkernel_experiments.py all
 # 6. decode ablation (where the remaining ms go)

@@ -1,0 +1,27 @@
+#!/bin/bash
+# Probe the axon TPU tunnel until it answers, then run the measurement
+# battery. The relay serves one session at a time and can wedge for a while
+# after a client dies — this keeps retrying instead of burning an operator's
+# attention.
+#
+#   bash scripts/tpu_watchdog.sh [results_dir] [max_probes]
+set -u
+OUT=${1:-results}
+MAX=${2:-120}
+PROBE='
+import time, jax, jax.numpy as jnp
+t0 = time.time()
+x = jnp.ones((256, 256), jnp.bfloat16)
+jax.block_until_ready(x @ x)
+print(f"TUNNEL_OK first_matmul={time.time()-t0:.1f}s")
+'
+for i in $(seq 1 "$MAX"); do
+  echo "probe $i/$MAX $(date -u +%H:%M:%S)"
+  if timeout -k 10 150 python -c "$PROBE" 2>&1 | grep TUNNEL_OK; then
+    echo "tunnel is up — starting battery"
+    exec bash "$(dirname "$0")/measure_all.sh" "$OUT"
+  fi
+  sleep 120
+done
+echo "tunnel never came up after $MAX probes"
+exit 1

@@ -36,6 +36,10 @@ def run_chat(demo_files, *extra, turns=("hi", "hi again")):
     model, tok = demo_files
     env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
     env.pop("JAX_PLATFORM_NAME", None)
+    # CPU child must not register the axon TPU plugin: sitecustomize's
+    # register() blocks at interpreter start while another process holds the
+    # (single-session) tunnel, even under JAX_PLATFORMS=cpu
+    env.pop("PALLAS_AXON_POOL_IPS", None)
     proc = subprocess.run(
         [sys.executable, "-m", "dllama_tpu.cli", "chat", "--model", model,
          "--tokenizer", tok, "--steps", "6", "--temperature", "0", "--tp", "1",
@@ -62,20 +66,8 @@ def test_chat_spec_matches_plain(demo_files):
 
 def test_chat_spec_sampled_matches_plain(demo_files):
     """Sampled chat (same --seed) must transcript-match with and without
-    speculative drafting: the spec path replays the same engine key chain."""
-    model, tok = demo_files
-    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
-
-    def run(*extra):
-        proc = subprocess.run(
-            [sys.executable, "-m", "dllama_tpu.cli", "chat", "--model", model,
-             "--tokenizer", tok, "--steps", "6", "--temperature", "0.8",
-             "--seed", "42", "--tp", "1", "--system-prompt", "",
-             "--chat-template", "llama2", *extra],
-            input="hi\nhi again\n", capture_output=True, text=True,
-            env=env, cwd=REPO, timeout=600,
-        )
-        assert proc.returncode == 0, proc.stderr[-2000:]
-        return proc.stdout
-
-    assert run() == run("--spec-draft", "4")
+    speculative drafting: the spec path replays the same engine key chain.
+    (argparse is last-wins, so the extra flags override run_chat's defaults.)"""
+    sampled = ("--temperature", "0.8", "--seed", "42")
+    assert run_chat(demo_files, *sampled) == run_chat(
+        demo_files, *sampled, "--spec-draft", "4")

@@ -133,3 +133,18 @@ def test_moe_loads_from_m_file(tmp_path):
     eng = Engine(cfg, params, SamplerConfig(temperature=0.0))
     out = [t for t, _ in eng.generate([1, 2], steps=4)]
     assert len(out) == 4
+
+
+def test_device_random_quant_params_moe_decode():
+    """The bench's on-device random q40 builder covers MoE (BENCH_MODEL=moe):
+    [L, E, ...] expert plane stacks + dense router must drive the
+    selected-experts quantized decode path end to end."""
+    cfg = mixtral_cfg(hidden_dim=128)
+    params = llama.device_random_quant_params(cfg, kind="q40", seed=0)
+    qt = params["layers"]["moe_up"]
+    assert qt.w.shape[:2] == (cfg.n_layers, cfg.n_experts)
+    assert params["layers"]["moe_router"].shape == (
+        cfg.n_layers, cfg.dim, cfg.n_experts)
+    eng = Engine(cfg, params, SamplerConfig(temperature=0.0))
+    toks, _, _ = eng.generate_fused([1, 2], steps=3)
+    assert len(toks) == 3 and all(0 <= t < cfg.vocab_size for t in toks)

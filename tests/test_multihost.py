@@ -59,6 +59,9 @@ def test_two_process_distributed_bootstrap(tmp_path):
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     env.pop("XLA_FLAGS", None)  # one device per process: a real 2-host shape
+    # CPU children must not register the axon TPU plugin (its register()
+    # blocks at interpreter start while any other process holds the tunnel)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
 
     def spawn(host_id):
