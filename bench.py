@@ -47,6 +47,7 @@ MIXTRAL_LITE = dict(
     arch="mixtral", dim=2048, hidden_dim=5632, n_layers=16, n_heads=16,
     n_kv_heads=8, vocab_size=32000, seq_len=512, head_size=128, kv_dim=1024,
     n_experts=8, n_active_experts=2, dtype="bfloat16",
+    rope_style="half", rope_theta=1e6,  # Mixtral's half-split rotary layout
 )
 
 # reference's best published single-node Llama 2 7B avg token time (ms)
@@ -220,6 +221,31 @@ def main() -> None:
     err_metric = {"tiny": "tinyllama_1.1b", "llama3": "llama3_8b",
                   "moe": "mixtral_lite"}.get(
         choice, "llama2_7b") + "_decode_ms_per_token"
+
+    # In-process deadline from PROCESS START (probes included): the probes
+    # bound backend INIT, but a tunnel can wedge mid-run (observed: param
+    # build hanging after a green probe). The timer emits the clean JSON
+    # error record and hard-exits so neither the driver's bench run nor the
+    # battery's outer `timeout` ever swallows the machine-readable failure.
+    import threading
+
+    deadline_s = float(os.environ.get("BENCH_DEADLINE_S", "1200"))
+
+    def _deadline():
+        print(json.dumps({
+            "metric": err_metric,
+            "value": None,
+            "unit": "ms/token",
+            "vs_baseline": None,
+            "error": f"bench exceeded {deadline_s:.0f}s deadline "
+                     "(tunnel wedged mid-run?)",
+        }), flush=True)
+        os._exit(1)
+
+    if deadline_s > 0:
+        timer = threading.Timer(deadline_s, _deadline)
+        timer.daemon = True
+        timer.start()
 
     if os.environ.get("DLLAMA_PLATFORM"):
         # same escape hatch as the CLI: force the backend via jax.config

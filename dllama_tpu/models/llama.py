@@ -363,9 +363,19 @@ def device_random_quant_params(cfg: ModelConfig, kind: str = "q40", seed: int = 
     MoE configs get [L, E, ...] expert plane stacks (the loader's layout:
     TP-within-expert, every chip a slice of every expert) with a dense f32
     router, so Q40 Grok-1/Mixtral-shape decode is benchable without a
-    checkpoint."""
+    checkpoint.
+
+    The whole build runs as ONE jitted program: on a tunneled TPU, ~25 eager
+    randint/astype dispatches are ~25 separate remote compiles + round trips
+    (any of which can wedge a flaky tunnel mid-build); one program is one
+    compile and one execute."""
+    return jax.jit(_quant_init, static_argnums=(1, 2))(
+        jax.random.PRNGKey(seed), cfg, kind
+    )
+
+
+def _quant_init(key, cfg: ModelConfig, kind: str) -> dict:
     L, D, H, KV = cfg.n_layers, cfg.dim, cfg.hidden_dim, cfg.kv_dim
-    key = jax.random.PRNGKey(seed)
     ks = iter(jax.random.split(key, 32))
 
     def qrand(K_, O_, prefix=(L,)):
@@ -520,8 +530,9 @@ def device_random_params(
     params = init_fn(jax.random.PRNGKey(seed))
     # norms start at 1 like a real checkpoint
     params["rms_final"] = jnp.ones_like(params["rms_final"])
-    params["layers"]["rms_att"] = jnp.ones_like(params["layers"]["rms_att"])
-    params["layers"]["rms_ffn"] = jnp.ones_like(params["layers"]["rms_ffn"])
+    for name in ("rms_att", "rms_ffn", "rms_moe", "rms_ffn2"):
+        if name in params["layers"]:
+            params["layers"][name] = jnp.ones_like(params["layers"][name])
     return params
 
 

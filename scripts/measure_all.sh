@@ -30,7 +30,7 @@ run() { # run <name> <cmd...>: capture stdout+stderr, never abort the battery
 # 0. tunnel sanity + a guaranteed green number: TinyLlama shape is the
 #    cheapest end-to-end decode (r02's only green driver number); if the
 #    tunnel dies mid-battery, this one already banked a measurement
-CMD_TIMEOUT=900 run bench_tiny env BENCH_MODEL=tiny python bench.py
+CMD_TIMEOUT=900 run bench_tiny env BENCH_MODEL=tiny BENCH_DEADLINE_S=840 python bench.py
 # 1. headline: Llama-2-7B q40 single-chip (the vs_baseline metric)
 run bench_7b python bench.py
 # 2. the north-star model shape

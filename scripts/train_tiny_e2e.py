@@ -61,9 +61,12 @@ def build_byte_tokenizer(path: str):
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("outdir", nargs="?", default="results/train_tiny_e2e")
-    ap.add_argument("--steps", type=int, default=2000, help="max train steps")
+    ap.add_argument("--steps", type=int, default=2500, help="max train steps")
     ap.add_argument("--no-cli", action="store_true",
                     help="skip the CLI subprocess drive (in-process check only)")
+    ap.add_argument("--serve-only", action="store_true",
+                    help="skip training; serve an existing outdir/tiny.m "
+                         "(e.g. re-drive a CPU-trained model on the TPU)")
     args = ap.parse_args(argv)
     os.makedirs(args.outdir, exist_ok=True)
 
@@ -101,9 +104,11 @@ def main(argv=None) -> int:
 
     # Training batches: every T-token window over the wrapped corpus, PLUS a
     # BOS-anchored variant of each (generation feeds BOS + prompt, so BOS
-    # must be in-distribution; windows start at every offset, so the model
-    # learns from relative context, not absolute positions).
-    T = 128
+    # must be in-distribution; windows start at every offset, so position
+    # can't identify corpus location). T bounds the TRAINED rope positions:
+    # generation must stay within prompt+steps <= T or the rollout walks
+    # into positions the model has never seen.
+    T = 192
     stream = corpus_ids * (2 + (T * 8) // len(corpus_ids))
     windows = []
     for start in range(0, len(corpus_ids)):
@@ -114,49 +119,67 @@ def main(argv=None) -> int:
     data = np.asarray(windows, dtype=np.int32)
     print(f"train windows: {data.shape}")
 
-    params = llama.random_params(cfg, seed=0)
-    opt = optax.adamw(optax.warmup_cosine_decay_schedule(
-        0.0, 3e-3, 50, args.steps, 3e-4), weight_decay=0.01)
-    opt_state = opt.init(params)
-    step = jax.jit(make_train_step(cfg, opt), donate_argnums=(0, 1))
+    final_loss, train_s = None, 0.0
+    if args.serve_only:
+        if not os.path.exists(m_path):
+            print(f"--serve-only but {m_path} does not exist")
+            return 2
+        print(f"serve-only: reusing {m_path}")
+    else:
+        params = llama.random_params(cfg, seed=0)
+        opt = optax.adamw(optax.warmup_cosine_decay_schedule(
+            0.0, 3e-3, 50, args.steps, 3e-4), weight_decay=0.01)
+        opt_state = opt.init(params)
+        step = jax.jit(make_train_step(cfg, opt), donate_argnums=(0, 1))
 
-    rng = np.random.default_rng(0)
-    B = 8
-    t0 = time.perf_counter()
-    loss = float("nan")
-    for i in range(args.steps):
-        batch = data[rng.integers(0, len(data), B)]
-        params, opt_state, loss = step(params, opt_state, batch)
-        if i % 100 == 0 or i == args.steps - 1:
-            print(f"step {i:4d}  loss {float(loss):.4f}")
-        if float(loss) < 0.012:
-            print(f"step {i:4d}  loss {float(loss):.4f} — memorized, stopping")
-            break
-    train_s = time.perf_counter() - t0
-    final_loss = float(loss)
+        rng = np.random.default_rng(0)
+        B = 8
+        t0 = time.perf_counter()
+        loss = float("nan")
+        for i in range(args.steps):
+            batch = data[rng.integers(0, len(data), B)]
+            params, opt_state, loss = step(params, opt_state, batch)
+            # sync with the device at most every 50 steps: float(loss) blocks
+            # on the step; a per-step host round trip serializes the loop
+            if i % 50 == 0 or i == args.steps - 1:
+                cur = float(loss)
+                if i % 100 == 0 or i == args.steps - 1:
+                    print(f"step {i:4d}  loss {cur:.4f}")
+                if cur < 0.012:
+                    print(f"step {i:4d}  loss {cur:.4f} — memorized, stopping")
+                    break
+        train_s = time.perf_counter() - t0
+        final_loss = float(loss)
 
-    # ---- write the trained weights through the real .m writer as Q40 ----
-    params = jax.device_get(params)
-    tensors = {"token_embedding": np.asarray(params["embedding"], np.float32),
-               "rms_final": np.asarray(params["rms_final"], np.float32),
-               "wcls": np.asarray(params["wcls"], np.float32).T}
-    for i in range(spec.n_layers):
-        for name in ("wq", "wk", "wv", "wo", "w1", "w2", "w3"):
-            tensors[f"layers.{i}.{name}"] = np.asarray(
-                params["layers"][name][i], np.float32).T
-        for name in ("rms_att", "rms_ffn"):
-            tensors[f"layers.{i}.{name}"] = np.asarray(
-                params["layers"][name][i], np.float32)
-    write_model(m_path, spec, {e.name: tensors[e.name].reshape(-1)
-                               for e in tensor_plan(spec)})
-    print(f"wrote {m_path} ({os.path.getsize(m_path) / 1e6:.1f} MB q40)")
+        # ---- write the trained weights through the real .m writer (Q40) ----
+        params = jax.device_get(params)
+        tensors = {"token_embedding": np.asarray(params["embedding"], np.float32),
+                   "rms_final": np.asarray(params["rms_final"], np.float32),
+                   "wcls": np.asarray(params["wcls"], np.float32).T}
+        for i in range(spec.n_layers):
+            for name in ("wq", "wk", "wv", "wo", "w1", "w2", "w3"):
+                tensors[f"layers.{i}.{name}"] = np.asarray(
+                    params["layers"][name][i], np.float32).T
+            for name in ("rms_att", "rms_ffn"):
+                tensors[f"layers.{i}.{name}"] = np.asarray(
+                    params["layers"][name][i], np.float32)
+        write_model(m_path, spec, {e.name: tensors[e.name].reshape(-1)
+                                   for e in tensor_plan(spec)})
+        print(f"wrote {m_path} ({os.path.getsize(m_path) / 1e6:.1f} MB q40)")
+        # f32 twin for quantization-noise diagnosis (same tensors, F32 file)
+        import dataclasses as _dc
+        spec_f32 = _dc.replace(spec, weights_float_type=blocks.F32,
+                               header_size=0)
+        write_model(m_path.replace(".m", "_f32.m"), spec_f32,
+                    {e.name: tensors[e.name].reshape(-1)
+                     for e in tensor_plan(spec_f32)})
 
     # ---- serve it back through the quantized engine ----
     # Token-level check: the greedy continuation of a corpus prefix must be
     # the corpus suffix. encode() prepends a SentencePiece-style dummy space
     # (like the reference tokenizer), so the prompt/expected split is done on
     # TOKENS of one full-corpus encoding — never by slicing decoded chars.
-    n_prompt, n_steps = 160, 200
+    n_prompt, n_steps = 100, 85  # prompt + rollout stays within trained T
     prompt_ids = [bos] + corpus_ids[:n_prompt]  # BOS + corpus prefix
     expected_ids = corpus_ids[n_prompt:n_prompt + n_steps]
     # byte vocab: corpus_ids = [dummy-space] + one token per corpus char, so
@@ -175,11 +198,16 @@ def main(argv=None) -> int:
     toks, prefill_ms, decode_ms = engine.generate_fused(prompt_ids, steps=n_steps)
     completion = tokenizer.decode(list(toks))
     ms_tok = decode_ms / max(1, len(toks) - 1)
-    n_match = 0
-    for a, b in zip(toks, expected_ids):
-        if a != b:
-            break
-        n_match += 1
+
+    def prefix_match(got, want) -> int:
+        n = 0
+        for a, b in zip(got, want):
+            if a != b:
+                break
+            n += 1
+        return n
+
+    n_match = prefix_match(toks, expected_ids)
     print(f"prompt tail: ...{prompt[-40:]!r}")
     print(f"completion : {completion[:80]!r}")
     print(f"expected   : {expected[:80]!r}")
@@ -187,6 +215,18 @@ def main(argv=None) -> int:
           f" {ms_tok:.2f} ms/token ({1000.0 / ms_tok:.1f} tok/s) on"
           f" {jax.devices()[0].platform}")
     in_process_ok = n_match >= int(0.95 * len(expected_ids))
+
+    f32_path = m_path.replace(".m", "_f32.m")
+    if not in_process_ok and os.path.exists(f32_path):
+        # q40 noise or underfit? The f32 twin answers.
+        with WeightFileReader(f32_path) as r32:
+            p32 = llama.params_from_reader(r32, ModelConfig.from_spec(r32.spec))
+        e32 = Engine(cfg, p32, SamplerConfig(temperature=0.0))
+        t32, _, _ = e32.generate_fused(prompt_ids, steps=n_steps)
+        m32 = prefix_match(t32, expected_ids)
+        print(f"f32 twin match: {m32}/{len(expected_ids)} tokens — "
+              + ("quantization noise is the gap" if m32 > n_match + 10
+                 else "underfit, not quantization"))
 
     # ---- and through the actual CLI, as a user would ----
     cli_ok, cli_out = None, ""
@@ -204,7 +244,10 @@ def main(argv=None) -> int:
              "--temperature", "0"],
             capture_output=True, text=True, env=env, cwd=REPO, timeout=1200)
         cli_out = proc.stdout
-        cli_ok = proc.returncode == 0 and expected[:120] in cli_out
+        # same 95% tolerance as the in-process gate: require the expected
+        # prefix, not the whole continuation verbatim
+        cli_ok = (proc.returncode == 0
+                  and expected[:int(0.95 * len(expected))] in cli_out)
         print(f"CLI generate: rc={proc.returncode} match={cli_ok}")
         if not cli_ok:
             print(proc.stdout[-1500:])
@@ -215,7 +258,7 @@ def main(argv=None) -> int:
         "model_bytes": os.path.getsize(m_path),
         "platform": jax.devices()[0].platform,
         "decode_ms_per_token": round(ms_tok, 3),
-        "match_chars": len(match), "expected_chars": len(expected),
+        "match_tokens": n_match, "expected_tokens": len(expected_ids),
         "in_process_ok": bool(in_process_ok), "cli_ok": cli_ok,
     }
     with open(os.path.join(args.outdir, "e2e_result.json"), "w") as f:
