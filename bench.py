@@ -337,6 +337,10 @@ def main() -> None:
     if fallback_reason is not None:
         # a fallback number must never read as a green headline run
         result["error"] = f"7B CONFIG FAILED, fallback metric only: {fallback_reason}"
+    if deadline_s > 0:
+        # a run finishing near the deadline must not emit a second (error)
+        # JSON record during teardown — the success line below is final
+        timer.cancel()
     print(json.dumps(result), flush=True)
 
 

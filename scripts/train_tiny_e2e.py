@@ -86,6 +86,7 @@ def main(argv=None) -> int:
 
     t_path = os.path.join(args.outdir, "tiny.t")
     m_path = os.path.join(args.outdir, "tiny.m")
+    f32_path = os.path.join(args.outdir, "tiny_f32.m")
     build_byte_tokenizer(t_path)
     tokenizer = Tokenizer(read_tokenizer(t_path))
 
@@ -170,7 +171,7 @@ def main(argv=None) -> int:
         import dataclasses as _dc
         spec_f32 = _dc.replace(spec, weights_float_type=blocks.F32,
                                header_size=0)
-        write_model(m_path.replace(".m", "_f32.m"), spec_f32,
+        write_model(f32_path, spec_f32,
                     {e.name: tensors[e.name].reshape(-1)
                      for e in tensor_plan(spec_f32)})
 
@@ -216,7 +217,6 @@ def main(argv=None) -> int:
           f" {jax.devices()[0].platform}")
     in_process_ok = n_match >= int(0.95 * len(expected_ids))
 
-    f32_path = m_path.replace(".m", "_f32.m")
     if not in_process_ok and os.path.exists(f32_path):
         # q40 noise or underfit? The f32 twin answers.
         with WeightFileReader(f32_path) as r32:
