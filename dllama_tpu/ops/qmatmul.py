@@ -32,6 +32,7 @@ Kernels run on TPU via Mosaic and anywhere else via ``interpret=True``
 from __future__ import annotations
 
 import functools
+import os
 from dataclasses import dataclass, field
 
 import jax
@@ -41,6 +42,16 @@ import numpy as np
 from dllama_tpu.quants import blocks
 
 QK = blocks.QK  # 32 values per quantization block
+
+#: q40 "no-subtract" dequant: the kernel drops the ``- 8`` nibble recentering
+#: (the VPU op the dequant is bound on) and the caller subtracts the exact
+#: correction ``8 * sum_blocks blocksum(x) * delta`` via two small MXU dots
+#: against the scale planes. Measured on v5e (scripts/qkernel_experiments.py,
+#: K=4096 O=11008): 537 GB/s effective vs 380 GB/s for the subtracting
+#: kernel, at ~2x the (still block-quantization-sized) rounding error —
+#: 7.6e-3 vs 3.7e-3 max-rel, both well inside the 2e-2 the q40 format itself
+#: implies. Opt out with DLLAMA_Q40_NOSUB=0 for the bit-conservative kernel.
+Q40_NOSUB = os.environ.get("DLLAMA_Q40_NOSUB", "1") != "0"
 
 
 def _interpret_default() -> bool:
@@ -245,7 +256,7 @@ def q80_matmul_stacked(x: jnp.ndarray, w: jnp.ndarray, scales: jnp.ndarray,
 # Q40: packed nibbles, two scale planes (even/odd 32-blocks)
 # ---------------------------------------------------------------------------
 
-def _q40_kernel(*refs, acc_dtype, stacked=False):
+def _q40_kernel(*refs, acc_dtype, stacked=False, nosub=False):
     from jax.experimental import pallas as pl
 
     if stacked:  # scalar-prefetch layout: leading layer axis, idx_ref first
@@ -261,8 +272,13 @@ def _q40_kernel(*refs, acc_dtype, stacked=False):
 
     pk = pk8.astype(jnp.int32)  # [bk/2, bo]
     hk, bo = pk.shape
-    lo = (pk & 0xF).astype(jnp.float32) - 8.0
-    hi = ((pk >> 4) & 0xF).astype(jnp.float32) - 8.0
+    # nosub drops the nibble recentering (the binding VPU op); the caller
+    # subtracts the exact 8 * blocksum(x) * delta correction outside
+    lo = (pk & 0xF).astype(jnp.float32)
+    hi = ((pk >> 4) & 0xF).astype(jnp.float32)
+    if not nosub:
+        lo = lo - 8.0
+        hi = hi - 8.0
     nsb = slo.shape[0]  # bk/64 superblocks in this tile
     s_lo = jnp.reshape(
         jnp.broadcast_to(slo[:, None, :], (nsb, QK, bo)), (hk, bo)
@@ -276,15 +292,95 @@ def _q40_kernel(*refs, acc_dtype, stacked=False):
     o_ref[...] += jnp.dot(xhi_ref[...], w_hi, preferred_element_type=acc_dtype)
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
+def _q40_corr_kernel(*refs):
+    """8 * (blocksums(x) @ scale planes) — the exact recentering term the
+    nosub kernel omits. Tiny MXU dots (contraction dim = K/64); the scale
+    planes are re-read from HBM (+~20% of the q40 bytes), a trade the VPU
+    savings win back several times over (see Q40_NOSUB)."""
+    if len(refs) == 6:  # stacked: scalar-prefetch layer index first
+        _idx_ref, xslo_ref, xshi_ref, slo_ref, shi_ref, o_ref = refs
+        slo, shi = slo_ref[0], shi_ref[0]
+    else:
+        xslo_ref, xshi_ref, slo_ref, shi_ref, o_ref = refs
+        slo, shi = slo_ref[...], shi_ref[...]
+    o_ref[...] = 8.0 * (
+        jnp.dot(xslo_ref[...], slo, preferred_element_type=jnp.float32)
+        + jnp.dot(xshi_ref[...], shi, preferred_element_type=jnp.float32)
+    )
+
+
+def _q40_block_sums(xp: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Per-32-block activation sums, split into the even/odd planes matching
+    the packed nibble layout (even 32-block = low nibble / s plane, odd =
+    high nibble / s2 plane). xp is the padded [T, K] activation."""
+    T, K = xp.shape
+    xs = xp.astype(jnp.float32).reshape(T, K // QK, QK).sum(-1)
+    return xs[:, 0::2], xs[:, 1::2]  # each [T, K/64]
+
+
+def _q40_correction(xp, s_lo, s_hi, layer=None, interpret=False):
+    """Run the correction kernel. ``s_lo/s_hi`` are [K/64, O] (or stacked
+    [L, K/64, O] with a traced ``layer``); returns [T, O] f32. A Pallas
+    kernel — not two jnp dots — so the stacked case steers the layer choice
+    through the scalar-prefetched index_map instead of materializing a
+    dynamic-slice of the scale planes every scan step."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    xs_lo, xs_hi = _q40_block_sums(xp)
+    T, NS = xs_lo.shape
+    O = s_lo.shape[-1]
+    bo = O if O < 128 else min(1024, _pad_up(O, 128))
+    bt = min(T, T_BLOCK)
+    params = pltpu.CompilerParams(
+        dimension_semantics=("parallel", "parallel"))
+    if layer is None:
+        return pl.pallas_call(
+            _q40_corr_kernel,
+            grid=(pl.cdiv(T, bt), pl.cdiv(O, bo)),
+            in_specs=[
+                pl.BlockSpec((bt, NS), lambda t_, o: (t_, 0)),
+                pl.BlockSpec((bt, NS), lambda t_, o: (t_, 0)),
+                pl.BlockSpec((NS, bo), lambda t_, o: (0, o)),
+                pl.BlockSpec((NS, bo), lambda t_, o: (0, o)),
+            ],
+            out_specs=pl.BlockSpec((bt, bo), lambda t_, o: (t_, o)),
+            out_shape=jax.ShapeDtypeStruct((T, O), jnp.float32),
+            compiler_params=params,
+            interpret=interpret,
+        )(xs_lo, xs_hi, s_lo, s_hi)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(pl.cdiv(T, bt), pl.cdiv(O, bo)),
+        in_specs=[
+            pl.BlockSpec((bt, NS), lambda t_, o, idx: (t_, 0)),
+            pl.BlockSpec((bt, NS), lambda t_, o, idx: (t_, 0)),
+            pl.BlockSpec((1, NS, bo), lambda t_, o, idx: (idx[0], 0, o)),
+            pl.BlockSpec((1, NS, bo), lambda t_, o, idx: (idx[0], 0, o)),
+        ],
+        out_specs=pl.BlockSpec((bt, bo), lambda t_, o, idx: (t_, o)),
+    )
+    return pl.pallas_call(
+        _q40_corr_kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((T, O), jnp.float32),
+        compiler_params=params,
+        interpret=interpret,
+    )(jnp.asarray(layer, jnp.int32).reshape(1), xs_lo, xs_hi, s_lo, s_hi)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret", "nosub"))
 def q40_matmul(x: jnp.ndarray, packed: jnp.ndarray, s_lo: jnp.ndarray,
-               s_hi: jnp.ndarray, interpret: bool | None = None) -> jnp.ndarray:
+               s_hi: jnp.ndarray, interpret: bool | None = None,
+               nosub: bool | None = None) -> jnp.ndarray:
     """``x [T, K] @ dequant(packed uint8 [K/2, O]) -> [T, O]`` f32."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     if interpret is None:
         interpret = _interpret_default()
+    if nosub is None:
+        nosub = Q40_NOSUB
     O = packed.shape[1]
     K = packed.shape[0] * 2  # the *packed* (padded) input dim
     xp, t = _pad_rows(_pad_cols(x.astype(jnp.bfloat16), K))
@@ -296,7 +392,7 @@ def q40_matmul(x: jnp.ndarray, packed: jnp.ndarray, s_lo: jnp.ndarray,
     bk, bo = tile_plan("q40", K, O)
     bt = min(T, T_BLOCK)
     out = pl.pallas_call(
-        functools.partial(_q40_kernel, acc_dtype=jnp.float32),
+        functools.partial(_q40_kernel, acc_dtype=jnp.float32, nosub=nosub),
         grid=(pl.cdiv(T, bt), pl.cdiv(O, bo), K // bk),
         in_specs=[
             pl.BlockSpec((bt, bk // 2), lambda t_, o, k: (t_, k)),
@@ -312,13 +408,16 @@ def q40_matmul(x: jnp.ndarray, packed: jnp.ndarray, s_lo: jnp.ndarray,
         ),
         interpret=interpret,
     )(x_lo, x_hi, packed, s_lo, s_hi)
+    if nosub:
+        out = out - _q40_correction(xp, s_lo, s_hi, interpret=interpret)
     return out[:t]
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
+@functools.partial(jax.jit, static_argnames=("interpret", "nosub"))
 def q40_matmul_stacked(x: jnp.ndarray, packed: jnp.ndarray, s_lo: jnp.ndarray,
                        s_hi: jnp.ndarray, layer: jnp.ndarray,
-                       interpret: bool | None = None) -> jnp.ndarray:
+                       interpret: bool | None = None,
+                       nosub: bool | None = None) -> jnp.ndarray:
     """Layer-indexed q40 matmul over STACKED planes ``packed uint8 [L, K/2,
     O]`` with a traced ``layer`` — see ``q80_matmul_stacked`` for why the
     layer selection must happen inside the kernel's index_map."""
@@ -327,6 +426,8 @@ def q40_matmul_stacked(x: jnp.ndarray, packed: jnp.ndarray, s_lo: jnp.ndarray,
 
     if interpret is None:
         interpret = _interpret_default()
+    if nosub is None:
+        nosub = Q40_NOSUB
     O = packed.shape[2]
     K = packed.shape[1] * 2
     xp, t = _pad_rows(_pad_cols(x.astype(jnp.bfloat16), K))
@@ -349,7 +450,8 @@ def q40_matmul_stacked(x: jnp.ndarray, packed: jnp.ndarray, s_lo: jnp.ndarray,
         out_specs=pl.BlockSpec((bt, bo), lambda t_, o, k, idx: (t_, o)),
     )
     out = pl.pallas_call(
-        functools.partial(_q40_kernel, acc_dtype=jnp.float32, stacked=True),
+        functools.partial(_q40_kernel, acc_dtype=jnp.float32, stacked=True,
+                          nosub=nosub),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((T, O), jnp.float32),
         compiler_params=pltpu.CompilerParams(
@@ -357,6 +459,9 @@ def q40_matmul_stacked(x: jnp.ndarray, packed: jnp.ndarray, s_lo: jnp.ndarray,
         ),
         interpret=interpret,
     )(jnp.asarray(layer, jnp.int32).reshape(1), x_lo, x_hi, packed, s_lo, s_hi)
+    if nosub:
+        out = out - _q40_correction(xp, s_lo, s_hi, layer=layer,
+                                    interpret=interpret)
     return out[:t]
 
 

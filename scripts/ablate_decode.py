@@ -52,10 +52,13 @@ def engine_variant(cfg, params, steps, fuse_quant=True):
 
 def matmuls_only(cfg, params, steps):
     """Scan of per-layer quant matmuls with data dependency, no attention."""
-    layers = params["layers"]
 
+    # layers MUST be a traced argument, not a closure capture: jit bakes
+    # captured arrays in as constants, and shipping a 7B model's 3.5 GB of
+    # quant planes as compile-time literals wedges the tunnel for minutes
+    # (observed: the r04 battery ablate timing out at 1500 s right here)
     @jax.jit
-    def run(x):
+    def run(x, layers):
         def step(x, _):
             def layer(x, lp):
                 names = [n for n in ("wqkv", "wq", "wk", "wv") if n in lp]
@@ -80,7 +83,7 @@ def matmuls_only(cfg, params, steps):
         return ys.sum()
 
     x = jnp.ones((1, cfg.dim), jnp.bfloat16)
-    dt = timed("matmuls", run, x)
+    dt = timed("matmuls", run, x, params["layers"])
     return dt * 1000.0 / steps
 
 

@@ -34,7 +34,10 @@ from dllama_tpu.ops.qmatmul import QK, QuantTensor  # noqa: E402
 
 
 def variant_a(x, qt):
-    return qmatmul.qmatmul(x, qt)
+    # pin nosub=False: A is the subtracting-kernel baseline regardless of
+    # the Q40_NOSUB production default
+    return qmatmul.q40_matmul(x.astype(jnp.bfloat16), qt.w, qt.s, qt.s2,
+                              nosub=False)
 
 
 def _q40_nosub_kernel(*refs, acc_dtype):
@@ -99,17 +102,27 @@ def variant_b(x, qt):
     return (out - corr)[:t]
 
 
+def variant_c(x, qt):
+    """The PRODUCTION no-subtract path (ops.qmatmul nosub=True): nosub
+    Pallas kernel + the Pallas correction kernel (vs B's out-of-kernel jnp
+    correction dots). This is what Q40_NOSUB=1 actually ships."""
+    return qmatmul.q40_matmul(x.astype(jnp.bfloat16), qt.w, qt.s, qt.s2,
+                              nosub=True)
+
+
 def variant_d(x, qt):
     qd = QuantTensor(w=qt.w, s=qt.s.astype(jnp.bfloat16),
                      s2=qt.s2.astype(jnp.bfloat16), kind=qt.kind,
                      k_logical=qt.k_logical)
-    return qmatmul.qmatmul(x, qd)
+    return qmatmul.q40_matmul(x.astype(jnp.bfloat16), qd.w, qd.s, qd.s2,
+                              nosub=False)
 
 
-#: (fn, scale-plane byte multiplier): A reads scales once; B reads them twice
-#: (in-kernel dequant + the out-of-kernel correction dots); D stores them
-#: bf16, halving their bytes
-VARIANTS = {"A": (variant_a, 1.0), "B": (variant_b, 2.0), "D": (variant_d, 0.5)}
+#: (fn, scale-plane byte multiplier): A reads scales once; B/C read them
+#: twice (in-kernel dequant + the correction dots); D stores them bf16,
+#: halving their bytes
+VARIANTS = {"A": (variant_a, 1.0), "B": (variant_b, 2.0),
+            "C": (variant_c, 2.0), "D": (variant_d, 0.5)}
 
 
 def nbytes_of(qt, scale_mult):
@@ -153,15 +166,68 @@ def timed(name, fn, qt, K, nbytes, n1=768, n2=1536, reps=5):
           flush=True)
 
 
+def stacked_ab(K, O, L=8, n1=96, n2=192, reps=5):
+    """A/B the LAYER-STACKED scalar-prefetch path (the decode scan's form):
+    scan over L layers calling q40_matmul_stacked with nosub False vs True.
+    This is the integration actually driving per-token decode latency —
+    the flat-variant numbers above can't see prefetch/correction-kernel
+    interactions."""
+    rng = np.random.default_rng(0)
+    qts = [qmatmul.quantize_tensor(
+        rng.standard_normal((K, O)).astype(np.float32) * 0.1, "q40",
+        to_device=False) for _ in range(L)]
+    w = jnp.asarray(np.stack([q.w for q in qts]))
+    s = jnp.asarray(np.stack([q.s for q in qts]))
+    s2 = jnp.asarray(np.stack([q.s2 for q in qts]))
+    nbytes = w.nbytes / L  # per layer-call; scales accounted via multiplier
+
+    for name, nosub in (("S-sub", False), ("S-nosub", True)):
+        # w/s/s2 are traced ARGUMENTS: closure capture would bake ~300 MB
+        # of planes into the program as constants (the ablate_decode.py
+        # tunnel-wedge bug all over again)
+        @functools.partial(jax.jit, static_argnames=("n", "nosub"))
+        def run(x, w, s, s2, n, nosub=nosub):
+            def step(carry, i):
+                y = qmatmul.q40_matmul_stacked(
+                    carry, w, s, s2, i % jnp.int32(L), nosub=nosub)[:, :K]
+                return (y * 1e-2).astype(carry.dtype), ()
+            x, _ = jax.lax.scan(step, x, jnp.arange(n, dtype=jnp.int32))
+            return jnp.sum(x.astype(jnp.float32))
+
+        x = jnp.asarray(rng.standard_normal((1, K)), jnp.bfloat16)
+
+        def go(n):
+            float(np.asarray(run(x, w, s, s2, n)))
+            ts = []
+            for _ in range(reps):
+                t0 = time.perf_counter()
+                float(np.asarray(run(x, w, s, s2, n)))
+                ts.append(time.perf_counter() - t0)
+            return statistics.median(ts)
+
+        ms = max(go(n2) - go(n1), 1e-9) * 1e3 / (n2 - n1)
+        mult = 2.0 if nosub else 1.0
+        nb = nbytes + (s.nbytes + s2.nbytes) / L * mult
+        print(f"{name}: {ms:7.4f} ms/layer-call -> {nb/(ms*1e-3)/1e9:7.1f}"
+              " GB/s", flush=True)
+
+
 if __name__ == "__main__":
     which = sys.argv[1] if len(sys.argv) > 1 else "all"
     K = int(sys.argv[2]) if len(sys.argv) > 2 else 4096
     O = int(sys.argv[3]) if len(sys.argv) > 3 else 11008
+    on_tpu = jax.default_backend() == "tpu"
+    if which in ("all", "S"):
+        if on_tpu:
+            stacked_ab(K, O)
+        else:
+            print("stacked A/B skipped: not on TPU", flush=True)
+        if which == "S":
+            sys.exit(0 if on_tpu else 1)
     qt = qmatmul.quantize_tensor(
         np.random.default_rng(0).standard_normal((K, O)).astype(np.float32) * 0.1,
         "q40")
     names = list(VARIANTS) if which == "all" else [which]
-    on_tpu = jax.default_backend() == "tpu"
     for n in names:
         fn, scale = VARIANTS[n]
         if check(n, fn, qt, K) and on_tpu:
