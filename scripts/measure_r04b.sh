@@ -1,0 +1,43 @@
+#!/bin/bash
+# Post-kernel-landing measurement battery (round 4, second pass): re-measures
+# the headline benches with the q40 no-subtract kernel as the default, the
+# kernel-variant shootout including the shipped C/stacked variants, the fixed
+# (traced-args) ablation, and the e2e drives the first battery lost to the
+# wedged tunnel. Same conventions as measure_all.sh: per-command hard
+# timeouts, every result banked separately under results/.
+#
+#   bash scripts/measure_r04b.sh [results_dir]
+set -u
+OUT=${1:-results}
+mkdir -p "$OUT"
+STAMP=$(date -u +%Y%m%dT%H%M%S)
+log() { echo "== $* ($(date -u +%H:%M:%S))" | tee -a "$OUT/measure_$STAMP.log"; }
+run() {
+  local name=$1; shift
+  log "$name: $*"
+  local T=${CMD_TIMEOUT:-1500}
+  timeout -k 30 "$T" "$@" >"$OUT/${name}_$STAMP.out" 2>&1
+  local rc=$?
+  { [ $rc -eq 124 ] || [ $rc -eq 137 ]; } && log "$name TIMED OUT after ${T}s (rc=$rc)"
+  log "$name rc=$rc"
+  tail -3 "$OUT/${name}_$STAMP.out" | tee -a "$OUT/measure_$STAMP.log"
+}
+
+# headline first: the end-to-end effect of the nosub kernel
+CMD_TIMEOUT=900 run bench_7b_nosub env BENCH_DEADLINE_S=840 python bench.py
+CMD_TIMEOUT=900 run bench_8b_nosub env BENCH_MODEL=llama3 BENCH_DEADLINE_S=840 python bench.py
+# the A/B that justifies (or reverts) the default: flat + stacked variants
+run qkernel_r04b python scripts/qkernel_experiments.py all
+# where the remaining ms go, with the traced-args fix
+run ablate_r04b python scripts/ablate_decode.py
+# kernel reference points (first battery lost this stage to the wedge)
+run kernel_bench_r04b python scripts/kernel_bench.py
+CMD_TIMEOUT=900 run bench_tiny_nosub env BENCH_MODEL=tiny BENCH_DEADLINE_S=840 python bench.py
+CMD_TIMEOUT=900 run bench_moe_nosub env BENCH_MODEL=moe BENCH_DEADLINE_S=840 python bench.py
+# native runtime end to end (exports, builds, drives dllama-native)
+run native_e2e_r04b python scripts/native_e2e.py /tmp/dllama_native_e2e_$STAMP
+# the real-trained-checkpoint artifact: train on the TPU, write a .m file,
+# serve it back through the quantized engine AND the CLI, check the text
+run train_e2e_r04b python scripts/train_tiny_e2e.py results/train_tiny_e2e_r04b
+
+log "r04b battery done — results in $OUT/"
