@@ -26,6 +26,9 @@ run() {
 # headline first: the end-to-end effect of the nosub kernel
 CMD_TIMEOUT=900 run bench_7b_nosub env BENCH_DEADLINE_S=840 python bench.py
 CMD_TIMEOUT=900 run bench_8b_nosub env BENCH_MODEL=llama3 BENCH_DEADLINE_S=840 python bench.py
+# prefill throughput (the reference prefills at full decode cost per token)
+CMD_TIMEOUT=900 run bench_7b_prefill env BENCH_PREFILL=448 BENCH_DEADLINE_S=840 python bench.py
+CMD_TIMEOUT=900 run bench_8b_prefill env BENCH_MODEL=llama3 BENCH_PREFILL=448 BENCH_DEADLINE_S=840 python bench.py
 # the A/B that justifies (or reverts) the default: flat + stacked variants
 run qkernel_r04b python scripts/qkernel_experiments.py all
 # where the remaining ms go, with the traced-args fix
