@@ -58,15 +58,23 @@ def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
-def _prefill_count() -> int:
-    """BENCH_PREFILL parsed defensively, ONCE, for every consumer: a
-    non-numeric or negative value counts as 0 (decode mode) rather than
+def _env_count(name: str) -> int:
+    """An integer env knob parsed defensively, ONCE, for every consumer: a
+    non-numeric or negative value counts as 0 (feature off) rather than
     raising — the bench's contract is to always end in one JSON line, and
-    the phase tag in main() must agree with what run_decode_bench ran."""
+    main()'s labeling must agree with what run_decode_bench actually ran."""
     try:
-        return max(0, int(os.environ.get("BENCH_PREFILL", "0") or 0))
+        return max(0, int(os.environ.get(name, "0") or 0))
     except ValueError:
         return 0
+
+
+def _prefill_count() -> int:
+    return _env_count("BENCH_PREFILL")
+
+
+def _seq_override() -> int:
+    return _env_count("BENCH_SEQ")
 
 
 def _run_probe(code: str, sentinel: str, timeout_s: int) -> tuple:
@@ -140,7 +148,18 @@ def run_decode_bench(cfg_dict: dict, bench_steps: int = None, quant_ok: bool = F
         bench_steps = int(os.environ.get("BENCH_STEPS", "0") or 0) or (
             256 if jax.default_backend() == "tpu" else 64
         )
+    # BENCH_SEQ=N overrides the context length: decode attention is a
+    # static-shape masked read of the WHOLE cache every step, so this
+    # measures long-context per-token cost directly (pair with
+    # BENCH_CACHE=f8, which halves exactly the bytes this knob adds)
+    seq = _seq_override()
+    if seq:
+        cfg_dict = dict(cfg_dict, seq_len=seq)
     cfg = ModelConfig(**cfg_dict)
+    # config tag shared by EVERY return path, so the result record always
+    # states the seq/cache configuration it was measured under
+    cfg_tag = (f"-seq{seq}" if seq else "") + (
+        "-f8cache" if os.environ.get("BENCH_CACHE") == "f8" else "")
     n_dev = len(jax.devices())
     mesh = None
     batch = int(os.environ.get("BENCH_BATCH", "0") or 0)
@@ -195,8 +214,13 @@ def run_decode_bench(cfg_dict: dict, bench_steps: int = None, quant_ok: bool = F
         toks = [int(t) for t in
                 np.random.default_rng(0).integers(1, cfg.vocab_size, pf)]
         log(f"prefill warmup ({pf} tokens, incl. compile)...")
+        # ONE cache allocated outside the timed region, CHAINED through the
+        # calls: _prefill donates its cache argument, so each call reuses
+        # the same HBM buffer in place — no per-call allocation and no
+        # cache-size-dependent zero-fill (new_cache()) inside the timing
+        cache = eng.new_cache()
         t0 = time.perf_counter()
-        logits, _ = eng.prefill(eng.new_cache(), toks)
+        logits, cache = eng.prefill(cache, toks)
         jax.block_until_ready(logits)
         log(f"warmup done in {time.perf_counter() - t0:.1f}s")
         R = 4
@@ -204,13 +228,13 @@ def run_decode_bench(cfg_dict: dict, bench_steps: int = None, quant_ok: bool = F
         for rep in range(3):
             t1 = time.perf_counter()
             for _ in range(R):
-                logits, _ = eng.prefill(eng.new_cache(), toks)
+                logits, cache = eng.prefill(cache, toks)
             jax.block_until_ready(logits)
             ms_tok = (time.perf_counter() - t1) * 1000.0 / R / pf
             times.append(ms_tok)
             log(f"rep {rep}: {ms_tok:.4f} ms/prompt-token "
                 f"({1000.0 / ms_tok:.0f} tok/s prefill)")
-        return min(times), f"{weights}-prefill{pf}"
+        return min(times), f"{weights}-prefill{pf}{cfg_tag}"
 
     # BENCH_BATCH=N measures BATCHED decode: N sequences share one weight
     # stream per step (Engine.generate_batch), so the reported value is the
@@ -232,7 +256,7 @@ def run_decode_bench(cfg_dict: dict, bench_steps: int = None, quant_ok: bool = F
             times.append(eff)
             log(f"rep {rep}: {wall_ms / emitted:.3f} ms/step over {emitted} "
                 f"steps, {eff:.3f} ms/token effective x{batch}")
-        return min(times), f"{weights}-batch{batch}"
+        return min(times), f"{weights}-batch{batch}{cfg_tag}"
 
     log(f"warmup ({bench_steps} fused steps, incl. compile)...")
     t0 = time.perf_counter()
@@ -246,7 +270,7 @@ def run_decode_bench(cfg_dict: dict, bench_steps: int = None, quant_ok: bool = F
         wall_ms = (time.perf_counter() - t1) * 1000.0
         times.append(wall_ms / bench_steps)
         log(f"rep {rep}: {wall_ms / bench_steps:.3f} ms/token ({bench_steps} tokens)")
-    return min(times), weights
+    return min(times), f"{weights}{cfg_tag}"
 
 
 def _backend_alive(timeout_s: int = 180) -> tuple:
@@ -374,8 +398,11 @@ def main() -> None:
         # a ratio against a 1.1B run would be apples-to-oranges; the prefill
         # mode compares legitimately (the reference prefills at decode cost)
         # but stays unclaimed here — the phase-tagged metric speaks for itself
+        # ... and only at the stock context length (BENCH_SEQ changes the
+        # per-token work, so the ratio would compare different jobs)
         "vs_baseline": (round(BASELINE_7B_SINGLE_NODE_MS / ms, 2)
-                        if name == "llama2_7b" and phase == "decode" else None),
+                        if name == "llama2_7b" and phase == "decode"
+                        and not _seq_override() else None),
         "baseline": "llama2-7b 1x GCP c3d-highcpu-30, 101.81 ms/token (reference README.md:88)",
         "weights": weights,
         "platform": jax.devices()[0].device_kind,

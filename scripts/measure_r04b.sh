@@ -29,6 +29,10 @@ CMD_TIMEOUT=900 run bench_8b_nosub env BENCH_MODEL=llama3 BENCH_DEADLINE_S=840 p
 # prefill throughput (the reference prefills at full decode cost per token)
 CMD_TIMEOUT=900 run bench_7b_prefill env BENCH_PREFILL=448 BENCH_DEADLINE_S=840 python bench.py
 CMD_TIMEOUT=900 run bench_8b_prefill env BENCH_MODEL=llama3 BENCH_PREFILL=448 BENCH_DEADLINE_S=840 python bench.py
+# long-context decode: full-cache masked attention at seq 4096, bf16 vs f8
+# KV (f8 halves exactly the bytes the longer context adds)
+CMD_TIMEOUT=900 run bench_7b_seq4k env BENCH_SEQ=4096 BENCH_DEADLINE_S=840 python bench.py
+CMD_TIMEOUT=900 run bench_7b_seq4k_f8 env BENCH_SEQ=4096 BENCH_CACHE=f8 BENCH_DEADLINE_S=840 python bench.py
 # the A/B that justifies (or reverts) the default: flat + stacked variants
 run qkernel_r04b python scripts/qkernel_experiments.py all
 # where the remaining ms go, with the traced-args fix
