@@ -12,8 +12,34 @@ OUT=${1:-results}
 mkdir -p "$OUT"
 STAMP=$(date -u +%Y%m%dT%H%M%S)
 log() { echo "== $* ($(date -u +%H:%M:%S))" | tee -a "$OUT/measure_$STAMP.log"; }
+
+# The relay serves ONE session and wedges for a while after a client dies
+# (the first r04 battery lost kernel_bench + native_e2e to 1500 s timeouts
+# against a wedged relay). Probe before every stage; while the probe fails,
+# wait instead of letting the stage burn its timeout doing nothing.
+probe_tunnel() {
+  timeout -k 10 150 python -c '
+import time, jax, jax.numpy as jnp
+t0 = time.time()
+jax.block_until_ready(jnp.ones((256, 256), jnp.bfloat16) @ jnp.ones((256, 256), jnp.bfloat16))
+print(f"TUNNEL_OK {time.time()-t0:.1f}s")' 2>&1 | grep -q TUNNEL_OK
+}
+wait_tunnel() { # up to ~30 min; returns nonzero if it never answers
+  local i
+  for i in $(seq 1 12); do
+    probe_tunnel && return 0
+    log "tunnel not answering (probe $i/12), waiting"
+    sleep 150
+  done
+  return 1
+}
+
 run() {
   local name=$1; shift
+  if ! wait_tunnel; then
+    log "$name SKIPPED: tunnel never answered"
+    return
+  fi
   log "$name: $*"
   local T=${CMD_TIMEOUT:-1500}
   timeout -k 30 "$T" "$@" >"$OUT/${name}_$STAMP.out" 2>&1
