@@ -24,13 +24,22 @@ t0 = time.time()
 jax.block_until_ready(jnp.ones((256, 256), jnp.bfloat16) @ jnp.ones((256, 256), jnp.bfloat16))
 print(f"TUNNEL_OK {time.time()-t0:.1f}s")' 2>&1 | grep -q TUNNEL_OK
 }
-wait_tunnel() { # up to ~30 min; returns nonzero if it never answers
+# worst case per call: 8 probes x (150 s timeout + 240 s sleep) ~= 52 min —
+# but only the FIRST stage ever pays it: once a wait exhausts, TUNNEL_DEAD
+# short-circuits every later stage so a dead tunnel can't stall the battery
+# for hours. The long inter-probe sleep also gives the single-session relay
+# a client-death-free window to recover in (each timed-out probe is itself
+# a dying client, which is what wedges the relay in the first place).
+TUNNEL_DEAD=0
+wait_tunnel() {
   local i
-  for i in $(seq 1 12); do
+  [ "$TUNNEL_DEAD" = 1 ] && return 1
+  for i in $(seq 1 8); do
     probe_tunnel && return 0
-    log "tunnel not answering (probe $i/12), waiting"
-    sleep 150
+    log "tunnel not answering (probe $i/8), waiting"
+    [ "$i" -lt 8 ] && sleep 240
   done
+  TUNNEL_DEAD=1
   return 1
 }
 
