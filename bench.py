@@ -49,6 +49,16 @@ MIXTRAL_LITE = dict(
     n_experts=8, n_active_experts=2, dtype="bfloat16",
     rope_style="half", rope_theta=1e6,  # Mixtral's half-split rotary layout
 )
+# Grok-1-shape MoE scaled to one chip (~2.7 GB q40): the reference's
+# flagship arch — x78.38 embedding / x0.577 logit scales, post-attention +
+# post-MoE norms, GELU experts, half-split rotary — at 1/8 the layer count
+# and 1/2 the width so the selected-experts decode fits a 16 GB chip.
+GROK1_LITE = dict(
+    arch="grok1", dim=3072, hidden_dim=4096, n_layers=8, n_heads=24,
+    n_kv_heads=8, vocab_size=32000, seq_len=512, head_size=128, kv_dim=1024,
+    n_experts=8, n_active_experts=2, hidden_act="gelu", dtype="bfloat16",
+    rope_style="half",
+)
 
 # reference's best published single-node Llama 2 7B avg token time (ms)
 BASELINE_7B_SINGLE_NODE_MS = 101.81
@@ -286,7 +296,7 @@ def main() -> None:
     choice = os.environ.get("BENCH_MODEL", "")
     err_phase = "prefill" if _prefill_count() else "decode"
     err_metric = {"tiny": "tinyllama_1.1b", "llama3": "llama3_8b",
-                  "moe": "mixtral_lite"}.get(
+                  "moe": "mixtral_lite", "grok": "grok1_lite"}.get(
         choice, "llama2_7b") + f"_{err_phase}_ms_per_token"
 
     # In-process deadline from PROCESS START (probes included): the probes
@@ -367,6 +377,8 @@ def main() -> None:
         name, cfg_dict = "llama3_8b", LLAMA3_8B
     elif choice == "moe":
         name, cfg_dict = "mixtral_lite", MIXTRAL_LITE
+    elif choice == "grok":
+        name, cfg_dict = "grok1_lite", GROK1_LITE
     else:
         name, cfg_dict = "llama2_7b", LLAMA2_7B
 

@@ -47,6 +47,26 @@ class ModelConfig:
     norm_eps: float = 1e-5
     dtype: str = "float32"
 
+    def __post_init__(self):
+        # Arch-implied semantics for DIRECTLY constructed configs: the Grok
+        # scalings, post-norms and the half-split rotary ARE the arch
+        # (`/root/reference/src/grok1-tasks.cpp`; from_spec hard-derives all
+        # of them from arch alone), and a grok1/mixtral left at the generic
+        # field defaults would silently run llama math. The generic defaults
+        # are therefore not expressible for these arches — by design, they
+        # are never correct for them. hidden_act is NOT derived: it is an
+        # independent file-header field (formats.spec.HiddenAct) that a
+        # grok1 checkpoint can legitimately set to silu.
+        if self.arch in ("grok1", "mixtral") and self.rope_style == rope_ops.INTERLEAVED:
+            object.__setattr__(self, "rope_style", rope_ops.HALF)
+        if self.arch == "grok1":
+            if self.embedding_scale == 1.0:
+                object.__setattr__(self, "embedding_scale", GROK_EMBEDDING_SCALE)
+            if self.logit_scale == 1.0:
+                object.__setattr__(self, "logit_scale", GROK_LOGIT_SCALE)
+            if not self.post_norms:
+                object.__setattr__(self, "post_norms", True)
+
     @property
     def jax_dtype(self):
         return jnp.dtype(self.dtype)

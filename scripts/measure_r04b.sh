@@ -76,6 +76,8 @@ run ablate_r04b python scripts/ablate_decode.py
 run kernel_bench_r04b python scripts/kernel_bench.py
 CMD_TIMEOUT=900 run bench_tiny_nosub env BENCH_MODEL=tiny BENCH_DEADLINE_S=840 python bench.py
 CMD_TIMEOUT=900 run bench_moe_nosub env BENCH_MODEL=moe BENCH_DEADLINE_S=840 python bench.py
+# Grok-1-shape MoE (the reference's flagship arch: scales, post-norms, GELU)
+CMD_TIMEOUT=900 run bench_grok env BENCH_MODEL=grok BENCH_DEADLINE_S=840 python bench.py
 # native runtime end to end (exports, builds, drives dllama-native)
 run native_e2e_r04b python scripts/native_e2e.py /tmp/dllama_native_e2e_$STAMP
 # the real-trained-checkpoint artifact: train on the TPU, write a .m file,

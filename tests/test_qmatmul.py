@@ -173,6 +173,46 @@ def test_kernel_exact_on_padded_k(kind, k):
     assert err <= 0.02 * np.abs(ref).max() + 1e-4, err
 
 
+@pytest.mark.parametrize("nosub", [False, True])
+def test_q40_ragged_o_tp_shard_width(nosub):
+    """EXECUTE (not just plan) the q40 kernel at a quantized-TP shard shape:
+    K=1408 (the lane-aligned pad of 11008/8=1376) x O=1376 — a ragged O
+    grid whose
+    boundary block is masked, through both the subtracting kernel and the
+    nosub path's correction kernel (whose block-sum operands use full-dim
+    minor blocks that are NOT lane-multiples at this width)."""
+    K, O = 1408, 1376
+    w = _rand((K, O), seed=21, scale=0.05)
+    x = jnp.asarray(_rand((3, K), seed=22))
+    qt = qmatmul.quantize_tensor(w, "q40")
+    out = qmatmul.q40_matmul(x.astype(jnp.bfloat16), qt.w, qt.s, qt.s2,
+                             nosub=nosub)
+    ref = np.asarray(x, np.float32) @ qmatmul.dequantize(qt)
+    err = np.abs(np.asarray(out[:, :O], np.float32) - ref).max()
+    assert err <= 0.02 * np.abs(ref).max() + 1e-4, err
+
+
+@pytest.mark.parametrize("nosub", [False, True])
+def test_q40_stacked_ragged_o_matches_flat(nosub):
+    """The stacked (scalar-prefetch) kernel + stacked correction kernel at
+    the same ragged TP-shard width must match the flat kernel per layer."""
+    K, O, L = 1408, 1376, 2
+    qts = [qmatmul.quantize_tensor(_rand((K, O), seed=30 + i, scale=0.05),
+                                   "q40", to_device=False) for i in range(L)]
+    w = jnp.asarray(np.stack([q.w for q in qts]))
+    s = jnp.asarray(np.stack([q.s for q in qts]))
+    s2 = jnp.asarray(np.stack([q.s2 for q in qts]))
+    x = jnp.asarray(_rand((1, K), seed=33), jnp.bfloat16)
+    for i in range(L):
+        got = qmatmul.q40_matmul_stacked(x, w, s, s2, jnp.int32(i),
+                                         nosub=nosub)
+        flat = qmatmul.q40_matmul(x, jnp.asarray(qts[i].w),
+                                  jnp.asarray(qts[i].s),
+                                  jnp.asarray(qts[i].s2), nosub=nosub)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(flat),
+                                   rtol=0, atol=1e-5)
+
+
 def test_matmul_any_dispatch():
     x = jnp.asarray(_rand((2, 64), seed=6))
     w = jnp.asarray(_rand((64, 128), seed=7))
