@@ -280,7 +280,16 @@ def run_decode_bench(cfg_dict: dict, bench_steps: int = None, quant_ok: bool = F
         wall_ms = (time.perf_counter() - t1) * 1000.0
         times.append(wall_ms / bench_steps)
         log(f"rep {rep}: {wall_ms / bench_steps:.3f} ms/token ({bench_steps} tokens)")
-    return min(times), f"{weights}{cfg_tag}"
+    # tag -flash ONLY when the kernel can actually engage on this run:
+    # quantized weights (the layer-scan path), a supported (T=1, seq, cache
+    # dtype) shape — otherwise a dense-path run would be labeled flash and
+    # corrupt the A/B the tag exists for
+    from dllama_tpu.ops import flash_decode
+
+    flash_on = (flash_decode.flash_enabled()
+                and weights in ("q40", "q80")
+                and flash_decode.supports(1, cfg.seq_len, cache_dtype))
+    return min(times), f"{weights}{cfg_tag}{'-flash' if flash_on else ''}"
 
 
 def _backend_alive(timeout_s: int = 180) -> tuple:

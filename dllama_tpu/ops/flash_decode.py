@@ -1,0 +1,177 @@
+"""Flash-decode attention: read ONLY the live KV-cache prefix.
+
+The dense decode path (ops.attention.gqa_attention) is a static-shape masked
+einsum — idiomatic XLA, but it streams the ENTIRE [S, kv, hd] cache from HBM
+every token and, under the layer scan, first materializes each layer's slab
+out of the stacked [L, S, kv, hd] cache (a dynamic-slice copy, the same
+failure mode the stacked qmatmul kernels eliminated for weights). At short
+context that is a few percent of decode bytes; at S=4096 the cache is
+2.1 GB/token on a 7B — comparable to the weights themselves — and almost all
+of it masked out.
+
+This kernel is the TPU-native fix (the online-softmax flash-decoding
+pattern): the caches stay in HBM (``memory_space=ANY``); a scalar-prefetched
+``[layer, n_live_blocks]`` pair steers a ``fori_loop`` whose trip count is
+the number of CACHE BLOCKS THAT ACTUALLY CONTAIN HISTORY, each iteration
+DMA-ing one [BS, hd] K and V block per kv-head into VMEM scratch and folding
+it into running (m, l, acc) online-softmax state. Bytes/token scale with
+``pos``, not ``seq_len``, and the stacked cache is read in place.
+
+Decode-only by design (T <= a few spec-verify rows): prefill stays on the
+dense path, where the causal mask is half-live anyway and the MXU is the
+bottleneck, not bandwidth.
+
+Semantics match gqa_attention exactly (same masking: query row t attends to
+cache positions <= pos + t; softmax in f32). Verified against it by
+tests/test_flash_decode.py in interpret mode; opt in on hardware with
+DLLAMA_FLASH_DECODE=1 until it is benchmark-proven (scripts/measure_r04b.sh
+ablation), then the default can flip.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+
+#: cache-block length (sequence positions per DMA). 256 divides every model
+#: seq_len the bench/CLI loads (512/1024/2048/4096/...); callers must fall
+#: back to the dense path when S % block is nonzero.
+BLOCK_S = 256
+
+
+def flash_enabled() -> bool:
+    return os.environ.get("DLLAMA_FLASH_DECODE", "0") == "1"
+
+
+def supports(T: int, S: int, cache_dtype) -> bool:
+    """Shapes/dtypes this kernel handles; anything else → dense path."""
+    return (
+        T <= 8
+        and S % BLOCK_S == 0
+        and jnp.dtype(cache_dtype) in (jnp.dtype(jnp.bfloat16), jnp.dtype(jnp.float32))
+    )
+
+
+def _kernel(idx_ref, q_ref, qpos_ref, k_hbm, v_hbm, o_ref,
+            k_buf, v_buf, k_sem, v_sem, *, block_s):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    h = pl.program_id(0)
+    layer = idx_ref[0]
+    n_blk = idx_ref[1]
+    q = q_ref[0].astype(jnp.float32)  # [Tg, hd]
+    Tg, hd = q.shape
+    qpos = qpos_ref[...]  # [Tg, 1] int32
+    scale = jax.lax.rsqrt(jnp.float32(hd))
+
+    def body(i, carry):
+        m, l, acc = carry
+        cp_k = pltpu.make_async_copy(
+            k_hbm.at[layer, pl.ds(i * block_s, block_s), h], k_buf, k_sem)
+        cp_v = pltpu.make_async_copy(
+            v_hbm.at[layer, pl.ds(i * block_s, block_s), h], v_buf, v_sem)
+        cp_k.start()
+        cp_v.start()
+        cp_k.wait()
+        k = k_buf[...].astype(jnp.float32)  # [BS, hd]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale  # [Tg, BS]
+        key_idx = i * block_s + jax.lax.broadcasted_iota(
+            jnp.int32, (Tg, block_s), 1)
+        s = jnp.where(key_idx <= qpos, s, jnp.float32(-1e30))
+        m_new = jnp.maximum(m, s.max(axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + p.sum(axis=-1, keepdims=True)
+        cp_v.wait()
+        v = v_buf[...].astype(jnp.float32)  # [BS, hd]
+        acc_new = acc * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return m_new, l_new, acc_new
+
+    init = (
+        jnp.full((Tg, 1), -1e30, jnp.float32),
+        jnp.zeros((Tg, 1), jnp.float32),
+        jnp.zeros((Tg, hd), jnp.float32),
+    )
+    m, l, acc = jax.lax.fori_loop(0, n_blk, body, init)
+    o_ref[0] = (acc / l).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def flash_decode_attention(
+    q: jnp.ndarray,        # [T, n_heads, head_size]
+    k_cache: jnp.ndarray,  # [L, S, n_kv_heads, head_size] (L=1 for unstacked)
+    v_cache: jnp.ndarray,  # same
+    pos: jnp.ndarray,      # scalar int32: sequence position of q[0]
+    layer: jnp.ndarray,    # scalar int32 selecting the cache layer
+    interpret: bool | None = None,
+) -> jnp.ndarray:
+    """Online-softmax decode attention over the live cache prefix only.
+
+    Returns [T, n_heads, head_size], numerically matching
+    ``gqa_attention(q, k_cache[layer], v_cache[layer], pos)``.
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    T, n_heads, hd = q.shape
+    L, S, n_kv, _ = k_cache.shape
+    group = n_heads // n_kv
+    assert S % BLOCK_S == 0, (S, BLOCK_S)
+
+    # rows = (t, g) pairs per kv head: row // group = query offset t
+    Tg = T * group
+    # round UP to a sublane multiple (not just floor at 8): T=5 x group=2
+    # would otherwise hand Mosaic a 10-sublane block; pad rows are
+    # discarded after
+    Tgp = max(8, -(-Tg // 8) * 8)
+    qr = q.reshape(T, n_kv, group, hd).transpose(1, 0, 2, 3).reshape(n_kv, Tg, hd)
+    if Tgp != Tg:
+        qr = jnp.pad(qr, ((0, 0), (0, Tgp - Tg), (0, 0)))
+    row_t = (jnp.arange(Tgp, dtype=jnp.int32) // group).clip(0, T - 1)
+    qpos = (pos + row_t)[:, None]  # [Tgp, 1]; pad rows clamp to a live pos
+
+    pos = jnp.asarray(pos, jnp.int32)
+    n_blk = (pos + T + BLOCK_S - 1) // BLOCK_S  # live cache blocks
+    idx = jnp.stack([jnp.asarray(layer, jnp.int32).reshape(()), n_blk])
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(n_kv,),
+        in_specs=[
+            pl.BlockSpec((1, Tgp, hd), lambda h, idx: (h, 0, 0)),
+            pl.BlockSpec((Tgp, 1), lambda h, idx: (0, 0)),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=pl.BlockSpec((1, Tgp, hd), lambda h, idx: (h, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((BLOCK_S, hd), k_cache.dtype),
+            pltpu.VMEM((BLOCK_S, hd), v_cache.dtype),
+            pltpu.SemaphoreType.DMA,
+            pltpu.SemaphoreType.DMA,
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(_kernel, block_s=BLOCK_S),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((n_kv, Tgp, hd), q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",)),
+        interpret=interpret,
+    )(idx, qr, qpos, k_cache, v_cache)
+    return (
+        out[:, :Tg]
+        .reshape(n_kv, T, group, hd)
+        .transpose(1, 0, 2, 3)
+        .reshape(T, n_heads, hd)
+    )

@@ -68,6 +68,10 @@ CMD_TIMEOUT=900 run bench_8b_prefill env BENCH_MODEL=llama3 BENCH_PREFILL=448 BE
 # KV (f8 halves exactly the bytes the longer context adds)
 CMD_TIMEOUT=900 run bench_7b_seq4k env BENCH_SEQ=4096 BENCH_DEADLINE_S=840 python bench.py
 CMD_TIMEOUT=900 run bench_7b_seq4k_f8 env BENCH_SEQ=4096 BENCH_CACHE=f8 BENCH_DEADLINE_S=840 python bench.py
+# flash-decode: live-prefix-only cache reads (ops/flash_decode.py) — the
+# seq-4k A/B is the payoff case, the stock run checks for regression
+CMD_TIMEOUT=900 run bench_7b_seq4k_flash env BENCH_SEQ=4096 DLLAMA_FLASH_DECODE=1 BENCH_DEADLINE_S=840 python bench.py
+CMD_TIMEOUT=900 run bench_7b_flash env DLLAMA_FLASH_DECODE=1 BENCH_DEADLINE_S=840 python bench.py
 # the A/B that justifies (or reverts) the default: flat + stacked variants
 run qkernel_r04b python scripts/qkernel_experiments.py all
 # where the remaining ms go, with the traced-args fix
