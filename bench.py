@@ -280,15 +280,13 @@ def run_decode_bench(cfg_dict: dict, bench_steps: int = None, quant_ok: bool = F
         wall_ms = (time.perf_counter() - t1) * 1000.0
         times.append(wall_ms / bench_steps)
         log(f"rep {rep}: {wall_ms / bench_steps:.3f} ms/token ({bench_steps} tokens)")
-    # tag -flash ONLY when the kernel can actually engage on this run:
-    # quantized weights (the layer-scan path), a supported (T=1, seq, cache
-    # dtype) shape — otherwise a dense-path run would be labeled flash and
-    # corrupt the A/B the tag exists for
+    # tag -flash ONLY when the kernel actually engaged on this run — the
+    # SAME gate the model layer uses (flash_decode.engages), so the label
+    # and the measured path can never drift apart
     from dllama_tpu.ops import flash_decode
 
-    flash_on = (flash_decode.flash_enabled()
-                and weights in ("q40", "q80")
-                and flash_decode.supports(1, cfg.seq_len, cache_dtype))
+    flash_on = flash_decode.engages(
+        weights in ("q40", "q80"), 1, cfg.seq_len, cache_dtype)
     return min(times), f"{weights}{cfg_tag}{'-flash' if flash_on else ''}"
 
 

@@ -634,7 +634,7 @@ def _attn_block(cfg: ModelConfig, lp: dict, rope: dict, x, k_cache, v_cache, pos
         v_cache = jax.lax.dynamic_update_slice_in_dim(
             v_cache, v.astype(v_cache.dtype), pos, axis=0)
         k_slab, v_slab = k_cache, v_cache
-        use_flash, kc4, lay = False, None, None
+        out = gqa_attention(q, k_slab, v_slab, pos)
     else:
         zero = jnp.int32(0)
         k_cache = jax.lax.dynamic_update_slice(
@@ -644,18 +644,15 @@ def _attn_block(cfg: ModelConfig, lp: dict, rope: dict, x, k_cache, v_cache, pos
         # DLLAMA_FLASH_DECODE=1: online-softmax kernel reading ONLY the live
         # cache prefix, straight from the stacked [L, S, kv, hd] cache — no
         # per-layer slab materialization, bytes scale with pos not seq_len
-        # (ops.flash_decode; opt-in until benchmark-proven on hardware)
-        use_flash = (flash_decode.flash_enabled()
-                     and flash_decode.supports(T, k_cache.shape[1], k_cache.dtype))
-        kc4, lay = (k_cache, v_cache), layer
-        if not use_flash:
+        # (ops.flash_decode; opt-in until benchmark-proven on hardware).
+        # weights_quantized=True by construction: only the quantized engine
+        # reaches this layer-scan branch.
+        if flash_decode.engages(True, T, k_cache.shape[1], k_cache.dtype):
+            out = flash_decode.flash_decode_attention(q, k_cache, v_cache, pos, layer)
+        else:
             k_slab = jax.lax.dynamic_index_in_dim(k_cache, layer, 0, keepdims=False)
             v_slab = jax.lax.dynamic_index_in_dim(v_cache, layer, 0, keepdims=False)
-
-    if use_flash:
-        out = flash_decode.flash_decode_attention(q, kc4[0], kc4[1], pos, lay)
-    else:
-        out = gqa_attention(q, k_slab, v_slab, pos)
+            out = gqa_attention(q, k_slab, v_slab, pos)
     out = _gather(out.reshape(T, -1), tp_axis, tp_compress)  # local heads -> full
     return _gather(matmul_any(out, lp["wo"], layer), tp_axis, tp_compress), k_cache, v_cache
 

@@ -47,12 +47,26 @@ def flash_enabled() -> bool:
 
 
 def supports(T: int, S: int, cache_dtype) -> bool:
-    """Shapes/dtypes this kernel handles; anything else → dense path."""
+    """Shapes/dtypes this kernel handles; anything else → dense path.
+
+    T covers plain decode (1) through spec-verify batches (draft_len+1 = 9
+    at the default draft_len=8) with margin; row padding rounds T*group up
+    to a sublane multiple either way. f8 caches stay dense until the
+    Mosaic f8 conversion path is hardware-validated."""
     return (
-        T <= 8
+        T <= 16
         and S % BLOCK_S == 0
         and jnp.dtype(cache_dtype) in (jnp.dtype(jnp.bfloat16), jnp.dtype(jnp.float32))
     )
+
+
+def engages(weights_quantized: bool, T: int, S: int, cache_dtype) -> bool:
+    """THE single gate for whether decode attention runs this kernel —
+    used by both the model layer and the bench's result tagging, so the
+    two can never drift. The quantized condition exists because only the
+    quantized engine takes the layer-scan (scalar-prefetch) path the
+    flash wiring lives on."""
+    return weights_quantized and flash_enabled() and supports(T, S, cache_dtype)
 
 
 def _kernel(idx_ref, q_ref, qpos_ref, k_hbm, v_hbm, o_ref,
@@ -68,16 +82,33 @@ def _kernel(idx_ref, q_ref, qpos_ref, k_hbm, v_hbm, o_ref,
     qpos = qpos_ref[...]  # [Tg, 1] int32
     scale = jax.lax.rsqrt(jnp.float32(hd))
 
+    # double-buffered: DMA for block i+1 is in flight while block i computes
+    # (k_buf/v_buf are [2, BS, hd]; per-slot semaphores)
+    def k_dma(i, slot):
+        return pltpu.make_async_copy(
+            k_hbm.at[layer, pl.ds(i * block_s, block_s), h],
+            k_buf.at[slot], k_sem.at[slot])
+
+    def v_dma(i, slot):
+        return pltpu.make_async_copy(
+            v_hbm.at[layer, pl.ds(i * block_s, block_s), h],
+            v_buf.at[slot], v_sem.at[slot])
+
+    k_dma(0, 0).start()
+    v_dma(0, 0).start()
+
     def body(i, carry):
         m, l, acc = carry
-        cp_k = pltpu.make_async_copy(
-            k_hbm.at[layer, pl.ds(i * block_s, block_s), h], k_buf, k_sem)
-        cp_v = pltpu.make_async_copy(
-            v_hbm.at[layer, pl.ds(i * block_s, block_s), h], v_buf, v_sem)
-        cp_k.start()
-        cp_v.start()
-        cp_k.wait()
-        k = k_buf[...].astype(jnp.float32)  # [BS, hd]
+        slot = jax.lax.rem(i, 2)
+        nxt = jax.lax.rem(i + 1, 2)
+
+        @pl.when(i + 1 < n_blk)
+        def _prefetch():
+            k_dma(i + 1, nxt).start()
+            v_dma(i + 1, nxt).start()
+
+        k_dma(i, slot).wait()
+        k = k_buf[slot].astype(jnp.float32)  # [BS, hd]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale  # [Tg, BS]
@@ -88,8 +119,8 @@ def _kernel(idx_ref, q_ref, qpos_ref, k_hbm, v_hbm, o_ref,
         p = jnp.exp(s - m_new)
         alpha = jnp.exp(m - m_new)
         l_new = l * alpha + p.sum(axis=-1, keepdims=True)
-        cp_v.wait()
-        v = v_buf[...].astype(jnp.float32)  # [BS, hd]
+        v_dma(i, slot).wait()
+        v = v_buf[slot].astype(jnp.float32)  # [BS, hd]
         acc_new = acc * alpha + jax.lax.dot_general(
             p, v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -155,10 +186,10 @@ def flash_decode_attention(
         ],
         out_specs=pl.BlockSpec((1, Tgp, hd), lambda h, idx: (h, 0, 0)),
         scratch_shapes=[
-            pltpu.VMEM((BLOCK_S, hd), k_cache.dtype),
-            pltpu.VMEM((BLOCK_S, hd), v_cache.dtype),
-            pltpu.SemaphoreType.DMA,
-            pltpu.SemaphoreType.DMA,
+            pltpu.VMEM((2, BLOCK_S, hd), k_cache.dtype),
+            pltpu.VMEM((2, BLOCK_S, hd), v_cache.dtype),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
         ],
     )
     out = pl.pallas_call(

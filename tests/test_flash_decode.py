@@ -87,9 +87,12 @@ def test_reads_only_live_blocks():
 def test_supports_gate():
     assert flash_decode.supports(1, 512, jnp.bfloat16)
     assert flash_decode.supports(8, 4096, jnp.float32)
-    assert not flash_decode.supports(9, 512, jnp.bfloat16)   # prefill-sized
+    assert flash_decode.supports(9, 512, jnp.bfloat16)   # default spec verify
+    assert not flash_decode.supports(17, 512, jnp.bfloat16)  # prefill-sized
     assert not flash_decode.supports(1, 500, jnp.bfloat16)   # ragged S
     assert not flash_decode.supports(1, 512, jnp.float8_e4m3fn)  # f8: dense path
+    # the single model/bench gate: quantized-path requirement composes in
+    assert not flash_decode.engages(False, 1, 512, jnp.bfloat16)
 
 
 def test_engine_decode_matches_dense_path(monkeypatch):
