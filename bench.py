@@ -206,6 +206,13 @@ def run_decode_bench(cfg_dict: dict, bench_steps: int = None, quant_ok: bool = F
                    else jnp.bfloat16)
     eng = Engine(cfg, params, SamplerConfig(temperature=0.0), cache_dtype=cache_dtype,
                  mesh=mesh, decode_chunk=bench_steps)
+    # -flash tag, computed ONCE for every decode return path from the SAME
+    # gate the model layer uses (flash_decode.engages), so the label and
+    # the measured path can never drift apart
+    from dllama_tpu.ops import flash_decode
+
+    flash_tag = "-flash" if flash_decode.engages(
+        weights in ("q40", "q80"), 1, cfg.seq_len, cache_dtype) else ""
     # Engine may have fused the projection matrices into new buffers; drop
     # this frame's reference so the unfused originals free immediately
     del params
@@ -266,7 +273,7 @@ def run_decode_bench(cfg_dict: dict, bench_steps: int = None, quant_ok: bool = F
             times.append(eff)
             log(f"rep {rep}: {wall_ms / emitted:.3f} ms/step over {emitted} "
                 f"steps, {eff:.3f} ms/token effective x{batch}")
-        return min(times), f"{weights}-batch{batch}{cfg_tag}"
+        return min(times), f"{weights}-batch{batch}{cfg_tag}{flash_tag}"
 
     log(f"warmup ({bench_steps} fused steps, incl. compile)...")
     t0 = time.perf_counter()
@@ -280,14 +287,7 @@ def run_decode_bench(cfg_dict: dict, bench_steps: int = None, quant_ok: bool = F
         wall_ms = (time.perf_counter() - t1) * 1000.0
         times.append(wall_ms / bench_steps)
         log(f"rep {rep}: {wall_ms / bench_steps:.3f} ms/token ({bench_steps} tokens)")
-    # tag -flash ONLY when the kernel actually engaged on this run — the
-    # SAME gate the model layer uses (flash_decode.engages), so the label
-    # and the measured path can never drift apart
-    from dllama_tpu.ops import flash_decode
-
-    flash_on = flash_decode.engages(
-        weights in ("q40", "q80"), 1, cfg.seq_len, cache_dtype)
-    return min(times), f"{weights}{cfg_tag}{'-flash' if flash_on else ''}"
+    return min(times), f"{weights}{cfg_tag}{flash_tag}"
 
 
 def _backend_alive(timeout_s: int = 180) -> tuple:

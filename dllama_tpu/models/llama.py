@@ -770,26 +770,37 @@ def _attn_block_batched(cfg: ModelConfig, lp: dict, rope: dict, x, k_cache,
     q = apply_rope(q, cos, sin, cfg.rope_style)
     k = apply_rope(k, cos, sin, cfg.rope_style)
 
-    if layer is None:
-        slab_k, slab_v = k_cache, v_cache
+    if (layer is not None
+            and flash_decode.engages(True, 1, k_cache.shape[2], k_cache.dtype)):
+        # flash path: scatter this step's K/V straight into the stacked
+        # [L, B, S, kv, hd] cache (no slab round-trip at all) and read each
+        # row's OWN live prefix in the kernel
+        rows = jnp.arange(B, dtype=jnp.int32)
+        k_cache = k_cache.at[layer, rows, pos].set(k.astype(k_cache.dtype))
+        v_cache = v_cache.at[layer, rows, pos].set(v.astype(v_cache.dtype))
+        out = flash_decode.flash_decode_attention_batched(
+            q, k_cache, v_cache, pos, layer)  # [B, local heads, hs]
     else:
-        slab_k = jax.lax.dynamic_index_in_dim(k_cache, layer, 0, keepdims=False)
-        slab_v = jax.lax.dynamic_index_in_dim(v_cache, layer, 0, keepdims=False)
-    write = jax.vmap(
-        lambda c, kk, p: jax.lax.dynamic_update_slice_in_dim(
-            c, kk[None].astype(c.dtype), p, axis=0))
-    slab_k = write(slab_k, k, pos)
-    slab_v = write(slab_v, v, pos)
-    if layer is None:
-        k_cache, v_cache = slab_k, slab_v
-    else:
-        zero = (0, 0, 0, 0)
-        k_cache = jax.lax.dynamic_update_slice(k_cache, slab_k[None], (layer, *zero))
-        v_cache = jax.lax.dynamic_update_slice(v_cache, slab_v[None], (layer, *zero))
+        if layer is None:
+            slab_k, slab_v = k_cache, v_cache
+        else:
+            slab_k = jax.lax.dynamic_index_in_dim(k_cache, layer, 0, keepdims=False)
+            slab_v = jax.lax.dynamic_index_in_dim(v_cache, layer, 0, keepdims=False)
+        write = jax.vmap(
+            lambda c, kk, p: jax.lax.dynamic_update_slice_in_dim(
+                c, kk[None].astype(c.dtype), p, axis=0))
+        slab_k = write(slab_k, k, pos)
+        slab_v = write(slab_v, v, pos)
+        if layer is None:
+            k_cache, v_cache = slab_k, slab_v
+        else:
+            zero = (0, 0, 0, 0)
+            k_cache = jax.lax.dynamic_update_slice(k_cache, slab_k[None], (layer, *zero))
+            v_cache = jax.lax.dynamic_update_slice(v_cache, slab_v[None], (layer, *zero))
 
-    out = jax.vmap(
-        lambda qb, ks, vs, p: gqa_attention(qb[None], ks, vs, p)[0]
-    )(q, slab_k, slab_v, pos)  # [B, local heads, hs]
+        out = jax.vmap(
+            lambda qb, ks, vs, p: gqa_attention(qb[None], ks, vs, p)[0]
+        )(q, slab_k, slab_v, pos)  # [B, local heads, hs]
     out = _gather(out.reshape(B, -1), tp_axis, tp_compress)
     return (_gather(matmul_any(out, lp["wo"], layer), tp_axis, tp_compress),
             k_cache, v_cache)

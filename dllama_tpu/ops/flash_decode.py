@@ -71,27 +71,31 @@ def engages(weights_quantized: bool, T: int, S: int, cache_dtype) -> bool:
 
 def _kernel(idx_ref, q_ref, qpos_ref, k_hbm, v_hbm, o_ref,
             k_buf, v_buf, k_sem, v_sem, *, block_s):
+    """Unified (batch, kv-head) grid program. idx_ref = [layer, n_blk[0],
+    ..., n_blk[B-1]]; caches are [L, B, S, kv, hd]; each program reads only
+    row b's live blocks for head h."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
-    h = pl.program_id(0)
+    b = pl.program_id(0)
+    h = pl.program_id(1)
     layer = idx_ref[0]
-    n_blk = idx_ref[1]
-    q = q_ref[0].astype(jnp.float32)  # [Tg, hd]
+    n_blk = idx_ref[1 + b]
+    q = q_ref[0, 0].astype(jnp.float32)  # [Tg, hd]
     Tg, hd = q.shape
-    qpos = qpos_ref[...]  # [Tg, 1] int32
+    qpos = qpos_ref[0]  # [Tg, 1] int32
     scale = jax.lax.rsqrt(jnp.float32(hd))
 
     # double-buffered: DMA for block i+1 is in flight while block i computes
     # (k_buf/v_buf are [2, BS, hd]; per-slot semaphores)
     def k_dma(i, slot):
         return pltpu.make_async_copy(
-            k_hbm.at[layer, pl.ds(i * block_s, block_s), h],
+            k_hbm.at[layer, b, pl.ds(i * block_s, block_s), h],
             k_buf.at[slot], k_sem.at[slot])
 
     def v_dma(i, slot):
         return pltpu.make_async_copy(
-            v_hbm.at[layer, pl.ds(i * block_s, block_s), h],
+            v_hbm.at[layer, b, pl.ds(i * block_s, block_s), h],
             v_buf.at[slot], v_sem.at[slot])
 
     k_dma(0, 0).start()
@@ -132,7 +136,56 @@ def _kernel(idx_ref, q_ref, qpos_ref, k_hbm, v_hbm, o_ref,
         jnp.zeros((Tg, hd), jnp.float32),
     )
     m, l, acc = jax.lax.fori_loop(0, n_blk, body, init)
-    o_ref[0] = (acc / l).astype(o_ref.dtype)
+    o_ref[0, 0] = (acc / l).astype(o_ref.dtype)
+
+
+def _launch(qr, qpos, k5, v5, n_blk, layer, interpret):
+    """qr [B, n_kv, Tgp, hd], qpos [B, Tgp, 1] i32, caches [L, B, S, kv,
+    hd], n_blk [B] i32 live-block counts -> [B, n_kv, Tgp, hd]."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, n_kv, Tgp, hd = qr.shape
+    idx = jnp.concatenate(
+        [jnp.asarray(layer, jnp.int32).reshape(1), n_blk.astype(jnp.int32)])
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(B, n_kv),
+        in_specs=[
+            pl.BlockSpec((1, 1, Tgp, hd), lambda b, h, idx: (b, h, 0, 0)),
+            pl.BlockSpec((1, Tgp, 1), lambda b, h, idx: (b, 0, 0)),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=pl.BlockSpec((1, 1, Tgp, hd), lambda b, h, idx: (b, h, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((2, BLOCK_S, hd), k5.dtype),
+            pltpu.VMEM((2, BLOCK_S, hd), v5.dtype),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+    )
+    return pl.pallas_call(
+        functools.partial(_kernel, block_s=BLOCK_S),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, n_kv, Tgp, hd), qr.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary", "arbitrary")),
+        interpret=interpret,
+    )(idx, qr, qpos, k5, v5)
+
+
+def _rows(q, n_kv, group, Tg, Tgp):
+    """[.., T, n_heads, hd] -> row layout [.., n_kv, Tgp, hd] (row = t*group+g)."""
+    lead = q.shape[:-3]
+    T, _, hd = q.shape[-3:]
+    qr = (q.reshape(*lead, T, n_kv, group, hd)
+          .swapaxes(-4, -3)
+          .reshape(*lead, n_kv, Tg, hd))
+    if Tgp != Tg:
+        pad = [(0, 0)] * (qr.ndim - 2) + [(0, Tgp - Tg), (0, 0)]
+        qr = jnp.pad(qr, pad)
+    return qr
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
@@ -149,9 +202,6 @@ def flash_decode_attention(
     Returns [T, n_heads, head_size], numerically matching
     ``gqa_attention(q, k_cache[layer], v_cache[layer], pos)``.
     """
-    from jax.experimental import pallas as pl
-    from jax.experimental.pallas import tpu as pltpu
-
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     T, n_heads, hd = q.shape
@@ -159,50 +209,50 @@ def flash_decode_attention(
     group = n_heads // n_kv
     assert S % BLOCK_S == 0, (S, BLOCK_S)
 
-    # rows = (t, g) pairs per kv head: row // group = query offset t
+    # rows = (t, g) pairs per kv head: row // group = query offset t,
+    # rounded UP to a sublane multiple (pad rows are discarded after)
     Tg = T * group
-    # round UP to a sublane multiple (not just floor at 8): T=5 x group=2
-    # would otherwise hand Mosaic a 10-sublane block; pad rows are
-    # discarded after
     Tgp = max(8, -(-Tg // 8) * 8)
-    qr = q.reshape(T, n_kv, group, hd).transpose(1, 0, 2, 3).reshape(n_kv, Tg, hd)
-    if Tgp != Tg:
-        qr = jnp.pad(qr, ((0, 0), (0, Tgp - Tg), (0, 0)))
+    qr = _rows(q, n_kv, group, Tg, Tgp)[None]  # B=1
     row_t = (jnp.arange(Tgp, dtype=jnp.int32) // group).clip(0, T - 1)
-    qpos = (pos + row_t)[:, None]  # [Tgp, 1]; pad rows clamp to a live pos
-
     pos = jnp.asarray(pos, jnp.int32)
-    n_blk = (pos + T + BLOCK_S - 1) // BLOCK_S  # live cache blocks
-    idx = jnp.stack([jnp.asarray(layer, jnp.int32).reshape(()), n_blk])
+    qpos = (pos + row_t)[None, :, None]  # [1, Tgp, 1]; pads clamp live
+    n_blk = ((pos + T + BLOCK_S - 1) // BLOCK_S).reshape(1)
 
-    grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=1,
-        grid=(n_kv,),
-        in_specs=[
-            pl.BlockSpec((1, Tgp, hd), lambda h, idx: (h, 0, 0)),
-            pl.BlockSpec((Tgp, 1), lambda h, idx: (0, 0)),
-            pl.BlockSpec(memory_space=pl.ANY),
-            pl.BlockSpec(memory_space=pl.ANY),
-        ],
-        out_specs=pl.BlockSpec((1, Tgp, hd), lambda h, idx: (h, 0, 0)),
-        scratch_shapes=[
-            pltpu.VMEM((2, BLOCK_S, hd), k_cache.dtype),
-            pltpu.VMEM((2, BLOCK_S, hd), v_cache.dtype),
-            pltpu.SemaphoreType.DMA((2,)),
-            pltpu.SemaphoreType.DMA((2,)),
-        ],
-    )
-    out = pl.pallas_call(
-        functools.partial(_kernel, block_s=BLOCK_S),
-        grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((n_kv, Tgp, hd), q.dtype),
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("arbitrary",)),
-        interpret=interpret,
-    )(idx, qr, qpos, k_cache, v_cache)
+    out = _launch(qr, qpos, k_cache[:, None], v_cache[:, None], n_blk,
+                  layer, interpret)
     return (
-        out[:, :Tg]
+        out[0, :, :Tg]
         .reshape(n_kv, T, group, hd)
         .transpose(1, 0, 2, 3)
         .reshape(T, n_heads, hd)
     )
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def flash_decode_attention_batched(
+    q: jnp.ndarray,        # [B, n_heads, head_size] — one token per sequence
+    k_cache: jnp.ndarray,  # [L, B, S, n_kv_heads, head_size]
+    v_cache: jnp.ndarray,  # same
+    pos: jnp.ndarray,      # [B] int32: each row's position
+    layer: jnp.ndarray,    # scalar int32 selecting the cache layer
+    interpret: bool | None = None,
+) -> jnp.ndarray:
+    """Batched decode: B independent sequences, each reading only ITS OWN
+    live prefix (row b stops at pos[b], not max(pos)). Matches
+    vmap(gqa_attention) over the per-row slabs."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    B, n_heads, hd = q.shape
+    L, Bc, S, n_kv, _ = k_cache.shape
+    assert B == Bc and S % BLOCK_S == 0, (B, Bc, S, BLOCK_S)
+    group = n_heads // n_kv
+    Tg = group
+    Tgp = max(8, -(-Tg // 8) * 8)
+    qr = _rows(q[:, None], n_kv, group, Tg, Tgp)  # [B, n_kv, Tgp, hd]
+    pos = jnp.asarray(pos, jnp.int32)
+    qpos = jnp.broadcast_to(pos[:, None, None], (B, Tgp, 1))
+    n_blk = (pos + 1 + BLOCK_S - 1) // BLOCK_S  # [B]
+
+    out = _launch(qr, qpos, k_cache, v_cache, n_blk, layer, interpret)
+    return out[:, :, :Tg].reshape(B, n_kv * group, hd)

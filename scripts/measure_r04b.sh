@@ -72,6 +72,9 @@ CMD_TIMEOUT=900 run bench_7b_seq4k_f8 env BENCH_SEQ=4096 BENCH_CACHE=f8 BENCH_DE
 # seq-4k A/B is the payoff case, the stock run checks for regression
 CMD_TIMEOUT=900 run bench_7b_seq4k_flash env BENCH_SEQ=4096 DLLAMA_FLASH_DECODE=1 BENCH_DEADLINE_S=840 python bench.py
 CMD_TIMEOUT=900 run bench_7b_flash env DLLAMA_FLASH_DECODE=1 BENCH_DEADLINE_S=840 python bench.py
+# batched serving at long context: per-row live-prefix reads vs full slabs
+CMD_TIMEOUT=900 run bench_7b_batch8_seq1k env BENCH_BATCH=8 BENCH_SEQ=1024 BENCH_DEADLINE_S=840 python bench.py
+CMD_TIMEOUT=900 run bench_7b_batch8_seq1k_flash env BENCH_BATCH=8 BENCH_SEQ=1024 DLLAMA_FLASH_DECODE=1 BENCH_DEADLINE_S=840 python bench.py
 # the A/B that justifies (or reverts) the default: flat + stacked variants
 run qkernel_r04b python scripts/qkernel_experiments.py all
 # where the remaining ms go, with the traced-args fix

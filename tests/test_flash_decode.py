@@ -23,7 +23,8 @@ def _mk(seed, T, S, n_heads, n_kv, hd, dtype=jnp.float32, L=1):
 
 
 @pytest.mark.parametrize("T,pos", [(1, 0), (1, 5), (1, 255), (1, 256),
-                                   (1, 300), (5, 250), (8, 0)])
+                                   (1, 300), (5, 250), (8, 0),
+                                   (9, 120), (16, 64)])
 def test_matches_dense_oracle(T, pos):
     S, n_heads, n_kv, hd = 512, 8, 4, 128
     q, k, v = _mk(1, T, S, n_heads, n_kv, hd)
@@ -136,3 +137,109 @@ def test_engine_decode_matches_dense_path(monkeypatch):
     flash = run(spy_calls=calls)
     assert calls, "flash kernel was never traced — the flag did not engage"
     assert flash == dense and len(dense) == 16
+
+
+def test_batched_matches_per_row_oracle():
+    """Each batch row must attend over exactly ITS OWN prefix — matching
+    vmap(gqa_attention) over per-row slabs, with rows at very different
+    positions (different live-block counts) in one launch."""
+    B, S, n_heads, n_kv, hd, L = 3, 1024, 8, 4, 64, 2
+    rng = np.random.default_rng(7)
+    q = jnp.asarray(rng.standard_normal((B, n_heads, hd)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((L, B, S, n_kv, hd)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((L, B, S, n_kv, hd)), jnp.float32)
+    pos = jnp.asarray([0, 300, 700], jnp.int32)
+    for layer in range(L):
+        want = jax.vmap(
+            lambda qb, ks, vs, p: gqa_attention(qb[None], ks, vs, p)[0]
+        )(q, k[layer], v[layer], pos)
+        got = flash_decode.flash_decode_attention_batched(
+            q, k, v, pos, jnp.int32(layer))
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-5, atol=2e-5)
+
+
+def test_batched_rows_ignore_other_rows_dead_blocks():
+    """NaNs beyond each row's OWN prefix (including rows with more history
+    than this one) must never leak in."""
+    B, S, n_heads, n_kv, hd = 2, 512, 4, 4, 64
+    rng = np.random.default_rng(8)
+    q = jnp.asarray(rng.standard_normal((B, n_heads, hd)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((1, B, S, n_kv, hd)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((1, B, S, n_kv, hd)), jnp.float32)
+    pos = jnp.asarray([10, 400], jnp.int32)
+    # poison row 0 beyond its single live block; row 1's history stays real
+    kn = k.at[:, 0, 256:].set(jnp.nan)
+    vn = v.at[:, 0, 256:].set(jnp.nan)
+    got = flash_decode.flash_decode_attention_batched(
+        q, kn, vn, pos, jnp.int32(0))
+    assert np.isfinite(np.asarray(got)).all()
+    want = jax.vmap(
+        lambda qb, ks, vs, p: gqa_attention(qb[None], ks, vs, p)[0]
+    )(q, k[0], v[0], pos)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_batched_engine_matches_dense_path(monkeypatch):
+    """generate_batch through a quantized engine with the flag on must emit
+    the same per-row streams as the dense path, with the batched kernel
+    spy-verified to have traced."""
+    from dllama_tpu.models import llama
+    from dllama_tpu.models.config import ModelConfig
+    from dllama_tpu.ops import flash_decode as fd
+    from dllama_tpu.runtime.generate import Engine
+    from dllama_tpu.runtime.sampler import SamplerConfig
+
+    cfg = ModelConfig(
+        arch="llama", dim=64, hidden_dim=128, n_layers=2, n_heads=4,
+        n_kv_heads=2, vocab_size=64, seq_len=512, head_size=16, kv_dim=32,
+        dtype="float32",
+    )
+    params = llama.quantize_params(llama.random_params(cfg, seed=0), "q40")
+    prompts = [[1, 5, 9], [7], [3, 3]]
+
+    def run():
+        eng = Engine(cfg, params, SamplerConfig(temperature=0.0))
+        return eng.generate_batch(prompts, steps=10)
+
+    monkeypatch.delenv("DLLAMA_FLASH_DECODE", raising=False)
+    dense = run()
+    calls = []
+    real = fd.flash_decode_attention_batched
+
+    def spy(*a, **kw):
+        calls.append(1)
+        return real(*a, **kw)
+
+    monkeypatch.setattr(fd, "flash_decode_attention_batched", spy)
+    monkeypatch.setenv("DLLAMA_FLASH_DECODE", "1")
+    flash = run()
+    assert calls, "batched flash kernel never traced"
+    assert flash == dense
+
+
+def test_spec_decode_engine_matches_with_flash(monkeypatch):
+    """generate_spec (T = draft+1 = 9 verify batches, newly admitted by the
+    T<=16 cap) with the flag on must emit exactly the dense-path stream."""
+    from dllama_tpu.models import llama
+    from dllama_tpu.models.config import ModelConfig
+    from dllama_tpu.runtime.generate import Engine
+    from dllama_tpu.runtime.sampler import SamplerConfig
+
+    cfg = ModelConfig(
+        arch="llama", dim=64, hidden_dim=128, n_layers=2, n_heads=4,
+        n_kv_heads=2, vocab_size=64, seq_len=512, head_size=16, kv_dim=32,
+        dtype="float32",
+    )
+    params = llama.quantize_params(llama.random_params(cfg, seed=0), "q40")
+
+    def run():
+        eng = Engine(cfg, params, SamplerConfig(temperature=0.0))
+        return [t for t, _ in eng.generate_spec([1, 5, 9], steps=14)]
+
+    monkeypatch.delenv("DLLAMA_FLASH_DECODE", raising=False)
+    dense = run()
+    monkeypatch.setenv("DLLAMA_FLASH_DECODE", "1")
+    flash = run()
+    assert flash == dense and len(dense) == 14
