@@ -106,10 +106,13 @@ def _run_probe(code: str, sentinel: str, timeout_s: int) -> tuple:
         return False, f"probe timed out after {timeout_s}s (TPU tunnel down?)"
     if proc.returncode == 0 and sentinel in proc.stdout:
         return True, ""
-    return False, (proc.stderr or proc.stdout).strip()[-400:]
+    # keep BOTH streams: callers distinguish "backend reachable but the
+    # kernel failed" (stdout sentinel present) from "no backend at all"
+    return False, ((proc.stdout or "") + (proc.stderr or "")).strip()[-500:]
 
 
-def _probe_quant_kernels(kind: str = "q40", timeout_s: int = 240) -> tuple:
+def _probe_quant_kernels(kind: str = "q40", timeout_s: int = 240,
+                         nosub_env: str | None = None) -> tuple:
     """Compile+run one tiny fused dequant-matmul in a subprocess.
 
     MUST run before this process touches the backend (some TPU runtimes are
@@ -117,6 +120,11 @@ def _probe_quant_kernels(kind: str = "q40", timeout_s: int = 240) -> tuple:
     could silently land on CPU and validate nothing). The child asserts it is
     actually on TPU; any other platform, error, or hang returns False and the
     bench falls back to dense bf16 — slower but it always finishes.
+
+    ``nosub_env``: force DLLAMA_Q40_NOSUB in the child, so main() can tell
+    "the nosub default's correction kernel fails on this Mosaic" apart from
+    "q40 kernels fail entirely" and fall back to the subtracting kernel
+    instead of all the way to dense bf16.
     """
     # honor the same platform override the bench itself uses: probing the TPU
     # while the bench is forced elsewhere (or vice versa) validates nothing
@@ -126,10 +134,13 @@ def _probe_quant_kernels(kind: str = "q40", timeout_s: int = 240) -> tuple:
         return False, "platform forced off-TPU"
 
     code = (
-        "import jax\n"
+        ("" if nosub_env is None else
+         f"import os; os.environ['DLLAMA_Q40_NOSUB'] = {nosub_env!r}\n")
+        + "import jax\n"
         + (f"jax.config.update('jax_platforms', {forced!r})\n" if forced else "")
         + "import jax.numpy as jnp\n"
         "assert jax.default_backend() == 'tpu', jax.default_backend()\n"
+        "print('BACKEND_TPU_OK')\n"  # reachable; later failures are kernel-level
         "from dllama_tpu.ops import qmatmul\n"
         f"qt = qmatmul.quantize_tensor(__import__('numpy').ones((128, 128), 'float32'), {kind!r})\n"
         "y = qmatmul.matmul_any(jnp.ones((1, 128), jnp.bfloat16), qt)\n"
@@ -137,6 +148,24 @@ def _probe_quant_kernels(kind: str = "q40", timeout_s: int = 240) -> tuple:
         "print('QPROBE_OK')\n"
     )
     return _run_probe(code, "QPROBE_OK", timeout_s)
+
+
+def _probe_q40_with_fallback() -> tuple:
+    """Probe the q40 kernels as configured; if the nosub DEFAULT fails at
+    the kernel level (backend demonstrably reachable — the child printed
+    BACKEND_TPU_OK — and the user did not explicitly choose a variant),
+    retry with the subtracting kernel and pin it for this process, so a
+    Mosaic rejection of the correction kernel degrades to the slower q40
+    kernel instead of all the way to dense bf16 (~3x the headline)."""
+    probed, detail = _probe_quant_kernels()
+    if (not probed and "BACKEND_TPU_OK" in detail
+            and "DLLAMA_Q40_NOSUB" not in os.environ):
+        log("nosub q40 probe failed on a live TPU; retrying with the "
+            "subtracting kernel (DLLAMA_Q40_NOSUB=0)")
+        probed, detail = _probe_quant_kernels(nosub_env="0")
+        if probed:
+            os.environ["DLLAMA_Q40_NOSUB"] = "0"  # before any dllama import
+    return probed, detail
 
 
 def run_decode_bench(cfg_dict: dict, bench_steps: int = None, quant_ok: bool = False):
@@ -213,6 +242,10 @@ def run_decode_bench(cfg_dict: dict, bench_steps: int = None, quant_ok: bool = F
 
     flash_tag = "-flash" if flash_decode.engages(
         weights in ("q40", "q80"), 1, cfg.seq_len, cache_dtype) else ""
+    # the subtracting q40 kernel (explicit opt-out OR the probe's nosub-
+    # rejection fallback) must be visible in any q40 record
+    if weights == "q40" and os.environ.get("DLLAMA_Q40_NOSUB") == "0":
+        cfg_tag += "-subkernel"
     # Engine may have fused the projection matrices into new buffers; drop
     # this frame's reference so the unfused originals free immediately
     del params
@@ -338,7 +371,7 @@ def main() -> None:
 
         jax.config.update("jax_platforms", os.environ["DLLAMA_PLATFORM"])
         quant_ok = ("BENCH_WEIGHTS" in os.environ
-                    or _probe_quant_kernels()[0])
+                    or _probe_q40_with_fallback()[0])
     else:
         # IMPORTANT: probe before anything initializes this process's
         # backend — a child spawned after the parent holds an exclusive TPU
@@ -352,7 +385,7 @@ def main() -> None:
             probed, detail = False, ""
             alive, bdetail = _backend_alive()
         else:
-            probed, detail = _probe_quant_kernels()
+            probed, detail = _probe_q40_with_fallback()
             if probed:
                 alive, bdetail = True, ""
             elif "timed out" in detail:
