@@ -106,9 +106,14 @@ def _run_probe(code: str, sentinel: str, timeout_s: int) -> tuple:
         return False, f"probe timed out after {timeout_s}s (TPU tunnel down?)"
     if proc.returncode == 0 and sentinel in proc.stdout:
         return True, ""
-    # keep BOTH streams: callers distinguish "backend reachable but the
-    # kernel failed" (stdout sentinel present) from "no backend at all"
-    return False, ((proc.stdout or "") + (proc.stderr or "")).strip()[-500:]
+    # keep BOTH streams, and keep the HEAD as well as the tail: the child's
+    # early stdout sentinel (BACKEND_TPU_OK) is how callers distinguish
+    # "backend reachable but the kernel failed" from "no backend at all",
+    # and a tail-only truncation would eat it under any long traceback
+    detail = ((proc.stdout or "") + (proc.stderr or "")).strip()
+    if len(detail) > 500:
+        detail = detail[:100] + " ... " + detail[-400:]
+    return False, detail
 
 
 def _probe_quant_kernels(kind: str = "q40", timeout_s: int = 240,
@@ -243,8 +248,11 @@ def run_decode_bench(cfg_dict: dict, bench_steps: int = None, quant_ok: bool = F
     flash_tag = "-flash" if flash_decode.engages(
         weights in ("q40", "q80"), 1, cfg.seq_len, cache_dtype) else ""
     # the subtracting q40 kernel (explicit opt-out OR the probe's nosub-
-    # rejection fallback) must be visible in any q40 record
-    if weights == "q40" and os.environ.get("DLLAMA_Q40_NOSUB") == "0":
+    # rejection fallback) must be visible in any q40 record — read the
+    # LATCHED module gate the kernels actually dispatched on, not the env
+    from dllama_tpu.ops import qmatmul as _qmatmul
+
+    if weights == "q40" and not _qmatmul.Q40_NOSUB:
         cfg_tag += "-subkernel"
     # Engine may have fused the projection matrices into new buffers; drop
     # this frame's reference so the unfused originals free immediately
