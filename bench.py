@@ -189,7 +189,7 @@ def run_decode_bench(cfg_dict: dict, bench_steps: int = None, quant_ok: bool = F
     from dllama_tpu.runtime.sampler import SamplerConfig
 
     if bench_steps is None:
-        bench_steps = int(os.environ.get("BENCH_STEPS", "0") or 0) or (
+        bench_steps = _env_count("BENCH_STEPS") or (
             256 if jax.default_backend() == "tpu" else 64
         )
     # BENCH_SEQ=N overrides the context length: decode attention is a
@@ -206,7 +206,7 @@ def run_decode_bench(cfg_dict: dict, bench_steps: int = None, quant_ok: bool = F
         "-f8cache" if os.environ.get("BENCH_CACHE") == "f8" else "")
     n_dev = len(jax.devices())
     mesh = None
-    batch = int(os.environ.get("BENCH_BATCH", "0") or 0)
+    batch = _env_count("BENCH_BATCH")
     if n_dev > 1 and cfg.n_kv_heads % n_dev == 0:
         from dllama_tpu.parallel.mesh import tp_mesh
 
@@ -242,16 +242,13 @@ def run_decode_bench(cfg_dict: dict, bench_steps: int = None, quant_ok: bool = F
                  mesh=mesh, decode_chunk=bench_steps)
     # -flash tag, computed ONCE for every decode return path from the SAME
     # gate the model layer uses (flash_decode.engages), so the label and
-    # the measured path can never drift apart
-    from dllama_tpu.ops import flash_decode
+    # the measured path can never drift apart; likewise the -subkernel tag
+    # reads the LATCHED qmatmul.Q40_NOSUB gate the kernels dispatched on
+    # (explicit opt-out OR the probe's nosub-rejection fallback)
+    from dllama_tpu.ops import flash_decode, qmatmul as _qmatmul
 
     flash_tag = "-flash" if flash_decode.engages(
         weights in ("q40", "q80"), 1, cfg.seq_len, cache_dtype) else ""
-    # the subtracting q40 kernel (explicit opt-out OR the probe's nosub-
-    # rejection fallback) must be visible in any q40 record — read the
-    # LATCHED module gate the kernels actually dispatched on, not the env
-    from dllama_tpu.ops import qmatmul as _qmatmul
-
     if weights == "q40" and not _qmatmul.Q40_NOSUB:
         cfg_tag += "-subkernel"
     # Engine may have fused the projection matrices into new buffers; drop

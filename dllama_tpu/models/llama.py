@@ -633,8 +633,7 @@ def _attn_block(cfg: ModelConfig, lp: dict, rope: dict, x, k_cache, v_cache, pos
             k_cache, k.astype(k_cache.dtype), pos, axis=0)
         v_cache = jax.lax.dynamic_update_slice_in_dim(
             v_cache, v.astype(v_cache.dtype), pos, axis=0)
-        k_slab, v_slab = k_cache, v_cache
-        out = gqa_attention(q, k_slab, v_slab, pos)
+        out = gqa_attention(q, k_cache, v_cache, pos)
     else:
         zero = jnp.int32(0)
         k_cache = jax.lax.dynamic_update_slice(
