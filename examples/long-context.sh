@@ -1,0 +1,50 @@
+#!/bin/bash
+# Long-context decode cost sweep — a capability the reference does not have
+# (its position counter is 16-bit and attention walks the full history per
+# token on CPU; SURVEY.md §5 "long-context: absent").
+#
+# Decode attention here is a static-shape masked read of the whole KV cache,
+# so per-token cost grows with the context window; this sweep prices one
+# model shape at several windows under three configurations:
+#   dense        the default path (whole-cache masked reads)
+#   f8           fp8 KV cache (half the cache bytes)
+#   flash        DLLAMA_FLASH_DECODE=1 (ops/flash_decode.py: DMA loop reads
+#                only the LIVE prefix — bytes scale with position, not
+#                window; the win grows with the window)
+#
+# Runs on the bench's synthetic-weights path, so no model files are needed.
+#
+# Usage: examples/long-context.sh [tiny|7b] [seq ...]
+set -u
+cd "$(dirname "$0")/.."
+
+MODEL=${1:-tiny}
+shift || true
+SEQS=${*:-1024 2048 4096}
+# "7b" passes through verbatim: any unrecognized BENCH_MODEL resolves to the
+# llama2_7b shape in bench.py REGARDLESS of backend (an empty value would
+# silently fall back to TinyLlama off-TPU)
+
+for SEQ in $SEQS; do
+  for MODE in dense f8 flash; do
+    case $MODE in
+      dense) ENV=() ;;
+      f8)    ENV=(BENCH_CACHE=f8) ;;
+      flash) ENV=(DLLAMA_FLASH_DECODE=1) ;;
+    esac
+    echo "== seq=$SEQ $MODE"
+    # a failed config prints its error record (or a clear no-record line if
+    # the bench died before emitting JSON) and the sweep continues
+    env BENCH_MODEL="$MODEL" BENCH_SEQ="$SEQ" ${ENV[@]+"${ENV[@]}"} python bench.py \
+      | python -c '
+import json, sys
+line = sys.stdin.readline().strip()
+if not line:
+    print("   (no record -- bench died before emitting JSON)")
+else:
+    r = json.loads(line)
+    err = "  ERROR: " + r["error"] if "error" in r else ""
+    print("   %s: %s ms/token  (%s)%s"
+          % (r.get("metric"), r.get("value"), r.get("weights"), err))'
+  done
+done
