@@ -155,6 +155,48 @@ def _probe_quant_kernels(kind: str = "q40", timeout_s: int = 240,
     return _run_probe(code, "QPROBE_OK", timeout_s)
 
 
+def _probe_flash_kernel(timeout_s: int = 240) -> None:
+    """If DLLAMA_FLASH_DECODE=1, compile+run one tiny flash-decode kernel in
+    a subprocess (with the cache dtype the bench will use) BEFORE this
+    process touches the backend. A Mosaic rejection — plausible for the f8
+    upcast path until hardware-validated — then degrades to the dense
+    attention path (flag unset, result tagged without -flash) instead of
+    killing the whole 7B bench into the TinyLlama fallback."""
+    if os.environ.get("DLLAMA_FLASH_DECODE", "0") != "1":
+        return
+    forced = os.environ.get("DLLAMA_PLATFORM")
+    if forced and forced != "tpu":
+        return  # off-TPU runs interpret mode; nothing to validate
+    cache = ("jnp.float8_e4m3fn" if os.environ.get("BENCH_CACHE") == "f8"
+             else "jnp.bfloat16")
+    code = (
+        "import jax\n"
+        + (f"jax.config.update('jax_platforms', {forced!r})\n" if forced else "")
+        + "import jax.numpy as jnp\n"
+        # a non-TPU default backend (CPU-only box, no forcing env) runs the
+        # kernel in interpret mode — nothing Mosaic-level to validate, so
+        # SKIP (keep the flag) instead of failing and popping it: identical
+        # machines must behave the same with and without DLLAMA_PLATFORM=cpu
+        "if jax.default_backend() != 'tpu':\n"
+        "    print('FLASH_OK (non-tpu backend: interpret mode)')\n"
+        "    raise SystemExit(0)\n"
+        "print('BACKEND_TPU_OK')\n"
+        "from dllama_tpu.ops import flash_decode\n"
+        "q = jnp.ones((1, 8, 128), jnp.bfloat16)\n"
+        f"k = jnp.ones((1, 512, 4, 128), {cache})\n"
+        f"v = jnp.ones((1, 512, 4, 128), {cache})\n"
+        "y = flash_decode.flash_decode_attention(\n"
+        "    q, k, v, jnp.int32(300), jnp.int32(0))\n"
+        "jax.block_until_ready(y)\n"
+        "print('FLASH_OK')\n"
+    )
+    ok, detail = _run_probe(code, "FLASH_OK", timeout_s)
+    if not ok:
+        log(f"flash-decode probe failed ({detail[:200]}); "
+            "falling back to dense attention (DLLAMA_FLASH_DECODE unset)")
+        os.environ.pop("DLLAMA_FLASH_DECODE", None)
+
+
 def _probe_q40_with_fallback() -> tuple:
     """Probe the q40 kernels as configured; if the nosub DEFAULT fails at
     the kernel level (backend demonstrably reachable — the child printed
@@ -248,7 +290,7 @@ def run_decode_bench(cfg_dict: dict, bench_steps: int = None, quant_ok: bool = F
     from dllama_tpu.ops import flash_decode, qmatmul as _qmatmul
 
     flash_tag = "-flash" if flash_decode.engages(
-        weights in ("q40", "q80"), 1, cfg.seq_len, cache_dtype) else ""
+        1, cfg.seq_len, cache_dtype) else ""
     if weights == "q40" and not _qmatmul.Q40_NOSUB:
         cfg_tag += "-subkernel"
     # Engine may have fused the projection matrices into new buffers; drop
@@ -409,6 +451,9 @@ def main() -> None:
         quant_ok = probed or "BENCH_WEIGHTS" in os.environ
     if not quant_ok and "BENCH_WEIGHTS" not in os.environ:
         log("q40 kernel probe failed/timed out; bench will use bf16 weights")
+    # after the quant probes (backend known reachable), before this process
+    # inits the backend: a flash compile failure must downgrade, not crash
+    _probe_flash_kernel()
 
     import jax
 

@@ -371,8 +371,11 @@ def export_sharded_step(cfg, params: dict, mesh, out_path: str,
     repl = NamedSharding(mesh, P())
 
     def step(params, rope, k_cache, v_cache, token, pos):
+        # allow_flash=False: dense pjit program — a Pallas call would not
+        # auto-partition (same constraint as runtime.generate's dense path)
         logits, new_cache = llama.forward(
-            cfg, params, rope, token, {"k": k_cache, "v": v_cache}, pos
+            cfg, params, rope, token, {"k": k_cache, "v": v_cache}, pos,
+            allow_flash=False,
         )
         return logits[0], new_cache["k"], new_cache["v"]
 

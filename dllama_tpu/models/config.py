@@ -38,34 +38,41 @@ class ModelConfig:
     n_active_experts: int = 0
     hidden_act: str = "silu"
     rope_theta: float = 10000.0
-    rope_style: str = rope_ops.INTERLEAVED
-    embedding_scale: float = 1.0
-    logit_scale: float = 1.0
+    # None = "derive from arch" for the four arch-implied fields below; an
+    # explicitly passed value always wins (ablation configs stay expressible)
+    rope_style: str | None = None
+    embedding_scale: float | None = None
+    logit_scale: float | None = None
     # grok1 re-normalizes after attention / moe output
     # (`/root/reference/src/grok1-tasks.cpp:16-41,244-262`)
-    post_norms: bool = False
+    post_norms: bool | None = None
     norm_eps: float = 1e-5
     dtype: str = "float32"
 
     def __post_init__(self):
-        # Arch-implied semantics for DIRECTLY constructed configs: the Grok
+        # Arch-implied semantics, resolved from None sentinels: the Grok
         # scalings, post-norms and the half-split rotary ARE the arch
         # (`/root/reference/src/grok1-tasks.cpp`; from_spec hard-derives all
-        # of them from arch alone), and a grok1/mixtral left at the generic
-        # field defaults would silently run llama math. The generic defaults
-        # are therefore not expressible for these arches — by design, they
-        # are never correct for them. hidden_act is NOT derived: it is an
-        # independent file-header field (formats.spec.HiddenAct) that a
-        # grok1 checkpoint can legitimately set to silu.
-        if self.arch in ("grok1", "mixtral") and self.rope_style == rope_ops.INTERLEAVED:
-            object.__setattr__(self, "rope_style", rope_ops.HALF)
-        if self.arch == "grok1":
-            if self.embedding_scale == 1.0:
-                object.__setattr__(self, "embedding_scale", GROK_EMBEDDING_SCALE)
-            if self.logit_scale == 1.0:
-                object.__setattr__(self, "logit_scale", GROK_LOGIT_SCALE)
-            if not self.post_norms:
-                object.__setattr__(self, "post_norms", True)
+        # of them from arch alone), so an unset field follows the arch —
+        # while an EXPLICIT value (even one equal to the generic default,
+        # e.g. grok1 with logit_scale=1.0 in an ablation) is preserved
+        # as passed. hidden_act is NOT derived: it is an independent
+        # file-header field (formats.spec.HiddenAct) that a grok1
+        # checkpoint can legitimately set to silu.
+        grok = self.arch == "grok1"
+        if self.rope_style is None:
+            object.__setattr__(
+                self, "rope_style",
+                rope_ops.HALF if self.arch in ("grok1", "mixtral")
+                else rope_ops.INTERLEAVED)
+        if self.embedding_scale is None:
+            object.__setattr__(
+                self, "embedding_scale", GROK_EMBEDDING_SCALE if grok else 1.0)
+        if self.logit_scale is None:
+            object.__setattr__(
+                self, "logit_scale", GROK_LOGIT_SCALE if grok else 1.0)
+        if self.post_norms is None:
+            object.__setattr__(self, "post_norms", grok)
 
     @property
     def jax_dtype(self):

@@ -644,9 +644,9 @@ def _attn_block(cfg: ModelConfig, lp: dict, rope: dict, x, k_cache, v_cache, pos
         # cache prefix, straight from the stacked [L, S, kv, hd] cache — no
         # per-layer slab materialization, bytes scale with pos not seq_len
         # (ops.flash_decode; opt-in until benchmark-proven on hardware).
-        # weights_quantized=True by construction: only the quantized engine
-        # reaches this layer-scan branch.
-        if flash_decode.engages(True, T, k_cache.shape[1], k_cache.dtype):
+        # Reached from BOTH engines: the quantized layer-scan and the dense
+        # index-scan forward() routes here when the gate engages.
+        if flash_decode.engages(T, k_cache.shape[1], k_cache.dtype):
             out = flash_decode.flash_decode_attention(q, k_cache, v_cache, pos, layer)
         else:
             k_slab = jax.lax.dynamic_index_in_dim(k_cache, layer, 0, keepdims=False)
@@ -666,6 +666,7 @@ def forward(
     tp_axis: str | None = None,
     gather_logits: bool = True,
     tp_compress: bool = False,
+    allow_flash: bool = True,
 ) -> tuple:
     """Process T tokens starting at ``pos``. Returns (logits [T, vocab] f32, new cache).
 
@@ -677,12 +678,24 @@ def forward(
     activations are re-gathered after each output-sharded matmul. With
     ``gather_logits=False`` the classifier is replicated (vocab not divisible
     by tp) and the final gather is skipped.
+
+    ``allow_flash=False``: the caller runs this forward under pjit with
+    sharded dense params (runtime.generate's dense-mesh path). GSPMD cannot
+    partition a Pallas custom call, so routing into the flash kernel there
+    would compile it replicated against an all-gathered cache — the caller
+    must pin the dense xs-scan instead.
     """
     x = embed(cfg, params, tokens)
     layers = params["layers"]
 
     quant_scan = any(isinstance(v, QuantTensor) for v in layers.values())
-    if quant_scan:
+    # Dense weights normally scan the layer stack as scan-xs (per-layer
+    # slabs); when flash decode engages, take the index-scan instead so the
+    # stacked KV cache rides the carry and the flash kernel reads its live
+    # prefix in place — dense weight slices still fuse into the dots (a
+    # dense dynamic-slice is fusable, unlike a Pallas operand).
+    if quant_scan or (allow_flash and flash_decode.engages(
+            tokens.shape[0], cache["k"].shape[1], cache["k"].dtype)):
         # Scan over a layer INDEX with the stacked quant planes closed over
         # as scan constants. Slicing the planes in the body (`w[idx]`) would
         # make XLA materialize a full copy of every layer's weights each
@@ -770,13 +783,17 @@ def _attn_block_batched(cfg: ModelConfig, lp: dict, rope: dict, x, k_cache,
     k = apply_rope(k, cos, sin, cfg.rope_style)
 
     if (layer is not None
-            and flash_decode.engages(True, 1, k_cache.shape[2], k_cache.dtype)):
+            and flash_decode.engages(1, k_cache.shape[2], k_cache.dtype)):
         # flash path: scatter this step's K/V straight into the stacked
         # [L, B, S, kv, hd] cache (no slab round-trip at all) and read each
-        # row's OWN live prefix in the kernel
+        # row's OWN live prefix in the kernel. The write position clamps to
+        # the last slot so a row stepped at pos >= seq_len leaves the same
+        # cache contents as the dense path's dynamic_update_slice (which
+        # clamps), instead of the scatter silently dropping the row.
         rows = jnp.arange(B, dtype=jnp.int32)
-        k_cache = k_cache.at[layer, rows, pos].set(k.astype(k_cache.dtype))
-        v_cache = v_cache.at[layer, rows, pos].set(v.astype(v_cache.dtype))
+        wpos = jnp.clip(pos, 0, k_cache.shape[2] - 1)
+        k_cache = k_cache.at[layer, rows, wpos].set(k.astype(k_cache.dtype))
+        v_cache = v_cache.at[layer, rows, wpos].set(v.astype(v_cache.dtype))
         out = flash_decode.flash_decode_attention_batched(
             q, k_cache, v_cache, pos, layer)  # [B, local heads, hs]
     else:
@@ -815,6 +832,7 @@ def forward_batched(
     tp_axis: str | None = None,
     gather_logits: bool = True,
     tp_compress: bool = False,
+    allow_flash: bool = True,
 ) -> tuple:
     """One decode step for B independent sequences -> (logits [B, vocab], cache).
 
@@ -826,11 +844,17 @@ def forward_batched(
     (greedy-tested per row); MoE routing/union selection is per-row already.
     ``tp_axis``: inside shard_map over a tp mesh (quant-TP batched serving,
     parallel.quant_tp.make_tp_forward_batched) — same gathers as ``forward``.
+    ``allow_flash=False``: caller runs under pjit with sharded dense params
+    (see ``forward``) — pin the dense xs-scan.
     """
     x = embed(cfg, params, tokens)
     layers = params["layers"]
     quant_scan = any(isinstance(v, QuantTensor) for v in layers.values())
-    if quant_scan:
+    # same routing as `forward`: dense weights take the index-scan when the
+    # batched flash kernel engages, so the stacked [L, B, S, kv, hd] cache
+    # stays in the carry and each row reads only its own live prefix
+    if quant_scan or (allow_flash and flash_decode.engages(
+            1, cache["k"].shape[2], cache["k"].dtype)):
         def layer_step(carry, idx):
             x, k_cache, v_cache = carry
             lp = {

@@ -32,6 +32,7 @@ from __future__ import annotations
 
 import functools
 import os
+import sys
 
 import jax
 import jax.numpy as jnp
@@ -51,22 +52,43 @@ def supports(T: int, S: int, cache_dtype) -> bool:
 
     T covers plain decode (1) through spec-verify batches (draft_len+1 = 9
     at the default draft_len=8) with margin; row padding rounds T*group up
-    to a sublane multiple either way. f8 caches stay dense until the
-    Mosaic f8 conversion path is hardware-validated."""
+    to a sublane multiple either way. f8 (float8_e4m3fn) caches are read
+    through the same VMEM scratch path with the f32 upcast in compute —
+    the combination long context wants (half the cache bytes AND
+    live-prefix-only reads)."""
     return (
         T <= 16
         and S % BLOCK_S == 0
-        and jnp.dtype(cache_dtype) in (jnp.dtype(jnp.bfloat16), jnp.dtype(jnp.float32))
+        and jnp.dtype(cache_dtype) in (jnp.dtype(jnp.bfloat16),
+                                       jnp.dtype(jnp.float32),
+                                       jnp.dtype(jnp.float8_e4m3fn))
     )
 
 
-def engages(weights_quantized: bool, T: int, S: int, cache_dtype) -> bool:
+#: (T, S, dtype) combinations already warned about — the fallback must be
+#: observable (ADVICE r04) but not per-trace noisy.
+_declined: set = set()
+
+
+def engages(T: int, S: int, cache_dtype) -> bool:
     """THE single gate for whether decode attention runs this kernel —
-    used by both the model layer and the bench's result tagging, so the
-    two can never drift. The quantized condition exists because only the
-    quantized engine takes the layer-scan (scalar-prefetch) path the
-    flash wiring lives on."""
-    return weights_quantized and flash_enabled() and supports(T, S, cache_dtype)
+    used by the model layers (quantized layer-scan AND dense index-scan
+    paths) and the bench's result tagging, so label and measured path can
+    never drift. When the user asked for flash but the shapes decline it,
+    say so once on stderr: a silent dense fallback under
+    DLLAMA_FLASH_DECODE=1 reads as "flash is on" otherwise."""
+    if not flash_enabled():
+        return False
+    if supports(T, S, cache_dtype):
+        return True
+    key = (T, S, jnp.dtype(cache_dtype).name)
+    if key not in _declined:
+        _declined.add(key)
+        print(f"dllama: DLLAMA_FLASH_DECODE=1 but flash decode declines "
+              f"T={T} S={S} cache={key[2]} (need T<=16, S%{BLOCK_S}==0, "
+              f"bf16/f32/f8 cache) — dense attention path used",
+              file=sys.stderr, flush=True)
+    return False
 
 
 def _kernel(idx_ref, q_ref, qpos_ref, k_hbm, v_hbm, o_ref,

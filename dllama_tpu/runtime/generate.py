@@ -137,8 +137,32 @@ class Engine:
 
             else:
                 # dense pjit: forward_batched partitions like forward (the
-                # per-row vmap'd attention shards by kv head unchanged)
+                # per-row vmap'd attention shards by kv head unchanged).
+                # allow_flash=False — GSPMD cannot partition a Pallas custom
+                # call, so routing this path into the flash kernel would
+                # compile it replicated against an all-gathered cache,
+                # destroying the TP scaling the mesh exists for; only the
+                # shard_map (quant) path may take flash under a mesh
                 self.params = _sh.shard_params(params, mesh, cfg)
+                from dllama_tpu.ops.flash_decode import flash_enabled
+
+                if flash_enabled():
+                    import sys as _sys
+
+                    print("dllama: DLLAMA_FLASH_DECODE=1 ignored on the "
+                          "dense-pjit TP path (Pallas calls don't partition "
+                          "under pjit); dense attention used — quantized "
+                          "weights take flash under TP via shard_map",
+                          file=_sys.stderr, flush=True)
+
+                def fwd(cfg_, params_, rope_, tokens_, cache_, pos_):
+                    return llama.forward(cfg_, params_, rope_, tokens_,
+                                         cache_, pos_, allow_flash=False)
+
+                def fwd_b(cfg_, params_, rope_, tokens_, cache_, pos_):
+                    return llama.forward_batched(cfg_, params_, rope_,
+                                                 tokens_, cache_, pos_,
+                                                 allow_flash=False)
             self._cache_sharding = NamedSharding(mesh, _sh.cache_spec())
             self._batch_cache_sharding = NamedSharding(
                 mesh, quant_tp.batch_cache_spec())

@@ -85,15 +85,84 @@ def test_reads_only_live_blocks():
                                rtol=2e-5, atol=2e-5)
 
 
-def test_supports_gate():
+def test_supports_gate(monkeypatch, capsys):
     assert flash_decode.supports(1, 512, jnp.bfloat16)
     assert flash_decode.supports(8, 4096, jnp.float32)
     assert flash_decode.supports(9, 512, jnp.bfloat16)   # default spec verify
+    assert flash_decode.supports(1, 4096, jnp.float8_e4m3fn)  # f8 composes
     assert not flash_decode.supports(17, 512, jnp.bfloat16)  # prefill-sized
     assert not flash_decode.supports(1, 500, jnp.bfloat16)   # ragged S
-    assert not flash_decode.supports(1, 512, jnp.float8_e4m3fn)  # f8: dense path
-    # the single model/bench gate: quantized-path requirement composes in
-    assert not flash_decode.engages(False, 1, 512, jnp.bfloat16)
+    # flag off -> never engages
+    monkeypatch.delenv("DLLAMA_FLASH_DECODE", raising=False)
+    assert not flash_decode.engages(1, 512, jnp.bfloat16)
+    # flag on + unsupported shape -> declines AND says so once (ADVICE r04)
+    monkeypatch.setenv("DLLAMA_FLASH_DECODE", "1")
+    flash_decode._declined.clear()
+    assert flash_decode.engages(1, 512, jnp.bfloat16)
+    assert not flash_decode.engages(1, 500, jnp.bfloat16)
+    assert not flash_decode.engages(1, 500, jnp.bfloat16)
+    err = capsys.readouterr().err
+    assert err.count("flash decode declines") == 1 and "S=500" in err
+
+
+def test_f8_cache_matches_oracle():
+    """f8_e4m3 cache blocks upcast in the kernel must match the dense oracle
+    reading the same f8 slabs — the long-context composition (f8 halves
+    cache bytes, flash skips dead blocks) VERDICT r04 flagged as mutually
+    exclusive."""
+    S, n_heads, n_kv, hd = 512, 8, 4, 128
+    rng = np.random.default_rng(11)
+    q = jnp.asarray(rng.standard_normal((1, n_heads, hd)), jnp.bfloat16)
+    k = jnp.asarray(rng.standard_normal((1, S, n_kv, hd)), jnp.float8_e4m3fn)
+    v = jnp.asarray(rng.standard_normal((1, S, n_kv, hd)), jnp.float8_e4m3fn)
+    for pos in (0, 255, 300):
+        want = gqa_attention(q, k[0], v[0], jnp.int32(pos))
+        got = flash_decode.flash_decode_attention(
+            q, k, v, jnp.int32(pos), jnp.int32(0))
+        np.testing.assert_allclose(np.asarray(got, np.float32),
+                                   np.asarray(want, np.float32),
+                                   rtol=3e-2, atol=3e-2)
+
+
+def test_dense_engine_engages_flash(monkeypatch):
+    """A DENSE (bf16/f32-weight) engine must also take the flash path now:
+    forward() routes dense weights through the index-scan when the gate
+    engages (VERDICT r04: dense-weight engines never used flash)."""
+    from dllama_tpu.models import llama
+    from dllama_tpu.models.config import ModelConfig
+    from dllama_tpu.ops import flash_decode as fd
+    from dllama_tpu.runtime.generate import Engine
+    from dllama_tpu.runtime.sampler import SamplerConfig
+
+    cfg = ModelConfig(
+        arch="llama", dim=64, hidden_dim=128, n_layers=2, n_heads=4,
+        n_kv_heads=2, vocab_size=64, seq_len=512, head_size=16, kv_dim=32,
+        dtype="float32",
+    )
+    params = llama.random_params(cfg, seed=0)
+
+    def run(spy_calls=None):
+        if spy_calls is not None:
+            real = fd.flash_decode_attention
+
+            def spy(*a, **kw):
+                spy_calls.append(1)
+                return real(*a, **kw)
+
+            monkeypatch.setattr(fd, "flash_decode_attention", spy)
+            monkeypatch.setattr(
+                "dllama_tpu.models.llama.flash_decode.flash_decode_attention",
+                spy)
+        eng = Engine(cfg, params, SamplerConfig(temperature=0.0))
+        return [t for t, _ in eng.generate([1, 5, 9], steps=16)]
+
+    monkeypatch.delenv("DLLAMA_FLASH_DECODE", raising=False)
+    dense = run()
+    monkeypatch.setenv("DLLAMA_FLASH_DECODE", "1")
+    calls = []
+    flash = run(spy_calls=calls)
+    assert calls, "flash never traced on the dense-weight path"
+    assert flash == dense and len(dense) == 16
 
 
 def test_engine_decode_matches_dense_path(monkeypatch):
@@ -289,3 +358,49 @@ def test_quant_tp_forward_matches_with_flash(monkeypatch):
     assert calls, "flash kernel never traced under shard_map"
     np.testing.assert_allclose(np.asarray(tp_logits), np.asarray(ref_logits),
                                rtol=1e-4, atol=1e-4)
+
+
+def test_dense_mesh_engine_declines_flash(monkeypatch, capsys):
+    """Dense weights under a pjit TP mesh must NOT route into the Pallas
+    flash kernel (GSPMD can't partition a custom call — it would compile
+    replicated against an all-gathered cache). The engine pins
+    allow_flash=False there and says so on stderr."""
+    import numpy as np
+
+    from dllama_tpu.models import llama
+    from dllama_tpu.models.config import ModelConfig
+    from dllama_tpu.ops import flash_decode as fd
+    from dllama_tpu.parallel.mesh import tp_mesh
+    from dllama_tpu.runtime.generate import Engine
+    from dllama_tpu.runtime.sampler import SamplerConfig
+
+    cfg = ModelConfig(
+        arch="llama", dim=64, hidden_dim=128, n_layers=2, n_heads=4,
+        n_kv_heads=4, vocab_size=64, seq_len=512, head_size=16, kv_dim=64,
+        dtype="float32",
+    )
+    params = llama.random_params(cfg, seed=0, dtype=np.float32)
+
+    def run():
+        eng = Engine(cfg, params, SamplerConfig(temperature=0.0),
+                     mesh=tp_mesh(4))
+        return [t for t, _ in eng.generate([1, 5], steps=6)]
+
+    monkeypatch.delenv("DLLAMA_FLASH_DECODE", raising=False)
+    want = run()
+
+    calls = []
+    real = fd.flash_decode_attention
+
+    def spy(*a, **kw):
+        calls.append(1)
+        return real(*a, **kw)
+
+    monkeypatch.setattr(fd, "flash_decode_attention", spy)
+    monkeypatch.setattr(
+        "dllama_tpu.models.llama.flash_decode.flash_decode_attention", spy)
+    monkeypatch.setenv("DLLAMA_FLASH_DECODE", "1")
+    got = run()
+    assert not calls, "flash kernel traced under the dense pjit mesh path"
+    assert got == want
+    assert "dense-pjit TP path" in capsys.readouterr().err

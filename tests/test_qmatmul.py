@@ -176,11 +176,11 @@ def test_kernel_exact_on_padded_k(kind, k):
 @pytest.mark.parametrize("nosub", [False, True])
 def test_q40_ragged_o_tp_shard_width(nosub):
     """EXECUTE (not just plan) the q40 kernel at a quantized-TP shard shape:
-    K=1408 (the lane-aligned pad of 11008/8=1376) x O=1376 — a ragged O
-    grid whose
-    boundary block is masked, through both the subtracting kernel and the
-    nosub path's correction kernel (whose block-sum operands use full-dim
-    minor blocks that are NOT lane-multiples at this width)."""
+    K=1408 (a 128-lane multiple that is NOT a K_MULTIPLE['q40']=512
+    multiple, forcing the internal 512-pad) x O=1376 — a ragged O grid
+    whose boundary block is masked, through both the subtracting kernel and
+    the nosub path's correction kernel (whose block-sum operands use
+    full-dim minor blocks that are NOT lane-multiples at this width)."""
     K, O = 1408, 1376
     w = _rand((K, O), seed=21, scale=0.05)
     x = jnp.asarray(_rand((3, K), seed=22))

@@ -265,3 +265,105 @@ def test_spec_decode_under_tp_matches_single_device():
     tp_eng = Engine(CFG, qp, SamplerConfig(temperature=0.0), mesh=tp_mesh(8))
     got = [t for t, _ in tp_eng.generate_spec([1, 2, 3], steps=16)]
     assert got == want
+
+
+# distinct sizes (dim=256, hidden' in {512,1024}, padded vocab=2048) so every
+# collective in the compiled HLO is attributable by payload size alone
+CFG_AUDIT = ModelConfig(
+    arch="llama", dim=256, hidden_dim=512, n_layers=2, n_heads=8, n_kv_heads=8,
+    vocab_size=2048, seq_len=64, head_size=32, kv_dim=256, dtype="float32",
+)
+
+
+def _collectives(txt):
+    """[(numel, dtype, op)] for every collective in compiled HLO text."""
+    import re
+
+    ops = re.findall(
+        r"=\s+(\w+)\[([^\]]*)\][^\n]*?\b"
+        r"(all-reduce|all-gather|reduce-scatter|all-to-all|collective-permute)\(",
+        txt,
+    )
+    out = []
+    for dtype, dims, op in ops:
+        ns = [int(d) for d in dims.split(",") if d.strip().isdigit()]
+        out.append((int(np.prod(ns)) if ns else 1, dtype, op))
+    return out
+
+
+def _decode_step_hlo(eng):
+    """Compiled HLO text of one engine decode step (T=1, greedy params)."""
+    cache = eng.new_cache()
+    return eng._decode_step.func.lower(
+        eng.params, eng.rope, cache, jnp.asarray(3, jnp.int32), jnp.int32(0),
+        jax.random.PRNGKey(0), jnp.float32(0.0), jnp.float32(0.9),
+    ).compile().as_text()
+
+
+def _padded_vocab(cfg, tp):
+    from dllama_tpu.ops.qmatmul import _pad_up
+
+    return _pad_up(cfg.vocab_size, 128 * tp)
+
+
+@pytest.mark.parametrize("tp", [2, 8])
+def test_quant_tp_wire_exact_claim_matches_compiled_hlo(tp):
+    """The quant-TP (shard_map) path reports its wire stats as EXACT
+    (Engine.wire_stats_exact). Audit the claim against the COMPILED decode
+    step at tp in {2, 8}: the layer scan body (appearing once, executing
+    n_layers times) must contain exactly the 4 all-gathers _wire_bytes
+    prices — 3 dim-payload (attention heads, wo out, w2 out) + 1 padded-
+    hidden-payload (FFN up) — plus the one padded-vocab f32 logits gather,
+    and NO other activation-scale collective. Payload bytes recomputed from
+    the HLO must equal _wire_bytes(1) to the byte."""
+    qp = _quant_params("q40")
+    mesh = tp_mesh(tp)
+    eng = Engine(CFG_AUDIT, qp, SamplerConfig(temperature=0.0), mesh=mesh)
+    assert eng.wire_stats_exact
+    txt = _decode_step_hlo(eng)
+
+    cfg = CFG_AUDIT
+    hidden = quant_tp.ffn_padded_width(cfg, "q40", tp)
+    vocab_padded = _padded_vocab(cfg, tp)
+    big = [c for c in _collectives(txt) if c[0] >= cfg.dim]
+    # every big collective is an all-gather (no psum partials by design)
+    assert all(op == "all-gather" for _, _, op in big), big
+    by_size: dict = {}
+    for n, dt, _ in big:
+        by_size.setdefault(n, []).append(dt)
+    assert sorted(by_size) == sorted({cfg.dim, hidden, vocab_padded} - {0}), by_size
+    assert len(by_size[cfg.dim]) == 3, by_size
+    assert len(by_size[hidden]) == 1, by_size
+    assert by_size[vocab_padded] == ["f32"], by_size
+
+    # reprice from the HLO and compare to the byte (f32 activations = 4 B)
+    frac = (tp - 1) / tp
+    hlo_bytes = (cfg.n_layers * (3 * cfg.dim + hidden) * 4.0
+                 + vocab_padded * 4.0) * frac
+    assert hlo_bytes == eng._wire_bytes(1)
+
+
+@pytest.mark.parametrize("tp", [8])
+def test_quant_tp_compressed_wire_matches_compiled_hlo(tp):
+    """Same audit for q80 wire compression: the per-layer gathers become
+    int8 payloads of features*1.125 bytes (quants + bitcast f32 block
+    scales in ONE collective); the logits gather stays plain f32."""
+    qp = _quant_params("q40")
+    eng = Engine(CFG_AUDIT, qp, SamplerConfig(temperature=0.0),
+                 mesh=tp_mesh(tp), tp_compress=True)
+    txt = _decode_step_hlo(eng)
+
+    cfg = CFG_AUDIT
+    hidden = quant_tp.ffn_padded_width(cfg, "q40", tp)
+    vocab_padded = _padded_vocab(cfg, tp)
+    big = [c for c in _collectives(txt) if c[0] >= cfg.dim]
+    assert all(op == "all-gather" for _, _, op in big), big
+    s8 = sorted(n for n, dt, _ in big if dt == "s8")
+    want_s8 = sorted([int(cfg.dim * 1.125)] * 3 + [int(hidden * 1.125)])
+    assert s8 == want_s8, (s8, want_s8)
+    f32 = [n for n, dt, _ in big if dt == "f32"]
+    assert f32 == [vocab_padded], big
+
+    frac = (tp - 1) / tp
+    hlo_bytes = (sum(want_s8) * cfg.n_layers + vocab_padded * 4.0) * frac
+    assert hlo_bytes == eng._wire_bytes(1)
