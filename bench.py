@@ -283,14 +283,17 @@ def run_decode_bench(cfg_dict: dict, bench_steps: int = None, quant_ok: bool = F
     eng = Engine(cfg, params, SamplerConfig(temperature=0.0), cache_dtype=cache_dtype,
                  mesh=mesh, decode_chunk=bench_steps)
     # -flash tag, computed ONCE for every decode return path from the SAME
-    # gate the model layer uses (flash_decode.engages), so the label and
-    # the measured path can never drift apart; likewise the -subkernel tag
-    # reads the LATCHED qmatmul.Q40_NOSUB gate the kernels dispatched on
-    # (explicit opt-out OR the probe's nosub-rejection fallback)
+    # gate the model layer uses (flash_decode.engages) PLUS the engine-path
+    # condition: the dense-pjit mesh branch pins allow_flash=False (Pallas
+    # calls don't partition under pjit), so a dense-weights multi-device
+    # run must not be labeled -flash. The -subkernel tag reads the LATCHED
+    # qmatmul.Q40_NOSUB gate the kernels dispatched on (explicit opt-out OR
+    # the probe's nosub-rejection fallback).
     from dllama_tpu.ops import flash_decode, qmatmul as _qmatmul
 
-    flash_tag = "-flash" if flash_decode.engages(
-        1, cfg.seq_len, cache_dtype) else ""
+    flash_possible = mesh is None or weights in ("q40", "q80")
+    flash_tag = "-flash" if (flash_possible and flash_decode.engages(
+        1, cfg.seq_len, cache_dtype)) else ""
     if weights == "q40" and not _qmatmul.Q40_NOSUB:
         cfg_tag += "-subkernel"
     # Engine may have fused the projection matrices into new buffers; drop

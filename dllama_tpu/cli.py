@@ -42,10 +42,11 @@ def build_parser() -> argparse.ArgumentParser:
                 type=float,
                 default=0.0,
                 metavar="MS",
-                help="merge greedy non-streaming requests arriving within "
-                "MS milliseconds into ONE batched decode (they share every "
-                "weight-streaming pass — ~Kx throughput under K-way "
-                "concurrency, same tokens as solo runs); 0 disables",
+                help="merge requests (greedy or sampled, streaming or not) "
+                "arriving within MS milliseconds into ONE batched decode "
+                "(they share every weight-streaming pass — ~Kx throughput "
+                "under K-way concurrency, same tokens as solo runs; "
+                "streaming rows emit chunk-sized SSE bursts); 0 disables",
             )
             sp.add_argument(
                 "--batch-max",

@@ -81,11 +81,17 @@ def engages(T: int, S: int, cache_dtype) -> bool:
         return False
     if supports(T, S, cache_dtype):
         return True
+    if T > 16:
+        # prefill-sized T declining is the DESIGN (the causal mask is
+        # half-live and the MXU is the bottleneck there, not bandwidth) —
+        # warning would misread as "flash is off" on runs whose T=1 decode
+        # engages it normally
+        return False
     key = (T, S, jnp.dtype(cache_dtype).name)
     if key not in _declined:
         _declined.add(key)
         print(f"dllama: DLLAMA_FLASH_DECODE=1 but flash decode declines "
-              f"T={T} S={S} cache={key[2]} (need T<=16, S%{BLOCK_S}==0, "
+              f"T={T} S={S} cache={key[2]} (need S%{BLOCK_S}==0 and a "
               f"bf16/f32/f8 cache) — dense attention path used",
               file=sys.stderr, flush=True)
     return False

@@ -221,29 +221,35 @@ class Engine:
             return toks, cache
 
         @partial(jax.jit, donate_argnums=(2,), static_argnames=("n_steps",))
-        def _decode_loop_batch(params, rope, cache, tokens, pos, key, temp, topp, n_steps):
+        def _decode_loop_batch(params, rope, cache, tokens, pos, keys, temps,
+                               topps, n_steps):
             """N batched decode steps fused into one program: every step
             streams the weights ONCE for all B sequences (llama.forward_batched)
             and samples each row on device. A row whose own context fills
             before the batch's step budget pins at slot seq_len-1 (its later
             tokens are garbage the caller discards); other rows are
-            unaffected — no cross-row truncation."""
+            unaffected — no cross-row truncation.
+
+            ``keys`` [B, 2] / ``temps`` [B] / ``topps`` [B]: every row runs
+            its OWN sampler chain and settings, split once per step exactly
+            like the solo paths' ``key, sub = split(key)`` — a sampled row
+            seeded like a solo request emits the solo request's exact stream
+            (the server batches mixed-sampler requests on this invariant)."""
 
             def body(carry, _):
-                cache, toks, pos_, key = carry
-                key, sub = jax.random.split(key)
+                cache, toks, pos_, keys_ = carry
                 logits, cache = fwd_b(cfg, params, rope, toks, cache, pos_)
-                subs = jax.random.split(sub, toks.shape[0])
-                nxt = jax.vmap(
-                    lambda l, k: sample_dynamic(l, k, temp, topp)
-                )(logits, subs).astype(jnp.int32)
+                split = jax.vmap(jax.random.split)(keys_)  # [B, 2, 2]
+                keys_, subs = split[:, 0], split[:, 1]
+                nxt = jax.vmap(sample_dynamic)(logits, subs, temps, topps
+                                               ).astype(jnp.int32)
                 pos_ = jnp.minimum(pos_ + 1, jnp.int32(cfg.seq_len - 1))
-                return (cache, nxt, pos_, key), nxt
+                return (cache, nxt, pos_, keys_), nxt
 
-            (cache, toks, pos, key), out = jax.lax.scan(
-                body, (cache, tokens, pos, key), length=n_steps
+            (cache, toks, pos, keys), out = jax.lax.scan(
+                body, (cache, tokens, pos, keys), length=n_steps
             )
-            return out, cache  # out [n_steps, B]
+            return out, cache, keys  # out [n_steps, B]
 
         bsh = (None if self._batch_cache_sharding is None else
                {"k": self._batch_cache_sharding, "v": self._batch_cache_sharding})
@@ -529,10 +535,23 @@ class Engine:
 
         Returns (tokens list, prefill_ms, decode_ms_total). No early stop —
         the whole loop runs on device; use generate() when stop tokens or
-        streaming matter more than raw latency.
+        streaming matter more than raw latency. With ``sampler`` given, the
+        key chain starts from its seed — reproducible per request like
+        ``generate``, but NOT bit-identical to it at temperature > 0: the
+        fused loop consumes one chain key per CHUNK (splitting per step on
+        device), while generate() splits the chain once per token.
         """
         scfg = sampler if sampler is not None else self.sampler_cfg
         temp, topp = jnp.float32(scfg.temperature), jnp.float32(scfg.topp)
+        if sampler is not None:
+            local_key = jax.random.PRNGKey(scfg.seed)
+
+            def next_key():
+                nonlocal local_key
+                local_key, sub = jax.random.split(local_key)
+                return sub
+        else:
+            next_key = self.next_key
         cache = self.new_cache()
         steps = min(steps, self.cfg.seq_len - len(prompt_tokens))
         t0 = time.perf_counter()
@@ -592,6 +611,8 @@ class Engine:
         self, prompts: list, steps: int,
         sampler: Optional[SamplerConfig] = None, stop_tokens: tuple = (),
         row_steps: Optional[list] = None,
+        samplers: Optional[list] = None,
+        on_chunk=None,
     ) -> list:
         """Decode B independent prompts TOGETHER: one weight-streaming pass
         per step serves every sequence (llama.forward_batched) — on
@@ -607,27 +628,38 @@ class Engine:
         step budget. ``row_steps``: per-row budgets for that done check
         (the server's mixed max_tokens; defaults to ``steps`` for all).
 
-        Greedy (temperature 0) rows are exactly the single-sequence greedy
-        streams. Sampled rows draw from a per-row key schedule derived from
-        one chain — valid samples of the same distributions, but not
-        bit-identical to B separate single-sequence runs. With ``sampler``
-        given, that chain starts from its seed (reproducible per request,
-        like generate()); otherwise the engine chain advances.
+        Sampling: every row runs its OWN key chain, split once per step —
+        the exact schedule ``generate`` walks. ``samplers`` gives row b its
+        full per-request settings (temperature/topp/seed) — a sampled row
+        is then BIT-IDENTICAL to a solo ``generate`` call with the same
+        SamplerConfig (the server batches mixed concurrent requests on
+        this; ``generate_fused`` differs at temperature > 0, see its
+        docstring). With a single ``sampler``, rows share its
+        temperature/topp and draw per-row chains split from its seed;
+        greedy (temperature 0) rows are exact solo streams either way. With
+        neither, the engine chain seeds the split.
+
+        ``on_chunk(rows)``: called after every fused device chunk with the
+        list of per-row tokens decoded so far THIS chunk (garbage past a
+        row's own budget already trimmed) — the server's batched SSE
+        streaming hook; tokens arrive in decode_chunk-sized bursts.
         """
         if not prompts or any(not p for p in prompts):
             raise ValueError("generate_batch needs non-empty prompts")
-        scfg = sampler if sampler is not None else self.sampler_cfg
-        temp, topp = jnp.float32(scfg.temperature), jnp.float32(scfg.topp)
         B = len(prompts)
-        if sampler is not None:
-            local_key = jax.random.PRNGKey(scfg.seed)
-
-            def next_key():
-                nonlocal local_key
-                local_key, sub = jax.random.split(local_key)
-                return sub
+        if samplers is not None:
+            if len(samplers) != B:
+                raise ValueError(f"samplers must have {B} entries")
+            temps = jnp.asarray([s.temperature for s in samplers], jnp.float32)
+            topps = jnp.asarray([s.topp for s in samplers], jnp.float32)
+            keys = jnp.stack([jax.random.PRNGKey(s.seed) for s in samplers])
         else:
-            next_key = self.next_key
+            scfg = sampler if sampler is not None else self.sampler_cfg
+            temps = jnp.full((B,), scfg.temperature, jnp.float32)
+            topps = jnp.full((B,), scfg.topp, jnp.float32)
+            base = (jax.random.PRNGKey(scfg.seed) if sampler is not None
+                    else self.next_key())
+            keys = jax.random.split(base, B)
 
         t0 = time.perf_counter()
         # Per-row prefill of everything but the LAST prompt token (its feed
@@ -669,21 +701,25 @@ class Engine:
         t1 = time.perf_counter()
         while remaining > 0:
             n = min(self.decode_chunk, prefill_bucket(remaining))
-            chunk, cache = self._decode_loop_batch(
-                cache, tokens, pos, next_key(), temp, topp, n_steps=n
+            chunk, cache, keys = self._decode_loop_batch(
+                cache, tokens, pos, keys, temps, topps, n_steps=n
             )
             take = min(n, remaining)
             arr = np.asarray(chunk)  # [n, B]
             done = steps - remaining  # tokens every row was offered so far
+            fresh: list = [[] for _ in range(B)]
             for b in range(B):
                 # a context-exhausted row pinned at its last slot: its tokens
                 # past rooms[b] are garbage — keep only its own budget
                 keep = max(0, min(take, rooms[b] - done))
-                out[b].extend(int(t) for t in arr[:keep, b])
+                fresh[b] = [int(t) for t in arr[:keep, b]]
+                out[b].extend(fresh[b])
             tokens = chunk[-1]
             # mirror the in-program per-row cap across chunk boundaries
             pos = jnp.minimum(pos + take, jnp.int32(self.cfg.seq_len - 1))
             remaining -= take
+            if on_chunk is not None:
+                on_chunk(fresh)
             if (stop_tokens or row_steps) and all(
                 len(out[b]) >= budgets[b]
                 or (stop_tokens and any(t in stop_tokens for t in out[b]))

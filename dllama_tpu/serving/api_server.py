@@ -30,6 +30,7 @@ from __future__ import annotations
 
 import codecs
 import json
+import queue as queue_mod
 import threading
 import time
 import uuid
@@ -120,14 +121,21 @@ def decode_token_row(tok, prev: int, row: list, stop_ids: tuple,
     return "".join(text_parts), finish, n_gen
 
 
-class GreedyBatcher:
-    """Merges concurrent greedy non-streaming completions into ONE batched
-    decode step stream (``Engine.generate_batch``): requests arriving within
-    ``window_ms`` of each other share every weight-streaming pass, so K
-    concurrent greedy requests cost ~one request's wall time instead of K
-    (decode is weight-bandwidth-bound). Greedy rows are bit-identical to
-    solo runs. The reference serves strictly one request at a time
-    (`/root/reference/src/apps/dllama-api/dllama-api.cpp:324-355`).
+class Batcher:
+    """Merges concurrent completions — greedy AND sampled, non-streaming AND
+    streaming — into ONE batched decode step stream (``Engine.generate_batch``):
+    requests arriving within ``window_ms`` of each other share every
+    weight-streaming pass, so K concurrent requests cost ~one request's wall
+    time instead of K (decode is weight-bandwidth-bound). Every row runs its
+    own sampler chain (per-row temperature/topp/seed are traced arrays), so
+    greedy rows AND sampled rows are bit-identical to their solo runs with
+    the same SamplerConfig. The reference serves strictly one request at a
+    time (`/root/reference/src/apps/dllama-api/dllama-api.cpp:324-355`).
+
+    Streaming rows consume a per-slot queue fed by the decode loop's
+    ``on_chunk`` hook: tokens arrive in fused-chunk bursts (decode_chunk
+    tokens per dispatch) rather than one SSE event per token — the
+    granularity cost of sharing one device program across the batch.
 
     Batched rows share a step budget (the max of the batch; a near-full-
     context row pins at its last slot without truncating the others —
@@ -136,13 +144,17 @@ class GreedyBatcher:
     """
 
     class _Slot:
-        __slots__ = ("prompt", "steps", "tokens", "error", "done")
+        __slots__ = ("prompt", "steps", "sampler", "tokens", "error", "done",
+                     "queue")
 
-        def __init__(self, prompt, steps):
-            self.prompt, self.steps = prompt, steps
+        def __init__(self, prompt, steps, sampler, streaming: bool):
+            self.prompt, self.steps, self.sampler = prompt, steps, sampler
             self.tokens = None
             self.error = None
             self.done = threading.Event()
+            # streaming protocol: list-of-token-ids items, then exactly one
+            # terminal item — None (clean end) or an Exception
+            self.queue = queue_mod.Queue() if streaming else None
 
     def __init__(self, state, window_ms: float = 15.0, max_batch: int = 8):
         self.state = state
@@ -153,38 +165,89 @@ class GreedyBatcher:
         self._lock = threading.Lock()
         self._pending: list = []
 
+    def _serve_solo(self, s) -> None:
+        """A batch of ONE delegates to the solo engine path, WITH prefix-
+        session claim/store: a lone conversation ticking along under
+        --batch-window must keep its KV reuse (and per-token streaming
+        granularity) instead of re-prefilling its whole history through the
+        batch path every turn — batching only changes anything under real
+        concurrency. Caller holds state.lock. Tokens are bit-identical to
+        the batched row (same per-request chain; the invariant
+        generate_batch documents)."""
+        st = self.state
+        try:
+            stop_ids = st.stop_token_ids()
+            session, feed = st.take_prefix_session(s.prompt)
+            history = list(s.prompt)
+            toks: list = []
+            for t, _ in st.engine.generate(feed, s.steps, session=session,
+                                           stop_tokens=stop_ids,
+                                           sampler=s.sampler):
+                history.append(t)
+                toks.append(t)
+                if s.queue is not None:
+                    s.queue.put([t])
+            st.store_prefix_session(history, st.engine.final_session)
+            s.tokens = toks
+            if s.queue is not None:
+                s.queue.put(None)
+            s.done.set()
+        except Exception as e:  # noqa: BLE001
+            s.error = RuntimeError(f"decode failed: {e!r}")
+            if s.queue is not None:
+                s.queue.put(s.error)
+            s.done.set()
+
     def _serve(self, batch: list) -> None:
         """Run one generate_batch for ``batch`` and resolve every slot —
         ALWAYS (any failure resolves every waiter with an error; a follower
         left waiting forever would hang its HTTP connection). The prompt
-        list is padded to the next power of two (dummy [0] rows, dropped
-        after) so distinct arrival counts reuse a handful of compiled batch
-        sizes instead of compiling one program per B."""
+        list is padded to the next power of two (dummy greedy [0] rows,
+        dropped after) so distinct arrival counts reuse a handful of
+        compiled batch sizes instead of compiling one program per B."""
+        if len(batch) == 1:
+            self._serve_solo(batch[0])
+            return
+        emitted = [0] * len(batch)  # tokens already pushed to stream queues
+
+        def on_chunk(fresh):
+            for i, s in enumerate(batch):
+                if s.queue is None:
+                    continue
+                burst = fresh[i][: max(0, s.steps - emitted[i])]
+                if burst:
+                    emitted[i] += len(burst)
+                    s.queue.put(burst)
+
         try:
             # per-row budgets drive the early exit: a 4-max_tokens row
             # counts done after 4 tokens, pad rows after 1 — neither keeps
             # the batch decoding to the whole envelope
             prompts, row_steps = padded_batch(
                 [s.prompt for s in batch], [s.steps for s in batch])
+            samplers = [s.sampler for s in batch] + [
+                SamplerConfig(temperature=0.0, seed=0)
+            ] * (len(prompts) - len(batch))
             rows = self.state.engine.generate_batch(
                 prompts, max(s.steps for s in batch),
-                sampler=SamplerConfig(temperature=0.0),
+                samplers=samplers,
                 stop_tokens=self.state.stop_token_ids(),
                 row_steps=row_steps,
+                on_chunk=on_chunk,
             )
             for s, row in zip(batch, rows):
                 s.tokens = row[: s.steps]
+                if s.queue is not None:
+                    s.queue.put(None)
                 s.done.set()
         except Exception as e:  # noqa: BLE001 — every waiter gets a 500
             for s in batch:
                 s.error = RuntimeError(f"batched decode failed: {e!r}")
+                if s.queue is not None:
+                    s.queue.put(s.error)
                 s.done.set()
 
-    def submit(self, prompt_tokens: list, max_tokens: int) -> list:
-        """Blocks until this request's greedy tokens are decoded (possibly
-        by another thread's batch run). Thread-safe; raises the batch's
-        failure as RuntimeError."""
-        slot = self._Slot(list(prompt_tokens), max_tokens)
+    def _submit_slot(self, slot) -> None:
         with self._lock:
             self._pending.append(slot)
             leader = len(self._pending) == 1
@@ -202,9 +265,39 @@ class GreedyBatcher:
                     self._serve(batch[i : i + self.max_batch])
         else:
             slot.done.wait()
+
+    def submit(self, prompt_tokens: list, max_tokens: int,
+               sampler: SamplerConfig) -> list:
+        """Blocks until this request's tokens are decoded (possibly by
+        another thread's batch run). Thread-safe; raises the batch's
+        failure as RuntimeError."""
+        slot = self._Slot(list(prompt_tokens), max_tokens, sampler,
+                          streaming=False)
+        self._submit_slot(slot)
         if slot.error is not None:
             raise slot.error
         return slot.tokens
+
+    def submit_stream(self, prompt_tokens: list, max_tokens: int,
+                      sampler: SamplerConfig):
+        """Yields bursts (lists) of token ids as the shared batch decodes.
+        Raises the batch failure as RuntimeError."""
+        slot = self._Slot(list(prompt_tokens), max_tokens, sampler,
+                          streaming=True)
+        done_in_thread = threading.Thread(
+            target=self._submit_slot, args=(slot,), daemon=True)
+        # run leader duty (or the follower wait) off-thread so THIS thread
+        # drains the queue live while the batch is still decoding — leader
+        # and follower rows both stream as chunks land
+        done_in_thread.start()
+        while True:
+            item = slot.queue.get()
+            if item is None:
+                break
+            if isinstance(item, Exception):
+                raise item
+            yield item
+        done_in_thread.join()
 
 
 class ServerState:
@@ -238,13 +331,13 @@ class ServerState:
         #: KV cache holds this many full-context caches
         self.batch_max = max(1, batch_max)
         self.lock = threading.Lock()  # engine serves one request at a time
-        # --batch-window > 0: greedy non-streaming requests that arrive
-        # within the window run as ONE batched decode (GreedyBatcher) —
-        # single-device or tensor-parallel alike. Off by default: batching
-        # adds up to window_ms latency per request and only pays off under
-        # concurrency.
+        # --batch-window > 0: requests (greedy or sampled, streaming or
+        # not) that arrive within the window run as ONE batched decode
+        # (Batcher) — single-device or tensor-parallel alike. Off by
+        # default: batching adds up to window_ms latency per request and
+        # only pays off under concurrency.
         self.batcher = (
-            GreedyBatcher(self, batch_window_ms, max_batch=batch_max)
+            Batcher(self, batch_window_ms, max_batch=batch_max)
             if batch_window_ms > 0 else None
         )
         # prefix cache: KV state + token history of recent completions, LRU.
@@ -254,6 +347,23 @@ class ServerState:
         # The reference restarts pos=0 with no reuse every request
         # (`/root/reference/src/apps/dllama-api/dllama-api.cpp:257`).
         self._sessions: list = []  # [(tokens, session)], oldest first
+
+    def has_prefix_session(self, prompt_tokens: list) -> bool:
+        """Read-only peek: does any cached session's history prefix
+        ``prompt_tokens``? Used WITHOUT the engine lock by the batcher gate
+        (a lock-free snapshot is safe under the GIL; a racy miss just costs
+        one re-prefill, a racy hit routes one request solo) — a multi-turn
+        conversation must keep its KV reuse instead of re-prefilling its
+        whole history through the batch path every turn."""
+        for cached, session in list(self._sessions):
+            if not (0 < len(cached) <= len(prompt_tokens)):
+                continue
+            if prompt_tokens[: len(cached)] != cached:
+                continue
+            if len(cached) == len(prompt_tokens) and session.pending_token is None:
+                continue
+            return True
+        return False
 
     def take_prefix_session(self, prompt_tokens: list) -> tuple:
         """Returns (session, tokens_to_feed). Claims (removes) the cached
@@ -383,6 +493,60 @@ class OpenAIHandler(BaseHTTPRequestHandler):
             pass  # client went away mid-stream; per-request isolation like
             # the reference's per-request catch (`dllama-api.cpp:347-351`)
 
+    def _stream_batched(self, base: dict, sampler: SamplerConfig,
+                        prompt_tokens: list, max_tokens: int) -> None:
+        """SSE streaming from the shared batched decode: bursts of
+        decode_chunk tokens per event instead of one event per token (the
+        granularity trade for sharing one device program across concurrent
+        requests). Stop strings never reach here (the batch gate routes
+        them solo), so only stop TOKENS and budgets truncate."""
+        st = self.state
+        tok = st.tokenizer
+        self.send_response(200)
+        self.send_header("Content-Type", "text/event-stream")
+        self.send_header("Cache-Control", "no-cache")
+        self.send_header("Connection", "close")
+        self.end_headers()
+
+        def emit_chunk(delta: dict, finish=None) -> None:
+            chunk = dict(base, object="chat.completion.chunk",
+                         choices=[{"index": 0, "delta": delta,
+                                   "finish_reason": finish}])
+            self.wfile.write(b"data: " + json.dumps(chunk).encode() + b"\n\n")
+            self.wfile.flush()
+
+        emit_chunk({"role": "assistant"})
+        utf8 = codecs.getincrementaldecoder("utf-8")("replace")
+        stop_ids = st.stop_token_ids()
+        prev = prompt_tokens[-1]
+        finish_reason = "length"
+        try:
+            for burst in st.batcher.submit_stream(prompt_tokens, max_tokens,
+                                                  sampler):
+                parts = []
+                stopped = False
+                for t in burst:
+                    if t in stop_ids:
+                        stopped = True
+                        break
+                    parts.append(utf8.decode(tok.decode_piece(prev, t)))
+                    prev = t
+                text = "".join(parts)
+                if text:
+                    emit_chunk({"content": text})
+                if stopped:
+                    finish_reason = "stop"
+                    break
+        except RuntimeError as e:
+            emit_chunk({"content": f"\n[error: {e}]"})
+        tail = utf8.decode(b"", True)
+        if tail:
+            emit_chunk({"content": tail})
+        emit_chunk({}, finish=finish_reason)
+        self.wfile.write(b"data: [DONE]\n\n")
+        self.wfile.flush()
+        self.close_connection = True
+
     def _handle_completions(self, req: dict) -> None:
         st = self.state
         messages = req.get("messages")
@@ -441,15 +605,22 @@ class OpenAIHandler(BaseHTTPRequestHandler):
         if n_choices > 1:
             # n samples of one prompt decode as ONE batch: the shared
             # prefix prefills once, every step streams the weights once for
-            # all n rows (generate_batch), each row sampling its own stream
+            # all n rows (generate_batch); choice i runs its own chain at
+            # seed+i — bit-identical to a solo request with that seed
             try:
                 prompts, row_steps = padded_batch(
                     [list(prompt_tokens)] * n_choices,
                     [max_tokens] * n_choices)
+                samplers = [
+                    SamplerConfig(temperature=sampler.temperature,
+                                  topp=sampler.topp, seed=sampler.seed + i)
+                    for i in range(n_choices)
+                ] + [SamplerConfig(temperature=0.0, seed=0)] * (
+                    len(prompts) - n_choices)
                 with st.lock:
                     rows = st.engine.generate_batch(
                         prompts, max_tokens,
-                        sampler=sampler, stop_tokens=st.stop_token_ids(),
+                        samplers=samplers, stop_tokens=st.stop_token_ids(),
                         row_steps=row_steps,
                     )[:n_choices]
             except Exception as e:  # noqa: BLE001
@@ -473,31 +644,39 @@ class OpenAIHandler(BaseHTTPRequestHandler):
             }))
             return
 
-        if (st.batcher is not None and not stream and not stops
-                and sampler.temperature == 0.0 and st.spec_draft == 0):
+        if (st.batcher is not None and not stops and st.spec_draft == 0
+                and not st.has_prefix_session(prompt_tokens)):
             # stop STRINGS stay on the solo path: its host loop aborts at
             # the string, while a batch would decode the row's whole budget
-            # on device before the host truncates; greedy non-streaming
-            # requests merge into one batched decode — same tokens as the
-            # solo path (greedy rows are exact)
-            try:
-                row = st.batcher.submit(prompt_tokens, max_tokens)
-            except RuntimeError as e:
-                # one poisoned batch must not reset K connections: every
-                # waiter gets its own 500
-                self._error(500, str(e))
-                return
-            text, finish_reason, n_generated = decode_token_row(
-                tok, prompt_tokens[-1], row, st.stop_token_ids(), stops)
-            self._json(200, dict(base, choices=[{
-                "index": 0,
-                "message": {"role": "assistant", "content": text},
-                "finish_reason": finish_reason,
-            }], usage={
-                "prompt_tokens": len(prompt_tokens),
-                "completion_tokens": n_generated,
-                "total_tokens": len(prompt_tokens) + n_generated,
-            }))
+            # on device before the host truncates. So does a prompt that
+            # EXTENDS a cached conversation: the batch path skips the
+            # prefix cache, and re-prefilling a growing history every turn
+            # would regress multi-turn latency with zero concurrency.
+            # Everything else — greedy or sampled, streaming or not —
+            # merges into one batched decode; every row runs its own
+            # sampler chain, so tokens are bit-identical to the solo path
+            # for the same SamplerConfig.
+            if stream:
+                self._stream_batched(base, sampler, prompt_tokens, max_tokens)
+            else:
+                try:
+                    row = st.batcher.submit(prompt_tokens, max_tokens, sampler)
+                except RuntimeError as e:
+                    # one poisoned batch must not reset K connections: every
+                    # waiter gets its own 500
+                    self._error(500, str(e))
+                    return
+                text, finish_reason, n_generated = decode_token_row(
+                    tok, prompt_tokens[-1], row, st.stop_token_ids(), stops)
+                self._json(200, dict(base, choices=[{
+                    "index": 0,
+                    "message": {"role": "assistant", "content": text},
+                    "finish_reason": finish_reason,
+                }], usage={
+                    "prompt_tokens": len(prompt_tokens),
+                    "completion_tokens": n_generated,
+                    "total_tokens": len(prompt_tokens) + n_generated,
+                }))
             return
 
         if stream:

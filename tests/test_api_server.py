@@ -538,3 +538,177 @@ def test_n_greedy_choices_are_identical(server):
     c = json.loads(data)["choices"]
     assert len(c) == 2
     assert c[0]["message"]["content"] == c[1]["message"]["content"]
+
+
+def test_concurrent_sampled_requests_batch_and_match_solo():
+    """Two concurrent temperature>0 requests inside the window must share
+    ONE generate_batch call AND return exactly the replies the batching-
+    disabled server gives for the same (seed, temperature) — per-row
+    sampler chains make batched sampled rows bit-identical to solo."""
+    tok = make_tokenizer()
+    cfg = tiny_cfg(vocab_size=tok.vocab_size, seq_len=512, dim=32, kv_dim=16,
+                   head_size=8, hidden_dim=64)
+    params = llama.random_params(cfg, seed=13)
+
+    def run_server(window_ms):
+        engine = Engine(cfg, params, SamplerConfig(temperature=0.0, seed=1))
+        state = ServerState(engine, tok, cfg, model_name="tiny-test",
+                            template="llama3", batch_window_ms=window_ms)
+        sizes = []
+        if state.batcher is not None:
+            orig = engine.generate_batch
+
+            def spy(prompts, steps, **kw):
+                sizes.append(len(prompts))
+                return orig(prompts, steps, **kw)
+
+            engine.generate_batch = spy
+        srv = create_server(state, host="127.0.0.1", port=0)
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        return srv, srv.server_address[1], sizes
+
+    reqs = [
+        dict(messages=[{"role": "user", "content": "hello world"}],
+             temperature=0.9, seed=5, max_tokens=6),
+        dict(messages=[{"role": "user", "content": "the the cat"}],
+             temperature=1.2, seed=11, max_tokens=6),
+    ]
+
+    def ask_all(port):
+        replies = [None] * len(reqs)
+
+        def one(i):
+            _, d = request(port, "POST", "/v1/chat/completions",
+                           chat_body(**reqs[i]))
+            replies[i] = json.loads(d)["choices"][0]["message"]["content"]
+
+        threads = [threading.Thread(target=one, args=(i,))
+                   for i in range(len(reqs))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return replies
+
+    srv_plain, port_plain, _ = run_server(0)
+    srv_batch, port_batch, sizes = run_server(400.0)
+    try:
+        request(port_batch, "POST", "/v1/chat/completions",
+                chat_body(max_tokens=2))  # warm compiles before the burst
+        want = ask_all(port_plain)
+        got = ask_all(port_batch)
+        assert got == want
+        assert sizes and max(sizes) >= 2, sizes  # requests actually merged
+    finally:
+        srv_plain.shutdown()
+        srv_batch.shutdown()
+
+
+def test_batched_streaming_sse_semantics():
+    """A streaming request through the batcher must emit well-formed SSE
+    (role chunk, content deltas, finish chunk, [DONE]) whose concatenated
+    text equals the batching-disabled server's streamed text for the same
+    request."""
+    tok = make_tokenizer()
+    cfg = tiny_cfg(vocab_size=tok.vocab_size, seq_len=512, dim=32, kv_dim=16,
+                   head_size=8, hidden_dim=64)
+    params = llama.random_params(cfg, seed=13)
+
+    def run_server(window_ms):
+        engine = Engine(cfg, params, SamplerConfig(temperature=0.0, seed=1))
+        state = ServerState(engine, tok, cfg, model_name="tiny-test",
+                            template="llama3", batch_window_ms=window_ms)
+        srv = create_server(state, host="127.0.0.1", port=0)
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        return srv, srv.server_address[1]
+
+    def stream_text(port):
+        import http.client as hc
+
+        conn = hc.HTTPConnection("127.0.0.1", port, timeout=120)
+        conn.request("POST", "/v1/chat/completions",
+                     body=json.dumps(chat_body(
+                         messages=[{"role": "user", "content": "hello world"}],
+                         stream=True, temperature=0.8, seed=3, max_tokens=8)),
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        raw = resp.read().decode()
+        conn.close()
+        events = [ln[len("data: "):] for ln in raw.split("\n")
+                  if ln.startswith("data: ")]
+        assert events[-1] == "[DONE]"
+        chunks = [json.loads(e) for e in events[:-1]]
+        assert chunks[0]["choices"][0]["delta"].get("role") == "assistant"
+        finals = [c for c in chunks
+                  if c["choices"][0]["finish_reason"] is not None]
+        assert len(finals) == 1 and chunks[-1] is finals[0]
+        return "".join(c["choices"][0]["delta"].get("content", "")
+                       for c in chunks)
+
+    srv_plain, port_plain = run_server(0)
+    srv_batch, port_batch = run_server(400.0)
+    try:
+        request(port_batch, "POST", "/v1/chat/completions",
+                chat_body(max_tokens=2))  # warm compiles
+        want = stream_text(port_plain)
+        got = stream_text(port_batch)
+        assert got == want and got
+    finally:
+        srv_plain.shutdown()
+        srv_batch.shutdown()
+
+
+def test_batched_server_singleton_keeps_prefix_cache():
+    """With --batch-window on and ZERO concurrency, a multi-turn chat must
+    still reuse its cached KV session: the singleton batch delegates to
+    the solo path (claiming AND storing sessions), so turn 2 prefills only
+    the suffix — not the whole history through the batch path. Turn 2 uses
+    the ForcedWarmEncoder pattern: random-weight replies don't BPE
+    round-trip, so a natural follow-up would cold-miss and test nothing."""
+    tok = make_tokenizer()
+    cfg = tiny_cfg(vocab_size=tok.vocab_size, seq_len=512, dim=32, kv_dim=16,
+                   head_size=8, hidden_dim=64)
+    params = llama.random_params(cfg, seed=13)
+    engine = Engine(cfg, params, SamplerConfig(temperature=0.0, seed=1))
+    state_box = [None]
+
+    class WarmTok:
+        def __getattr__(self, name):
+            return getattr(tok, name)
+
+        def encode(self, text, add_bos=True):
+            if "<<WARM>>" in text:
+                return list(state_box[0]._sessions[-1][0]) + [263, 264, 265]
+            return tok.encode(text, add_bos=add_bos)
+
+    state = ServerState(engine, WarmTok(), cfg, model_name="tiny-test",
+                        template="llama3", batch_window_ms=30.0)
+    state_box[0] = state
+    fed = []
+    orig = engine.generate
+
+    def spy(feed_tokens, *a, **kw):
+        fed.append(len(feed_tokens))
+        return orig(feed_tokens, *a, **kw)
+
+    engine.generate = spy
+    srv = create_server(state, host="127.0.0.1", port=0)
+    port = srv.server_address[1]
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        msgs = [{"role": "user", "content": "hello world"}]
+        _, d1 = request(port, "POST", "/v1/chat/completions",
+                        chat_body(messages=msgs, max_tokens=4))
+        assert json.loads(d1)["choices"][0]["message"]["content"] is not None
+        assert fed, "singleton batch did not take the solo generate path"
+        assert state._sessions, "singleton batch did not store its session"
+        _, d2 = request(port, "POST", "/v1/chat/completions",
+                        chat_body(messages=[{"role": "user",
+                                             "content": "<<WARM>>"}],
+                                  max_tokens=4))
+        assert json.loads(d2)["choices"][0]["message"]["content"] is not None
+        # turn 2 claimed the cached session: only the 3-token suffix (plus
+        # the session's pending token) was fed, not the whole history
+        assert len(fed) >= 2 and fed[-1] <= 4, fed
+    finally:
+        srv.shutdown()

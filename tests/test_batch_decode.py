@@ -167,3 +167,48 @@ def test_batched_row_budgets_early_exit_without_stop_tokens():
     eng = Engine(CFG, params, SamplerConfig(temperature=0.0), decode_chunk=4)
     got = eng.generate_batch([[5, 9], [7]], steps=32, row_steps=[3, 4])
     assert len(got[0]) == 4 and len(got[1]) == 4  # one 4-step chunk, then exit
+
+
+def test_batched_per_row_samplers_bit_identical_to_solo():
+    """Row b with samplers[b]=SamplerConfig(T, p, seed) must emit EXACTLY
+    the stream of a solo generate() with that config: per-row key chains
+    split once per step like the solo paths (the server batches mixed
+    sampled requests on this invariant)."""
+    params = llama.random_params(CFG, seed=3, dtype=np.float32)
+    samplers = [
+        SamplerConfig(temperature=0.9, topp=0.95, seed=7),
+        SamplerConfig(temperature=0.0, seed=1),      # greedy row in the mix
+        SamplerConfig(temperature=1.3, topp=0.8, seed=42),
+    ]
+    want = []
+    for p, s in zip(PROMPTS, samplers):
+        eng = Engine(CFG, params, SamplerConfig(temperature=0.0))
+        want.append([t for t, _ in eng.generate(list(p), steps=10, sampler=s)])
+    eng = Engine(CFG, params, SamplerConfig(temperature=0.0))
+    got = eng.generate_batch(PROMPTS, steps=10, samplers=samplers)
+    assert got == want
+
+
+def test_batched_on_chunk_streams_every_token_once():
+    """on_chunk bursts concatenated must equal the returned rows (the SSE
+    streaming hook must neither drop nor duplicate)."""
+    params = llama.random_params(CFG, seed=0, dtype=np.float32)
+    eng = Engine(CFG, params, SamplerConfig(temperature=0.0), decode_chunk=4)
+    seen = [[] for _ in PROMPTS]
+
+    def on_chunk(fresh):
+        assert len(fresh) == len(PROMPTS)
+        for b, burst in enumerate(fresh):
+            seen[b].extend(burst)
+
+    rows = eng.generate_batch(PROMPTS, steps=10, on_chunk=on_chunk)
+    assert seen == rows
+    assert all(len(r) == 10 for r in rows)
+
+
+def test_batched_samplers_wrong_length_rejected():
+    params = llama.random_params(CFG, seed=0, dtype=np.float32)
+    eng = Engine(CFG, params, SamplerConfig(temperature=0.0))
+    with pytest.raises(ValueError):
+        eng.generate_batch(PROMPTS, steps=4,
+                           samplers=[SamplerConfig(temperature=0.0)])
