@@ -563,7 +563,7 @@ class Engine:
             return [], self.prefill_ms, 0.0
         if len(prompt_tokens) > 1:
             last_logits, cache = self.prefill(cache, prompt_tokens, 0)
-            token = sample_dynamic(last_logits, self.next_key(), temp, topp)
+            token = sample_dynamic(last_logits, next_key(), temp, topp)
             pos = len(prompt_tokens)
             first = [int(token)]
             steps -= 1
@@ -589,7 +589,7 @@ class Engine:
             n = min(chunk_size, prefill_bucket(remaining))
             n = min(n, self.cfg.seq_len - pos)  # never write cache out of range
             chunk, cache = self._decode_loop(
-                cache, token, jnp.int32(pos), self.next_key(), temp, topp, n_steps=n
+                cache, token, jnp.int32(pos), next_key(), temp, topp, n_steps=n
             )
             take = min(n, remaining)
             chunk_list = [int(t) for t in np.asarray(chunk)]

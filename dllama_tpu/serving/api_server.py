@@ -141,6 +141,16 @@ class Batcher:
     context row pins at its last slot without truncating the others —
     Engine.generate_batch clamps per row), skip the prefix cache, and
     stop-truncate on the host — the trade for the shared weight stream.
+
+    KV-reuse trade, explicitly: batches of >= 2 rows neither claim nor
+    store prefix sessions (extracting per-row sessions from the batch
+    cache would pin B full-context KV caches in HBM — the session cache's
+    budget is ~2). So under SUSTAINED concurrency a multi-turn chat
+    re-prefills its history each turn; that is the deliberate price for
+    sharing every decode weight stream, and prefill is the cheap
+    (MXU-bound, bucketed) phase. The zero/low-concurrency cases keep full
+    reuse: prompts extending a cached session route solo at the gate, and
+    a batch of ONE delegates to the solo path (_serve_solo).
     """
 
     class _Slot:
@@ -348,6 +358,21 @@ class ServerState:
         # (`/root/reference/src/apps/dllama-api/dllama-api.cpp:257`).
         self._sessions: list = []  # [(tokens, session)], oldest first
 
+    @staticmethod
+    def _session_matches(cached: list, session, prompt_tokens: list) -> bool:
+        """THE prefix-match predicate, shared by the claim
+        (take_prefix_session) and the lock-free peek (has_prefix_session) so
+        the batcher gate can never drift from what the solo path would
+        actually claim: cached history must be a non-empty prefix of the
+        prompt, and an exact-length match needs a pending token (an empty
+        suffix with nothing pending would leave generate() with no input)."""
+        if not (0 < len(cached) <= len(prompt_tokens)):
+            return False
+        if prompt_tokens[: len(cached)] != cached:
+            return False
+        return not (len(cached) == len(prompt_tokens)
+                    and session.pending_token is None)
+
     def has_prefix_session(self, prompt_tokens: list) -> bool:
         """Read-only peek: does any cached session's history prefix
         ``prompt_tokens``? Used WITHOUT the engine lock by the batcher gate
@@ -355,15 +380,8 @@ class ServerState:
         one re-prefill, a racy hit routes one request solo) — a multi-turn
         conversation must keep its KV reuse instead of re-prefilling its
         whole history through the batch path every turn."""
-        for cached, session in list(self._sessions):
-            if not (0 < len(cached) <= len(prompt_tokens)):
-                continue
-            if prompt_tokens[: len(cached)] != cached:
-                continue
-            if len(cached) == len(prompt_tokens) and session.pending_token is None:
-                continue
-            return True
-        return False
+        return any(self._session_matches(cached, session, prompt_tokens)
+                   for cached, session in list(self._sessions))
 
     def take_prefix_session(self, prompt_tokens: list) -> tuple:
         """Returns (session, tokens_to_feed). Claims (removes) the cached
@@ -373,14 +391,7 @@ class ServerState:
         under lock."""
         best, best_len = -1, 0
         for i, (cached, session) in enumerate(self._sessions):
-            if not (0 < len(cached) <= len(prompt_tokens)):
-                continue
-            if prompt_tokens[: len(cached)] != cached:
-                continue
-            # the cached session's pending token is cached[-1] (fed on the
-            # next generate); an empty suffix with nothing pending would
-            # leave generate() with no input at all
-            if len(cached) == len(prompt_tokens) and session.pending_token is None:
+            if not self._session_matches(cached, session, prompt_tokens):
                 continue
             if len(cached) > best_len:
                 best, best_len = i, len(cached)

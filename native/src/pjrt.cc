@@ -169,34 +169,73 @@ size_t Executable::num_outputs() const {
   return n_out_;
 }
 
+size_t Executable::num_addressable_devices() const {
+  PJRT_LoadedExecutable_AddressableDevices_Args a;
+  std::memset(&a, 0, sizeof(a));
+  a.struct_size = PJRT_LoadedExecutable_AddressableDevices_Args_STRUCT_SIZE;
+  a.executable = exec_;
+  Check(api_, api_->PJRT_LoadedExecutable_AddressableDevices(&a),
+        "LoadedExecutable_AddressableDevices");
+  return a.num_addressable_devices;
+}
+
 std::vector<Buffer> Executable::Execute(
     const std::vector<PJRT_Buffer*>& args) {
+  std::vector<std::vector<Buffer>> out = ExecuteSharded({args});
+  return std::move(out[0]);
+}
+
+std::vector<std::vector<Buffer>> Executable::ExecuteSharded(
+    const std::vector<std::vector<PJRT_Buffer*>>& args) {
+  if (args.empty()) throw PjrtError("ExecuteSharded: no device arg lists");
+  const size_t n_dev = args.size();
+  const size_t n_args = args[0].size();
+  for (const auto& l : args)
+    if (l.size() != n_args)
+      throw PjrtError("ExecuteSharded: ragged per-device arg lists");
   const size_t n_out = num_outputs();
-  std::vector<PJRT_Buffer*> outputs(n_out, nullptr);
-  PJRT_Buffer** output_list = outputs.data();
-  PJRT_Buffer* const* arg_list = args.data();
+
+  // per-device argument pointers and per-device output slots
+  std::vector<PJRT_Buffer* const*> arg_lists(n_dev);
+  for (size_t d = 0; d < n_dev; ++d) arg_lists[d] = args[d].data();
+  std::vector<std::vector<PJRT_Buffer*>> outputs(
+      n_dev, std::vector<PJRT_Buffer*>(n_out, nullptr));
+  std::vector<PJRT_Buffer**> output_lists(n_dev);
+  for (size_t d = 0; d < n_dev; ++d) output_lists[d] = outputs[d].data();
 
   PJRT_ExecuteOptions opts;
   std::memset(&opts, 0, sizeof(opts));
   opts.struct_size = PJRT_ExecuteOptions_STRUCT_SIZE;
 
-  PJRT_Event* done = nullptr;
+  std::vector<PJRT_Event*> done(n_dev, nullptr);
   PJRT_LoadedExecutable_Execute_Args a;
   std::memset(&a, 0, sizeof(a));
   a.struct_size = PJRT_LoadedExecutable_Execute_Args_STRUCT_SIZE;
   a.executable = exec_;
   a.options = &opts;
-  a.argument_lists = &arg_list;
-  a.num_devices = 1;
-  a.num_args = args.size();
-  a.output_lists = &output_list;
-  a.device_complete_events = &done;
+  a.argument_lists = arg_lists.data();
+  a.num_devices = n_dev;
+  a.num_args = n_args;
+  a.output_lists = output_lists.data();
+  a.device_complete_events = done.data();
   Check(api_, api_->PJRT_LoadedExecutable_Execute(&a), "Execute");
-  AwaitAndDestroy(api_, done, "Execute completion");
+  // every shard must complete (and every event be destroyed) even if one
+  // throws — collect the first failure after draining all events
+  std::string first_err;
+  for (size_t d = 0; d < n_dev; ++d) {
+    try {
+      AwaitAndDestroy(api_, done[d], "Execute completion");
+    } catch (const PjrtError& e) {
+      if (first_err.empty()) first_err = e.what();
+    }
+  }
+  if (!first_err.empty()) throw PjrtError(first_err);
 
-  std::vector<Buffer> out;
-  out.reserve(n_out);
-  for (PJRT_Buffer* b : outputs) out.emplace_back(api_, b);
+  std::vector<std::vector<Buffer>> out(n_dev);
+  for (size_t d = 0; d < n_dev; ++d) {
+    out[d].reserve(n_out);
+    for (PJRT_Buffer* b : outputs[d]) out[d].emplace_back(api_, b);
+  }
   return out;
 }
 
@@ -291,7 +330,12 @@ std::string Client::platform_name() const {
 }
 
 Buffer Client::ToDevice(const void* data, PJRT_Buffer_Type type,
-                        const std::vector<int64_t>& dims) {
+                        const std::vector<int64_t>& dims,
+                        size_t device_index) {
+  if (device_index >= devices_.size())
+    throw PjrtError("ToDevice: device index " + std::to_string(device_index) +
+                    " out of range (" + std::to_string(devices_.size()) +
+                    " addressable devices)");
   PJRT_Client_BufferFromHostBuffer_Args a;
   std::memset(&a, 0, sizeof(a));
   a.struct_size = PJRT_Client_BufferFromHostBuffer_Args_STRUCT_SIZE;
@@ -302,7 +346,7 @@ Buffer Client::ToDevice(const void* data, PJRT_Buffer_Type type,
   a.num_dims = dims.size();
   a.host_buffer_semantics =
       PJRT_HostBufferSemantics_kImmutableUntilTransferCompletes;
-  a.device = devices_[0];
+  a.device = devices_[device_index];
   Check(api_, api_->PJRT_Client_BufferFromHostBuffer(&a), "BufferFromHost");
   AwaitAndDestroy(api_, a.done_with_host_buffer, "BufferFromHost transfer");
   return Buffer(api_, a.buffer);

@@ -66,7 +66,11 @@ class Buffer {
   PJRT_Buffer* buf_ = nullptr;
 };
 
-// A compiled program on one device. Execute() consumes/produces Buffers.
+// A compiled program on one or more devices. Execute() consumes/produces
+// Buffers; ExecuteSharded() runs an SPMD program across N devices in one
+// call (the native analog of the reference's per-layer multi-node step —
+// /root/reference/src/transformer.cpp:569-728 — except the collectives live
+// inside the compiled program, not in this runtime).
 class Executable {
  public:
   Executable() = default;
@@ -78,12 +82,21 @@ class Executable {
   ~Executable();
 
   size_t num_outputs() const;  // cached after the first call
+  // Devices this loaded executable is bound to run on (one per shard of an
+  // SPMD program; a single-device program reports one).
+  size_t num_addressable_devices() const;
   // Single-device synchronous execute. Donated inputs (per the program's
   // input/output aliasing, e.g. the KV cache) are consumed: their Buffer
   // handles are invalidated by the runtime even though we don't reset them —
   // the caller must replace them with the aliased outputs and never touch
   // them again.
   std::vector<Buffer> Execute(const std::vector<PJRT_Buffer*>& args);
+  // Multi-device synchronous execute: args[d] is device d's argument list
+  // (every list the same length, each buffer resident on its device, in
+  // the order of Executable's addressable devices). Returns one output
+  // list per device. Same donation semantics as Execute, per device.
+  std::vector<std::vector<Buffer>> ExecuteSharded(
+      const std::vector<std::vector<PJRT_Buffer*>>& args);
 
  private:
   void reset();
@@ -107,10 +120,12 @@ class Client {
   std::string platform_name() const;
   size_t num_devices() const { return devices_.size(); }
 
-  // Host->device copy onto the first addressable device (blocking until the
-  // host data may be reused).
+  // Host->device copy onto addressable device `device_index` (default: the
+  // first), blocking until the host data may be reused. Multi-device
+  // programs place each weight/cache shard on its own device this way
+  // before ExecuteSharded.
   Buffer ToDevice(const void* data, PJRT_Buffer_Type type,
-                  const std::vector<int64_t>& dims);
+                  const std::vector<int64_t>& dims, size_t device_index = 0);
 
   // Compile StableHLO bytecode ("mlir" format) with a serialized
   // xla.CompileOptionsProto (produced at export time by JAX).

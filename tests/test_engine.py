@@ -214,3 +214,31 @@ def test_token_stats_split_inference_from_transfer():
     # a nonzero transfer component distinct from generation time
     assert any(s.transfer_ms > 0 for s in decode_stats)
     assert any(abs(s.inference_ms - s.generation_ms) > 1e-9 for s in decode_stats)
+
+
+def test_generate_fused_seed_reproducible():
+    """generate_fused with an explicit sampler must be reproducible per
+    seed (its own chain, not the engine chain) — r5 review caught the
+    closure being built but never called."""
+    import numpy as np
+
+    from dllama_tpu.models import llama
+    from dllama_tpu.models.config import ModelConfig
+    from dllama_tpu.runtime.generate import Engine
+    from dllama_tpu.runtime.sampler import SamplerConfig
+
+    cfg = ModelConfig(
+        arch="llama", dim=64, hidden_dim=128, n_layers=2, n_heads=4,
+        n_kv_heads=2, vocab_size=96, seq_len=64, head_size=16, kv_dim=32,
+        dtype="float32",
+    )
+    params = llama.random_params(cfg, seed=0, dtype=np.float32)
+    eng = Engine(cfg, params, SamplerConfig(temperature=0.0))
+    s = SamplerConfig(temperature=0.9, topp=0.95, seed=7)
+    a, _, _ = eng.generate_fused([1, 5, 9], steps=8, sampler=s)
+    b, _, _ = eng.generate_fused([1, 5, 9], steps=8, sampler=s)
+    assert a == b and len(a) == 8
+    c, _, _ = eng.generate_fused(
+        [1, 5, 9], steps=8, sampler=SamplerConfig(temperature=0.9,
+                                                  topp=0.95, seed=8))
+    assert c != a  # a different seed draws a different stream
