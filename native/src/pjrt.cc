@@ -219,6 +219,14 @@ std::vector<std::vector<Buffer>> Executable::ExecuteSharded(
   a.output_lists = output_lists.data();
   a.device_complete_events = done.data();
   Check(api_, api_->PJRT_LoadedExecutable_Execute(&a), "Execute");
+  // wrap raw outputs in RAII Buffers FIRST: if a completion event below
+  // throws, every shard's output (successful shards included) must still
+  // be destroyed, or device HBM leaks on each failed execute
+  std::vector<std::vector<Buffer>> out(n_dev);
+  for (size_t d = 0; d < n_dev; ++d) {
+    out[d].reserve(n_out);
+    for (PJRT_Buffer* b : outputs[d]) out[d].emplace_back(api_, b);
+  }
   // every shard must complete (and every event be destroyed) even if one
   // throws — collect the first failure after draining all events
   std::string first_err;
@@ -230,12 +238,6 @@ std::vector<std::vector<Buffer>> Executable::ExecuteSharded(
     }
   }
   if (!first_err.empty()) throw PjrtError(first_err);
-
-  std::vector<std::vector<Buffer>> out(n_dev);
-  for (size_t d = 0; d < n_dev; ++d) {
-    out[d].reserve(n_out);
-    for (PJRT_Buffer* b : outputs[d]) out[d].emplace_back(api_, b);
-  }
   return out;
 }
 

@@ -1,0 +1,20 @@
+"""Shared backend-forcing escape hatch for the measurement scripts.
+
+The container's sitecustomize pins JAX at the axon TPU tunnel; with the
+tunnel down, the FIRST backend touch hangs forever. ``DLLAMA_PLATFORM=cpu``
+forces the platform via jax.config (the env var alone is too late — the
+sitecustomize already imported jax), mirroring bench.py and the CLI.
+
+Usage, immediately after ``import jax`` and before any backend use::
+
+    from _platform import apply_platform_override
+    apply_platform_override(jax)
+"""
+
+import os
+
+
+def apply_platform_override(jax_module) -> None:
+    forced = os.environ.get("DLLAMA_PLATFORM")
+    if forced:
+        jax_module.config.update("jax_platforms", forced)

@@ -17,6 +17,11 @@ import sys
 import time
 
 import jax
+
+sys.path.insert(0, __import__("os").path.dirname(__import__("os").path.abspath(__file__)))
+from _platform import apply_platform_override  # noqa: E402
+
+apply_platform_override(jax)
 import jax.numpy as jnp
 import numpy as np
 

@@ -1,0 +1,83 @@
+#!/bin/bash
+# Round-5 measurement battery — VERDICT r04's "only non-negotiable" is a
+# driver-green perf record, so the order is: headline decode first (nosub
+# default end-to-end), then the flash/f8 long-context matrix (flash now
+# composes with f8 caches AND dense engines), the ablation that localizes
+# the ~4 ms non-kernel overhead, the kernel shootout incl. the new
+# E/F/G variants (int8-MXU, 2048-lane O tiles, bf16 correction planes),
+# prefill, MoE/Grok shapes, and the two e2e proofs (native, train->serve).
+#
+#   bash scripts/measure_r05.sh [results_dir]
+#
+# Probe-and-wait before every stage (the single-session relay wedges after
+# a client dies); TUNNEL_DEAD short-circuits once a wait exhausts.
+set -u
+OUT=${1:-results}
+mkdir -p "$OUT"
+STAMP=$(date -u +%Y%m%dT%H%M%S)
+log() { echo "== $* ($(date -u +%H:%M:%S))" | tee -a "$OUT/measure_$STAMP.log"; }
+
+probe_tunnel() {
+  timeout -k 10 150 python -c '
+import time, jax, jax.numpy as jnp
+t0 = time.time()
+jax.block_until_ready(jnp.ones((256, 256), jnp.bfloat16) @ jnp.ones((256, 256), jnp.bfloat16))
+print(f"TUNNEL_OK {time.time()-t0:.1f}s")' 2>&1 | grep -q TUNNEL_OK
+}
+TUNNEL_DEAD=0
+wait_tunnel() {
+  local i
+  [ "$TUNNEL_DEAD" = 1 ] && return 1
+  for i in $(seq 1 8); do
+    probe_tunnel && return 0
+    log "tunnel not answering (probe $i/8), waiting"
+    [ "$i" -lt 8 ] && sleep 240
+  done
+  TUNNEL_DEAD=1
+  return 1
+}
+
+run() {
+  local name=$1; shift
+  if ! wait_tunnel; then
+    log "$name SKIPPED: tunnel never answered"
+    return
+  fi
+  log "$name: $*"
+  local T=${CMD_TIMEOUT:-1500}
+  timeout -k 30 "$T" "$@" >"$OUT/${name}_$STAMP.out" 2>&1
+  local rc=$?
+  { [ $rc -eq 124 ] || [ $rc -eq 137 ]; } && log "$name TIMED OUT after ${T}s (rc=$rc)"
+  log "$name rc=$rc"
+  tail -3 "$OUT/${name}_$STAMP.out" | tee -a "$OUT/measure_$STAMP.log"
+}
+
+# ---- headline: the driver's own metric, nosub default -------------------
+CMD_TIMEOUT=900 run bench_7b env BENCH_DEADLINE_S=840 python bench.py
+CMD_TIMEOUT=900 run bench_8b env BENCH_MODEL=llama3 BENCH_DEADLINE_S=840 python bench.py
+# ---- flash/f8 long-context matrix (seq 4096 is where they earn keep) ----
+CMD_TIMEOUT=900 run bench_7b_seq4k env BENCH_SEQ=4096 BENCH_DEADLINE_S=840 python bench.py
+CMD_TIMEOUT=900 run bench_7b_seq4k_flash env BENCH_SEQ=4096 DLLAMA_FLASH_DECODE=1 BENCH_DEADLINE_S=840 python bench.py
+CMD_TIMEOUT=900 run bench_7b_seq4k_f8 env BENCH_SEQ=4096 BENCH_CACHE=f8 BENCH_DEADLINE_S=840 python bench.py
+CMD_TIMEOUT=900 run bench_7b_seq4k_f8_flash env BENCH_SEQ=4096 BENCH_CACHE=f8 DLLAMA_FLASH_DECODE=1 BENCH_DEADLINE_S=840 python bench.py
+CMD_TIMEOUT=900 run bench_7b_flash env DLLAMA_FLASH_DECODE=1 BENCH_DEADLINE_S=840 python bench.py
+CMD_TIMEOUT=900 run bench_7b_seq2k_flash env BENCH_SEQ=2048 DLLAMA_FLASH_DECODE=1 BENCH_DEADLINE_S=840 python bench.py
+CMD_TIMEOUT=900 run bench_7b_seq2k env BENCH_SEQ=2048 BENCH_DEADLINE_S=840 python bench.py
+# ---- where the non-kernel ms go (VERDICT next #2) -----------------------
+run ablate_r05 python scripts/ablate_decode.py
+# ---- kernel shootout incl. the new E/F/G variants (next #6) -------------
+run qkernel_r05 python scripts/qkernel_experiments.py all
+run kernel_bench_r05 python scripts/kernel_bench.py
+# ---- prefill + batch throughput ----------------------------------------
+CMD_TIMEOUT=900 run bench_7b_prefill env BENCH_PREFILL=448 BENCH_DEADLINE_S=840 python bench.py
+CMD_TIMEOUT=900 run bench_7b_batch8 env BENCH_BATCH=8 BENCH_DEADLINE_S=840 python bench.py
+CMD_TIMEOUT=900 run bench_7b_batch8_seq1k_flash env BENCH_BATCH=8 BENCH_SEQ=1024 DLLAMA_FLASH_DECODE=1 BENCH_DEADLINE_S=840 python bench.py
+# ---- other model shapes -------------------------------------------------
+CMD_TIMEOUT=900 run bench_tiny env BENCH_MODEL=tiny BENCH_DEADLINE_S=840 python bench.py
+CMD_TIMEOUT=900 run bench_moe env BENCH_MODEL=moe BENCH_DEADLINE_S=840 python bench.py
+CMD_TIMEOUT=900 run bench_grok env BENCH_MODEL=grok BENCH_DEADLINE_S=840 python bench.py
+# ---- the two e2e proofs (VERDICT next #4/#5) ----------------------------
+run native_e2e_r05 python scripts/native_e2e.py /tmp/dllama_native_e2e_$STAMP
+run train_e2e_r05 python scripts/train_tiny_e2e.py results/train_tiny_e2e_r05
+
+log "r05 battery done — results in $OUT/"
