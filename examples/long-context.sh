@@ -11,6 +11,8 @@
 #   flash        DLLAMA_FLASH_DECODE=1 (ops/flash_decode.py: DMA loop reads
 #                only the LIVE prefix — bytes scale with position, not
 #                window; the win grows with the window)
+#   f8+flash     both composed (round 5): half-width cache blocks AND
+#                live-prefix-only reads — the long-context end state
 #
 # Runs on the bench's synthetic-weights path, so no model files are needed.
 #
@@ -26,11 +28,12 @@ SEQS=${*:-1024 2048 4096}
 # silently fall back to TinyLlama off-TPU)
 
 for SEQ in $SEQS; do
-  for MODE in dense f8 flash; do
+  for MODE in dense f8 flash f8+flash; do
     case $MODE in
-      dense) ENV=() ;;
-      f8)    ENV=(BENCH_CACHE=f8) ;;
-      flash) ENV=(DLLAMA_FLASH_DECODE=1) ;;
+      dense)    ENV=() ;;
+      f8)       ENV=(BENCH_CACHE=f8) ;;
+      flash)    ENV=(DLLAMA_FLASH_DECODE=1) ;;
+      f8+flash) ENV=(BENCH_CACHE=f8 DLLAMA_FLASH_DECODE=1) ;;
     esac
     echo "== seq=$SEQ $MODE"
     # a failed config prints its error record (or a clear no-record line if

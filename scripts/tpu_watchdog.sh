@@ -4,10 +4,16 @@
 # after a client dies — this keeps retrying instead of burning an operator's
 # attention.
 #
-#   bash scripts/tpu_watchdog.sh [results_dir] [max_probes]
+#   bash scripts/tpu_watchdog.sh [results_dir] [max_probes] [battery]
 set -u
 OUT=${1:-results}
 MAX=${2:-120}
+BATTERY=${3:-measure_all.sh}
+# fail a typo'd battery name NOW, not after hours of probing
+if [ ! -f "$(dirname "$0")/$BATTERY" ]; then
+  echo "battery script not found: $(dirname "$0")/$BATTERY" >&2
+  exit 1
+fi
 PROBE='
 import time, jax, jax.numpy as jnp
 t0 = time.time()
@@ -18,8 +24,8 @@ print(f"TUNNEL_OK first_matmul={time.time()-t0:.1f}s")
 for i in $(seq 1 "$MAX"); do
   echo "probe $i/$MAX $(date -u +%H:%M:%S)"
   if timeout -k 10 150 python -c "$PROBE" 2>&1 | grep TUNNEL_OK; then
-    echo "tunnel is up — starting battery"
-    exec bash "$(dirname "$0")/measure_all.sh" "$OUT"
+    echo "tunnel is up — starting battery $BATTERY"
+    exec bash "$(dirname "$0")/$BATTERY" "$OUT"
   fi
   sleep 120
 done
