@@ -115,6 +115,16 @@ def build_parser() -> argparse.ArgumentParser:
             )
         if mode in ("inference", "generate", "serve", "chat"):
             sp.add_argument(
+                "--decode-chunk",
+                type=int,
+                default=None,
+                metavar="N",
+                help="fused-decode chunk size (default 64): one device "
+                "dispatch per N tokens. Bigger amortizes host round trips "
+                "(tunneled/remote PJRT); smaller tightens streaming burst "
+                "granularity — batched SSE rows emit one burst per chunk",
+            )
+            sp.add_argument(
                 "--spec-draft",
                 type=int,
                 default=0,
@@ -245,8 +255,14 @@ def load_engine(args):
     if tp_compress and not compress_active:
         print("⚠️  --buffer-float-type q80 only applies to quantized weights "
               "(q40/q80) under --tp; running plain gathers")
+    from dllama_tpu.runtime.generate import DECODE_CHUNK
+
+    # explicit None check: an invalid explicit value (e.g. 0) must reach
+    # Engine's own validation and error, not silently become the default
+    chunk = getattr(args, "decode_chunk", None)
     engine = Engine(cfg, params, sampler_cfg, cache_dtype=cache_dtype, mesh=mesh,
-                    tp_compress=compress_active)
+                    tp_compress=compress_active,
+                    decode_chunk=DECODE_CHUNK if chunk is None else chunk)
     if mesh is not None:
         wire = "q80-compressed" if compress_active else "plain"
         print(f"🔗 tensor-parallel over {n_tp} devices (ICI mesh, {wire} gathers)")

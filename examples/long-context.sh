@@ -5,7 +5,7 @@
 #
 # Decode attention here is a static-shape masked read of the whole KV cache,
 # so per-token cost grows with the context window; this sweep prices one
-# model shape at several windows under three configurations:
+# model shape at several windows under four configurations:
 #   dense        the default path (whole-cache masked reads)
 #   f8           fp8 KV cache (half the cache bytes)
 #   flash        DLLAMA_FLASH_DECODE=1 (ops/flash_decode.py: DMA loop reads

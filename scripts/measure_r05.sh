@@ -77,7 +77,10 @@ CMD_TIMEOUT=900 run bench_tiny env BENCH_MODEL=tiny BENCH_DEADLINE_S=840 python 
 CMD_TIMEOUT=900 run bench_moe env BENCH_MODEL=moe BENCH_DEADLINE_S=840 python bench.py
 CMD_TIMEOUT=900 run bench_grok env BENCH_MODEL=grok BENCH_DEADLINE_S=840 python bench.py
 # ---- the two e2e proofs (VERDICT next #4/#5) ----------------------------
+# each phase is its own process so the single-session relay is never held
+# by a parent while its child waits for a session (the r04 rc=124 lesson)
 run native_e2e_r05 python scripts/native_e2e.py /tmp/dllama_native_e2e_$STAMP
-run train_e2e_r05 python scripts/train_tiny_e2e.py results/train_tiny_e2e_r05
+run train_e2e_r05 python scripts/train_tiny_e2e.py results/train_tiny_e2e_r05 --no-cli
+run train_e2e_cli_r05 python scripts/train_tiny_e2e.py results/train_tiny_e2e_r05 --cli-only
 
 log "r05 battery done — results in $OUT/"

@@ -8,19 +8,26 @@ Exports a tiny random Llama + a synthetic vocab with the current backend's
 PJRT plugin options in the manifest, builds native/, then runs
 ``dllama-native generate`` against the plugin and checks it emits tokens.
 Exits 0 on success.
+
+Session discipline (the r04 battery's rc=124 lesson): the axon relay serves
+ONE session. The export phase runs in a SUBPROCESS that exits (releasing
+the session) before ``dllama-native`` creates its own client — the
+coordinating parent never touches the backend (importing jax is safe: the
+sitecustomize's register() sets the plugin env vars without claiming a
+session; claiming happens at PJRT_Client_Create).
 """
 
 import os
 import subprocess
 import sys
+import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
 
-def main() -> int:
-    out_dir = sys.argv[1] if len(sys.argv) > 1 else "/tmp/dllama_native_e2e"
-
+def export_phase(out_dir: str) -> int:
+    """Touches the backend: export model + tokenizer, then EXIT."""
     import jax.numpy as jnp
 
     from dllama_tpu import export_native
@@ -43,6 +50,26 @@ def main() -> int:
     vocab += [f"<0x{b:02X}>".encode() for b in range(256)]
     tok = TokenizerData(vocab=vocab, scores=[0.0] * len(vocab), bos_id=1, eos_id=2)
     write_tokenizer(os.path.join(out_dir, "tokenizer.t"), tok)
+    print("export phase done")
+    return 0
+
+
+def main() -> int:
+    args = [a for a in sys.argv[1:] if a != "--export-only"]
+    out_dir = args[0] if args else "/tmp/dllama_native_e2e"
+    if "--export-only" in sys.argv:
+        return export_phase(out_dir)
+
+    # phase 1 in a subprocess: its clean exit releases the relay session
+    # before the native binary asks for one
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), out_dir, "--export-only"],
+        timeout=900, cwd=REPO,
+    )
+    if proc.returncode != 0:
+        print("❌ export phase failed")
+        return 1
+    time.sleep(5)  # give the single-session relay a beat to recycle
 
     native = os.path.join(REPO, "native")
     subprocess.run(["make", "-j4"], cwd=native, check=True)

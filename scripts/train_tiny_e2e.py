@@ -19,6 +19,17 @@ decoding from a corpus prefix must reproduce the corpus continuation
 Usage:  python scripts/train_tiny_e2e.py [outdir] [--steps N] [--no-cli]
 Writes  outdir/tiny.m, outdir/tiny.t, outdir/e2e_result.json
 Exit 0 only if the generated continuation matches the corpus.
+
+Session discipline on the TPU (the r04 battery's rc=124 lesson): the axon
+relay serves ONE session, so a parent that holds it starves its own CLI
+child forever. Run the two halves as separate processes there:
+
+    python scripts/train_tiny_e2e.py outdir --no-cli     # train + in-process
+    python scripts/train_tiny_e2e.py outdir --cli-only   # CLI drive, fresh
+
+``--cli-only`` never touches the backend in the parent — the CLI subprocess
+gets the whole session. (Off-TPU the combined run stays fine: the child is
+forced onto CPU.)
 """
 
 from __future__ import annotations
@@ -58,6 +69,70 @@ def build_byte_tokenizer(path: str):
     return tok
 
 
+#: prompt/expected split shared by the in-process and CLI gates (tokens of
+#: one full-corpus encoding; byte vocab maps token n to CORPUS[n-1])
+N_PROMPT, N_STEPS = 100, 85
+
+
+def drive_cli(outdir: str, child_on_cpu: bool) -> bool:
+    """THE CLI-drive block, shared by the combined off-TPU flow and the
+    --cli-only phase so the command, tolerance, and verdict can't drift.
+    ``child_on_cpu``: scrub the relay env vars and force the child onto CPU
+    (off-TPU runs; without it a dead tunnel hangs the child)."""
+    m_path = os.path.join(outdir, "tiny.m")
+    t_path = os.path.join(outdir, "tiny.t")
+    prompt = CORPUS[:N_PROMPT - 1]
+    expected = CORPUS[N_PROMPT - 1:N_PROMPT - 1 + N_STEPS]
+    env = dict(os.environ, PYTHONPATH=REPO)
+    if child_on_cpu:
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, "-m", "dllama_tpu.cli", "generate",
+         "--model", m_path, "--tokenizer", t_path,
+         "--prompt", prompt, "--steps", str(N_STEPS),
+         "--temperature", "0"],
+        capture_output=True, text=True, env=env, cwd=REPO, timeout=1200)
+    # same 95% tolerance as the in-process gate: require the expected
+    # prefix, not the whole continuation verbatim
+    cli_ok = (proc.returncode == 0
+              and expected[:int(0.95 * len(expected))] in proc.stdout)
+    print(f"CLI generate: rc={proc.returncode} match={cli_ok}")
+    if not cli_ok:
+        print(proc.stdout[-1500:])
+        print(proc.stderr[-1500:])
+    return cli_ok
+
+
+def cli_phase(outdir: str) -> int:
+    """--cli-only: drive the CLI on an existing outdir — backend untouched
+    in this process (see module docstring), so the child gets the whole
+    relay session on TPU. Merges its verdict into e2e_result.json. The
+    child goes to CPU when the operator forced this process off the TPU
+    (decided from env alone — touching the backend to ask would claim the
+    very session the child needs)."""
+    m_path = os.path.join(outdir, "tiny.m")
+    t_path = os.path.join(outdir, "tiny.t")
+    res_path = os.path.join(outdir, "e2e_result.json")
+    if not (os.path.exists(m_path) and os.path.exists(t_path)):
+        print(f"--cli-only but {m_path} / {t_path} missing "
+              "(run the training phase first)")
+        return 2
+    child_on_cpu = (os.environ.get("DLLAMA_PLATFORM") == "cpu"
+                    or os.environ.get("JAX_PLATFORMS") == "cpu"
+                    or not os.environ.get("PALLAS_AXON_POOL_IPS"))
+    cli_ok = drive_cli(outdir, child_on_cpu)
+    result = {}
+    if os.path.exists(res_path):
+        with open(res_path) as f:
+            result = json.load(f)
+    result["cli_ok"] = bool(cli_ok)
+    with open(res_path, "w") as f:
+        json.dump(result, f, indent=1)
+    print(json.dumps(result))
+    return 0 if cli_ok else 1
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("outdir", nargs="?", default="results/train_tiny_e2e")
@@ -67,8 +142,15 @@ def main(argv=None) -> int:
     ap.add_argument("--serve-only", action="store_true",
                     help="skip training; serve an existing outdir/tiny.m "
                          "(e.g. re-drive a CPU-trained model on the TPU)")
+    ap.add_argument("--cli-only", action="store_true",
+                    help="only the CLI subprocess drive against an existing "
+                         "outdir; the parent never touches the backend (the "
+                         "single-session relay goes wholly to the child)")
     args = ap.parse_args(argv)
     os.makedirs(args.outdir, exist_ok=True)
+
+    if args.cli_only:
+        return cli_phase(args.outdir)
 
     import jax
     import jax.numpy as jnp
@@ -180,7 +262,7 @@ def main(argv=None) -> int:
     # the corpus suffix. encode() prepends a SentencePiece-style dummy space
     # (like the reference tokenizer), so the prompt/expected split is done on
     # TOKENS of one full-corpus encoding — never by slicing decoded chars.
-    n_prompt, n_steps = 100, 85  # prompt + rollout stays within trained T
+    n_prompt, n_steps = N_PROMPT, N_STEPS  # rollout stays within trained T
     prompt_ids = [bos] + corpus_ids[:n_prompt]  # BOS + corpus prefix
     expected_ids = corpus_ids[n_prompt:n_prompt + n_steps]
     # byte vocab: corpus_ids = [dummy-space] + one token per corpus char, so
@@ -229,29 +311,18 @@ def main(argv=None) -> int:
                  else "underfit, not quantization"))
 
     # ---- and through the actual CLI, as a user would ----
-    cli_ok, cli_out = None, ""
+    cli_ok = None
+    if not args.no_cli and jax.default_backend() == "tpu":
+        # this parent HOLDS the single relay session; a CLI child would wait
+        # for one forever (the r04 rc=124). The battery runs the CLI drive
+        # as its own --cli-only stage after this process exits.
+        print("on TPU: skipping in-process CLI drive — run "
+              f"`python {sys.argv[0]} {args.outdir} --cli-only` next")
+        args.no_cli = True
     if not args.no_cli:
-        env = dict(os.environ, PYTHONPATH=REPO)
-        if jax.default_backend() != "tpu":
-            # keep the child off the axon relay (register() blocks while any
-            # other process holds the single-session tunnel)
-            env.pop("PALLAS_AXON_POOL_IPS", None)
-            env["JAX_PLATFORMS"] = "cpu"
-        proc = subprocess.run(
-            [sys.executable, "-m", "dllama_tpu.cli", "generate",
-             "--model", m_path, "--tokenizer", t_path,
-             "--prompt", prompt, "--steps", str(n_steps),
-             "--temperature", "0"],
-            capture_output=True, text=True, env=env, cwd=REPO, timeout=1200)
-        cli_out = proc.stdout
-        # same 95% tolerance as the in-process gate: require the expected
-        # prefix, not the whole continuation verbatim
-        cli_ok = (proc.returncode == 0
-                  and expected[:int(0.95 * len(expected))] in cli_out)
-        print(f"CLI generate: rc={proc.returncode} match={cli_ok}")
-        if not cli_ok:
-            print(proc.stdout[-1500:])
-            print(proc.stderr[-1500:])
+        # off-TPU: keep the child off the axon relay (register() blocks
+        # while any other process holds the single-session tunnel)
+        cli_ok = drive_cli(args.outdir, child_on_cpu=True)
 
     result = {
         "final_loss": final_loss, "train_seconds": round(train_s, 1),
