@@ -19,6 +19,14 @@ CFG = ModelConfig(
 
 F8 = jnp.float8_e4m3fn
 
+# flash-compatible shape: seq_len % flash BLOCK_S (256) == 0, shared by the
+# composition tests so the flash gate's shape requirements live in ONE place
+FLASH_CFG = ModelConfig(
+    arch="llama", dim=64, hidden_dim=128, n_layers=2, n_heads=4,
+    n_kv_heads=2, vocab_size=96, seq_len=512, head_size=16, kv_dim=32,
+    dtype="float32",
+)
+
 
 def test_f8_cache_logits_close_to_f32_cache():
     params = llama.random_params(CFG, seed=0)
@@ -57,3 +65,70 @@ def test_f8_cache_under_tp():
                 mesh=tp_mesh(4))
     got, _, _ = tp.generate_fused([4, 8], steps=6)
     assert got == want
+
+
+def test_f8_cache_batched_flash_matches_dense(monkeypatch):
+    """generate_batch on an f8 cache with DLLAMA_FLASH_DECODE=1 (the batched
+    flash kernel reading f8 blocks per row) must emit the dense-path rows —
+    the f8 x flash x batch composition in one check."""
+    from dllama_tpu.ops import flash_decode as fd
+
+    params = llama.quantize_params(
+        llama.random_params(FLASH_CFG, seed=2, dtype=np.float32), "q40")
+    prompts = [[5, 9, 3], [7]]
+
+    def run():
+        eng = Engine(FLASH_CFG, params, SamplerConfig(temperature=0.0),
+                     cache_dtype=F8)
+        return eng.generate_batch(prompts, steps=8)
+
+    monkeypatch.delenv("DLLAMA_FLASH_DECODE", raising=False)
+    dense = run()
+    calls = []
+    real = fd.flash_decode_attention_batched
+
+    def spy(*a, **kw):
+        calls.append(a[1].dtype)
+        return real(*a, **kw)
+
+    monkeypatch.setattr(fd, "flash_decode_attention_batched", spy)
+    monkeypatch.setenv("DLLAMA_FLASH_DECODE", "1")
+    flash = run()
+    assert calls and all(d == F8 for d in calls), calls
+    assert flash == dense
+
+
+def test_f8_cache_spec_decode_flash_matches_dense(monkeypatch):
+    """generate_spec (T=draft+1 verify rows) on an f8 cache with flash on
+    must emit the dense-path stream — the spec-verify x f8 x flash corner.
+    The kernel spy pins that flash really traced (incl. a T>1 verify row):
+    a silently-declining gate would compare dense vs dense."""
+    from dllama_tpu.ops import flash_decode as fd
+
+    params = llama.quantize_params(
+        llama.random_params(FLASH_CFG, seed=3, dtype=np.float32), "q40")
+
+    def run(spy_calls=None):
+        if spy_calls is not None:
+            real = fd.flash_decode_attention
+
+            def spy(*a, **kw):
+                spy_calls.append((a[0].shape[0], a[1].dtype))
+                return real(*a, **kw)
+
+            monkeypatch.setattr(fd, "flash_decode_attention", spy)
+            monkeypatch.setattr(
+                "dllama_tpu.models.llama.flash_decode.flash_decode_attention",
+                spy)
+        eng = Engine(FLASH_CFG, params, SamplerConfig(temperature=0.0),
+                     cache_dtype=F8)
+        return [t for t, _ in eng.generate_spec([1, 5, 9], steps=12)]
+
+    monkeypatch.delenv("DLLAMA_FLASH_DECODE", raising=False)
+    dense = run()
+    monkeypatch.setenv("DLLAMA_FLASH_DECODE", "1")
+    calls = []
+    flash = run(spy_calls=calls)
+    assert calls and all(d == F8 for _, d in calls), calls[:4]
+    assert any(T > 1 for T, _ in calls), "no multi-row verify step traced"
+    assert flash == dense and len(dense) == 12
