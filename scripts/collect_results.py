@@ -1,0 +1,90 @@
+"""Collate a measurement battery's banked records into one markdown table.
+
+Scans ``results/*_<stamp>.out`` files for the single-line JSON records the
+bench emits (and the shootout/ablation's plain-text lines), newest stamp per
+stage name, and prints a markdown summary ready to paste into RESULTS_r05.md.
+
+Usage: python scripts/collect_results.py [results_dir]
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import re
+import sys
+
+
+def latest_per_stage(results_dir: str) -> dict:
+    """{stage: path} for the newest timestamped .out of each stage."""
+    stages: dict = {}
+    for path in sorted(glob.glob(os.path.join(results_dir, "*_*.out"))):
+        base = os.path.basename(path)
+        m = re.match(r"(.+)_(\d{8}T\d{6})\.out$", base)
+        if not m:
+            continue
+        name, stamp = m.groups()
+        if name not in stages or stamp > stages[name][0]:
+            stages[name] = (stamp, path)
+    return {k: v[1] for k, v in stages.items()}
+
+
+def last_json(path: str):
+    rec = None
+    with open(path, errors="replace") as f:
+        for line in f:
+            line = line.strip()
+            if line.startswith("{") and line.endswith("}"):
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    pass
+    return rec
+
+
+def main() -> int:
+    results_dir = sys.argv[1] if len(sys.argv) > 1 else "results"
+    stages = latest_per_stage(results_dir)
+    if not stages:
+        print(f"no staged .out records in {results_dir}/")
+        return 1
+
+    bench_rows = []
+    other = []
+    for name in sorted(stages):
+        path = stages[name]
+        rec = last_json(path)
+        if rec and "metric" in rec:
+            err = rec.get("error")
+            bench_rows.append(
+                (name, rec.get("metric"), rec.get("value"),
+                 rec.get("weights"), rec.get("vs_baseline"),
+                 f" ERROR: {err}" if err else ""))
+        else:
+            # shootout/ablation/e2e stages: surface their last few lines
+            with open(path, errors="replace") as f:
+                tail = [ln.rstrip() for ln in f.readlines() if ln.strip()][-6:]
+            other.append((name, tail))
+
+    if bench_rows:
+        dash = lambda v: "—" if v is None else v  # noqa: E731
+        print("| stage | metric | ms/token | weights | vs baseline | note |")
+        print("|---|---|---|---|---|---|")
+        for name, metric, value, weights, vs, err in bench_rows:
+            print(f"| {name} | {metric} | {dash(value)} | {dash(weights)} |"
+                  f" {dash(vs)} | {err.strip() or '—'} |")
+        print()
+    for name, tail in other:
+        print(f"### {name}")
+        for ln in tail:
+            print(f"    {ln}")
+        print()
+    return 0
+
+
+if __name__ == "__main__":
+    try:
+        raise SystemExit(main())
+    except BrokenPipeError:  # | head etc. closing stdout is not an error
+        raise SystemExit(0)

@@ -116,10 +116,9 @@ def test_f8_cache_spec_decode_flash_matches_dense(monkeypatch):
                 spy_calls.append((a[0].shape[0], a[1].dtype))
                 return real(*a, **kw)
 
+            # llama.py imports the MODULE, so patching fd's attribute is the
+            # single patch point (no function-level import to chase)
             monkeypatch.setattr(fd, "flash_decode_attention", spy)
-            monkeypatch.setattr(
-                "dllama_tpu.models.llama.flash_decode.flash_decode_attention",
-                spy)
         eng = Engine(FLASH_CFG, params, SamplerConfig(temperature=0.0),
                      cache_dtype=F8)
         return [t for t, _ in eng.generate_spec([1, 5, 9], steps=12)]
