@@ -894,6 +894,93 @@ def forward_batched(
     return logits, {"k": new_k, "v": new_v}
 
 
+def forward_batched_verify(
+    cfg: ModelConfig,
+    params: dict,
+    rope: dict,
+    tokens: jnp.ndarray,  # [B, T] int32 — pending + draft rows per sequence
+    cache: dict,  # {"k","v": [L, B, S, n_kv, hd]}
+    pos: jnp.ndarray,  # [B] int32 — position of tokens[b, 0]
+) -> tuple:
+    """T tokens for each of B independent sequences -> (logits [B, T, vocab]
+    f32, cache): the BATCHED speculative-verify step. Row b's math is
+    exactly ``forward`` at (T, pos[b]) — T=draft_len+1 candidate positions
+    scored in one weight-streaming pass for ALL rows, composing the two
+    bandwidth wins (batching shares the weight stream across sequences,
+    speculation shares it across positions within each sequence).
+
+    All matmuls run on the flattened [B*T, dim] activation (one kernel call
+    per matrix — the quant kernels never see the batch structure); rope,
+    cache writes, and attention are per-row (vmap over the pure attention).
+    MoE routing on the flattened rows is exact: the selected-experts union
+    caps at min(E, B*T*k). Dense attention only (the batched flash kernel
+    is one-token-per-row); single-mesh only (no tp_axis — the shard_map
+    wrappers cover plain decode).
+    """
+    B, T = tokens.shape
+    x = embed(cfg, params, tokens)  # [B, T, dim]
+    layers = params["layers"]
+
+    def layer_step(carry, idx):
+        x, k_cache, v_cache = carry
+        lp = {
+            name: (leaf if isinstance(leaf, QuantTensor)
+                   else jax.lax.dynamic_index_in_dim(leaf, idx, 0, keepdims=False))
+            for name, leaf in layers.items()
+        }
+        xb = rmsnorm(x, lp["rms_att"], cfg.norm_eps)
+        xf = xb.reshape(B * T, cfg.dim)
+        if "wqkv" in lp:
+            qkv = matmul_any(xf, lp["wqkv"], idx)
+            d, kv = cfg.dim, cfg.kv_dim
+            q, k, v = qkv[:, :d], qkv[:, d : d + kv], qkv[:, d + kv :]
+        else:
+            q = matmul_any(xf, lp["wq"], idx)
+            k = matmul_any(xf, lp["wk"], idx)
+            v = matmul_any(xf, lp["wv"], idx)
+        q = q.reshape(B, T, -1, cfg.head_size)
+        k = k.reshape(B, T, -1, cfg.head_size)
+        v = v.reshape(B, T, -1, cfg.head_size)
+
+        # per-row angles for positions pos[b]..pos[b]+T-1 (the table gather
+        # clamps at seq_len-1; rows that close are emission-capped by the
+        # caller's budgets before any clamped position could be emitted)
+        ppos = pos[:, None] + jnp.arange(T, dtype=jnp.int32)[None, :]
+        cos = rope["cos"][ppos][:, :, None, :]  # [B, T, 1, hs/2]
+        sin = rope["sin"][ppos][:, :, None, :]
+        q = apply_rope(q, cos, sin, cfg.rope_style)
+        k = apply_rope(k, cos, sin, cfg.rope_style)
+
+        slab_k = jax.lax.dynamic_index_in_dim(k_cache, idx, 0, keepdims=False)
+        slab_v = jax.lax.dynamic_index_in_dim(v_cache, idx, 0, keepdims=False)
+        write = jax.vmap(
+            lambda c, kk, p: jax.lax.dynamic_update_slice_in_dim(
+                c, kk.astype(c.dtype), p, axis=0))
+        slab_k = write(slab_k, k, pos)
+        slab_v = write(slab_v, v, pos)
+        zero = (0, 0, 0, 0)
+        k_cache = jax.lax.dynamic_update_slice(k_cache, slab_k[None], (idx, *zero))
+        v_cache = jax.lax.dynamic_update_slice(v_cache, slab_v[None], (idx, *zero))
+
+        out = jax.vmap(gqa_attention)(q, slab_k, slab_v, pos)  # [B, T, H, hd]
+        att = matmul_any(out.reshape(B * T, -1), lp["wo"], idx)
+        x = _ffn_residual(cfg, lp, x.reshape(B * T, cfg.dim),
+                          att, layer=idx).reshape(B, T, cfg.dim)
+        return (x, k_cache, v_cache), None
+
+    (x, new_k, new_v), _ = jax.lax.scan(
+        layer_step, (x, cache["k"], cache["v"]),
+        jnp.arange(cfg.n_layers, dtype=jnp.int32),
+    )
+    x = rmsnorm(x, params["rms_final"], cfg.norm_eps)
+    logits = matmul_any(x.reshape(B * T, cfg.dim),
+                        params["wcls"]).astype(jnp.float32)
+    logits = logits.reshape(B, T, -1)
+    if cfg.logit_scale != 1.0:
+        logits = logits * cfg.logit_scale
+    return logits, {"k": new_k, "v": new_v}
+
+
 def forward_train(
     cfg: ModelConfig,
     params: dict,

@@ -265,6 +265,17 @@ class Engine:
         )
 
         @partial(jax.jit, donate_argnums=(2,))
+        def _verify_batch(params, rope, cache, tokens, pos):
+            """Batched greedy speculative verify: [B, T] candidate rows ->
+            every (row, position)'s argmax next token in ONE program — the
+            batching and speculation bandwidth wins composed (weights stream
+            once for B sequences x T positions). Single-mesh path only
+            (llama.forward_batched_verify)."""
+            logits, cache = llama.forward_batched_verify(
+                cfg, params, rope, tokens, cache, pos)
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32), cache
+
+        @partial(jax.jit, donate_argnums=(2,))
         def _verify_step(params, rope, cache, tokens, pos):
             """Speculative verify: feed [pending, draft_1..draft_k] at pos,
             return every position's greedy next token. One device program
@@ -292,6 +303,7 @@ class Engine:
         self._decode_loop = partial(_decode_loop, self.params, self.rope)
         self._decode_loop_batch = partial(_decode_loop_batch, self.params, self.rope)
         self._verify_step = partial(_verify_step, self.params, self.rope)
+        self._verify_batch = partial(_verify_batch, self.params, self.rope)
         self._verify_sampled = partial(_verify_sampled, self.params, self.rope)
 
         # compiled once; materializes the cache already-sharded (allocate-then-
@@ -661,31 +673,9 @@ class Engine:
                     else self.next_key())
             keys = jax.random.split(base, B)
 
-        t0 = time.perf_counter()
-        # Per-row prefill of everything but the LAST prompt token (its feed
-        # is the uniform first batched step, so a row emits min(steps, room)
-        # tokens). Each prefilled single-sequence cache is written straight
-        # into the preallocated [L, B, S, kv, hd] batch cache (donated
-        # in-place update), so peak HBM is the batch cache plus ONE single
-        # cache — never B of them side by side.
-        cache = self._batch_cache_init(B)
-        # rows sharing a prompt prefix (the OpenAI `n` case: n samples of
-        # one prompt) prefill ONCE and copy into each row
-        groups: dict = {}
-        for b, p in enumerate(prompts):
-            if len(p) > 1:
-                groups.setdefault(tuple(p[:-1]), []).append(b)
-        for prefix, rows_b in groups.items():
-            single = self.new_cache()
-            _, single = self.prefill(single, list(prefix), 0)
-            for b in rows_b:
-                cache = self._batch_cache_insert(cache, single, jnp.int32(b))
-            del single  # 1-token-prompt rows keep their zero slots
-        pend = [int(p[-1]) for p in prompts]
-        poss = [len(p) - 1 for p in prompts]
+        cache, pend, poss = self._prefill_batch_rows(prompts)
         tokens = jnp.asarray(pend, jnp.int32)
         pos = jnp.asarray(poss, jnp.int32)
-        self.prefill_ms = (time.perf_counter() - t0) * 1000.0
 
         rooms = [self.cfg.seq_len - p for p in poss]  # feeds each row allows
         steps = min(steps, max(rooms))
@@ -728,6 +718,151 @@ class Engine:
                 break
         self.decode_ms = (time.perf_counter() - t1) * 1000.0
         return out
+
+    def _prefill_batch_rows(self, prompts: list) -> tuple:
+        """Shared-prefix batched prefill for the batch decode paths: init the
+        [L, B, S, kv, hd] cache, prefill each DISTINCT prompt prefix once
+        (rows sharing a prefix — the OpenAI `n` case — reuse it) and write
+        it straight into the batch cache (donated in-place update), so peak
+        HBM is the batch cache plus ONE single cache — never B side by
+        side. The last prompt token stays pending (the uniform first
+        batched step feeds it, so a row emits min(steps, room) tokens).
+        Returns (cache, pending tokens [B], positions [B]); sets
+        prefill_ms."""
+        t0 = time.perf_counter()
+        cache = self._batch_cache_init(len(prompts))
+        groups: dict = {}
+        for b, p in enumerate(prompts):
+            if len(p) > 1:
+                groups.setdefault(tuple(p[:-1]), []).append(b)
+        for prefix, rows_b in groups.items():
+            single = self.new_cache()
+            _, single = self.prefill(single, list(prefix), 0)
+            for b in rows_b:
+                cache = self._batch_cache_insert(cache, single, jnp.int32(b))
+            del single  # 1-token-prompt rows keep their zero slots
+        pend = [int(p[-1]) for p in prompts]
+        poss = [len(p) - 1 for p in prompts]
+        self.prefill_ms = (time.perf_counter() - t0) * 1000.0
+        return cache, pend, poss
+
+    def generate_batch_spec(
+        self, prompts: list, steps: int,
+        stop_tokens: tuple = (),
+        row_steps: Optional[list] = None,
+        draft_len: int = 8,
+        ngram: int = 3,
+        sampler: Optional[SamplerConfig] = None,
+    ) -> tuple:
+        """Batched GREEDY decode with prompt-lookup speculative drafting:
+        every verify step scores draft_len+1 candidate positions for ALL B
+        sequences in one weight-streaming pass — the two bandwidth
+        multipliers (batching across sequences, speculation across
+        positions) composed. Beyond both the reference (one token, one
+        sequence per step) and this engine's own generate_batch /
+        generate_spec taken alone.
+
+        Returns (rows, stats): row b equals generate_batch's greedy row b
+        truncated at its first stop token (speculation changes the
+        schedule, never the tokens — per-position argmax is what the plain
+        batched step computes; generate_batch rows may CARRY tokens past a
+        stop for the caller to truncate, this path truncates itself);
+        stats = {"verify_steps", "accepted_drafts", "emitted"}.
+
+        Greedy only (``sampler`` with temperature > 0 raises): replaying B
+        per-row sampled key chains through a shared-T verify is bookkeeping
+        this path doesn't carry yet — sampled batches run generate_batch,
+        sampled solo spec runs generate_spec. Single mesh only (a mesh
+        engine raises: _verify_batch jits forward_batched_verify directly,
+        which has no shard_map wrapper — the quant-TP layout would feed the
+        kernels per-shard planes); rows with no matching n-gram still
+        verify their pending token (a T-row step emits at least 1 token
+        per row, exactly like plain decode).
+
+        Cache safety mirrors generate_spec: rejected/pad slots hold garbage
+        K/V that later steps overwrite before any query attends them; a
+        FINISHED row keeps verifying its pending token in place without
+        advancing — its emissions are already taken, and its (per-row) cache
+        slab can't affect other rows.
+        """
+        if not prompts or any(not p for p in prompts):
+            raise ValueError("generate_batch_spec needs non-empty prompts")
+        if self.mesh is not None:
+            raise ValueError(
+                "generate_batch_spec does not run on a mesh engine (no "
+                "shard_map wrapper for the batched verify forward); use "
+                "generate_batch under TP")
+        scfg = sampler if sampler is not None else self.sampler_cfg
+        if scfg.temperature > 0.0:
+            raise ValueError(
+                "generate_batch_spec is greedy-only; use generate_batch for "
+                "sampled batches or generate_spec for sampled solo decoding")
+        B = len(prompts)
+        S = self.cfg.seq_len
+
+        cache, pend, poss = self._prefill_batch_rows(prompts)
+
+        rooms = [S - p for p in poss]
+        budgets = [min(rooms[b], row_steps[b] if row_steps else steps,
+                       steps) for b in range(B)]
+        indexes = [_NgramIndex(ngram) for _ in range(B)]
+        for b, p in enumerate(prompts):
+            indexes[b].extend(p[:-1])
+        out: list = [[] for _ in range(B)]
+        done = [budgets[b] <= 0 for b in range(B)]
+        verify_steps = accepted = 0
+
+        t1 = time.perf_counter()
+        while not all(done):
+            # shared static T, shrunk so the most context-constrained ACTIVE
+            # row's write window stays in range (T values bucket to at most
+            # draft_len+1 distinct compiles)
+            T = min(draft_len + 1,
+                    min(S - poss[b] for b in range(B) if not done[b]))
+            T = max(T, 1)
+            feeds, drafts = [], []
+            for b in range(B):
+                if done[b]:
+                    drafts.append([])
+                    feeds.append([pend[b]] * T)  # re-verify in place
+                    continue
+                k = min(T - 1, budgets[b] - len(out[b]) - 1)
+                d = indexes[b].draft(pend[b], k) if k > 0 else []
+                drafts.append(d)
+                feeds.append([pend[b]] + d + [0] * (T - 1 - len(d)))
+            g, cache = self._verify_batch(
+                cache, jnp.asarray(feeds, jnp.int32),
+                jnp.asarray([min(poss[b], S - T) if done[b] else poss[b]
+                             for b in range(B)], jnp.int32))
+            g = np.asarray(g)  # [B, T]
+            verify_steps += 1
+            for b in range(B):
+                if done[b]:
+                    continue
+                row = [int(v) for v in g[b]]
+                m = 0
+                while m < len(drafts[b]) and drafts[b][m] == row[m]:
+                    m += 1
+                accepted += m
+                emit = row[: m + 1]
+                take = min(len(emit), budgets[b] - len(out[b]))
+                for j in range(take):
+                    if emit[j] in stop_tokens:
+                        take = j + 1
+                        break
+                emit = emit[:take]
+                indexes[b].extend([pend[b]] + drafts[b][:m])
+                out[b].extend(emit)
+                pend[b] = emit[-1]
+                poss[b] += m + 1
+                if (len(out[b]) >= budgets[b]
+                        or (stop_tokens and emit
+                            and emit[-1] in stop_tokens)):
+                    done[b] = True
+        self.decode_ms = (time.perf_counter() - t1) * 1000.0
+        return out, {"verify_steps": verify_steps,
+                     "accepted_drafts": accepted,
+                     "emitted": sum(len(r) for r in out)}
 
     def generate_spec(
         self,

@@ -183,16 +183,28 @@ class Batcher:
         batch path every turn — batching only changes anything under real
         concurrency. Caller holds state.lock. Tokens are bit-identical to
         the batched row (same per-request chain; the invariant
-        generate_batch documents)."""
+        generate_batch documents). A --spec-draft server speculates here
+        too (generate_spec is exact at any temperature)."""
         st = self.state
         try:
             stop_ids = st.stop_token_ids()
             session, feed = st.take_prefix_session(s.prompt)
             history = list(s.prompt)
+            if st.spec_draft > 0:
+                pending = 1 if (session is not None
+                                and session.pending_token is not None) else 0
+                n_consumed = len(s.prompt) - len(feed) - pending
+                stream = st.engine.generate_spec(
+                    feed, s.steps, session=session, stop_tokens=stop_ids,
+                    draft_len=st.spec_draft,
+                    history=s.prompt[:n_consumed] if session else None,
+                    sampler=s.sampler)
+            else:
+                stream = st.engine.generate(feed, s.steps, session=session,
+                                            stop_tokens=stop_ids,
+                                            sampler=s.sampler)
             toks: list = []
-            for t, _ in st.engine.generate(feed, s.steps, session=session,
-                                           stop_tokens=stop_ids,
-                                           sampler=s.sampler):
+            for t, _ in stream:
                 history.append(t)
                 toks.append(t)
                 if s.queue is not None:
@@ -235,16 +247,34 @@ class Batcher:
             # the batch decoding to the whole envelope
             prompts, row_steps = padded_batch(
                 [s.prompt for s in batch], [s.steps for s in batch])
-            samplers = [s.sampler for s in batch] + [
-                SamplerConfig(temperature=0.0, seed=0)
-            ] * (len(prompts) - len(batch))
-            rows = self.state.engine.generate_batch(
-                prompts, max(s.steps for s in batch),
-                samplers=samplers,
-                stop_tokens=self.state.stop_token_ids(),
-                row_steps=row_steps,
-                on_chunk=on_chunk,
-            )
+            if (self.state.spec_draft > 0
+                    and self.state.engine.mesh is None
+                    and all(s.sampler.temperature == 0.0 and s.queue is None
+                            for s in batch)):
+                # all-greedy non-streaming batch on a --spec-draft server:
+                # BATCHED speculative verify — every launch scores
+                # draft_len+1 positions for all rows (exact; rows equal
+                # plain batched greedy). Mixed/sampled/streaming batches
+                # fall through to the plain batched decode below, and so
+                # do TENSOR-PARALLEL engines (generate_batch_spec has no
+                # shard_map wrapper; generate_batch does).
+                rows, _stats = self.state.engine.generate_batch_spec(
+                    prompts, max(s.steps for s in batch),
+                    stop_tokens=self.state.stop_token_ids(),
+                    row_steps=row_steps,
+                    draft_len=self.state.spec_draft,
+                )
+            else:
+                samplers = [s.sampler for s in batch] + [
+                    SamplerConfig(temperature=0.0, seed=0)
+                ] * (len(prompts) - len(batch))
+                rows = self.state.engine.generate_batch(
+                    prompts, max(s.steps for s in batch),
+                    samplers=samplers,
+                    stop_tokens=self.state.stop_token_ids(),
+                    row_steps=row_steps,
+                    on_chunk=on_chunk,
+                )
             for s, row in zip(batch, rows):
                 s.tokens = row[: s.steps]
                 if s.queue is not None:
@@ -655,7 +685,7 @@ class OpenAIHandler(BaseHTTPRequestHandler):
             }))
             return
 
-        if (st.batcher is not None and not stops and st.spec_draft == 0
+        if (st.batcher is not None and not stops
                 and not st.has_prefix_session(prompt_tokens)):
             # stop STRINGS stay on the solo path: its host loop aborts at
             # the string, while a batch would decode the row's whole budget
@@ -666,7 +696,10 @@ class OpenAIHandler(BaseHTTPRequestHandler):
             # Everything else — greedy or sampled, streaming or not —
             # merges into one batched decode; every row runs its own
             # sampler chain, so tokens are bit-identical to the solo path
-            # for the same SamplerConfig.
+            # for the same SamplerConfig. On a --spec-draft server an
+            # all-greedy non-streaming batch runs the BATCHED speculative
+            # verify (Batcher._serve); singletons speculate on the solo
+            # path either way.
             if stream:
                 self._stream_batched(base, sampler, prompt_tokens, max_tokens)
             else:

@@ -712,3 +712,64 @@ def test_batched_server_singleton_keeps_prefix_cache():
         assert len(fed) >= 2 and fed[-1] <= 4, fed
     finally:
         srv.shutdown()
+
+
+def test_spec_server_batches_concurrent_greedy_via_batched_verify():
+    """--spec-draft + --batch-window: concurrent greedy non-streaming
+    requests must run through Engine.generate_batch_spec (spy-pinned) and
+    return exactly the replies a plain server (no spec, no batching) gives —
+    batched speculation is exact."""
+    tok = make_tokenizer()
+    cfg = tiny_cfg(vocab_size=tok.vocab_size, seq_len=512, dim=32, kv_dim=16,
+                   head_size=8, hidden_dim=64)
+    params = llama.random_params(cfg, seed=13)
+
+    def run_server(window_ms, spec):
+        engine = Engine(cfg, params, SamplerConfig(temperature=0.0, seed=1))
+        state = ServerState(engine, tok, cfg, model_name="tiny-test",
+                            template="llama3", batch_window_ms=window_ms,
+                            spec_draft=spec)
+        calls = []
+        orig = engine.generate_batch_spec
+
+        def spy(*a, **kw):
+            calls.append(1)
+            return orig(*a, **kw)
+
+        engine.generate_batch_spec = spy
+        srv = create_server(state, host="127.0.0.1", port=0)
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        return srv, srv.server_address[1], calls
+
+    prompts = ["hello world hello world", "the the the cat"]
+
+    def ask_all(port):
+        replies = [None] * len(prompts)
+
+        def one(i):
+            _, d = request(port, "POST", "/v1/chat/completions",
+                           chat_body(messages=[{"role": "user",
+                                                "content": prompts[i]}],
+                                     max_tokens=6))
+            replies[i] = json.loads(d)["choices"][0]["message"]["content"]
+
+        threads = [threading.Thread(target=one, args=(i,))
+                   for i in range(len(prompts))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return replies
+
+    srv_plain, port_plain, _ = run_server(0, 0)
+    srv_spec, port_spec, calls = run_server(400.0, 4)
+    try:
+        request(port_spec, "POST", "/v1/chat/completions",
+                chat_body(max_tokens=2))  # warm compiles before the burst
+        want = ask_all(port_plain)
+        got = ask_all(port_spec)
+        assert got == want
+        assert calls, "generate_batch_spec never ran for the greedy batch"
+    finally:
+        srv_plain.shutdown()
+        srv_spec.shutdown()

@@ -1,0 +1,144 @@
+"""Batched speculative decoding: forward_batched_verify and
+Engine.generate_batch_spec.
+
+The verify forward must match per-row solo ``forward`` at (T, pos[b])
+exactly (the sharding-invariance idea applied to the batch axis), and the
+engine's batched spec streams must equal the plain batched greedy rows —
+speculation changes the schedule, never the tokens.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dllama_tpu.models import llama
+from dllama_tpu.models.config import ModelConfig
+from dllama_tpu.runtime.generate import Engine
+from dllama_tpu.runtime.sampler import SamplerConfig
+
+CFG = ModelConfig(
+    arch="llama", dim=64, hidden_dim=128, n_layers=2, n_heads=4, n_kv_heads=2,
+    vocab_size=96, seq_len=64, head_size=16, kv_dim=32, dtype="float32",
+)
+
+MOE_CFG = ModelConfig(
+    arch="mixtral", dim=64, hidden_dim=128, n_layers=2, n_heads=4, n_kv_heads=4,
+    vocab_size=96, seq_len=64, head_size=16, kv_dim=64, n_experts=8,
+    n_active_experts=2, dtype="float32",
+)
+
+
+@pytest.mark.parametrize("cfg,quant", [(CFG, None), (CFG, "q40"),
+                                       (MOE_CFG, "q40")])
+def test_verify_forward_matches_per_row_solo(cfg, quant):
+    """[B, T] verify logits row b == solo forward of the same T tokens at
+    pos[b] against row b's cache — mixed positions, one launch."""
+    params = llama.random_params(cfg, seed=0, dtype=np.float32)
+    if quant:
+        params = llama.quantize_params(params, quant)
+    params = jax.tree.map(jnp.asarray, params)
+    rope = llama.rope_tables(cfg)
+    B, T = 3, 4
+    rng = np.random.default_rng(5)
+    tokens = jnp.asarray(rng.integers(1, cfg.vocab_size, (B, T)), jnp.int32)
+    pos = jnp.asarray([0, 7, 13], jnp.int32)
+
+    # per-row caches with real history: prefill row b with p[b] tokens solo
+    history = [list(rng.integers(1, cfg.vocab_size, int(p)))
+               for p in np.asarray(pos)]
+    solo_caches = []
+    want = []
+    for b in range(B):
+        cache = llama.init_cache(cfg)
+        if history[b]:
+            _, cache = jax.jit(
+                lambda p, r, c, t: llama.forward(cfg, p, r, t, c, jnp.int32(0))
+            )(params, rope, cache, jnp.asarray(history[b], jnp.int32))
+        solo_caches.append(cache)
+        logits, _ = jax.jit(
+            lambda p, r, c, t, q: llama.forward(cfg, p, r, t, c, q)
+        )(params, rope, jax.tree.map(jnp.copy, cache), tokens[b], pos[b])
+        want.append(np.asarray(logits))
+
+    batch_cache = {
+        kk: jnp.stack([solo_caches[b][kk] for b in range(B)], axis=1)
+        for kk in ("k", "v")
+    }
+    got, new_cache = jax.jit(
+        lambda p, r, c, t, q: llama.forward_batched_verify(cfg, p, r, t, c, q)
+    )(params, rope, batch_cache, tokens, pos)
+    got = np.asarray(got)
+    for b in range(B):
+        np.testing.assert_allclose(got[b], want[b], rtol=2e-4, atol=2e-4)
+    assert new_cache["k"].shape == batch_cache["k"].shape
+
+
+@pytest.mark.parametrize("cfg,quant", [(CFG, "q40"), (MOE_CFG, "q40"),
+                                       (CFG, None)])
+def test_generate_batch_spec_equals_plain_batched(cfg, quant):
+    """Batched spec greedy rows == plain generate_batch greedy rows, with a
+    repetitive prompt so drafts actually accept (multi-token steps)."""
+    params = llama.random_params(cfg, seed=1, dtype=np.float32)
+    if quant:
+        params = llama.quantize_params(params, quant)
+    # repetition makes the n-gram index draft successfully
+    prompts = [[5, 9, 3, 5, 9, 3, 5, 9], [7, 7, 7, 7, 7], [4, 2]]
+
+    eng = Engine(cfg, params, SamplerConfig(temperature=0.0))
+    want = eng.generate_batch(prompts, steps=12)
+    eng2 = Engine(cfg, params, SamplerConfig(temperature=0.0))
+    got, stats = eng2.generate_batch_spec(prompts, steps=12, draft_len=4)
+    assert got == want
+    # the whole point: drafts actually accept on repetitive context, so
+    # some launch emitted multiple tokens for some row
+    assert stats["accepted_drafts"] > 0, stats
+
+
+def test_generate_batch_spec_stop_tokens_and_budgets():
+    params = llama.quantize_params(
+        llama.random_params(CFG, seed=2, dtype=np.float32), "q40")
+    eng = Engine(CFG, params, SamplerConfig(temperature=0.0))
+    plain = eng.generate_batch([[5, 9, 3], [7]], steps=10,
+                               row_steps=[3, 10])
+    eng2 = Engine(CFG, params, SamplerConfig(temperature=0.0))
+    spec, _ = eng2.generate_batch_spec([[5, 9, 3], [7]], steps=10,
+                                       row_steps=[3, 10], draft_len=4)
+    assert spec[0][:3] == plain[0][:3] and spec[1] == plain[1]
+    # row budgets honored
+    assert len(spec[0]) == 3 and len(spec[1]) == 10
+
+
+def test_generate_batch_spec_stop_token_truncates_row():
+    """spec rows truncate AT their first stop token (contract: equal to the
+    plain greedy row truncated there); the other row keeps its budget."""
+    params = llama.quantize_params(
+        llama.random_params(CFG, seed=2, dtype=np.float32), "q40")
+    eng = Engine(CFG, params, SamplerConfig(temperature=0.0))
+    plain = eng.generate_batch([[5, 9, 3], [7]], steps=10)
+    # pick a stop token that actually occurs mid-row in row 0's stream
+    stop = plain[0][4]
+    cut = plain[0].index(stop) + 1
+    eng2 = Engine(CFG, params, SamplerConfig(temperature=0.0))
+    spec, _ = eng2.generate_batch_spec([[5, 9, 3], [7]], steps=10,
+                                       draft_len=4, stop_tokens=(stop,))
+    assert spec[0] == plain[0][:cut]
+    if stop in plain[1]:
+        assert spec[1] == plain[1][: plain[1].index(stop) + 1]
+    else:
+        assert spec[1] == plain[1]
+
+
+def test_generate_batch_spec_rejects_sampled_and_mesh():
+    from dllama_tpu.parallel.mesh import tp_mesh
+
+    params = llama.quantize_params(
+        llama.random_params(CFG, seed=3, dtype=np.float32), "q40")
+    eng = Engine(CFG, params, SamplerConfig(temperature=0.0))
+    with pytest.raises(ValueError):
+        eng.generate_batch_spec([[1]], steps=4,
+                                sampler=SamplerConfig(temperature=0.8))
+    mesh_eng = Engine(CFG, params, SamplerConfig(temperature=0.0),
+                      mesh=tp_mesh(2))
+    with pytest.raises(ValueError):
+        mesh_eng.generate_batch_spec([[1]], steps=4)
