@@ -336,6 +336,46 @@ def run_decode_bench(cfg_dict: dict, bench_steps: int = None, quant_ok: bool = F
                 f"({1000.0 / ms_tok:.0f} tok/s prefill)")
         return min(times), f"{weights}-prefill{pf}{cfg_tag}"
 
+    # BENCH_SPEC=K measures speculative decoding (prompt-lookup drafts of up
+    # to K tokens, exact greedy): solo generate_spec, or — with BENCH_BATCH —
+    # generate_batch_spec (draft_len+1 positions x B rows per weight pass).
+    # The prompt repeats a short phrase so drafting has something to match;
+    # the acceptance rate is printed so the number can be read honestly
+    # (random weights don't generate Shakespeare, but greedy loops repeat).
+    spec = _env_count("BENCH_SPEC")
+    if spec:
+        rng_p = __import__("numpy").random.default_rng(1)
+        phrase = [int(t) for t in rng_p.integers(1, cfg.vocab_size, 6)]
+        prompt = (phrase * 6)[:30]
+        if batch > 1:
+            prompts = [list(prompt)] * batch
+            log(f"warmup (batched spec, B={batch}, draft={spec})...")
+            eng.generate_batch_spec(prompts, steps=bench_steps, draft_len=spec)
+            times = []
+            for rep in range(3):
+                t1 = time.perf_counter()
+                rows, stats = eng.generate_batch_spec(
+                    prompts, steps=bench_steps, draft_len=spec)
+                wall = (time.perf_counter() - t1) * 1000.0
+                emitted = stats["emitted"]
+                times.append(wall / emitted)
+                log(f"rep {rep}: {wall / emitted:.3f} ms/token effective "
+                    f"({emitted} tokens, {stats['verify_steps']} launches, "
+                    f"{stats['accepted_drafts']} drafts accepted)")
+            return min(times), f"{weights}-spec{spec}-batch{batch}{cfg_tag}"
+        log(f"warmup (solo spec, draft={spec})...")
+        list(eng.generate_spec(list(prompt), steps=bench_steps))
+        times = []
+        for rep in range(3):
+            t1 = time.perf_counter()
+            toks = [t for t, _ in eng.generate_spec(list(prompt),
+                                                    steps=bench_steps)]
+            wall = (time.perf_counter() - t1) * 1000.0
+            times.append(wall / max(1, len(toks)))
+            log(f"rep {rep}: {wall / max(1, len(toks)):.3f} ms/token "
+                f"({len(toks)} tokens)")
+        return min(times), f"{weights}-spec{spec}{cfg_tag}{flash_tag}"
+
     # BENCH_BATCH=N measures BATCHED decode: N sequences share one weight
     # stream per step (Engine.generate_batch), so the reported value is the
     # EFFECTIVE ms/token across the batch (wall / emitted / N) — decode is

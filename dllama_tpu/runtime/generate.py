@@ -799,6 +799,11 @@ class Engine:
                 "sampled batches or generate_spec for sampled solo decoding")
         B = len(prompts)
         S = self.cfg.seq_len
+        if sampler is None:
+            # mirror generate_batch's no-sampler branch, which burns one
+            # engine-chain key even when greedy — substituting this path
+            # must not desync later sampled calls on the same engine chain
+            self.next_key()
 
         cache, pend, poss = self._prefill_batch_rows(prompts)
 

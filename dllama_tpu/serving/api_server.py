@@ -187,22 +187,10 @@ class Batcher:
         too (generate_spec is exact at any temperature)."""
         st = self.state
         try:
-            stop_ids = st.stop_token_ids()
             session, feed = st.take_prefix_session(s.prompt)
             history = list(s.prompt)
-            if st.spec_draft > 0:
-                pending = 1 if (session is not None
-                                and session.pending_token is not None) else 0
-                n_consumed = len(s.prompt) - len(feed) - pending
-                stream = st.engine.generate_spec(
-                    feed, s.steps, session=session, stop_tokens=stop_ids,
-                    draft_len=st.spec_draft,
-                    history=s.prompt[:n_consumed] if session else None,
-                    sampler=s.sampler)
-            else:
-                stream = st.engine.generate(feed, s.steps, session=session,
-                                            stop_tokens=stop_ids,
-                                            sampler=s.sampler)
+            stream = st.open_stream(s.prompt, feed, session, s.steps,
+                                    s.sampler)
             toks: list = []
             for t, _ in stream:
                 history.append(t)
@@ -258,11 +246,15 @@ class Batcher:
                 # fall through to the plain batched decode below, and so
                 # do TENSOR-PARALLEL engines (generate_batch_spec has no
                 # shard_map wrapper; generate_batch does).
+                # explicit greedy sampler: the ENGINE default may be sampled
+                # (CLI --temperature 0.8) and would trip the greedy-only
+                # guard even though every REQUEST in this batch is greedy
                 rows, _stats = self.state.engine.generate_batch_spec(
                     prompts, max(s.steps for s in batch),
                     stop_tokens=self.state.stop_token_ids(),
                     row_steps=row_steps,
                     draft_len=self.state.spec_draft,
+                    sampler=SamplerConfig(temperature=0.0, seed=0),
                 )
             else:
                 samplers = [s.sampler for s in batch] + [
@@ -451,6 +443,32 @@ class ServerState:
         self._sessions.append((list(tokens), session))
         while len(self._sessions) > self.session_cache:
             self._evict_oldest()
+
+    def open_stream(self, prompt_tokens: list, feed_tokens: list, session,
+                    max_tokens: int, sampler: SamplerConfig):
+        """THE solo token-stream dispatch, shared by the HTTP solo path and
+        the batcher's singleton delegation so the spec-vs-plain branch and
+        the n-gram history arithmetic can never drift. A --spec-draft
+        server speculates (generate_spec is exact at any temperature);
+        ``history`` tells its n-gram index about tokens already consumed
+        into the claimed session's cache (the cached prefix minus its
+        pending token, when it has one) so drafts match across earlier
+        turns of the chat."""
+        stop_ids = self.stop_token_ids()
+        if self.spec_draft > 0:
+            pending = 1 if (session is not None
+                            and session.pending_token is not None) else 0
+            n_consumed = len(prompt_tokens) - len(feed_tokens) - pending
+            return self.engine.generate_spec(
+                feed_tokens, max_tokens, session=session,
+                stop_tokens=stop_ids, draft_len=self.spec_draft,
+                history=prompt_tokens[:n_consumed] if session else None,
+                sampler=sampler,
+            )
+        return self.engine.generate(
+            feed_tokens, max_tokens, session=session,
+            stop_tokens=stop_ids, sampler=sampler,
+        )
 
     def stop_token_ids(self) -> tuple:
         """Hard stop ids: EOS plus the Llama-3 end-of-turn token when the
@@ -752,26 +770,8 @@ class OpenAIHandler(BaseHTTPRequestHandler):
             stop_ids = st.stop_token_ids()
             session, feed_tokens = st.take_prefix_session(prompt_tokens)
             history = list(prompt_tokens)
-            if st.spec_draft > 0:
-                # tokens already consumed into the claimed session's cache
-                # (the cached prefix minus its pending token, when it has
-                # one): lets the n-gram draft match across earlier turns of
-                # the chat. Sampled requests replay the same per-request key
-                # chain the plain path walks, so responses are identical
-                # either way.
-                pending = 1 if session is not None and session.pending_token is not None else 0
-                n_consumed = len(prompt_tokens) - len(feed_tokens) - pending
-                stream_iter = st.engine.generate_spec(
-                    feed_tokens, max_tokens, session=session,
-                    stop_tokens=stop_ids, draft_len=st.spec_draft,
-                    history=prompt_tokens[:n_consumed] if session else None,
-                    sampler=sampler,
-                )
-            else:
-                stream_iter = st.engine.generate(
-                    feed_tokens, max_tokens, session=session,
-                    stop_tokens=stop_ids, sampler=sampler,
-                )
+            stream_iter = st.open_stream(prompt_tokens, feed_tokens, session,
+                                         max_tokens, sampler)
             for tok_id, _stats in stream_iter:
                 n_generated += 1
                 history.append(tok_id)

@@ -72,6 +72,9 @@ run kernel_bench_r05 python scripts/kernel_bench.py
 CMD_TIMEOUT=900 run bench_7b_prefill env BENCH_PREFILL=448 BENCH_DEADLINE_S=840 python bench.py
 CMD_TIMEOUT=900 run bench_7b_batch8 env BENCH_BATCH=8 BENCH_DEADLINE_S=840 python bench.py
 CMD_TIMEOUT=900 run bench_7b_batch8_seq1k_flash env BENCH_BATCH=8 BENCH_SEQ=1024 DLLAMA_FLASH_DECODE=1 BENCH_DEADLINE_S=840 python bench.py
+# ---- speculative decoding (solo + batched-verify composition) -----------
+CMD_TIMEOUT=900 run bench_7b_spec8 env BENCH_SPEC=8 BENCH_DEADLINE_S=840 python bench.py
+CMD_TIMEOUT=900 run bench_7b_spec8_batch4 env BENCH_SPEC=8 BENCH_BATCH=4 BENCH_DEADLINE_S=840 python bench.py
 # ---- other model shapes -------------------------------------------------
 CMD_TIMEOUT=900 run bench_tiny env BENCH_MODEL=tiny BENCH_DEADLINE_S=840 python bench.py
 CMD_TIMEOUT=900 run bench_moe env BENCH_MODEL=moe BENCH_DEADLINE_S=840 python bench.py

@@ -773,3 +773,53 @@ def test_spec_server_batches_concurrent_greedy_via_batched_verify():
     finally:
         srv_plain.shutdown()
         srv_spec.shutdown()
+
+
+def test_spec_server_default_sampled_engine_still_batches_greedy_requests():
+    """A --spec-draft --batch-window server whose ENGINE default is sampled
+    (CLI --temperature 0.8) must still serve a batch of greedy REQUESTS
+    through the batched verify — the explicit greedy sampler in the batcher
+    keeps the greedy-only guard out of the way (r5 review catch)."""
+    tok = make_tokenizer()
+    cfg = tiny_cfg(vocab_size=tok.vocab_size, seq_len=512, dim=32, kv_dim=16,
+                   head_size=8, hidden_dim=64)
+    params = llama.random_params(cfg, seed=13)
+    engine = Engine(cfg, params, SamplerConfig(temperature=0.8, seed=1))
+    state = ServerState(engine, tok, cfg, model_name="tiny-test",
+                        template="llama3", batch_window_ms=300.0,
+                        default_sampler=SamplerConfig(temperature=0.8),
+                        spec_draft=4)
+    calls = []
+    orig = engine.generate_batch_spec
+
+    def spy(*a, **kw):
+        calls.append(1)
+        return orig(*a, **kw)
+
+    engine.generate_batch_spec = spy
+    srv = create_server(state, host="127.0.0.1", port=0)
+    port = srv.server_address[1]
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        request(port, "POST", "/v1/chat/completions",
+                chat_body(max_tokens=2, temperature=0.0))  # warm (singleton)
+        replies = [None, None]
+
+        def one(i):
+            st, d = request(port, "POST", "/v1/chat/completions",
+                            chat_body(messages=[{"role": "user",
+                                                 "content": f"hey {i} hey {i}"}],
+                                      max_tokens=5, temperature=0.0))
+            replies[i] = (st, json.loads(d))
+
+        threads = [threading.Thread(target=one, args=(i,)) for i in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        for st_, obj in replies:
+            assert st_ == 200, obj
+            assert isinstance(obj["choices"][0]["message"]["content"], str)
+        assert calls, "batched verify never ran"
+    finally:
+        srv.shutdown()

@@ -142,3 +142,21 @@ def test_generate_batch_spec_rejects_sampled_and_mesh():
                       mesh=tp_mesh(2))
     with pytest.raises(ValueError):
         mesh_eng.generate_batch_spec([[1]], steps=4)
+
+
+def test_generate_batch_spec_advances_engine_chain_like_generate_batch():
+    """Substituting the spec path for generate_batch must leave the engine
+    PRNG chain in the same state, or later sampled calls diverge."""
+    params = llama.quantize_params(
+        llama.random_params(CFG, seed=4, dtype=np.float32), "q40")
+    prompts = [[5, 9, 3], [7]]
+
+    eng_a = Engine(CFG, params, SamplerConfig(temperature=0.0, seed=11))
+    eng_a.generate_batch(prompts, steps=4)
+    after_a = [t for t, _ in eng_a.generate(
+        [1], steps=6, sampler=None)]  # engine chain, greedy burn included
+
+    eng_b = Engine(CFG, params, SamplerConfig(temperature=0.0, seed=11))
+    eng_b.generate_batch_spec(prompts, steps=4)
+    after_b = [t for t, _ in eng_b.generate([1], steps=6, sampler=None)]
+    assert after_a == after_b
