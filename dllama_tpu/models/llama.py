@@ -901,6 +901,9 @@ def forward_batched_verify(
     tokens: jnp.ndarray,  # [B, T] int32 — pending + draft rows per sequence
     cache: dict,  # {"k","v": [L, B, S, n_kv, hd]}
     pos: jnp.ndarray,  # [B] int32 — position of tokens[b, 0]
+    tp_axis: str | None = None,
+    gather_logits: bool = True,
+    tp_compress: bool = False,
 ) -> tuple:
     """T tokens for each of B independent sequences -> (logits [B, T, vocab]
     f32, cache): the BATCHED speculative-verify step. Row b's math is
@@ -914,8 +917,9 @@ def forward_batched_verify(
     cache writes, and attention are per-row (vmap over the pure attention).
     MoE routing on the flattened rows is exact: the selected-experts union
     caps at min(E, B*T*k). Dense attention only (the batched flash kernel
-    is one-token-per-row); single-mesh only (no tp_axis — the shard_map
-    wrappers cover plain decode).
+    is one-token-per-row). ``tp_axis``: inside shard_map over a tp mesh
+    (quant-TP, parallel.quant_tp.make_tp_verify_batched) — local heads +
+    kv-shard caches, the same activation gathers as ``forward_batched``.
     """
     B, T = tokens.shape
     x = embed(cfg, params, tokens)  # [B, T, dim]
@@ -938,6 +942,8 @@ def forward_batched_verify(
             q = matmul_any(xf, lp["wq"], idx)
             k = matmul_any(xf, lp["wk"], idx)
             v = matmul_any(xf, lp["wv"], idx)
+        # head counts derive from the ARRAY shapes: under tp they are the
+        # local slices (the reference's MultiHeadAttSlice head split)
         q = q.reshape(B, T, -1, cfg.head_size)
         k = k.reshape(B, T, -1, cfg.head_size)
         v = v.reshape(B, T, -1, cfg.head_size)
@@ -963,9 +969,11 @@ def forward_batched_verify(
         v_cache = jax.lax.dynamic_update_slice(v_cache, slab_v[None], (idx, *zero))
 
         out = jax.vmap(gqa_attention)(q, slab_k, slab_v, pos)  # [B, T, H, hd]
-        att = matmul_any(out.reshape(B * T, -1), lp["wo"], idx)
+        heads = _gather(out.reshape(B * T, -1), tp_axis, tp_compress)
+        att = _gather(matmul_any(heads, lp["wo"], idx), tp_axis, tp_compress)
         x = _ffn_residual(cfg, lp, x.reshape(B * T, cfg.dim),
-                          att, layer=idx).reshape(B, T, cfg.dim)
+                          att, tp_axis, tp_compress,
+                          layer=idx).reshape(B, T, cfg.dim)
         return (x, k_cache, v_cache), None
 
     (x, new_k, new_v), _ = jax.lax.scan(
@@ -975,6 +983,9 @@ def forward_batched_verify(
     x = rmsnorm(x, params["rms_final"], cfg.norm_eps)
     logits = matmul_any(x.reshape(B * T, cfg.dim),
                         params["wcls"]).astype(jnp.float32)
+    if tp_axis is not None and gather_logits:
+        # slice off lane-alignment vocab padding, exactly like `forward`
+        logits = _gather(logits, tp_axis)[..., : cfg.vocab_size]
     logits = logits.reshape(B, T, -1)
     if cfg.logit_scale != 1.0:
         logits = logits * cfg.logit_scale

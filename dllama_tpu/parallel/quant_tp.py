@@ -214,19 +214,19 @@ def batch_cache_spec() -> P:
     return P(None, None, None, TP, None)
 
 
-def make_tp_forward_batched(cfg: ModelConfig, mesh, params: dict,
-                            compress: bool = False):
-    """``fwd(params, rope, cache, tokens, pos) -> (logits, cache)`` for the
-    BATCHED decode step (``llama.forward_batched``: tokens/pos are [B]) as a
-    shard_map program over the same output-sharded quant planes as
-    ``make_tp_forward`` — multi-chip batched serving, B sequences sharing
-    every local weight stream AND every ICI gather."""
-    from dllama_tpu.models import llama
-
+def _make_tp_program(cfg: ModelConfig, mesh, params: dict, compress: bool,
+                     inner_fn, cache_spec_fn):
+    """THE shard_map builder behind every quantized-TP program — solo
+    decode/prefill, batched decode, batched spec-verify. One place for the
+    in/out specs, the vocab-divisibility gather_logits condition, and the
+    check_vma setting, so the three entry points can never drift.
+    ``inner_fn(cfg, params, rope, tokens, cache, pos, *, tp_axis,
+    gather_logits, tp_compress)`` is the llama forward variant;
+    ``cache_spec_fn`` its cache PartitionSpec ([L,S,...] vs [L,B,S,...])."""
     n_tp = mesh.shape[TP]
     pspecs = quant_param_specs(params, cfg, n_tp)
     gather_logits = cfg.vocab_size % n_tp == 0
-    cspec = {"k": batch_cache_spec(), "v": batch_cache_spec()}
+    cspec = {"k": cache_spec_fn(), "v": cache_spec_fn()}
 
     @partial(
         shard_map,
@@ -236,12 +236,39 @@ def make_tp_forward_batched(cfg: ModelConfig, mesh, params: dict,
         check_vma=False,
     )
     def fwd(params, rope, cache, tokens, pos):
-        return llama.forward_batched(
+        return inner_fn(
             cfg, params, rope, tokens, cache, pos,
             tp_axis=TP, gather_logits=gather_logits, tp_compress=compress,
         )
 
     return fwd
+
+
+def make_tp_forward_batched(cfg: ModelConfig, mesh, params: dict,
+                            compress: bool = False):
+    """``fwd(params, rope, cache, tokens, pos) -> (logits, cache)`` for the
+    BATCHED decode step (``llama.forward_batched``: tokens/pos are [B]) as a
+    shard_map program over the same output-sharded quant planes as
+    ``make_tp_forward`` — multi-chip batched serving, B sequences sharing
+    every local weight stream AND every ICI gather."""
+    from dllama_tpu.models import llama
+
+    return _make_tp_program(cfg, mesh, params, compress,
+                            llama.forward_batched, batch_cache_spec)
+
+
+def make_tp_verify_batched(cfg: ModelConfig, mesh, params: dict,
+                           compress: bool = False):
+    """``fwd(params, rope, cache, tokens, pos) -> (logits, cache)`` for the
+    BATCHED speculative-verify step (``llama.forward_batched_verify``:
+    tokens [B, T], pos [B]) as a shard_map program over the same
+    output-sharded quant planes — batched speculation under tensor
+    parallelism: draft_len+1 positions x B rows share every local weight
+    stream AND every ICI gather per launch."""
+    from dllama_tpu.models import llama
+
+    return _make_tp_program(cfg, mesh, params, compress,
+                            llama.forward_batched_verify, batch_cache_spec)
 
 
 def make_tp_forward(cfg: ModelConfig, mesh, params: dict, compress: bool = False):
@@ -258,22 +285,5 @@ def make_tp_forward(cfg: ModelConfig, mesh, params: dict, compress: bool = False
     """
     from dllama_tpu.models import llama
 
-    n_tp = mesh.shape[TP]
-    pspecs = quant_param_specs(params, cfg, n_tp)
-    gather_logits = cfg.vocab_size % n_tp == 0
-    cspec = {"k": cache_spec(), "v": cache_spec()}
-
-    @partial(
-        shard_map,
-        mesh=mesh,
-        in_specs=(pspecs, P(), cspec, P(), P()),
-        out_specs=(P(), cspec),
-        check_vma=False,
-    )
-    def fwd(params, rope, cache, tokens, pos):
-        return llama.forward(
-            cfg, params, rope, tokens, cache, pos,
-            tp_axis=TP, gather_logits=gather_logits, tp_compress=compress,
-        )
-
-    return fwd
+    return _make_tp_program(cfg, mesh, params, compress,
+                            llama.forward, cache_spec)

@@ -112,6 +112,10 @@ class Engine:
         self.decode_chunk = decode_chunk
         fwd = llama.forward
         fwd_b = llama.forward_batched
+        fwd_v = llama.forward_batched_verify
+        #: generate_batch_spec availability: single mesh, or quant-TP
+        #: shard_map (the dense-pjit mesh path has no verify wrapper)
+        self.supports_batch_spec = True
         self._batch_cache_sharding = None
         if mesh is not None:
             from dllama_tpu.parallel import quant_tp, sharding as _sh
@@ -128,6 +132,9 @@ class Engine:
                 tp_fwd_b = quant_tp.make_tp_forward_batched(
                     cfg, mesh, self.params, compress=tp_compress
                 )
+                tp_fwd_v = quant_tp.make_tp_verify_batched(
+                    cfg, mesh, self.params, compress=tp_compress
+                )
 
                 def fwd(cfg_, params_, rope_, tokens_, cache_, pos_):
                     return tp_fwd(params_, rope_, cache_, tokens_, pos_)
@@ -135,7 +142,11 @@ class Engine:
                 def fwd_b(cfg_, params_, rope_, tokens_, cache_, pos_):
                     return tp_fwd_b(params_, rope_, cache_, tokens_, pos_)
 
+                def fwd_v(cfg_, params_, rope_, tokens_, cache_, pos_):
+                    return tp_fwd_v(params_, rope_, cache_, tokens_, pos_)
+
             else:
+                self.supports_batch_spec = False
                 # dense pjit: forward_batched partitions like forward (the
                 # per-row vmap'd attention shards by kv head unchanged).
                 # allow_flash=False — GSPMD cannot partition a Pallas custom
@@ -269,10 +280,9 @@ class Engine:
             """Batched greedy speculative verify: [B, T] candidate rows ->
             every (row, position)'s argmax next token in ONE program — the
             batching and speculation bandwidth wins composed (weights stream
-            once for B sequences x T positions). Single-mesh path only
-            (llama.forward_batched_verify)."""
-            logits, cache = llama.forward_batched_verify(
-                cfg, params, rope, tokens, cache, pos)
+            once for B sequences x T positions). Single mesh or quant-TP
+            shard_map (fwd_v resolves to make_tp_verify_batched there)."""
+            logits, cache = fwd_v(cfg, params, rope, tokens, cache, pos)
             return jnp.argmax(logits, axis=-1).astype(jnp.int32), cache
 
         @partial(jax.jit, donate_argnums=(2,))
@@ -772,12 +782,12 @@ class Engine:
         Greedy only (``sampler`` with temperature > 0 raises): replaying B
         per-row sampled key chains through a shared-T verify is bookkeeping
         this path doesn't carry yet — sampled batches run generate_batch,
-        sampled solo spec runs generate_spec. Single mesh only (a mesh
-        engine raises: _verify_batch jits forward_batched_verify directly,
-        which has no shard_map wrapper — the quant-TP layout would feed the
-        kernels per-shard planes); rows with no matching n-gram still
-        verify their pending token (a T-row step emits at least 1 token
-        per row, exactly like plain decode).
+        sampled solo spec runs generate_spec. Runs single-device AND under
+        quantized TP (the shard_map verify wrapper,
+        parallel.quant_tp.make_tp_verify_batched); only the dense-pjit
+        mesh path raises (supports_batch_spec). Rows with no matching
+        n-gram still verify their pending token (a T-row step emits at
+        least 1 token per row, exactly like plain decode).
 
         Cache safety mirrors generate_spec: rejected/pad slots hold garbage
         K/V that later steps overwrite before any query attends them; a
@@ -787,11 +797,12 @@ class Engine:
         """
         if not prompts or any(not p for p in prompts):
             raise ValueError("generate_batch_spec needs non-empty prompts")
-        if self.mesh is not None:
+        if not self.supports_batch_spec:
             raise ValueError(
-                "generate_batch_spec does not run on a mesh engine (no "
-                "shard_map wrapper for the batched verify forward); use "
-                "generate_batch under TP")
+                "generate_batch_spec does not run on the dense-pjit mesh "
+                "path (no shard_map wrapper for the batched verify "
+                "forward); quantized-TP and single-device engines support "
+                "it — use generate_batch here")
         scfg = sampler if sampler is not None else self.sampler_cfg
         if scfg.temperature > 0.0:
             raise ValueError(

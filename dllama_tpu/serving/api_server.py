@@ -236,16 +236,16 @@ class Batcher:
             prompts, row_steps = padded_batch(
                 [s.prompt for s in batch], [s.steps for s in batch])
             if (self.state.spec_draft > 0
-                    and self.state.engine.mesh is None
+                    and getattr(self.state.engine, "supports_batch_spec", False)
                     and all(s.sampler.temperature == 0.0 and s.queue is None
                             for s in batch)):
                 # all-greedy non-streaming batch on a --spec-draft server:
                 # BATCHED speculative verify — every launch scores
                 # draft_len+1 positions for all rows (exact; rows equal
-                # plain batched greedy). Mixed/sampled/streaming batches
-                # fall through to the plain batched decode below, and so
-                # do TENSOR-PARALLEL engines (generate_batch_spec has no
-                # shard_map wrapper; generate_batch does).
+                # plain batched greedy), single-device or quantized-TP.
+                # Mixed/sampled/streaming batches fall through to the
+                # plain batched decode below, and so does the dense-pjit
+                # mesh path (no shard_map verify wrapper there).
                 # explicit greedy sampler: the ENGINE default may be sampled
                 # (CLI --temperature 0.8) and would trip the greedy-only
                 # guard even though every REQUEST in this batch is greedy

@@ -129,19 +129,23 @@ def test_generate_batch_spec_stop_token_truncates_row():
         assert spec[1] == plain[1]
 
 
-def test_generate_batch_spec_rejects_sampled_and_mesh():
+def test_generate_batch_spec_rejects_sampled_and_dense_mesh():
     from dllama_tpu.parallel.mesh import tp_mesh
 
-    params = llama.quantize_params(
+    qparams = llama.quantize_params(
         llama.random_params(CFG, seed=3, dtype=np.float32), "q40")
-    eng = Engine(CFG, params, SamplerConfig(temperature=0.0))
+    eng = Engine(CFG, qparams, SamplerConfig(temperature=0.0))
     with pytest.raises(ValueError):
         eng.generate_batch_spec([[1]], steps=4,
                                 sampler=SamplerConfig(temperature=0.8))
-    mesh_eng = Engine(CFG, params, SamplerConfig(temperature=0.0),
-                      mesh=tp_mesh(2))
+    # dense weights on a pjit mesh: no shard_map verify wrapper -> raises
+    # (quant-TP engines DO support it — tests/test_tp_quant.py)
+    dense_mesh_eng = Engine(CFG, llama.random_params(CFG, seed=3,
+                                                     dtype=np.float32),
+                            SamplerConfig(temperature=0.0), mesh=tp_mesh(2))
+    assert not dense_mesh_eng.supports_batch_spec
     with pytest.raises(ValueError):
-        mesh_eng.generate_batch_spec([[1]], steps=4)
+        dense_mesh_eng.generate_batch_spec([[1]], steps=4)
 
 
 def test_generate_batch_spec_advances_engine_chain_like_generate_batch():

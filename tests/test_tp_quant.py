@@ -367,3 +367,18 @@ def test_quant_tp_compressed_wire_matches_compiled_hlo(tp):
     frac = (tp - 1) / tp
     hlo_bytes = (sum(want_s8) * cfg.n_layers + vocab_padded * 4.0) * frac
     assert hlo_bytes == eng._wire_bytes(1)
+
+
+def test_batched_spec_under_quant_tp_matches_single_device():
+    """generate_batch_spec on an 8-device quant-TP mesh (the shard_map
+    verify wrapper) must emit exactly the single-device rows — batching x
+    speculation x tensor parallelism composed, sharding-invariant."""
+    qp = _quant_params("q40")
+    prompts = [[5, 9, 3, 5, 9, 3, 5, 9], [7, 7, 7, 7], [4, 2]]
+    single = Engine(CFG, qp, SamplerConfig(temperature=0.0))
+    want, stats_s = single.generate_batch_spec(prompts, steps=10, draft_len=4)
+    tp_eng = Engine(CFG, qp, SamplerConfig(temperature=0.0), mesh=tp_mesh(8))
+    assert tp_eng.supports_batch_spec
+    got, stats_tp = tp_eng.generate_batch_spec(prompts, steps=10, draft_len=4)
+    assert got == want
+    assert stats_tp["emitted"] == stats_s["emitted"]
