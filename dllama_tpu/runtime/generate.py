@@ -763,6 +763,7 @@ class Engine:
         draft_len: int = 8,
         ngram: int = 3,
         sampler: Optional[SamplerConfig] = None,
+        on_step=None,
     ) -> tuple:
         """Batched GREEDY decode with prompt-lookup speculative drafting:
         every verify step scores draft_len+1 candidate positions for ALL B
@@ -788,6 +789,12 @@ class Engine:
         mesh path raises (supports_batch_spec). Rows with no matching
         n-gram still verify their pending token (a T-row step emits at
         least 1 token per row, exactly like plain decode).
+
+        ``on_step(fresh)``: called after every verify launch with each
+        row's tokens emitted by THAT launch (empty for finished rows) —
+        the server's batched-spec SSE hook. Unlike generate_batch's
+        on_chunk, bursts here are final (budget- and stop-truncated
+        already) and arrive every 1..draft_len+1 tokens.
 
         Cache safety mirrors generate_spec: rejected/pad slots hold garbage
         K/V that later steps overwrite before any query attends them; a
@@ -852,6 +859,7 @@ class Engine:
                              for b in range(B)], jnp.int32))
             g = np.asarray(g)  # [B, T]
             verify_steps += 1
+            fresh: list = [[] for _ in range(B)]
             for b in range(B):
                 if done[b]:
                     continue
@@ -869,12 +877,15 @@ class Engine:
                 emit = emit[:take]
                 indexes[b].extend([pend[b]] + drafts[b][:m])
                 out[b].extend(emit)
+                fresh[b] = emit
                 pend[b] = emit[-1]
                 poss[b] += m + 1
                 if (len(out[b]) >= budgets[b]
                         or (stop_tokens and emit
                             and emit[-1] in stop_tokens)):
                     done[b] = True
+            if on_step is not None:
+                on_step(fresh)
         self.decode_ms = (time.perf_counter() - t1) * 1000.0
         return out, {"verify_steps": verify_steps,
                      "accepted_drafts": accepted,

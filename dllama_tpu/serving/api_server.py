@@ -218,17 +218,6 @@ class Batcher:
         if len(batch) == 1:
             self._serve_solo(batch[0])
             return
-        emitted = [0] * len(batch)  # tokens already pushed to stream queues
-
-        def on_chunk(fresh):
-            for i, s in enumerate(batch):
-                if s.queue is None:
-                    continue
-                burst = fresh[i][: max(0, s.steps - emitted[i])]
-                if burst:
-                    emitted[i] += len(burst)
-                    s.queue.put(burst)
-
         try:
             # per-row budgets drive the early exit: a 4-max_tokens row
             # counts done after 4 tokens, pad rows after 1 — neither keeps
@@ -237,15 +226,20 @@ class Batcher:
                 [s.prompt for s in batch], [s.steps for s in batch])
             if (self.state.spec_draft > 0
                     and getattr(self.state.engine, "supports_batch_spec", False)
-                    and all(s.sampler.temperature == 0.0 and s.queue is None
-                            for s in batch)):
-                # all-greedy non-streaming batch on a --spec-draft server:
-                # BATCHED speculative verify — every launch scores
-                # draft_len+1 positions for all rows (exact; rows equal
-                # plain batched greedy), single-device or quantized-TP.
-                # Mixed/sampled/streaming batches fall through to the
-                # plain batched decode below, and so does the dense-pjit
-                # mesh path (no shard_map verify wrapper there).
+                    and all(s.sampler.temperature == 0.0 for s in batch)):
+                # all-greedy batch on a --spec-draft server: BATCHED
+                # speculative verify — every launch scores draft_len+1
+                # positions for all rows (exact; rows equal plain batched
+                # greedy), single-device or quantized-TP. Streaming rows
+                # get per-launch bursts (already budget/stop-truncated).
+                # Mixed sampled batches fall through to the plain batched
+                # decode below, and so does the dense-pjit mesh path (no
+                # shard_map verify wrapper there).
+                def on_step(fresh):
+                    for i, s in enumerate(batch):
+                        if s.queue is not None and fresh[i]:
+                            s.queue.put(fresh[i])
+
                 # explicit greedy sampler: the ENGINE default may be sampled
                 # (CLI --temperature 0.8) and would trip the greedy-only
                 # guard even though every REQUEST in this batch is greedy
@@ -255,8 +249,23 @@ class Batcher:
                     row_steps=row_steps,
                     draft_len=self.state.spec_draft,
                     sampler=SamplerConfig(temperature=0.0, seed=0),
+                    on_step=on_step,
                 )
             else:
+                # cap logic belongs to THIS path only: plain chunks may
+                # carry tokens past a row's budget; spec bursts arrive
+                # pre-truncated (on_step above needs no emitted[] cap)
+                emitted = [0] * len(batch)
+
+                def on_chunk(fresh):
+                    for i, s in enumerate(batch):
+                        if s.queue is None:
+                            continue
+                        burst = fresh[i][: max(0, s.steps - emitted[i])]
+                        if burst:
+                            emitted[i] += len(burst)
+                            s.queue.put(burst)
+
                 samplers = [s.sampler for s in batch] + [
                     SamplerConfig(temperature=0.0, seed=0)
                 ] * (len(prompts) - len(batch))
@@ -715,9 +724,9 @@ class OpenAIHandler(BaseHTTPRequestHandler):
             # merges into one batched decode; every row runs its own
             # sampler chain, so tokens are bit-identical to the solo path
             # for the same SamplerConfig. On a --spec-draft server an
-            # all-greedy non-streaming batch runs the BATCHED speculative
-            # verify (Batcher._serve); singletons speculate on the solo
-            # path either way.
+            # all-greedy batch (streaming included — per-launch bursts)
+            # runs the BATCHED speculative verify (Batcher._serve);
+            # singletons speculate on the solo path either way.
             if stream:
                 self._stream_batched(base, sampler, prompt_tokens, max_tokens)
             else:

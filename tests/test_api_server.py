@@ -823,3 +823,74 @@ def test_spec_server_default_sampled_engine_still_batches_greedy_requests():
         assert calls, "batched verify never ran"
     finally:
         srv.shutdown()
+
+
+def test_spec_server_batched_streaming_sse():
+    """Streaming requests join the batched speculative verify on a
+    --spec-draft --batch-window server: SSE stream well-formed, text equal
+    to the batching-disabled plain server's stream."""
+    tok = make_tokenizer()
+    cfg = tiny_cfg(vocab_size=tok.vocab_size, seq_len=512, dim=32, kv_dim=16,
+                   head_size=8, hidden_dim=64)
+    params = llama.random_params(cfg, seed=13)
+
+    def run_server(window_ms, spec):
+        engine = Engine(cfg, params, SamplerConfig(temperature=0.0, seed=1))
+        state = ServerState(engine, tok, cfg, model_name="tiny-test",
+                            template="llama3", batch_window_ms=window_ms,
+                            spec_draft=spec)
+        calls = []
+        orig = engine.generate_batch_spec
+
+        def spy(*a, **kw):
+            calls.append(1)
+            return orig(*a, **kw)
+
+        engine.generate_batch_spec = spy
+        srv = create_server(state, host="127.0.0.1", port=0)
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        return srv, srv.server_address[1], calls
+
+    def stream_text(port, content):
+        import http.client as hc
+
+        conn = hc.HTTPConnection("127.0.0.1", port, timeout=120)
+        conn.request("POST", "/v1/chat/completions",
+                     body=json.dumps(chat_body(
+                         messages=[{"role": "user", "content": content}],
+                         stream=True, temperature=0.0, max_tokens=8)),
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        raw = resp.read().decode()
+        conn.close()
+        events = [ln[len("data: "):] for ln in raw.split("\n")
+                  if ln.startswith("data: ")]
+        assert events[-1] == "[DONE]"
+        chunks = [json.loads(e) for e in events[:-1]]
+        return "".join(c["choices"][0]["delta"].get("content", "")
+                       for c in chunks)
+
+    srv_plain, port_plain, _ = run_server(0, 0)
+    srv_spec, port_spec, calls = run_server(250.0, 4)
+    try:
+        request(port_spec, "POST", "/v1/chat/completions",
+                chat_body(max_tokens=2))  # warm (singleton, solo path)
+        # two concurrent STREAMING requests so the batch path engages
+        texts = {}
+
+        def one(name, content):
+            texts[name] = stream_text(port_spec, content)
+
+        threads = [threading.Thread(target=one, args=(f"s{i}", p))
+                   for i, p in enumerate(["hello world", "the the cat"])]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        want0 = stream_text(port_plain, "hello world")
+        want1 = stream_text(port_plain, "the the cat")
+        assert texts["s0"] == want0 and texts["s1"] == want1
+        assert calls, "batched spec verify never engaged for the stream batch"
+    finally:
+        srv_plain.shutdown()
+        srv_spec.shutdown()
