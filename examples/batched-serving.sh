@@ -1,13 +1,17 @@
 #!/bin/bash
 # Concurrent-request batching demo — the serving mode the reference's
 # one-request-at-a-time server (src/apps/dllama-api/dllama-api.cpp:324-355)
-# has no analog for: greedy non-streaming requests arriving within the
-# batch window share every weight-streaming decode pass.
+# has no analog for: requests (greedy or sampled, streaming or not)
+# arriving within the batch window share every weight-streaming decode
+# pass.
 #
 # Starts the API server with --batch-window, fires K concurrent chat
 # completions, and prints each reply plus the aggregate wall time. Compare
 # with a --batch-window 0 run: batched wall time stays near a single
-# request's, serial wall time grows ~linearly with K.
+# request's, serial wall time grows ~linearly with K. Set SPEC_DRAFT=8 to
+# serve the batch through the BATCHED speculative verify (draft_len+1
+# positions x K rows per weight pass — multiplies with the batching win
+# on repetitive text).
 #
 # Usage: examples/batched-serving.sh <model.m> <tokenizer.t> [K] [window_ms]
 set -e
@@ -18,9 +22,11 @@ TOKENIZER=${2:?usage: batched-serving.sh model.m tokenizer.t [K] [window_ms]}
 K=${3:-4}
 WINDOW=${4:-50}
 PORT=${PORT:-9991}
+SPEC_DRAFT=${SPEC_DRAFT:-0}
 
 python -m dllama_tpu.cli serve --model "$MODEL" --tokenizer "$TOKENIZER" \
-  --port "$PORT" --temperature 0 --batch-window "$WINDOW" &
+  --port "$PORT" --temperature 0 --batch-window "$WINDOW" \
+  --spec-draft "$SPEC_DRAFT" &
 SERVER=$!
 trap 'kill $SERVER 2>/dev/null' EXIT
 
