@@ -60,6 +60,16 @@ GROK1_LITE = dict(
     rope_style="half",
 )
 
+# serving-shape smoke model for the CPU-runnable continuous-batching mode:
+# the scheduler comparison (continuous vs static window) is about SCHEDULING,
+# not model speed, so a small fast shape keeps the staggered-arrival replay
+# inside CI wall clocks while still decoding real tokens.
+SMOKE_SERVE = dict(
+    arch="llama", dim=256, hidden_dim=512, n_layers=4, n_heads=8,
+    n_kv_heads=4, vocab_size=512, seq_len=256, head_size=32, kv_dim=128,
+    dtype="float32",
+)
+
 # reference's best published single-node Llama 2 7B avg token time (ms)
 BASELINE_7B_SINGLE_NODE_MS = 101.81
 
@@ -215,6 +225,71 @@ def _probe_q40_with_fallback() -> tuple:
     return probed, detail
 
 
+def _serving_replay(eng, mode: str, reqs: list, arrivals_s: list,
+                    max_batch: int, chunk: int) -> tuple:
+    """Replay ONE staggered-arrival workload -> (wall_s, latency_s, tokens).
+
+    ``reqs`` is [(prompt_tokens, steps)]; ``arrivals_s[i]`` is request i's
+    arrival offset from replay start. "continuous" admits into the resident
+    slot pool between fused chunks (Engine.batch_session); "static" mimics
+    the pre-continuous window batcher: run generate_batch to full drain,
+    then batch whatever arrived in the meantime. latency_s[i] is request
+    i's arrival-to-last-token time; tokens counts everything emitted, so
+    tokens/wall_s is the aggregate serving throughput under that scheduler.
+    """
+    from dllama_tpu.runtime.sampler import SamplerConfig
+
+    greedy = SamplerConfig(temperature=0.0, seed=0)
+    lat = [0.0] * len(reqs)
+    tokens = 0
+    nxt, pending = 0, []
+    t0 = time.perf_counter()
+    if mode == "continuous":
+        sess = eng.batch_session(max_batch, chunk=chunk)
+        slot_req, emitted = {}, [0] * len(reqs)
+        while nxt < len(reqs) or pending or slot_req:
+            while nxt < len(reqs) and arrivals_s[nxt] <= time.perf_counter() - t0:
+                pending.append(nxt)
+                nxt += 1
+            while pending and sess.free_slots:
+                j = pending.pop(0)
+                slot = sess.admit(list(reqs[j][0]), steps=reqs[j][1],
+                                  sampler=greedy)
+                slot_req[slot] = j
+            if not slot_req:
+                # pool empty and the next request is not due yet: idle wait
+                time.sleep(max(0.0, arrivals_s[nxt] - (time.perf_counter() - t0)))
+                continue
+            for slot, burst in sess.step_chunk().items():
+                j = slot_req[slot]
+                emitted[j] += len(burst)
+                if sess.is_done(slot):
+                    lat[j] = (time.perf_counter() - t0) - arrivals_s[j]
+                    tokens += emitted[j]
+                    sess.release(slot)
+                    del slot_req[slot]
+        sess.close()
+    else:
+        while nxt < len(reqs) or pending:
+            while nxt < len(reqs) and arrivals_s[nxt] <= time.perf_counter() - t0:
+                pending.append(nxt)
+                nxt += 1
+            if not pending:
+                time.sleep(max(0.0, arrivals_s[nxt] - (time.perf_counter() - t0)))
+                continue
+            group, pending = pending[:max_batch], pending[max_batch:]
+            rows = eng.generate_batch(
+                [list(reqs[j][0]) for j in group],
+                steps=max(reqs[j][1] for j in group),
+                sampler=greedy,
+                row_steps=[reqs[j][1] for j in group])
+            end = time.perf_counter() - t0
+            for j, row in zip(group, rows):
+                lat[j] = end - arrivals_s[j]
+                tokens += min(len(row), reqs[j][1])
+    return time.perf_counter() - t0, lat, tokens
+
+
 def run_decode_bench(cfg_dict: dict, bench_steps: int = None, quant_ok: bool = False):
     """``bench_steps`` trades compile time against timing fidelity: the whole
     run is ONE dispatch + ONE host sync, and on a tunneled TPU that sync has
@@ -336,6 +411,74 @@ def run_decode_bench(cfg_dict: dict, bench_steps: int = None, quant_ok: bool = F
                 f"({1000.0 / ms_tok:.0f} tok/s prefill)")
         return min(times), f"{weights}-prefill{pf}{cfg_tag}"
 
+    # BENCH_CONTINUOUS=N replays a staggered-arrival serving workload of N
+    # requests through BOTH schedulers — the continuous slot pool
+    # (Engine.batch_session: rows admitted mid-flight between fused chunks)
+    # and the old static window batcher (generate_batch run to full drain,
+    # then re-batch the queue) — and reports aggregate tok/s plus
+    # per-request latency for each. Every third request gets a 4x budget:
+    # that is the static pathology (short rows queue behind the long row's
+    # drain) continuous batching exists to remove. CPU-runnable; pair with
+    # BENCH_MODEL=smoke off-TPU so the replay fits a CI wall clock.
+    cont = _env_count("BENCH_CONTINUOUS")
+    if cont:
+        rng_c = __import__("numpy").random.default_rng(2)
+        prompt = [int(t) for t in rng_c.integers(1, cfg.vocab_size, 6)]
+        B = min(max(2, batch or 4), cont)
+        chunk = 8
+        # budgets in whole chunks so every decode dispatch compiles at ONE
+        # n_steps; same prompt length -> one prefill bucket
+        base = max(chunk, bench_steps // 4 // chunk * chunk)
+        cap = (cfg.seq_len - len(prompt)) // chunk * chunk
+        reqs = [(prompt, min(cap, 4 * base if i % 3 == 2 else base))
+                for i in range(cont)]
+        log(f"continuous-batching replay: {cont} requests, pool={B}, "
+            f"chunk={chunk}, budgets {base}/{min(cap, 4 * base)}")
+        old_chunk = eng.decode_chunk
+        eng.decode_chunk = chunk  # static batcher drains at the same grain
+        greedy = SamplerConfig(temperature=0.0, seed=0)
+        # warmup compiles every shape either replay can hit — the pool's
+        # (B, chunk) decode loop, the single-row prefill bucket, and each
+        # static group size 1..B — and times one resident chunk to set a
+        # near-capacity arrival gap (pool service rate ~1 request/chunk at
+        # these budgets; 1.5 chunks/arrival -> ~0.7 utilization)
+        log("warmup (compile: pool chunk + static group sizes)...")
+        t0 = time.perf_counter()
+        sess = eng.batch_session(B, chunk=chunk)
+        s0 = sess.admit(list(prompt), steps=3 * chunk, sampler=greedy)
+        sess.step_chunk()  # first chunk pays the compile; don't time it
+        t1 = time.perf_counter()
+        sess.step_chunk()
+        chunk_s = time.perf_counter() - t1
+        while not sess.is_done(s0):
+            sess.step_chunk()
+        sess.close()
+        for b in range(1, B + 1):
+            eng.generate_batch([list(prompt)] * b, steps=chunk,
+                               sampler=greedy)
+        log(f"warmup done in {time.perf_counter() - t0:.1f}s "
+            f"({chunk_s * 1000:.0f} ms/resident chunk)")
+        arrivals = [i * 1.5 * chunk_s for i in range(cont)]
+        results = {}
+        for mode in ("static", "continuous"):
+            wall, lats, toks = _serving_replay(eng, mode, reqs, arrivals,
+                                               B, chunk)
+            results[mode] = (wall, toks)
+            ms_sorted = sorted(x * 1000.0 for x in lats)
+            log(f"{mode:>10}: {toks} tokens in {wall:.2f}s = "
+                f"{toks / wall:.1f} tok/s aggregate | request latency mean "
+                f"{sum(ms_sorted) / len(ms_sorted):.0f} ms, "
+                f"p50 {ms_sorted[len(ms_sorted) // 2]:.0f} ms, "
+                f"max {ms_sorted[-1]:.0f} ms")
+        eng.decode_chunk = old_chunk
+        (c_wall, c_toks), (s_wall, s_toks) = (results["continuous"],
+                                              results["static"])
+        log(f"continuous vs static: {c_toks / c_wall:.1f} vs "
+            f"{s_toks / s_wall:.1f} tok/s aggregate "
+            f"({(c_toks / c_wall) / (s_toks / s_wall):.2f}x)")
+        return (c_wall * 1000.0 / max(1, c_toks),
+                f"{weights}-continuous{cont}x{B}{cfg_tag}")
+
     # BENCH_SPEC=K measures speculative decoding (prompt-lookup drafts of up
     # to K tokens, exact greedy): solo generate_spec, or — with BENCH_BATCH —
     # generate_batch_spec (draft_len+1 positions x B rows per weight pass).
@@ -343,6 +486,14 @@ def run_decode_bench(cfg_dict: dict, bench_steps: int = None, quant_ok: bool = F
     # the acceptance rate is printed so the number can be read honestly
     # (random weights don't generate Shakespeare, but greedy loops repeat).
     spec = _env_count("BENCH_SPEC")
+    if spec and batch > 1 and not getattr(eng, "supports_batch_spec", True):
+        # dense-pjit mesh engines have no shard_map verify wrapper — the
+        # spec-batch combination would raise; measure plain batched decode
+        # and SAY so instead of dying mid-battery (ADVICE r05)
+        log(f"BENCH_SPEC={spec} with BENCH_BATCH={batch}: batched spec "
+            "verify unavailable on the dense-pjit mesh path; falling back "
+            "to plain batched decode")
+        spec = 0
     if spec:
         rng_p = __import__("numpy").random.default_rng(1)
         phrase = [int(t) for t in rng_p.integers(1, cfg.vocab_size, 6)]
@@ -424,9 +575,11 @@ def _backend_alive(timeout_s: int = 180) -> tuple:
 def main() -> None:
     # metric name for the error path, resolvable without touching jax
     choice = os.environ.get("BENCH_MODEL", "")
-    err_phase = "prefill" if _prefill_count() else "decode"
+    err_phase = ("prefill" if _prefill_count()
+                 else "serve" if _env_count("BENCH_CONTINUOUS") else "decode")
     err_metric = {"tiny": "tinyllama_1.1b", "llama3": "llama3_8b",
-                  "moe": "mixtral_lite", "grok": "grok1_lite"}.get(
+                  "moe": "mixtral_lite", "grok": "grok1_lite",
+                  "smoke": "smoke"}.get(
         choice, "llama2_7b") + f"_{err_phase}_ms_per_token"
 
     # In-process deadline from PROCESS START (probes included): the probes
@@ -502,7 +655,12 @@ def main() -> None:
 
     platform = jax.devices()[0].platform
     choice = os.environ.get("BENCH_MODEL", "")
-    if choice == "tiny" or (not choice and platform == "cpu"):
+    if choice == "smoke" or (not choice and platform == "cpu"
+                             and _env_count("BENCH_CONTINUOUS")):
+        # the continuous-vs-static comparison measures SCHEDULING, so the
+        # CPU default is a shape small enough to replay inside CI budgets
+        name, cfg_dict = "smoke", SMOKE_SERVE
+    elif choice == "tiny" or (not choice and platform == "cpu"):
         name, cfg_dict = "tinyllama_1.1b", TINYLLAMA_1_1B
     elif choice == "llama3":
         # the north-star config (no published same-hardware baseline number;
@@ -534,7 +692,8 @@ def main() -> None:
         name, cfg_dict = "tinyllama_1.1b", TINYLLAMA_1_1B
         ms, weights = run_decode_bench(cfg_dict, quant_ok=quant_ok)
 
-    phase = "prefill" if _prefill_count() else "decode"
+    phase = ("prefill" if _prefill_count()
+             else "serve" if _env_count("BENCH_CONTINUOUS") else "decode")
     result = {
         "metric": f"{name}_{phase}_ms_per_token",
         "value": round(ms, 3),

@@ -42,20 +42,33 @@ def build_parser() -> argparse.ArgumentParser:
                 type=float,
                 default=0.0,
                 metavar="MS",
-                help="merge requests (greedy or sampled, streaming or not) "
-                "arriving within MS milliseconds into ONE batched decode "
-                "(they share every weight-streaming pass — ~Kx throughput "
-                "under K-way concurrency, same tokens as solo runs; "
-                "streaming rows emit chunk-sized SSE bursts); 0 disables",
+                help="arrival window in milliseconds before the scheduler "
+                "routes a batch: concurrent requests share every "
+                "weight-streaming pass (~Kx throughput under K-way "
+                "concurrency, same tokens as solo runs), and later "
+                "arrivals join the running pool mid-flight (continuous "
+                "batching; streaming rows emit chunk-sized SSE bursts); "
+                "0 disables batching entirely",
             )
             sp.add_argument(
                 "--batch-max",
                 type=int,
                 default=8,
                 metavar="B",
-                help="largest merged batch (HBM bound: the batch KV cache "
-                "holds B full-context caches); overflow drains in "
-                "successive batches",
+                help="slot-pool size for continuous batching (HBM bound: "
+                "the resident batch cache holds B full-context rows); "
+                "requests beyond B queue and are admitted into slots as "
+                "earlier rows finish — mid-flight, between decode chunks",
+            )
+            sp.add_argument(
+                "--batch-chunk",
+                type=int,
+                default=8,
+                metavar="N",
+                help="fused decode steps per scheduler pass: smaller N "
+                "admits queued arrivals into free slots sooner (lower "
+                "time-to-first-token under load) at more host round trips; "
+                "larger N amortizes dispatch overhead",
             )
         sp.add_argument("--model", required=True)
         sp.add_argument("--tokenizer", required=True)
@@ -173,6 +186,22 @@ def maybe_init_distributed(args) -> int:
 
 
 def load_engine(args):
+    # flash decode + float8 cache is the one flash configuration not yet
+    # hardware-proven: probe the kernel in a SUBPROCESS before this process
+    # touches the backend (TPU runtimes are per-process exclusive), so a
+    # Mosaic rejection downgrades to dense attention up front instead of
+    # crashing the server/chat on its first decode dispatch.
+    if (args.cache_dtype == "f8"
+            and os.environ.get("DLLAMA_FLASH_DECODE", "0") == "1"):
+        from dllama_tpu.ops import flash_decode as _fd
+
+        ok, detail = _fd.probe_kernel(cache="f8")
+        if not ok:
+            print(f"⚠️  flash-decode f8 probe failed ({detail[:200]}); "
+                  "falling back to dense attention (DLLAMA_FLASH_DECODE "
+                  "unset)", file=sys.stderr, flush=True)
+            os.environ.pop("DLLAMA_FLASH_DECODE", None)
+
     import jax
     import jax.numpy as jnp
 

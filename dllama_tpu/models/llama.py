@@ -667,6 +667,7 @@ def forward(
     gather_logits: bool = True,
     tp_compress: bool = False,
     allow_flash: bool = True,
+    last_pos=None,
 ) -> tuple:
     """Process T tokens starting at ``pos``. Returns (logits [T, vocab] f32, new cache).
 
@@ -684,6 +685,12 @@ def forward(
     partition a Pallas custom call, so routing into the flash kernel there
     would compile it replicated against an all-gathered cache — the caller
     must pin the dense xs-scan instead.
+
+    ``last_pos`` (traced scalar): compute the lm_head only at that row —
+    logits come back [1, vocab]. Prefill reads exactly one row of logits,
+    and at a 128k vocab the [bucket, vocab] classifier matmul dwarfs the
+    one row actually consumed; every layer still processes (and caches) all
+    T positions.
     """
     x = embed(cfg, params, tokens)
     layers = params["layers"]
@@ -735,6 +742,8 @@ def forward(
             layer_step, x, (layers, cache["k"], cache["v"])
         )
 
+    if last_pos is not None:
+        x = jax.lax.dynamic_slice_in_dim(x, last_pos, 1, axis=0)
     x = rmsnorm(x, params["rms_final"], cfg.norm_eps)
     logits = matmul_any(x, params["wcls"]).astype(jnp.float32)
     if tp_axis is not None and gather_logits:

@@ -284,3 +284,64 @@ def flash_decode_attention_batched(
 
     out = _launch(qr, qpos, k_cache, v_cache, n_blk, layer, interpret)
     return out[:, :, :Tg].reshape(B, n_kv * group, hd)
+
+
+def probe_kernel(cache: str = "bf16", timeout_s: int = 240) -> tuple:
+    """Compile+run one tiny flash-decode kernel in a SUBPROCESS with the
+    given cache dtype ("bf16" | "f8") -> (ok, failure_detail).
+
+    For callers that haven't touched the backend yet (bench, CLI serve): a
+    Mosaic rejection — plausible for the f8 upcast path until it is
+    hardware-validated — surfaces here as a clean (False, detail) the
+    caller can downgrade on (unset DLLAMA_FLASH_DECODE, run dense
+    attention) instead of crashing on the first decode dispatch. The
+    subprocess matters twice over: a down TPU tunnel hangs backend init in
+    native code (un-timeout-able in-process), and some TPU runtimes are
+    per-process exclusive, so a probe spawned AFTER the parent holds the
+    chip would silently land on CPU and validate nothing.
+
+    Skips (returns True) when the default backend is not TPU — interpret
+    mode has nothing Mosaic-level to validate — and when DLLAMA_PLATFORM
+    forces the parent off-TPU.
+    """
+    import subprocess
+
+    forced = os.environ.get("DLLAMA_PLATFORM")
+    if forced and forced != "tpu":
+        return True, "platform forced off-TPU; interpret mode, nothing to probe"
+    cache_expr = "jnp.float8_e4m3fn" if cache == "f8" else "jnp.bfloat16"
+    # the child must resolve THIS package even when the caller runs from an
+    # arbitrary cwd (the CLI does; bench chdirs to the repo root itself)
+    pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    code = (
+        f"import sys; sys.path.insert(0, {pkg_root!r})\n"
+        "import jax\n"
+        + (f"jax.config.update('jax_platforms', {forced!r})\n" if forced else "")
+        + "import jax.numpy as jnp\n"
+        "if jax.default_backend() != 'tpu':\n"
+        "    print('FLASH_OK (non-tpu backend: interpret mode)')\n"
+        "    raise SystemExit(0)\n"
+        "print('BACKEND_TPU_OK')\n"
+        "from dllama_tpu.ops import flash_decode\n"
+        "q = jnp.ones((1, 8, 128), jnp.bfloat16)\n"
+        f"k = jnp.ones((1, 512, 4, 128), {cache_expr})\n"
+        f"v = jnp.ones((1, 512, 4, 128), {cache_expr})\n"
+        "y = flash_decode.flash_decode_attention(\n"
+        "    q, k, v, jnp.int32(300), jnp.int32(0))\n"
+        "jax.block_until_ready(y)\n"
+        "print('FLASH_OK')\n"
+    )
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True, text=True, timeout=timeout_s,
+        )
+    except subprocess.TimeoutExpired:
+        return False, f"probe timed out after {timeout_s}s (TPU tunnel down?)"
+    if proc.returncode == 0 and "FLASH_OK" in proc.stdout:
+        return True, ""
+    detail = ((proc.stdout or "") + (proc.stderr or "")).strip()
+    if len(detail) > 500:
+        detail = detail[:100] + " ... " + detail[-400:]
+    return False, detail

@@ -113,6 +113,12 @@ class Engine:
         fwd = llama.forward
         fwd_b = llama.forward_batched
         fwd_v = llama.forward_batched_verify
+        # prefill-only forward computing the lm_head at ONE row (see
+        # llama.forward last_pos): at a 128k vocab the [bucket, vocab]
+        # classifier matmul dwarfs the single row prefill consumes. None on
+        # the quant-TP path — its shard_map wrappers carry a fixed signature
+        # and the vocab-sharded gather wants the full [T, vocab] layout.
+        fwd_last = llama.forward
         #: generate_batch_spec availability: single mesh, or quant-TP
         #: shard_map (the dense-pjit mesh path has no verify wrapper)
         self.supports_batch_spec = True
@@ -135,6 +141,8 @@ class Engine:
                 tp_fwd_v = quant_tp.make_tp_verify_batched(
                     cfg, mesh, self.params, compress=tp_compress
                 )
+
+                fwd_last = None
 
                 def fwd(cfg_, params_, rope_, tokens_, cache_, pos_):
                     return tp_fwd(params_, rope_, cache_, tokens_, pos_)
@@ -169,6 +177,8 @@ class Engine:
                 def fwd(cfg_, params_, rope_, tokens_, cache_, pos_):
                     return llama.forward(cfg_, params_, rope_, tokens_,
                                          cache_, pos_, allow_flash=False)
+
+                fwd_last = partial(llama.forward, allow_flash=False)
 
                 def fwd_b(cfg_, params_, rope_, tokens_, cache_, pos_):
                     return llama.forward_batched(cfg_, params_, rope_,
@@ -207,8 +217,14 @@ class Engine:
 
         @partial(jax.jit, donate_argnums=(2,))
         def _prefill(params, rope, cache, padded_tokens, n_tokens, pos):
-            # n_tokens is traced (dynamic index) so one compile serves every
-            # prompt length within a bucket
+            # n_tokens is traced (dynamic slice/index) so one compile serves
+            # every prompt length within a bucket
+            if fwd_last is not None:
+                # lm_head at the final prompt row only ([1, vocab]) — the
+                # other bucket-1 rows of logits were never read
+                logits, cache = fwd_last(cfg, params, rope, padded_tokens,
+                                         cache, pos, last_pos=n_tokens - 1)
+                return logits[0], cache
             logits, cache = fwd(cfg, params, rope, padded_tokens, cache, pos)
             return jax.lax.dynamic_index_in_dim(logits, n_tokens - 1, keepdims=False), cache
 
@@ -756,6 +772,16 @@ class Engine:
         self.prefill_ms = (time.perf_counter() - t0) * 1000.0
         return cache, pend, poss
 
+    def batch_session(self, max_batch: int,
+                      chunk: Optional[int] = None) -> "BatchSession":
+        """Open a persistent slot-pool decode session (continuous batching):
+        one resident [L, max_batch, S, kv, hd] donated batch cache whose rows
+        are admitted, stepped, and released INDEPENDENTLY — see BatchSession.
+        ``chunk`` is the fused steps per ``step_chunk`` call (defaults to the
+        engine's decode_chunk); (max_batch, chunk) picks the single
+        _decode_loop_batch compile every chunk of the session reuses."""
+        return BatchSession(self, max_batch, chunk)
+
     def generate_batch_spec(
         self, prompts: list, steps: int,
         stop_tokens: tuple = (),
@@ -1075,6 +1101,216 @@ class Engine:
         # pos-of-that-token, pending) — tokens speculated past the `steps`
         # cap were never emitted and their cache slots will be overwritten
         # before any resumed decode attends them
+
+
+@dataclasses.dataclass
+class _SlotState:
+    """Host-side bookkeeping for one occupied BatchSession slot."""
+
+    room: int  # feeds the row's remaining context allows (S - admit pos)
+    budget: int  # min(room, the caller's step budget)
+    stop_tokens: tuple
+    offered: int = 0  # tokens the fused chunks have offered this row so far
+    done: bool = False  # budget/stop reached; pinned in place until release()
+    emitted: int = 0  # tokens actually kept (post budget/stop truncation)
+
+
+class BatchSession:
+    """Slot-pool decode over ONE resident donated batch cache — the
+    continuous-batching primitive. Where ``generate_batch`` forms a batch
+    once and runs it to completion (a long row holds the device while short
+    rows' slots idle), a BatchSession lets rows join (``admit``), step
+    (``step_chunk``), and leave (``release``) independently BETWEEN fused
+    decode chunks: the serving scheduler admits newly arrived requests into
+    freed slots while its neighbours keep decoding.
+
+    Row math is EXACTLY generate_batch's: every chunk is one
+    ``_decode_loop_batch`` program over all ``max_batch`` rows, each row
+    running its OWN sampler chain (key split once per step) — so a row
+    admitted mid-flight emits a stream BIT-IDENTICAL to a solo ``generate``
+    call with the same SamplerConfig, no matter what its neighbours are
+    doing. Free/finished rows ride along pinned in place (pos clamped at
+    seq_len-1, feeding token 0) exactly like context-exhausted rows in
+    generate_batch: their writes are garbage at slots no live query attends.
+
+    Slot-slab reuse needs no clearing: admitting a multi-token prompt
+    overwrites the slot's whole [L, S, kv, hd] slab (_batch_cache_insert),
+    and a 1-token prompt starts at pos 0 where overwrite-before-attend
+    holds — every position <= pos is written by the CURRENT occupant before
+    any of its queries attends it; stale garbage sits only at masked
+    positions.
+
+    One compile serves the whole session: B = max_batch and n_steps = chunk
+    are fixed, so the first step_chunk pays the trace and every later chunk
+    reuses it regardless of which rows are live.
+    """
+
+    def __init__(self, eng: Engine, max_batch: int, chunk: Optional[int] = None):
+        if max_batch < 1:
+            raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+        chunk = eng.decode_chunk if chunk is None else chunk
+        if chunk < 1:
+            raise ValueError(f"chunk must be >= 1, got {chunk}")
+        self.eng = eng
+        self.max_batch = max_batch
+        self.chunk = chunk
+        S = eng.cfg.seq_len
+        self.cache = eng._batch_cache_init(max_batch)
+        self._tokens = jnp.zeros((max_batch,), jnp.int32)
+        # free slots pin at the last cache slot, like exhausted rows
+        self._pos = jnp.full((max_batch,), S - 1, jnp.int32)
+        self._keys = jnp.stack(
+            [jax.random.PRNGKey(0) for _ in range(max_batch)])
+        self._temps = jnp.zeros((max_batch,), jnp.float32)
+        self._topps = jnp.ones((max_batch,), jnp.float32)
+        self._slots: list = [None] * max_batch
+        self._closed = False
+        self.decode_ms = 0.0  # cumulative fused-chunk wall time
+        self.prefill_ms = 0.0  # cumulative admit-prefill wall time
+
+    # -- introspection ----------------------------------------------------
+    @property
+    def free_slots(self) -> list:
+        """Indices admit() can take right now."""
+        return [b for b, st in enumerate(self._slots) if st is None]
+
+    @property
+    def occupied(self) -> list:
+        """Admitted-and-not-released slot indices (done rows included)."""
+        return [b for b, st in enumerate(self._slots) if st is not None]
+
+    @property
+    def num_live(self) -> int:
+        """Rows the next step_chunk will actually advance."""
+        return sum(1 for st in self._slots
+                   if st is not None and not st.done)
+
+    def is_done(self, slot: int) -> bool:
+        """True once the row hit its stop token or budget (it no longer
+        receives tokens; release() it to free the slab)."""
+        st = self._slots[slot]
+        if st is None:
+            raise ValueError(f"slot {slot} is not occupied")
+        return st.done
+
+    # -- lifecycle --------------------------------------------------------
+    def admit(self, prompt_tokens: list, steps: int,
+              sampler: Optional[SamplerConfig] = None,
+              stop_tokens: tuple = ()) -> int:
+        """Prefill ``prompt_tokens`` into a free slot and return its index.
+
+        The prompt's prefix runs through the engine's bucketed prefill into
+        a fresh single cache, written straight into the slot's slab (donated
+        in-place update); the last prompt token stays pending so the row's
+        first fused step samples from the final-prompt-position logits with
+        the FIRST key of a fresh PRNGKey(sampler.seed) chain — the exact
+        schedule a solo ``generate`` walks (``sampler`` defaults to the
+        engine's SamplerConfig). ``steps``/``stop_tokens`` are this row's
+        private budget and stop set, checked per chunk like generate_batch's
+        row_steps/stop_tokens.
+
+        Raises RuntimeError when no slot is free (check ``free_slots``).
+        """
+        if self._closed:
+            raise RuntimeError("batch session is closed")
+        if not prompt_tokens:
+            raise ValueError("admit needs a non-empty prompt")
+        free = self.free_slots
+        if not free:
+            raise RuntimeError(
+                f"no free slot (max_batch={self.max_batch}); release a "
+                "finished row first")
+        slot = free[0]
+        S = self.eng.cfg.seq_len
+        if len(prompt_tokens) > S:
+            raise ValueError(
+                f"prompt of {len(prompt_tokens)} tokens exceeds seq_len {S}")
+        scfg = sampler if sampler is not None else self.eng.sampler_cfg
+        t0 = time.perf_counter()
+        if len(prompt_tokens) > 1:
+            single = self.eng.new_cache()
+            _, single = self.eng.prefill(single, list(prompt_tokens[:-1]), 0)
+            self.cache = self.eng._batch_cache_insert(
+                self.cache, single, jnp.int32(slot))
+            del single
+        self.prefill_ms += (time.perf_counter() - t0) * 1000.0
+        pos0 = len(prompt_tokens) - 1
+        self._tokens = self._tokens.at[slot].set(int(prompt_tokens[-1]))
+        self._pos = self._pos.at[slot].set(pos0)
+        self._keys = self._keys.at[slot].set(jax.random.PRNGKey(scfg.seed))
+        self._temps = self._temps.at[slot].set(scfg.temperature)
+        self._topps = self._topps.at[slot].set(scfg.topp)
+        room = S - pos0
+        budget = min(room, steps)
+        self._slots[slot] = _SlotState(
+            room=room, budget=budget, stop_tokens=tuple(stop_tokens),
+            done=budget <= 0)
+        return slot
+
+    def step_chunk(self) -> dict:
+        """Run ONE fused chunk over the pool and return {slot: fresh tokens}
+        for every live row — each list is already truncated at the row's own
+        budget and (inclusively) at its first stop token, and is never empty:
+        a live row always nets at least one token per chunk, so staggered
+        admission can never starve a row. Rows that just finished are marked
+        done (``is_done``) and skip future chunks until released. Returns {}
+        without touching the device when nothing is live."""
+        if self._closed:
+            raise RuntimeError("batch session is closed")
+        live = [b for b, st in enumerate(self._slots)
+                if st is not None and not st.done]
+        if not live:
+            return {}
+        t1 = time.perf_counter()
+        chunk, self.cache, self._keys = self.eng._decode_loop_batch(
+            self.cache, self._tokens, self._pos, self._keys, self._temps,
+            self._topps, n_steps=self.chunk)
+        arr = np.asarray(chunk)  # [chunk, B]
+        self._tokens = chunk[-1]
+        # mirror the in-program per-row pin across chunk boundaries
+        self._pos = jnp.minimum(self._pos + self.chunk,
+                                jnp.int32(self.eng.cfg.seq_len - 1))
+        self.decode_ms += (time.perf_counter() - t1) * 1000.0
+        fresh: dict = {}
+        for b in live:
+            st = self._slots[b]
+            # a context-exhausted row pinned at its last slot: tokens past
+            # its room are garbage — generate_batch's exact accounting
+            keep = max(0, min(self.chunk, st.room - st.offered))
+            st.offered += self.chunk
+            toks = [int(t) for t in arr[:keep, b]]
+            take = min(len(toks), st.budget - st.emitted)
+            for j in range(take):
+                if toks[j] in st.stop_tokens:
+                    take = j + 1
+                    break
+            toks = toks[:take]
+            st.emitted += len(toks)
+            if (st.emitted >= st.budget
+                    or (st.stop_tokens and toks
+                        and toks[-1] in st.stop_tokens)):
+                st.done = True
+            fresh[b] = toks
+        return fresh
+
+    def release(self, slot: int) -> None:
+        """Free the slot for the next admit(). The slab is NOT cleared (see
+        class docstring for why reuse is safe); the row re-pins at the last
+        cache slot like a free slot."""
+        if self._slots[slot] is None:
+            raise ValueError(f"slot {slot} is not occupied")
+        self._slots[slot] = None
+        self._pos = self._pos.at[slot].set(self.eng.cfg.seq_len - 1)
+
+    def close(self) -> None:
+        """Drop the resident batch cache's device buffers. Idempotent."""
+        if self._closed:
+            return
+        self._closed = True
+        for leaf in jax.tree.leaves(self.cache):
+            leaf.delete()
+        self.cache = None
+        self._slots = [None] * self.max_batch
 
 
 class _NgramIndex:

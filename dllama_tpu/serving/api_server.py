@@ -122,35 +122,41 @@ def decode_token_row(tok, prev: int, row: list, stop_ids: tuple,
 
 
 class Batcher:
-    """Merges concurrent completions — greedy AND sampled, non-streaming AND
-    streaming — into ONE batched decode step stream (``Engine.generate_batch``):
-    requests arriving within ``window_ms`` of each other share every
-    weight-streaming pass, so K concurrent requests cost ~one request's wall
-    time instead of K (decode is weight-bandwidth-bound). Every row runs its
-    own sampler chain (per-row temperature/topp/seed are traced arrays), so
-    greedy rows AND sampled rows are bit-identical to their solo runs with
-    the same SamplerConfig. The reference serves strictly one request at a
-    time (`/root/reference/src/apps/dllama-api/dllama-api.cpp:324-355`).
+    """CONTINUOUS batching scheduler: concurrent completions — greedy AND
+    sampled, non-streaming AND streaming — share one resident slot-pool
+    decode (``Engine.batch_session``). A dedicated scheduler thread drains
+    an arrival queue and admits requests into free cache slots BETWEEN fused
+    decode chunks, so a request arriving mid-decode starts after at most one
+    chunk (~chunk tokens) instead of waiting for the whole running batch to
+    drain, and a finished row's slot is handed to the next waiter the moment
+    it stops — the static-window pathology (a long row holding K idle slots
+    hostage) is gone. Every row runs its own sampler chain (per-row
+    temperature/topp/seed are traced arrays), so greedy rows AND sampled
+    rows are bit-identical to their solo runs with the same SamplerConfig —
+    WHENEVER they were admitted. The reference serves strictly one request
+    at a time (`/root/reference/src/apps/dllama-api/dllama-api.cpp:324-355`).
 
-    Streaming rows consume a per-slot queue fed by the decode loop's
-    ``on_chunk`` hook: tokens arrive in fused-chunk bursts (decode_chunk
-    tokens per dispatch) rather than one SSE event per token — the
-    granularity cost of sharing one device program across the batch.
+    Streaming rows consume a per-slot queue fed from the scheduler loop:
+    tokens arrive in fused-chunk bursts (``--batch-chunk`` tokens per
+    dispatch) rather than one SSE event per token — the granularity cost of
+    sharing one device program across the pool.
 
-    Batched rows share a step budget (the max of the batch; a near-full-
-    context row pins at its last slot without truncating the others —
-    Engine.generate_batch clamps per row), skip the prefix cache, and
-    stop-truncate on the host — the trade for the shared weight stream.
+    Two special cases keep their faster paths: a batch of ONE delegates to
+    the solo engine path (prefix-session KV reuse, per-token streaming —
+    _serve_solo), and an all-greedy window on a --spec-draft server runs the
+    batched speculative verify (_serve_spec) when it fits the pool at once —
+    speculation's drafting arithmetic assumes a fixed row set, so it runs
+    run-to-completion; overflow and mixed windows take the continuous path.
 
-    KV-reuse trade, explicitly: batches of >= 2 rows neither claim nor
-    store prefix sessions (extracting per-row sessions from the batch
-    cache would pin B full-context KV caches in HBM — the session cache's
-    budget is ~2). So under SUSTAINED concurrency a multi-turn chat
+    KV-reuse trade, explicitly: pooled rows (>= 2 concurrent) neither claim
+    nor store prefix sessions (extracting per-row sessions from the pool
+    cache would pin max_batch full-context KV caches in HBM — the session
+    cache's budget is ~2). So under SUSTAINED concurrency a multi-turn chat
     re-prefills its history each turn; that is the deliberate price for
     sharing every decode weight stream, and prefill is the cheap
     (MXU-bound, bucketed) phase. The zero/low-concurrency cases keep full
     reuse: prompts extending a cached session route solo at the gate, and
-    a batch of ONE delegates to the solo path (_serve_solo).
+    singletons delegate to _serve_solo.
     """
 
     class _Slot:
@@ -166,14 +172,20 @@ class Batcher:
             # terminal item — None (clean end) or an Exception
             self.queue = queue_mod.Queue() if streaming else None
 
-    def __init__(self, state, window_ms: float = 15.0, max_batch: int = 8):
+    def __init__(self, state, window_ms: float = 15.0, max_batch: int = 8,
+                 chunk: int = 8):
         self.state = state
         self.window_s = window_ms / 1000.0
-        #: HBM bound: the batch KV cache is max_batch full-context caches
+        #: HBM bound: the pool KV cache is max_batch full-context caches
         #: (--batch-max; size against seq_len x n_layers x kv x cache dtype)
         self.max_batch = max(1, max_batch)
+        #: fused steps between admission checks (--batch-chunk): smaller =
+        #: lower admission latency for mid-decode arrivals, larger = fewer
+        #: host round trips per token
+        self.chunk = max(1, chunk)
         self._lock = threading.Lock()
-        self._pending: list = []
+        self._arrivals: queue_mod.Queue = queue_mod.Queue()
+        self._thread = None
 
     def _serve_solo(self, s) -> None:
         """A batch of ONE delegates to the solo engine path, WITH prefix-
@@ -208,129 +220,172 @@ class Batcher:
                 s.queue.put(s.error)
             s.done.set()
 
-    def _serve(self, batch: list) -> None:
-        """Run one generate_batch for ``batch`` and resolve every slot —
-        ALWAYS (any failure resolves every waiter with an error; a follower
-        left waiting forever would hang its HTTP connection). The prompt
-        list is padded to the next power of two (dummy greedy [0] rows,
-        dropped after) so distinct arrival counts reuse a handful of
-        compiled batch sizes instead of compiling one program per B."""
-        if len(batch) == 1:
-            self._serve_solo(batch[0])
-            return
+    @staticmethod
+    def _fail(slots, e) -> None:
+        """Resolve every waiter with an error — ALWAYS on failure (a waiter
+        left hanging would hang its HTTP connection)."""
+        err = RuntimeError(f"batched decode failed: {e!r}")
+        for s in slots:
+            s.error = err
+            if s.queue is not None:
+                s.queue.put(err)
+            s.done.set()
+
+    def _serve_spec(self, batch: list) -> None:
+        """All-greedy window on a --spec-draft server: BATCHED speculative
+        verify — every launch scores draft_len+1 positions for all rows
+        (exact; rows equal plain batched greedy), single-device or
+        quantized-TP. Streaming rows get per-launch bursts (already
+        budget/stop-truncated). Run-to-completion: speculation's per-row
+        drafting state assumes a fixed row set, so this fast path keeps the
+        static shape — the scheduler only routes a window here when it fits
+        the pool at once; contended windows decode continuously instead.
+        The prompt list is padded to the next power of two (dummy greedy
+        [0] rows of budget 1, dropped after) so distinct arrival counts
+        reuse a handful of compiled batch sizes."""
         try:
-            # per-row budgets drive the early exit: a 4-max_tokens row
-            # counts done after 4 tokens, pad rows after 1 — neither keeps
-            # the batch decoding to the whole envelope
             prompts, row_steps = padded_batch(
                 [s.prompt for s in batch], [s.steps for s in batch])
-            if (self.state.spec_draft > 0
-                    and getattr(self.state.engine, "supports_batch_spec", False)
-                    and all(s.sampler.temperature == 0.0 for s in batch)):
-                # all-greedy batch on a --spec-draft server: BATCHED
-                # speculative verify — every launch scores draft_len+1
-                # positions for all rows (exact; rows equal plain batched
-                # greedy), single-device or quantized-TP. Streaming rows
-                # get per-launch bursts (already budget/stop-truncated).
-                # Mixed sampled batches fall through to the plain batched
-                # decode below, and so does the dense-pjit mesh path (no
-                # shard_map verify wrapper there).
-                def on_step(fresh):
-                    for i, s in enumerate(batch):
-                        if s.queue is not None and fresh[i]:
-                            s.queue.put(fresh[i])
 
-                # explicit greedy sampler: the ENGINE default may be sampled
-                # (CLI --temperature 0.8) and would trip the greedy-only
-                # guard even though every REQUEST in this batch is greedy
-                rows, _stats = self.state.engine.generate_batch_spec(
-                    prompts, max(s.steps for s in batch),
-                    stop_tokens=self.state.stop_token_ids(),
-                    row_steps=row_steps,
-                    draft_len=self.state.spec_draft,
-                    sampler=SamplerConfig(temperature=0.0, seed=0),
-                    on_step=on_step,
-                )
-            else:
-                # cap logic belongs to THIS path only: plain chunks may
-                # carry tokens past a row's budget; spec bursts arrive
-                # pre-truncated (on_step above needs no emitted[] cap)
-                emitted = [0] * len(batch)
+            def on_step(fresh):
+                for i, s in enumerate(batch):
+                    if s.queue is not None and fresh[i]:
+                        s.queue.put(fresh[i])
 
-                def on_chunk(fresh):
-                    for i, s in enumerate(batch):
-                        if s.queue is None:
-                            continue
-                        burst = fresh[i][: max(0, s.steps - emitted[i])]
-                        if burst:
-                            emitted[i] += len(burst)
-                            s.queue.put(burst)
-
-                samplers = [s.sampler for s in batch] + [
-                    SamplerConfig(temperature=0.0, seed=0)
-                ] * (len(prompts) - len(batch))
-                rows = self.state.engine.generate_batch(
-                    prompts, max(s.steps for s in batch),
-                    samplers=samplers,
-                    stop_tokens=self.state.stop_token_ids(),
-                    row_steps=row_steps,
-                    on_chunk=on_chunk,
-                )
+            # explicit greedy sampler: the ENGINE default may be sampled
+            # (CLI --temperature 0.8) and would trip the greedy-only
+            # guard even though every REQUEST in this batch is greedy
+            rows, _stats = self.state.engine.generate_batch_spec(
+                prompts, max(s.steps for s in batch),
+                stop_tokens=self.state.stop_token_ids(),
+                row_steps=row_steps,
+                draft_len=self.state.spec_draft,
+                sampler=SamplerConfig(temperature=0.0, seed=0),
+                on_step=on_step,
+            )
             for s, row in zip(batch, rows):
                 s.tokens = row[: s.steps]
                 if s.queue is not None:
                     s.queue.put(None)
                 s.done.set()
         except Exception as e:  # noqa: BLE001 — every waiter gets a 500
-            for s in batch:
-                s.error = RuntimeError(f"batched decode failed: {e!r}")
-                if s.queue is not None:
-                    s.queue.put(s.error)
-                s.done.set()
+            self._fail(batch, e)
 
-    def _submit_slot(self, slot) -> None:
+    def _serve_continuous(self, batch: list) -> None:
+        """THE continuous path: open a slot-pool session, admit ``batch``
+        into free slots, and between every fused chunk (a) stream each live
+        row's fresh burst to its own queue, (b) release rows the moment
+        they hit stop/budget — resolving their waiters immediately, not at
+        batch end — and (c) admit newly arrived requests into the freed
+        slots (rolling admission; the arrival queue is polled between
+        chunks, so a mid-decode arrival waits at most one chunk). Runs
+        until the pool drains AND no arrivals are waiting. Every admitted
+        row is bit-identical to its solo run (BatchSession's invariant);
+        the session is closed on exit so the pool cache's HBM is held only
+        while traffic needs it."""
+        st = self.state
+        stop_ids = st.stop_token_ids()
+        waiting = list(batch)
+        slot_map: dict = {}  # session slot index -> _Slot
+        sess = None
+        try:
+            sess = st.engine.batch_session(self.max_batch, chunk=self.chunk)
+            while waiting or slot_map:
+                while waiting and sess.free_slots:
+                    s = waiting.pop(0)
+                    try:
+                        b = sess.admit(s.prompt, s.steps, sampler=s.sampler,
+                                       stop_tokens=stop_ids)
+                    except Exception as e:  # noqa: BLE001 — this row only
+                        self._fail([s], e)
+                        continue
+                    s.tokens = []
+                    slot_map[b] = s
+                for b, burst in sess.step_chunk().items():
+                    s = slot_map[b]
+                    s.tokens.extend(burst)
+                    if s.queue is not None and burst:
+                        s.queue.put(burst)
+                    if sess.is_done(b):
+                        # free the slab NOW — the next waiter admits into
+                        # it on this very loop pass
+                        sess.release(b)
+                        del slot_map[b]
+                        if s.queue is not None:
+                            s.queue.put(None)
+                        s.done.set()
+                while True:  # rolling admission: drain mid-chunk arrivals
+                    try:
+                        waiting.append(self._arrivals.get_nowait())
+                    except queue_mod.Empty:
+                        break
+        except Exception as e:  # noqa: BLE001 — every waiter gets a 500
+            self._fail(list(slot_map.values()) + waiting, e)
+        finally:
+            if sess is not None:
+                sess.close()
+
+    def _scheduler_loop(self) -> None:
+        """The scheduler daemon: wait for an arrival, hold the admission
+        window open for companions, then route the window — singleton ->
+        solo path (prefix-cache reuse), all-greedy spec-capable fit ->
+        batched speculative verify, anything else -> continuous slot-pool
+        decode. The engine lock is held per window, so handler-side solo
+        requests (stop strings, prefix-session extensions) interleave
+        between windows exactly as before."""
+        while True:
+            first = self._arrivals.get()
+            if self.window_s > 0:
+                time.sleep(self.window_s)  # let concurrent requests join
+            window = [first]
+            while True:
+                try:
+                    window.append(self._arrivals.get_nowait())
+                except queue_mod.Empty:
+                    break
+            with self.state.lock:  # the engine serves one pool at a time
+                if len(window) == 1 and self._arrivals.empty():
+                    self._serve_solo(window[0])
+                elif (len(window) <= self.max_batch
+                        and self.state.spec_draft > 0
+                        and getattr(self.state.engine,
+                                    "supports_batch_spec", False)
+                        and all(s.sampler.temperature == 0.0
+                                for s in window)):
+                    self._serve_spec(window)
+                else:
+                    self._serve_continuous(window)
+
+    def _enqueue(self, slot) -> None:
         with self._lock:
-            self._pending.append(slot)
-            leader = len(self._pending) == 1
-        if leader:
-            time.sleep(self.window_s)  # let concurrent requests join
-            with self.state.lock:  # the engine serves one batch at a time
-                # snapshot ONCE: slots arriving during _serve belong to the
-                # new leader they spawned (it is already queued on
-                # state.lock) — re-reading here would keep this thread
-                # serving other leaders' batches and delay its own HTTP
-                # response unboundedly under sustained load
-                with self._lock:
-                    batch, self._pending = self._pending, []
-                for i in range(0, len(batch), self.max_batch):
-                    self._serve(batch[i : i + self.max_batch])
-        else:
-            slot.done.wait()
+            if self._thread is None:
+                self._thread = threading.Thread(
+                    target=self._scheduler_loop, daemon=True,
+                    name="dllama-batch-scheduler")
+                self._thread.start()
+        self._arrivals.put(slot)
 
     def submit(self, prompt_tokens: list, max_tokens: int,
                sampler: SamplerConfig) -> list:
-        """Blocks until this request's tokens are decoded (possibly by
-        another thread's batch run). Thread-safe; raises the batch's
-        failure as RuntimeError."""
+        """Blocks until this request's tokens are decoded (by the scheduler
+        thread's pool). Thread-safe; raises the decode's failure as
+        RuntimeError."""
         slot = self._Slot(list(prompt_tokens), max_tokens, sampler,
                           streaming=False)
-        self._submit_slot(slot)
+        self._enqueue(slot)
+        slot.done.wait()
         if slot.error is not None:
             raise slot.error
         return slot.tokens
 
     def submit_stream(self, prompt_tokens: list, max_tokens: int,
                       sampler: SamplerConfig):
-        """Yields bursts (lists) of token ids as the shared batch decodes.
-        Raises the batch failure as RuntimeError."""
+        """Yields bursts (lists) of token ids as the pool decodes — from
+        admission, not from batch completion. Raises the decode failure as
+        RuntimeError."""
         slot = self._Slot(list(prompt_tokens), max_tokens, sampler,
                           streaming=True)
-        done_in_thread = threading.Thread(
-            target=self._submit_slot, args=(slot,), daemon=True)
-        # run leader duty (or the follower wait) off-thread so THIS thread
-        # drains the queue live while the batch is still decoding — leader
-        # and follower rows both stream as chunks land
-        done_in_thread.start()
+        self._enqueue(slot)
         while True:
             item = slot.queue.get()
             if item is None:
@@ -338,7 +393,6 @@ class Batcher:
             if isinstance(item, Exception):
                 raise item
             yield item
-        done_in_thread.join()
 
 
 class ServerState:
@@ -348,7 +402,7 @@ class ServerState:
                  default_sampler: SamplerConfig = SamplerConfig(),
                  default_seed: int = None, spec_draft: int = 0,
                  session_cache: int = 2, batch_window_ms: float = 0.0,
-                 batch_max: int = 8):
+                 batch_max: int = 8, batch_chunk: int = 8):
         """``default_seed``: seed for requests that send none — None means a
         fresh time-based seed per request (the launch-flag --seed plumbs in
         here so an operator can make the whole server reproducible).
@@ -373,12 +427,15 @@ class ServerState:
         self.batch_max = max(1, batch_max)
         self.lock = threading.Lock()  # engine serves one request at a time
         # --batch-window > 0: requests (greedy or sampled, streaming or
-        # not) that arrive within the window run as ONE batched decode
-        # (Batcher) — single-device or tensor-parallel alike. Off by
-        # default: batching adds up to window_ms latency per request and
-        # only pays off under concurrency.
+        # not) that arrive within the window share a continuously batched
+        # slot-pool decode (Batcher) — single-device or tensor-parallel
+        # alike; later arrivals are admitted into freed slots between
+        # fused chunks of --batch-chunk steps. Off by default: batching
+        # adds up to window_ms latency per request and only pays off under
+        # concurrency.
         self.batcher = (
-            Batcher(self, batch_window_ms, max_batch=batch_max)
+            Batcher(self, batch_window_ms, max_batch=batch_max,
+                    chunk=batch_chunk)
             if batch_window_ms > 0 else None
         )
         # prefix cache: KV state + token history of recent completions, LRU.
@@ -557,14 +614,15 @@ class OpenAIHandler(BaseHTTPRequestHandler):
             return
         try:
             self._handle_completions(req)
-        except BrokenPipeError:
-            pass  # client went away mid-stream; per-request isolation like
-            # the reference's per-request catch (`dllama-api.cpp:347-351`)
+        except (BrokenPipeError, ConnectionResetError):
+            pass  # client went away mid-stream (FIN -> BrokenPipe, RST ->
+            # ConnectionReset); per-request isolation like the reference's
+            # per-request catch (`dllama-api.cpp:347-351`)
 
     def _stream_batched(self, base: dict, sampler: SamplerConfig,
                         prompt_tokens: list, max_tokens: int) -> None:
-        """SSE streaming from the shared batched decode: bursts of
-        decode_chunk tokens per event instead of one event per token (the
+        """SSE streaming from the shared pool decode: bursts of up to
+        batch-chunk tokens per event instead of one event per token (the
         granularity trade for sharing one device program across concurrent
         requests). Stop strings never reach here (the batch gate routes
         them solo), so only stop TOKENS and budgets truncate."""
@@ -850,6 +908,7 @@ def serve(args) -> None:
         session_cache=getattr(args, "session_cache", 2),
         batch_window_ms=getattr(args, "batch_window", 0.0),
         batch_max=getattr(args, "batch_max", 8),
+        batch_chunk=getattr(args, "batch_chunk", 8),
     )
     srv = create_server(state, host=args.host, port=args.port)
     print(f"📡 listening on {args.host}:{args.port} "

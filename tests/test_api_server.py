@@ -443,9 +443,9 @@ def test_miss_at_capacity_evicts_before_fresh_prefill():
 
 
 def test_concurrent_greedy_requests_batch_into_one_decode():
-    """K greedy non-streaming requests inside the batch window must run as
-    ONE Engine.generate_batch call (B >= 2) and return exactly the replies a
-    batching-disabled server gives for the same prompts."""
+    """K greedy non-streaming requests inside the batch window must share
+    ONE slot-pool decode session (>= 2 rows co-resident) and return exactly
+    the replies a batching-disabled server gives for the same prompts."""
     tok = make_tokenizer()
     cfg = tiny_cfg(vocab_size=tok.vocab_size, seq_len=512, dim=32, kv_dim=16,
                    head_size=8, hidden_dim=64)
@@ -455,15 +455,23 @@ def test_concurrent_greedy_requests_batch_into_one_decode():
         engine = Engine(cfg, params, SamplerConfig(temperature=0.0, seed=1))
         state = ServerState(engine, tok, cfg, model_name="tiny-test",
                             template="llama3", batch_window_ms=window_ms)
-        sizes = []
+        sizes = []  # pool occupancy after every admit
         if state.batcher is not None:
-            orig = engine.generate_batch
+            orig = engine.batch_session
 
-            def spy(prompts, steps, **kw):
-                sizes.append(len(prompts))
-                return orig(prompts, steps, **kw)
+            def spy(max_batch, chunk=None):
+                sess = orig(max_batch, chunk)
+                orig_admit = sess.admit
 
-            engine.generate_batch = spy
+                def admit(*a, **kw):
+                    slot = orig_admit(*a, **kw)
+                    sizes.append(len(sess.occupied))
+                    return slot
+
+                sess.admit = admit
+                return sess
+
+            engine.batch_session = spy
         srv = create_server(state, host="127.0.0.1", port=0)
         threading.Thread(target=srv.serve_forever, daemon=True).start()
         return srv, srv.server_address[1], sizes
@@ -542,9 +550,9 @@ def test_n_greedy_choices_are_identical(server):
 
 def test_concurrent_sampled_requests_batch_and_match_solo():
     """Two concurrent temperature>0 requests inside the window must share
-    ONE generate_batch call AND return exactly the replies the batching-
-    disabled server gives for the same (seed, temperature) — per-row
-    sampler chains make batched sampled rows bit-identical to solo."""
+    ONE slot-pool decode session AND return exactly the replies the
+    batching-disabled server gives for the same (seed, temperature) —
+    per-row sampler chains make pooled sampled rows bit-identical to solo."""
     tok = make_tokenizer()
     cfg = tiny_cfg(vocab_size=tok.vocab_size, seq_len=512, dim=32, kv_dim=16,
                    head_size=8, hidden_dim=64)
@@ -554,15 +562,23 @@ def test_concurrent_sampled_requests_batch_and_match_solo():
         engine = Engine(cfg, params, SamplerConfig(temperature=0.0, seed=1))
         state = ServerState(engine, tok, cfg, model_name="tiny-test",
                             template="llama3", batch_window_ms=window_ms)
-        sizes = []
+        sizes = []  # pool occupancy after every admit
         if state.batcher is not None:
-            orig = engine.generate_batch
+            orig = engine.batch_session
 
-            def spy(prompts, steps, **kw):
-                sizes.append(len(prompts))
-                return orig(prompts, steps, **kw)
+            def spy(max_batch, chunk=None):
+                sess = orig(max_batch, chunk)
+                orig_admit = sess.admit
 
-            engine.generate_batch = spy
+                def admit(*a, **kw):
+                    slot = orig_admit(*a, **kw)
+                    sizes.append(len(sess.occupied))
+                    return slot
+
+                sess.admit = admit
+                return sess
+
+            engine.batch_session = spy
         srv = create_server(state, host="127.0.0.1", port=0)
         threading.Thread(target=srv.serve_forever, daemon=True).start()
         return srv, srv.server_address[1], sizes

@@ -1,0 +1,241 @@
+"""Continuous batching (Engine.batch_session + the rolling-admission server).
+
+The slot-pool session's contract is that membership in the pool is
+invisible in the tokens: a row admitted mid-flight into a half-busy pool —
+or into a slab a previous request just vacated — emits EXACTLY the stream
+of a solo ``generate()`` with the same SamplerConfig, and a live row nets
+at least one token per chunk, so staggered arrivals can never starve or
+deadlock. These tests pin all of that, engine-level and over real HTTP.
+"""
+
+import http.client
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from dllama_tpu.models import llama
+from dllama_tpu.models.config import ModelConfig
+from dllama_tpu.runtime.generate import Engine
+from dllama_tpu.runtime.sampler import SamplerConfig
+
+CFG = ModelConfig(
+    arch="llama", dim=64, hidden_dim=128, n_layers=2, n_heads=4, n_kv_heads=2,
+    vocab_size=96, seq_len=64, head_size=16, kv_dim=32, dtype="float32",
+)
+
+PROMPTS = [[5, 9, 3], [7], [1, 2, 3, 4, 5, 6, 11]]  # mixed lengths incl. 1
+
+
+def _solo(params, prompt, steps, sampler=None, cfg=CFG):
+    eng = Engine(cfg, params, SamplerConfig(temperature=0.0))
+    return [t for t, _ in eng.generate(list(prompt), steps=steps,
+                                       sampler=sampler)]
+
+
+def _drain(sess, slots):
+    """Step until every slot in ``slots`` is done; return {slot: tokens}."""
+    out = {b: [] for b in slots}
+    while any(not sess.is_done(b) for b in slots):
+        for b, burst in sess.step_chunk().items():
+            if b in out:
+                out[b].extend(burst)
+    return out
+
+
+def test_mid_flight_admit_bit_identical_to_solo():
+    """The tentpole invariant: a row admitted while the pool is mid-decode
+    (sampled or greedy, any slot) emits exactly its solo stream — the
+    resident batch cache, the pinned free rows, and the other rows'
+    key-chain splits must all be invisible to it."""
+    params = llama.random_params(CFG, seed=0, dtype=np.float32)
+    samplers = [
+        SamplerConfig(temperature=0.9, topp=0.95, seed=7),
+        SamplerConfig(temperature=0.0, seed=1),      # greedy row in the mix
+        SamplerConfig(temperature=1.3, topp=0.8, seed=42),
+    ]
+    want = [_solo(params, p, 12, s) for p, s in zip(PROMPTS, samplers)]
+
+    eng = Engine(CFG, params, SamplerConfig(temperature=0.0))
+    sess = eng.batch_session(max_batch=3, chunk=4)
+    got = {}
+    s0 = sess.admit(PROMPTS[0], steps=12, sampler=samplers[0])
+    got[s0] = []
+    for b, burst in sess.step_chunk().items():  # row 0 is already 4 deep...
+        got[b].extend(burst)
+    s1 = sess.admit(PROMPTS[1], steps=12, sampler=samplers[1])  # ...join now
+    got[s1] = []
+    for b, burst in sess.step_chunk().items():
+        got[b].extend(burst)
+    s2 = sess.admit(PROMPTS[2], steps=12, sampler=samplers[2])  # 8 deep
+    got[s2] = []
+    for b, tokens in _drain(sess, [s0, s1, s2]).items():
+        got[b].extend(tokens)
+    sess.close()
+    assert [got[s0], got[s1], got[s2]] == want
+
+
+def test_released_slot_reuse_no_contamination():
+    """A slab vacated by release() is reused WITHOUT clearing (admit
+    overwrites the prefix; positions past the new row's pos are masked) —
+    the successor must still match its solo stream bit for bit."""
+    params = llama.random_params(CFG, seed=3, dtype=np.float32)
+    eng = Engine(CFG, params, SamplerConfig(temperature=0.0))
+    sess = eng.batch_session(max_batch=2, chunk=4)
+
+    first = sess.admit([9, 2, 4, 8, 1, 3], steps=8,
+                       sampler=SamplerConfig(temperature=1.1, seed=5))
+    _drain(sess, [first])
+    sess.release(first)
+    assert sess.free_slots == [0, 1]
+
+    # the 1-token successor lands in the dirtiest possible slab state:
+    # its pos-0 write leaves every other position holding the first
+    # request's stale KV, all of which must stay masked out
+    reuse = sess.admit([7], steps=10,
+                       sampler=SamplerConfig(temperature=0.8, seed=11))
+    assert reuse == first  # lowest free slot: genuinely the same slab
+    got = _drain(sess, [reuse])[reuse]
+    sess.close()
+    assert got == _solo(params, [7], 10, SamplerConfig(temperature=0.8, seed=11))
+
+
+def test_stop_token_truncates_inclusively_and_frees_early():
+    params = llama.random_params(CFG, seed=5, dtype=np.float32)
+    eng = Engine(CFG, params, SamplerConfig(temperature=0.0))
+    full = _solo(params, PROMPTS[0], 16)
+    # first token that does not appear earlier in the stream: stopping on it
+    # pins exactly where the solo stream first emits it
+    k = next(i for i, t in enumerate(full) if t not in full[:i])
+    sess = eng.batch_session(max_batch=2, chunk=4)
+    s0 = sess.admit(PROMPTS[0], steps=16, stop_tokens=(full[k],))
+    got = _drain(sess, [s0])[s0]
+    assert got == full[: k + 1]  # stop token emitted, nothing after
+    sess.release(s0)
+    sess.close()
+
+
+def test_budget_and_accounting():
+    """Bookkeeping the scheduler leans on: per-chunk bursts are never empty
+    for a live row, sum to the budget, and done rows leave step_chunk()."""
+    params = llama.random_params(CFG, seed=6, dtype=np.float32)
+    eng = Engine(CFG, params, SamplerConfig(temperature=0.0))
+    sess = eng.batch_session(max_batch=2, chunk=4)
+    short = sess.admit(PROMPTS[0], steps=6)   # done after chunk 2
+    long = sess.admit(PROMPTS[2], steps=11)
+    bursts = {short: [], long: []}
+    while not (sess.is_done(short) and sess.is_done(long)):
+        fresh = sess.step_chunk()
+        assert all(burst for burst in fresh.values())  # live rows always net
+        for b, burst in fresh.items():
+            bursts[b].append(len(burst))
+    assert sum(bursts[short]) == 6 and sum(bursts[long]) == 11
+    assert len(bursts[short]) == 2  # 4 + 2, absent from later chunks
+    assert sess.num_live == 0 and sess.is_done(short) and sess.is_done(long)
+    sess.release(short)
+    assert sess.free_slots == [0]
+    with pytest.raises(ValueError):
+        sess.is_done(short)  # released slot is no longer queryable
+    sess.close()
+
+
+def test_admit_validation():
+    params = llama.random_params(CFG, seed=0, dtype=np.float32)
+    eng = Engine(CFG, params, SamplerConfig(temperature=0.0))
+    sess = eng.batch_session(max_batch=1, chunk=2)
+    with pytest.raises(ValueError):
+        sess.admit([], steps=4)  # empty prompt
+    sess.admit([5], steps=4)
+    with pytest.raises(RuntimeError):
+        sess.admit([7], steps=4)  # pool full
+    sess.close()
+    with pytest.raises(RuntimeError):
+        sess.admit([5], steps=4)  # closed session
+
+
+# ---------------------------------------------------------------------------
+# Server-level: staggered arrivals through the rolling-admission scheduler
+# ---------------------------------------------------------------------------
+
+def _request(port, body):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=120)
+    conn.request("POST", "/v1/chat/completions", body=json.dumps(body),
+                 headers={"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    data = resp.read()
+    conn.close()
+    return resp.status, data
+
+
+def test_staggered_arrivals_drain_without_deadlock():
+    """More requests than slots, arriving spread across several decode
+    chunks: the scheduler must admit them into freed slots mid-flight and
+    answer every one (timeout-guarded), with the same tokens a
+    batching-disabled server returns."""
+    from dllama_tpu.formats.tokenizer_file import TokenizerData
+    from dllama_tpu.serving.api_server import ServerState, create_server
+    from dllama_tpu.tokenizer.bpe import Tokenizer
+
+    from tests.test_llama_forward import tiny_cfg
+
+    vocab = [b"<unk>", b"<s>", b"</s>"]
+    vocab += [b"<0x%02X>" % b for b in range(256)]
+    vocab += [b" ", b"e", b"t", b"he", b" the", b"hello", b" world"]
+    scores = [0.0] * 259 + [-1.0, -2.0, -2.0, -1.5, -1.2, -1.1, -1.1]
+    tok = Tokenizer(TokenizerData(vocab=vocab, scores=scores, bos_id=1, eos_id=2))
+    cfg = tiny_cfg(vocab_size=tok.vocab_size, seq_len=512, dim=32, kv_dim=16,
+                   head_size=8, hidden_dim=64)
+    params = llama.random_params(cfg, seed=13)
+
+    def run_server(window_ms):
+        engine = Engine(cfg, params, SamplerConfig(temperature=0.0, seed=1))
+        state = ServerState(engine, tok, cfg, model_name="tiny-test",
+                            template="llama3", batch_window_ms=window_ms,
+                            batch_max=2, batch_chunk=2)
+        srv = create_server(state, host="127.0.0.1", port=0)
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        return srv, srv.server_address[1]
+
+    prompts = ["hello world", "the the cat", "world hello the",
+               "hello the world", "t e t e"]
+
+    def ask_all(port, stagger_s=0.0):
+        replies = [None] * len(prompts)
+
+        def one(i):
+            if stagger_s:
+                time.sleep(i * stagger_s)
+            _, d = _request(port, {
+                "model": "tiny-test", "temperature": 0.0,
+                "max_tokens": 4 + 4 * (i % 3),  # mixed budgets
+                "messages": [{"role": "user", "content": prompts[i]}],
+            })
+            replies[i] = json.loads(d)["choices"][0]["message"]["content"]
+
+        threads = [threading.Thread(target=one, args=(i,), daemon=True)
+                   for i in range(len(prompts))]
+        for t in threads:
+            t.start()
+        deadline = time.monotonic() + 120
+        for t in threads:
+            t.join(timeout=max(1.0, deadline - time.monotonic()))
+        assert not any(t.is_alive() for t in threads), \
+            "staggered requests deadlocked"
+        return replies
+
+    srv_plain, port_plain = run_server(0)
+    srv_batch, port_batch = run_server(40.0)
+    try:
+        # warm compile caches so arrival timing isn't swamped by tracing
+        _request(port_batch, {"model": "tiny-test", "temperature": 0.0,
+                              "max_tokens": 2,
+                              "messages": [{"role": "user", "content": "hi"}]})
+        want = ask_all(port_plain)
+        got = ask_all(port_batch, stagger_s=0.05)
+        assert None not in got
+        assert got == want
+    finally:
+        srv_plain.shutdown()
+        srv_batch.shutdown()
