@@ -479,6 +479,71 @@ def run_decode_bench(cfg_dict: dict, bench_steps: int = None, quant_ok: bool = F
         return (c_wall * 1000.0 / max(1, c_toks),
                 f"{weights}-continuous{cont}x{B}{cfg_tag}")
 
+    # BENCH_FAULTS=N replays a concurrent workload through the REAL serving
+    # scheduler (ServerState + Batcher + supervisor) with a deterministic
+    # fault plan installed (DLLAMA_FAULTS, default step_chunk:raise:every=3).
+    # The measurement is BOUNDEDNESS, not speed: every request must resolve
+    # — tokens or a typed error — within the join timeout, with the
+    # supervisor restarting the scheduler through every injected crash. A
+    # hang fails the bench. CPU-runnable (BENCH_MODEL=smoke).
+    nfaults = _env_count("BENCH_FAULTS")
+    if nfaults:
+        import threading as _threading
+
+        from dllama_tpu import faults as _faults
+        from dllama_tpu.serving.api_server import ServerState
+
+        class _FakeTok:
+            # stop handling off: rows run to budget (no tokenizer needed —
+            # the replay exercises the scheduler, not detokenization)
+            eos_id = -1
+
+            def piece_id(self, _b):
+                return -1
+
+        fspec = os.environ.get("DLLAMA_FAULTS") or "step_chunk:raise:every=3"
+        plan = _faults.install(fspec)
+        st = ServerState(eng, _FakeTok(), cfg, model_name="bench",
+                         batch_window_ms=5.0, batch_max=min(4, nfaults),
+                         batch_chunk=4)
+        rng_f = __import__("numpy").random.default_rng(3)
+        fprompt = [int(t) for t in rng_f.integers(1, cfg.vocab_size, 6)]
+        fsteps = max(8, bench_steps // 8)
+        outcomes = {"ok": 0, "error": 0, "hang": 0}
+        olock = _threading.Lock()
+
+        def _one_request():
+            try:
+                st.batcher.submit(list(fprompt), fsteps,
+                                  SamplerConfig(temperature=0.0, seed=0))
+                key = "ok"
+            except RuntimeError:
+                key = "error"  # typed + bounded: exactly the contract
+            with olock:
+                outcomes[key] += 1
+
+        log(f"fault replay: {nfaults} requests under '{fspec}'")
+        t0 = time.perf_counter()
+        threads = [_threading.Thread(target=_one_request, daemon=True)
+                   for _ in range(nfaults)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120.0)
+            if t.is_alive():
+                with olock:
+                    outcomes["hang"] += 1
+        wall = time.perf_counter() - t0
+        _faults.clear()
+        log(f"fault replay: {outcomes} in {wall:.2f}s | injected "
+            f"{plan.counters()} | scheduler crashes "
+            f"{st.batcher.crash_count}")
+        if outcomes["hang"]:
+            raise RuntimeError(
+                f"fault replay left requests hanging: {outcomes}")
+        return (wall * 1000.0 / max(1, nfaults),
+                f"{weights}-faults{nfaults}{cfg_tag}")
+
     # BENCH_SPEC=K measures speculative decoding (prompt-lookup drafts of up
     # to K tokens, exact greedy): solo generate_spec, or — with BENCH_BATCH —
     # generate_batch_spec (draft_len+1 positions x B rows per weight pass).
@@ -576,7 +641,8 @@ def main() -> None:
     # metric name for the error path, resolvable without touching jax
     choice = os.environ.get("BENCH_MODEL", "")
     err_phase = ("prefill" if _prefill_count()
-                 else "serve" if _env_count("BENCH_CONTINUOUS") else "decode")
+                 else "serve" if _env_count("BENCH_CONTINUOUS")
+                 else "faults" if _env_count("BENCH_FAULTS") else "decode")
     err_metric = {"tiny": "tinyllama_1.1b", "llama3": "llama3_8b",
                   "moe": "mixtral_lite", "grok": "grok1_lite",
                   "smoke": "smoke"}.get(
@@ -656,7 +722,8 @@ def main() -> None:
     platform = jax.devices()[0].platform
     choice = os.environ.get("BENCH_MODEL", "")
     if choice == "smoke" or (not choice and platform == "cpu"
-                             and _env_count("BENCH_CONTINUOUS")):
+                             and (_env_count("BENCH_CONTINUOUS")
+                                  or _env_count("BENCH_FAULTS"))):
         # the continuous-vs-static comparison measures SCHEDULING, so the
         # CPU default is a shape small enough to replay inside CI budgets
         name, cfg_dict = "smoke", SMOKE_SERVE
@@ -693,7 +760,8 @@ def main() -> None:
         ms, weights = run_decode_bench(cfg_dict, quant_ok=quant_ok)
 
     phase = ("prefill" if _prefill_count()
-             else "serve" if _env_count("BENCH_CONTINUOUS") else "decode")
+             else "serve" if _env_count("BENCH_CONTINUOUS")
+             else "faults" if _env_count("BENCH_FAULTS") else "decode")
     result = {
         "metric": f"{name}_{phase}_ms_per_token",
         "value": round(ms, 3),

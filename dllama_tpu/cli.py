@@ -70,6 +70,40 @@ def build_parser() -> argparse.ArgumentParser:
                 "time-to-first-token under load) at more host round trips; "
                 "larger N amortizes dispatch overhead",
             )
+            sp.add_argument(
+                "--request-timeout",
+                type=float,
+                default=0.0,
+                metavar="S",
+                help="per-request wall-clock budget in seconds, counted "
+                "from admission (queue time included): an expired request "
+                "gets 504 and its decode row is released at the next chunk "
+                "boundary; 0 = unlimited",
+            )
+            sp.add_argument(
+                "--queue-depth",
+                type=int,
+                default=64,
+                metavar="N",
+                help="max requests in flight (decoding + waiting): overflow "
+                "is rejected immediately with 429 + Retry-After instead of "
+                "queuing unboundedly",
+            )
+            sp.add_argument(
+                "--drain-timeout",
+                type=float,
+                default=30.0,
+                metavar="S",
+                help="SIGTERM grace: stop admitting (503), finish live "
+                "requests up to S seconds, then exit",
+            )
+            sp.add_argument(
+                "--pid-file",
+                default=None,
+                metavar="PATH",
+                help="write the server pid here (atomic tmp+rename); "
+                "removed on shutdown",
+            )
         sp.add_argument("--model", required=True)
         sp.add_argument("--tokenizer", required=True)
         sp.add_argument("--prompt", default=None)
@@ -161,6 +195,18 @@ def build_parser() -> argparse.ArgumentParser:
         sp.add_argument("--num-hosts", type=int, default=None)
         sp.add_argument("--host-id", type=int, default=None)
     return p
+
+
+def write_pid_file(path: str) -> None:
+    """Write this process's pid to ``path`` ATOMICALLY (tmp + rename in the
+    same directory): a monitor polling the file never reads a half-written
+    pid, and a crash mid-write leaves the old file intact."""
+    tmp = f"{path}.{os.getpid()}.tmp"
+    with open(tmp, "w") as f:
+        f.write(f"{os.getpid()}\n")
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
 
 
 def maybe_init_distributed(args) -> int:

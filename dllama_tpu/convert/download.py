@@ -1,13 +1,22 @@
 """Prequantized model downloader (parity with `/root/reference/download-model.py`,
 urllib instead of requests so there is no extra dependency). Downloads a `.m`
 weight file + `.t` tokenizer into ``models/<name>/`` and writes a ready-to-run
-launch script for the TPU CLI."""
+launch script for the TPU CLI.
+
+Transfers are multi-GB, so a transient network error must not restart from
+byte zero: each fetch streams into ``<path>.part``, retries with exponential
+backoff + jitter, resumes with an HTTP ``Range`` request from wherever the
+partial file stopped, and only renames onto the final path once complete."""
 
 from __future__ import annotations
 
+import errno
 import os
+import random
 import stat
 import sys
+import time
+import urllib.error
 import urllib.request
 
 # same published checkpoints the reference fetches (`download-model.py:5-18`)
@@ -35,17 +44,79 @@ ALIASES = {
 }
 
 
-def download_file(url: str, path: str) -> None:
+#: errors worth retrying: server hiccups and rate limits. A 4xx other than
+#: 408/429 (bad URL, auth) will never heal by waiting — fail fast.
+RETRYABLE_HTTP = (408, 429, 500, 502, 503, 504)
+
+
+def _fetch_once(url: str, part_path: str, chunk_size: int) -> None:
+    """One streaming attempt into ``part_path``, resuming with an HTTP
+    ``Range`` request from the partial file's current size. Raises on any
+    network/HTTP error (the caller owns retry policy); an HTTP 416 with
+    bytes on disk means the file is already complete (resume offset == total
+    length) and returns cleanly."""
+    offset = os.path.getsize(part_path) if os.path.exists(part_path) else 0
+    req = urllib.request.Request(url)
+    if offset > 0:
+        req.add_header("Range", f"bytes={offset}-")
+    try:
+        resp = urllib.request.urlopen(req, timeout=60)
+    except urllib.error.HTTPError as e:
+        if e.code == 416 and offset > 0:
+            return  # nothing left past our offset: the .part IS the file
+        raise
+    with resp:
+        if offset > 0 and resp.status != 206:
+            # server ignored the Range (some mirrors do): restart from zero
+            offset = 0
+        mode = "ab" if offset > 0 else "wb"
+        done = offset
+        with open(part_path, mode) as f:
+            while True:
+                chunk = resp.read(chunk_size)
+                if not chunk:
+                    break
+                f.write(chunk)
+                done += len(chunk)
+                if (done // (8192 * 1024)) != ((done - len(chunk)) // (8192 * 1024)):
+                    sys.stdout.write(f"\rDownloaded {done // 1024} kB")
+                    sys.stdout.flush()
+
+
+def download_file(url: str, path: str, retries: int = 5,
+                  backoff_s: float = 1.0, chunk_size: int = 1 << 20) -> None:
+    """Fetch ``url`` to ``path``: stream into ``path.part``, retry transient
+    failures with exponential backoff + jitter (resuming via Range from the
+    bytes already on disk), atomically rename into place when complete."""
     print(f"📄 {url}")
-
-    def report(blocks, block_size, total):
-        kb = blocks * block_size // 1024
-        if kb % 8192 < block_size // 1024:
-            sys.stdout.write(f"\rDownloaded {kb} kB")
+    part_path = path + ".part"
+    last_err = None
+    for attempt in range(retries + 1):
+        if attempt > 0:
+            delay = backoff_s * (2 ** (attempt - 1)) * (1 + random.random())
+            sys.stdout.write(f"\n↻ retry {attempt}/{retries} in {delay:.1f}s "
+                             f"({last_err})\n")
             sys.stdout.flush()
-
-    urllib.request.urlretrieve(url, path, reporthook=report)
-    sys.stdout.write(" ✅\n")
+            time.sleep(delay)
+        try:
+            _fetch_once(url, part_path, chunk_size)
+            os.replace(part_path, path)  # atomic: readers never see a torso
+            sys.stdout.write(" ✅\n")
+            return
+        except urllib.error.HTTPError as e:
+            if e.code not in RETRYABLE_HTTP:
+                raise  # 404/403/401: waiting will not help
+            last_err = f"HTTP {e.code}"
+        except (urllib.error.URLError, ConnectionError, TimeoutError) as e:
+            last_err = repr(e)
+        except OSError as e:
+            if e.errno not in (errno.ECONNRESET, errno.ETIMEDOUT,
+                               errno.EPIPE, None):
+                raise  # disk-full etc.: not a network hiccup
+            last_err = repr(e)
+    raise RuntimeError(
+        f"download failed after {retries} retries: {url} ({last_err}); "
+        f"partial bytes kept at {part_path} — rerun to resume")
 
 
 def download_model(name: str, dest_root: str = "models") -> tuple:

@@ -1,0 +1,172 @@
+"""Deterministic fault injection for the serving/runtime seams.
+
+``DLLAMA_FAULTS`` (or a programmatic :func:`install`) names a plan of fault
+points; the Engine/BatchSession/server code calls :func:`fire(site)
+<fire>` at its seams and the plan decides — by deterministic per-site call
+counters, never randomness — whether that call raises or stalls. Chaos tests
+and the ``BENCH_FAULTS`` replay drive every failure path CPU-only, the same
+way the CI suite drives the TP paths on a virtual device mesh.
+
+Spec grammar (sites separated by ``;``)::
+
+    DLLAMA_FAULTS="step_chunk:raise:every=3;admit:raise:times=1;stream:slow:delay_ms=50"
+
+    <site>:<action>[:key=val[,key=val...]]
+
+* ``site`` — where the hook fires. The wired seams are ``admit`` and
+  ``step_chunk`` (BatchSession), ``prefill`` (Engine), ``stream`` (the SSE
+  writer), and ``scheduler`` (top of every server scheduler window — the
+  supervisor-restart drill).
+* ``action`` — ``raise`` (throw :class:`FaultInjected`) or ``slow`` (sleep
+  ``delay_ms``, default 50).
+* options — ``every=N`` fire on every Nth call (default every call),
+  ``after=N`` skip the first N calls, ``times=N`` fire at most N times,
+  ``delay_ms=X`` for ``slow``.
+
+The hot-path cost when no plan is installed is one global ``is None`` check.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+SITES = ("admit", "step_chunk", "prefill", "stream", "scheduler")
+ACTIONS = ("raise", "slow")
+
+
+class FaultInjected(RuntimeError):
+    """Raised by a ``raise``-action fault point. Deliberately a RuntimeError
+    subclass: injected faults must flow through the SAME handling as real
+    engine failures — that equivalence is what the chaos suite proves."""
+
+    def __init__(self, site: str):
+        super().__init__(f"injected fault at {site}")
+        self.site = site
+
+
+class _Point:
+    """One ``site:action`` rule with its deterministic firing schedule."""
+
+    __slots__ = ("site", "action", "every", "after", "times", "delay_ms",
+                 "calls", "fired")
+
+    def __init__(self, site: str, action: str, every: int = 1, after: int = 0,
+                 times: int = 0, delay_ms: float = 50.0):
+        if site not in SITES:
+            raise ValueError(f"unknown fault site {site!r} (known: {SITES})")
+        if action not in ACTIONS:
+            raise ValueError(
+                f"unknown fault action {action!r} (known: {ACTIONS})")
+        if every < 1:
+            raise ValueError(f"every must be >= 1, got {every}")
+        self.site, self.action = site, action
+        self.every, self.after = every, after
+        self.times = times  # 0 = unlimited
+        self.delay_ms = delay_ms
+        self.calls = 0  # calls seen at this site
+        self.fired = 0  # times this point actually fired
+
+    def should_fire(self) -> bool:
+        """Advance the call counter and decide. Caller holds the plan lock."""
+        self.calls += 1
+        if self.calls <= self.after:
+            return False
+        if (self.calls - self.after) % self.every != 0:
+            return False
+        if self.times and self.fired >= self.times:
+            return False
+        self.fired += 1
+        return True
+
+
+class FaultPlan:
+    """A parsed set of fault points, counted deterministically per site."""
+
+    def __init__(self, points: list):
+        self._points = points
+        self._lock = threading.Lock()
+
+    @classmethod
+    def parse(cls, spec: str) -> "FaultPlan":
+        points = []
+        for part in spec.split(";"):
+            part = part.strip()
+            if not part:
+                continue
+            fields = part.split(":")
+            if len(fields) < 2:
+                raise ValueError(
+                    f"bad fault spec {part!r}: want site:action[:k=v,...]")
+            site, action = fields[0].strip(), fields[1].strip()
+            opts: dict = {}
+            if len(fields) > 2:
+                for kv in fields[2].split(","):
+                    if "=" not in kv:
+                        raise ValueError(
+                            f"bad fault option {kv!r} in {part!r}")
+                    k, v = kv.split("=", 1)
+                    k = k.strip()
+                    if k not in ("every", "after", "times", "delay_ms"):
+                        raise ValueError(f"unknown fault option {k!r}")
+                    opts[k] = float(v) if k == "delay_ms" else int(v)
+            points.append(_Point(site, action, **opts))
+        return cls(points)
+
+    def fire(self, site: str) -> None:
+        """Run every matching point's decision for one call at ``site``."""
+        sleep_ms = 0.0
+        with self._lock:
+            for p in self._points:
+                if p.site != site or not p.should_fire():
+                    continue
+                if p.action == "raise":
+                    raise FaultInjected(site)
+                sleep_ms = max(sleep_ms, p.delay_ms)
+        if sleep_ms > 0:
+            time.sleep(sleep_ms / 1000.0)
+
+    def counters(self) -> dict:
+        """{site: (calls, fired)} — test/bench introspection."""
+        with self._lock:
+            return {p.site: (p.calls, p.fired) for p in self._points}
+
+
+#: the active plan. None (the default) makes fire() a single attribute test.
+_plan: FaultPlan = None
+_env_loaded = False
+
+
+def install(spec: str) -> FaultPlan:
+    """Install ``spec`` as the active plan (replacing any prior one)."""
+    global _plan, _env_loaded
+    _plan = FaultPlan.parse(spec)
+    _env_loaded = True
+    return _plan
+
+
+def clear() -> None:
+    """Remove the active plan (fire() returns to its no-op fast path)."""
+    global _plan, _env_loaded
+    _plan = None
+    _env_loaded = True  # an explicit clear() outranks the env var
+
+
+def active() -> FaultPlan:
+    """The active plan, lazily loading ``DLLAMA_FAULTS`` once. None when
+    fault injection is off."""
+    global _plan, _env_loaded
+    if not _env_loaded:
+        _env_loaded = True
+        spec = os.environ.get("DLLAMA_FAULTS", "")
+        if spec:
+            _plan = FaultPlan.parse(spec)
+    return _plan
+
+
+def fire(site: str) -> None:
+    """The seam hook: no-op unless a plan names ``site``."""
+    plan = _plan if _env_loaded else active()
+    if plan is not None:
+        plan.fire(site)

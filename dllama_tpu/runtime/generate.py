@@ -24,6 +24,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from dllama_tpu import faults
 from dllama_tpu.models import llama
 from dllama_tpu.models.config import ModelConfig
 from dllama_tpu.runtime.sampler import SamplerConfig, sample_dynamic
@@ -456,6 +457,7 @@ class Engine:
             raise ValueError(
                 f"prompt of {len(tokens)} tokens at pos {pos} exceeds seq_len {self.cfg.seq_len}"
             )
+        faults.fire("prefill")
         # clamp the padded bucket to the remaining context: an out-of-range
         # dynamic_update_slice start would be silently clamped by XLA, writing
         # K/V into wrong slots with wrong rope angles
@@ -1220,6 +1222,7 @@ class BatchSession:
             raise RuntimeError(
                 f"no free slot (max_batch={self.max_batch}); release a "
                 "finished row first")
+        faults.fire("admit")
         slot = free[0]
         S = self.eng.cfg.seq_len
         if len(prompt_tokens) > S:
@@ -1261,6 +1264,7 @@ class BatchSession:
                 if st is not None and not st.done]
         if not live:
             return {}
+        faults.fire("step_chunk")
         t1 = time.perf_counter()
         chunk, self.cache, self._keys = self.eng._decode_loop_batch(
             self.cache, self._tokens, self._pos, self._keys, self._temps,
@@ -1292,6 +1296,18 @@ class BatchSession:
                 st.done = True
             fresh[b] = toks
         return fresh
+
+    def cancel(self, slot: int) -> None:
+        """Stop decoding ``slot``'s row NOW (cancellation / deadline expiry):
+        the row is marked done so the next ``step_chunk`` excludes it from
+        the live set — exactly the state a budget-exhausted row reaches, so
+        no new invariants: it rides along pinned until ``release()`` frees
+        its slab (the serving scheduler releases at the same chunk boundary
+        it cancels at). Idempotent on an already-done row."""
+        st = self._slots[slot]
+        if st is None:
+            raise ValueError(f"slot {slot} is not occupied")
+        st.done = True
 
     def release(self, slot: int) -> None:
         """Free the slot for the next admit(). The slab is NOT cleared (see

@@ -30,13 +30,24 @@ from __future__ import annotations
 
 import codecs
 import json
+import os
 import queue as queue_mod
 import threading
 import time
 import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+from dllama_tpu import faults
 from dllama_tpu.runtime.sampler import SamplerConfig
+from dllama_tpu.serving.lifecycle import (
+    AdmissionGate,
+    CancelToken,
+    Deadline,
+    DeadlineExceeded,
+    LifecycleError,
+    SchedulerCrashed,
+    Supervisor,
+)
 from dllama_tpu.serving.templates import render_llama2_turn, render_llama3_chat
 
 
@@ -161,9 +172,10 @@ class Batcher:
 
     class _Slot:
         __slots__ = ("prompt", "steps", "sampler", "tokens", "error", "done",
-                     "queue")
+                     "queue", "deadline", "cancel")
 
-        def __init__(self, prompt, steps, sampler, streaming: bool):
+        def __init__(self, prompt, steps, sampler, streaming: bool,
+                     deadline=None, cancel=None):
             self.prompt, self.steps, self.sampler = prompt, steps, sampler
             self.tokens = None
             self.error = None
@@ -171,6 +183,28 @@ class Batcher:
             # streaming protocol: list-of-token-ids items, then exactly one
             # terminal item — None (clean end) or an Exception
             self.queue = queue_mod.Queue() if streaming else None
+            #: lifecycle.Deadline — wall-clock budget from submit, checked
+            #: by the scheduler BETWEEN chunks (and between solo tokens)
+            self.deadline = deadline
+            #: lifecycle.CancelToken — set by the SSE writer when the client
+            #: socket dies; the scheduler releases the row's slot at the
+            #: next chunk boundary instead of decoding for a dead socket
+            self.cancel = cancel
+
+        def lifecycle_error(self):
+            """None, or the typed error that should resolve this request
+            NOW (cancellation outranks deadline: a dead client's row frees
+            its slot whatever its remaining budget)."""
+            if self.cancel is not None and self.cancel.cancelled:
+                return self.cancel.error()
+            if self.deadline is not None and self.deadline.expired():
+                return self.deadline.error()
+            return None
+
+    #: extra client-side wait past a slot's deadline before the HTTP thread
+    #: gives up on the scheduler resolving it (a wedged device dispatch must
+    #: not hang the connection forever — the chaos suite's no-hang bound)
+    DEADLINE_GRACE_S = 5.0
 
     def __init__(self, state, window_ms: float = 15.0, max_batch: int = 8,
                  chunk: int = 8):
@@ -185,7 +219,39 @@ class Batcher:
         self.chunk = max(1, chunk)
         self._lock = threading.Lock()
         self._arrivals: queue_mod.Queue = queue_mod.Queue()
-        self._thread = None
+        #: lifecycle.Supervisor owning the scheduler thread: a crashed loop
+        #: fails its window's slots 503 and restarts instead of leaving
+        #: every later submit() hanging on a dead daemon
+        self._supervisor: Supervisor = None
+        #: the window currently being routed — what _on_crash must fail
+        self._window: list = []
+        #: the live slot-pool session (while _serve_continuous runs):
+        #: readiness reporting + crash cleanup
+        self._active_sess = None
+
+    # -- introspection (readiness probe) ----------------------------------
+    @property
+    def scheduler_alive(self) -> bool:
+        """False only when the scheduler thread has died and the supervisor
+        has not (yet) restarted it; a never-started scheduler is healthy —
+        it starts on demand at the first submit."""
+        sup = self._supervisor
+        return sup is None or sup.alive
+
+    @property
+    def crash_count(self) -> int:
+        sup = self._supervisor
+        return 0 if sup is None else sup.crash_count
+
+    def queue_depth(self) -> int:
+        """Arrivals waiting for the scheduler to route them."""
+        return self._arrivals.qsize()
+
+    def occupancy(self) -> tuple:
+        """(occupied slots, pool size) of the live decode session — (0, B)
+        between pool sessions."""
+        sess = self._active_sess
+        return (len(sess.occupied) if sess is not None else 0, self.max_batch)
 
     def _serve_solo(self, s) -> None:
         """A batch of ONE delegates to the solo engine path, WITH prefix-
@@ -199,37 +265,54 @@ class Batcher:
         too (generate_spec is exact at any temperature)."""
         st = self.state
         try:
+            err = s.lifecycle_error()
+            if err is not None:
+                self._resolve_err(s, err)
+                return
             session, feed = st.take_prefix_session(s.prompt)
             history = list(s.prompt)
             stream = st.open_stream(s.prompt, feed, session, s.steps,
                                     s.sampler)
             toks: list = []
+            err = None
             for t, _ in stream:
                 history.append(t)
                 toks.append(t)
                 if s.queue is not None:
                     s.queue.put([t])
+                err = s.lifecycle_error()
+                if err is not None:
+                    break  # abandon the generator at a token boundary;
+                    # final_session is refreshed before every yield, so the
+                    # stored state matches exactly what was consumed
             st.store_prefix_session(history, st.engine.final_session)
+            if err is not None:
+                self._resolve_err(s, err)
+                return
             s.tokens = toks
             if s.queue is not None:
                 s.queue.put(None)
             s.done.set()
         except Exception as e:  # noqa: BLE001
-            s.error = RuntimeError(f"decode failed: {e!r}")
-            if s.queue is not None:
-                s.queue.put(s.error)
-            s.done.set()
+            self._resolve_err(s, e if isinstance(e, LifecycleError)
+                              else RuntimeError(f"decode failed: {e!r}"))
 
     @staticmethod
-    def _fail(slots, e) -> None:
+    def _resolve_err(s, err) -> None:
+        """Resolve ONE waiter with ``err`` (typed lifecycle errors pass
+        through so the handler can speak their HTTP status)."""
+        s.error = err
+        if s.queue is not None:
+            s.queue.put(err)
+        s.done.set()
+
+    def _fail(self, slots, e) -> None:
         """Resolve every waiter with an error — ALWAYS on failure (a waiter
         left hanging would hang its HTTP connection)."""
-        err = RuntimeError(f"batched decode failed: {e!r}")
+        err = (e if isinstance(e, LifecycleError)
+               else RuntimeError(f"batched decode failed: {e!r}"))
         for s in slots:
-            s.error = err
-            if s.queue is not None:
-                s.queue.put(err)
-            s.done.set()
+            self._resolve_err(s, err)
 
     def _serve_spec(self, batch: list) -> None:
         """All-greedy window on a --spec-draft server: BATCHED speculative
@@ -242,7 +325,15 @@ class Batcher:
         the pool at once; contended windows decode continuously instead.
         The prompt list is padded to the next power of two (dummy greedy
         [0] rows of budget 1, dropped after) so distinct arrival counts
-        reuse a handful of compiled batch sizes."""
+        reuse a handful of compiled batch sizes.
+
+        Lifecycle: cancelled/expired requests are resolved BEFORE the batch
+        forms; mid-verify cancellation is not plumbed here (speculation's
+        drafting arithmetic assumes a fixed row set) — a dead row rides to
+        batch end, the price of this fast path."""
+        batch = [s for s in batch if not self._reap_slot(s)]
+        if not batch:
+            return
         try:
             prompts, row_steps = padded_batch(
                 [s.prompt for s in batch], [s.steps for s in batch])
@@ -271,6 +362,15 @@ class Batcher:
         except Exception as e:  # noqa: BLE001 — every waiter gets a 500
             self._fail(batch, e)
 
+    def _reap_slot(self, s) -> bool:
+        """Resolve ``s`` with its lifecycle error if it has one. True when
+        the slot was resolved (drop it from scheduling)."""
+        err = s.lifecycle_error()
+        if err is None:
+            return False
+        self._resolve_err(s, err)
+        return True
+
     def _serve_continuous(self, batch: list) -> None:
         """THE continuous path: open a slot-pool session, admit ``batch``
         into free slots, and between every fused chunk (a) stream each live
@@ -290,7 +390,21 @@ class Batcher:
         sess = None
         try:
             sess = st.engine.batch_session(self.max_batch, chunk=self.chunk)
+            self._active_sess = sess
             while waiting or slot_map:
+                # lifecycle reap, BETWEEN chunks: a cancelled (client gone)
+                # or deadline-expired row is released NOW — its slab goes to
+                # the next waiter this very loop pass — and dead waiters
+                # never occupy a slot at all
+                waiting = [s for s in waiting if not self._reap_slot(s)]
+                for b in list(slot_map):
+                    s = slot_map[b]
+                    err = s.lifecycle_error()
+                    if err is not None:
+                        sess.cancel(b)
+                        sess.release(b)
+                        del slot_map[b]
+                        self._resolve_err(s, err)
                 while waiting and sess.free_slots:
                     s = waiting.pop(0)
                     try:
@@ -322,6 +436,7 @@ class Batcher:
         except Exception as e:  # noqa: BLE001 — every waiter gets a 500
             self._fail(list(slot_map.values()) + waiting, e)
         finally:
+            self._active_sess = None
             if sess is not None:
                 sess.close()
 
@@ -332,9 +447,20 @@ class Batcher:
         batched speculative verify, anything else -> continuous slot-pool
         decode. The engine lock is held per window, so handler-side solo
         requests (stop strings, prefix-session extensions) interleave
-        between windows exactly as before."""
+        between windows exactly as before.
+
+        Runs under a lifecycle.Supervisor: an exception escaping a window
+        fails that window's slots with a 503-able SchedulerCrashed (see
+        _on_crash) and the loop restarts — queued arrivals stay queued for
+        the restarted thread. Returns (ending supervision) only when the
+        server is draining and the queue is empty."""
         while True:
-            first = self._arrivals.get()
+            try:
+                first = self._arrivals.get(timeout=0.25)
+            except queue_mod.Empty:
+                if self.state.gate.draining:
+                    return  # drain complete: clean supervisor exit
+                continue
             if self.window_s > 0:
                 time.sleep(self.window_s)  # let concurrent requests join
             window = [first]
@@ -343,51 +469,105 @@ class Batcher:
                     window.append(self._arrivals.get_nowait())
                 except queue_mod.Empty:
                     break
-            with self.state.lock:  # the engine serves one pool at a time
-                if len(window) == 1 and self._arrivals.empty():
-                    self._serve_solo(window[0])
-                elif (len(window) <= self.max_batch
-                        and self.state.spec_draft > 0
-                        and getattr(self.state.engine,
-                                    "supports_batch_spec", False)
-                        and all(s.sampler.temperature == 0.0
-                                for s in window)):
-                    self._serve_spec(window)
-                else:
-                    self._serve_continuous(window)
+            # NO try/finally here: on an exception _window must SURVIVE the
+            # unwind so the supervisor's _on_crash can fail exactly these
+            # slots (a finally would clear it first and strand the waiters)
+            self._window = window
+            faults.fire("scheduler")
+            window = [s for s in window if not self._reap_slot(s)]
+            if window:
+                with self.state.lock:  # the engine serves one pool at a time
+                    if len(window) == 1 and self._arrivals.empty():
+                        self._serve_solo(window[0])
+                    elif (len(window) <= self.max_batch
+                            and self.state.spec_draft > 0
+                            and getattr(self.state.engine,
+                                        "supports_batch_spec", False)
+                            and all(s.sampler.temperature == 0.0
+                                    for s in window)):
+                        self._serve_spec(window)
+                    else:
+                        self._serve_continuous(window)
+            self._window = []
+
+    def _on_crash(self, exc: BaseException) -> None:
+        """Supervisor hook for a crashed scheduler iteration: every slot of
+        the in-flight window resolves with a 503-able error (no waiter may
+        hang on a dead thread), and a leaked pool session's HBM is freed.
+        Arrivals still queued are NOT failed — the restarted loop serves
+        them; replaying the FAILED window is the client's call, not ours."""
+        window, self._window = self._window, []
+        err = exc if isinstance(exc, LifecycleError) else SchedulerCrashed(exc)
+        for s in window:
+            if not s.done.is_set():
+                self._resolve_err(s, err)
+        sess, self._active_sess = self._active_sess, None
+        if sess is not None:
+            try:
+                sess.close()
+            except Exception:  # noqa: BLE001 — cleanup must not re-crash
+                pass
 
     def _enqueue(self, slot) -> None:
         with self._lock:
-            if self._thread is None:
-                self._thread = threading.Thread(
-                    target=self._scheduler_loop, daemon=True,
+            if self._supervisor is None:
+                self._supervisor = Supervisor(
+                    self._scheduler_loop, self._on_crash,
                     name="dllama-batch-scheduler")
-                self._thread.start()
+            self._supervisor.start()
         self._arrivals.put(slot)
 
+    def _wait_resolution(self, slot, tick_s: float = 0.25) -> None:
+        """Wait for the scheduler to resolve ``slot`` — BOUNDED: gives up
+        with a typed error when the scheduler thread is dead (supervisor
+        exhausted) or the slot's deadline passed long enough ago that the
+        between-chunks enforcement clearly isn't coming (wedged device
+        dispatch). submit() must never block forever."""
+        while not slot.done.wait(tick_s):
+            if not self.scheduler_alive:
+                raise SchedulerCrashed(
+                    RuntimeError("scheduler thread is not running"))
+            dl = slot.deadline
+            if dl is not None and dl.remaining() < -self.DEADLINE_GRACE_S:
+                raise dl.error()
+
     def submit(self, prompt_tokens: list, max_tokens: int,
-               sampler: SamplerConfig) -> list:
+               sampler: SamplerConfig, deadline: Deadline = None,
+               cancel: CancelToken = None) -> list:
         """Blocks until this request's tokens are decoded (by the scheduler
         thread's pool). Thread-safe; raises the decode's failure as
-        RuntimeError."""
+        RuntimeError (typed LifecycleError for deadline/cancel/crash)."""
         slot = self._Slot(list(prompt_tokens), max_tokens, sampler,
-                          streaming=False)
+                          streaming=False, deadline=deadline, cancel=cancel)
         self._enqueue(slot)
-        slot.done.wait()
+        self._wait_resolution(slot)
         if slot.error is not None:
             raise slot.error
         return slot.tokens
 
     def submit_stream(self, prompt_tokens: list, max_tokens: int,
-                      sampler: SamplerConfig):
+                      sampler: SamplerConfig, deadline: Deadline = None,
+                      cancel: CancelToken = None):
         """Yields bursts (lists) of token ids as the pool decodes — from
         admission, not from batch completion. Raises the decode failure as
-        RuntimeError."""
+        RuntimeError. A set ``cancel`` token ends the generator (the
+        scheduler releases the row's slot at its next chunk boundary)."""
         slot = self._Slot(list(prompt_tokens), max_tokens, sampler,
-                          streaming=True)
+                          streaming=True, deadline=deadline, cancel=cancel)
         self._enqueue(slot)
         while True:
-            item = slot.queue.get()
+            try:
+                item = slot.queue.get(timeout=0.25)
+            except queue_mod.Empty:
+                if cancel is not None and cancel.cancelled:
+                    return  # the writer stopped consuming; don't spin
+                if not self.scheduler_alive:
+                    raise SchedulerCrashed(
+                        RuntimeError("scheduler thread is not running"))
+                dl = slot.deadline
+                if dl is not None and dl.remaining() < -self.DEADLINE_GRACE_S:
+                    raise dl.error()
+                continue
             if item is None:
                 break
             if isinstance(item, Exception):
@@ -402,7 +582,8 @@ class ServerState:
                  default_sampler: SamplerConfig = SamplerConfig(),
                  default_seed: int = None, spec_draft: int = 0,
                  session_cache: int = 2, batch_window_ms: float = 0.0,
-                 batch_max: int = 8, batch_chunk: int = 8):
+                 batch_max: int = 8, batch_chunk: int = 8,
+                 request_timeout: float = 0.0, queue_depth: int = 64):
         """``default_seed``: seed for requests that send none — None means a
         fresh time-based seed per request (the launch-flag --seed plumbs in
         here so an operator can make the whole server reproducible).
@@ -412,7 +593,13 @@ class ServerState:
         any temperature: greedy verifies against argmax, sampled against the
         same per-request key chain. ``session_cache``: how many conversation
         KV states to keep resident (each holds a full KV cache in HBM —
-        size this against seq_len x n_layers x kv_dim x cache dtype)."""
+        size this against seq_len x n_layers x kv_dim x cache dtype).
+        ``request_timeout``: per-request wall-clock budget in seconds
+        (--request-timeout; 0 = unlimited) — an expired request 504s and
+        its decode row is released at the next chunk boundary.
+        ``queue_depth``: max concurrent requests admitted (--queue-depth);
+        overflow is rejected 429 + Retry-After instead of queuing
+        unboundedly."""
         self.engine = engine
         self.tokenizer = tokenizer
         self.cfg = cfg
@@ -425,6 +612,11 @@ class ServerState:
         #: HBM bound shared by the batcher AND the `n` parameter: a batch's
         #: KV cache holds this many full-context caches
         self.batch_max = max(1, batch_max)
+        self.request_timeout = max(0.0, request_timeout or 0.0)
+        #: bounded admission: EVERY completion request (solo or batched)
+        #: acquires before doing work, so backpressure is a fast 429 at the
+        #: door rather than an unbounded pile of blocked HTTP threads
+        self.gate = AdmissionGate(queue_depth)
         self.lock = threading.Lock()  # engine serves one request at a time
         # --batch-window > 0: requests (greedy or sampled, streaming or
         # not) that arrive within the window share a continuously batched
@@ -543,6 +735,36 @@ class ServerState:
         eot = self.tokenizer.piece_id(b"<|eot_id|>")
         return ids + ((eot,) if eot >= 0 else ())
 
+    def begin_drain(self) -> None:
+        """SIGTERM path: stop admitting (new requests 503), let in-flight
+        requests finish. The scheduler loop exits cleanly once its queue is
+        empty and the gate reports draining."""
+        self.gate.begin_drain()
+
+    def readiness(self) -> tuple:
+        """(ready, info) for the /ready probe. NOT ready while draining or
+        while the scheduler thread is dead (supervisor mid-restart); the
+        info dict reports the load picture either way so operators see WHY."""
+        batcher = self.batcher
+        occupied, total = (batcher.occupancy() if batcher is not None
+                           else (0, self.batch_max))
+        scheduler_alive = (batcher.scheduler_alive
+                          if batcher is not None else True)
+        ready = not self.gate.draining and scheduler_alive
+        return ready, {
+            "status": "ready" if ready else "not_ready",
+            "draining": self.gate.draining,
+            "scheduler_alive": scheduler_alive,
+            "scheduler_crashes": (batcher.crash_count
+                                  if batcher is not None else 0),
+            "inflight": self.gate.depth,
+            "queue_capacity": self.gate.capacity,
+            "queue_depth": (batcher.queue_depth()
+                            if batcher is not None else 0),
+            "slots_occupied": occupied,
+            "slots_total": total,
+        }
+
     def build_prompt(self, messages: list) -> str:
         """Render a full conversation (the API is stateless: each request
         carries all messages, same as the reference, `dllama-api.cpp:173-181`)."""
@@ -574,16 +796,29 @@ class OpenAIHandler(BaseHTTPRequestHandler):
         pass
 
     # -- helpers ----------------------------------------------------------
-    def _json(self, code: int, obj: dict) -> None:
+    def _json(self, code: int, obj: dict, headers: dict = None) -> None:
         body = json.dumps(obj).encode()
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
         self.end_headers()
         self.wfile.write(body)
 
     def _error(self, code: int, message: str) -> None:
         self._json(code, {"error": {"message": message, "type": "invalid_request_error"}})
+
+    def _lifecycle_error(self, e: LifecycleError) -> None:
+        """Speak a typed lifecycle rejection: its own HTTP status (429
+        queue-full, 503 draining/crash, 504 deadline) and a Retry-After
+        header when the error carries one."""
+        headers = {}
+        if e.retry_after_s is not None:
+            headers["Retry-After"] = str(max(1, int(round(e.retry_after_s))))
+        self._json(e.http_status,
+                   {"error": {"message": str(e), "type": "server_error"}},
+                   headers=headers)
 
     # -- routes -----------------------------------------------------------
     def do_GET(self):
@@ -598,7 +833,14 @@ class OpenAIHandler(BaseHTTPRequestHandler):
                 }],
             })
         elif self.path in ("/health", "/healthz"):
+            # LIVENESS: 200 whenever the process can answer — a draining or
+            # scheduler-crashed server is still alive (don't restart it);
+            # readiness is /ready's job
             self._json(200, {"status": "ok"})
+        elif self.path == "/ready":
+            # READINESS: should a load balancer send traffic here?
+            ready, info = self.state.readiness()
+            self._json(200 if ready else 503, info)
         else:
             self._error(404, f"unknown path {self.path}")
 
@@ -612,34 +854,72 @@ class OpenAIHandler(BaseHTTPRequestHandler):
         except (ValueError, json.JSONDecodeError) as e:
             self._error(400, f"bad JSON body: {e}")
             return
+        # bounded admission at the door: gate capacity covers EVERY in-
+        # flight completion (solo and batched alike), so overflow is an
+        # immediate 429 + Retry-After and a draining server answers 503
+        # instead of stranding requests behind a closing engine
+        try:
+            admitted_at = self.state.gate.acquire()
+        except LifecycleError as e:
+            self._lifecycle_error(e)
+            return
         try:
             self._handle_completions(req)
+        except LifecycleError as e:
+            # typed lifecycle end that escaped before any bytes were
+            # written (non-streaming deadline/crash): speak its status
+            try:
+                self._lifecycle_error(e)
+            except (BrokenPipeError, ConnectionResetError):
+                pass
         except (BrokenPipeError, ConnectionResetError):
             pass  # client went away mid-stream (FIN -> BrokenPipe, RST ->
             # ConnectionReset); per-request isolation like the reference's
             # per-request catch (`dllama-api.cpp:347-351`)
+        finally:
+            self.state.gate.release(admitted_at)
 
     def _stream_batched(self, base: dict, sampler: SamplerConfig,
-                        prompt_tokens: list, max_tokens: int) -> None:
+                        prompt_tokens: list, max_tokens: int,
+                        deadline: Deadline = None) -> None:
         """SSE streaming from the shared pool decode: bursts of up to
         batch-chunk tokens per event instead of one event per token (the
         granularity trade for sharing one device program across concurrent
         requests). Stop strings never reach here (the batch gate routes
-        them solo), so only stop TOKENS and budgets truncate."""
+        them solo), so only stop TOKENS and budgets truncate.
+
+        Lifecycle: a write failure (client FIN/RST — or an injected
+        ``stream:raise`` fault, which simulates exactly that) flips the
+        request's CancelToken instead of decoding on for a dead socket; the
+        scheduler releases the row's slot at the next chunk boundary. A
+        deadline expiry ends the stream with finish_reason "timeout"."""
         st = self.state
         tok = st.tokenizer
+        cancel = CancelToken()
         self.send_response(200)
         self.send_header("Content-Type", "text/event-stream")
         self.send_header("Cache-Control", "no-cache")
         self.send_header("Connection", "close")
         self.end_headers()
 
+        client_gone = False
+
         def emit_chunk(delta: dict, finish=None) -> None:
-            chunk = dict(base, object="chat.completion.chunk",
-                         choices=[{"index": 0, "delta": delta,
-                                   "finish_reason": finish}])
-            self.wfile.write(b"data: " + json.dumps(chunk).encode() + b"\n\n")
-            self.wfile.flush()
+            nonlocal client_gone
+            if client_gone:
+                return
+            try:
+                faults.fire("stream")
+                chunk = dict(base, object="chat.completion.chunk",
+                             choices=[{"index": 0, "delta": delta,
+                                       "finish_reason": finish}])
+                self.wfile.write(b"data: " + json.dumps(chunk).encode()
+                                 + b"\n\n")
+                self.wfile.flush()
+            except (BrokenPipeError, ConnectionResetError,
+                    faults.FaultInjected):
+                client_gone = True
+                cancel.cancel("client disconnected mid-stream")
 
         emit_chunk({"role": "assistant"})
         utf8 = codecs.getincrementaldecoder("utf-8")("replace")
@@ -648,7 +928,8 @@ class OpenAIHandler(BaseHTTPRequestHandler):
         finish_reason = "length"
         try:
             for burst in st.batcher.submit_stream(prompt_tokens, max_tokens,
-                                                  sampler):
+                                                  sampler, deadline=deadline,
+                                                  cancel=cancel):
                 parts = []
                 stopped = False
                 for t in burst:
@@ -663,14 +944,24 @@ class OpenAIHandler(BaseHTTPRequestHandler):
                 if stopped:
                     finish_reason = "stop"
                     break
+                if client_gone:
+                    break  # cancel is set; the scheduler reaps the row at
+                    # its next chunk boundary — stop consuming now
+        except DeadlineExceeded as e:
+            emit_chunk({"content": f"\n[error: {e}]"})
+            finish_reason = "timeout"
         except RuntimeError as e:
             emit_chunk({"content": f"\n[error: {e}]"})
         tail = utf8.decode(b"", True)
         if tail:
             emit_chunk({"content": tail})
         emit_chunk({}, finish=finish_reason)
-        self.wfile.write(b"data: [DONE]\n\n")
-        self.wfile.flush()
+        if not client_gone:
+            try:
+                self.wfile.write(b"data: [DONE]\n\n")
+                self.wfile.flush()
+            except (BrokenPipeError, ConnectionResetError):
+                pass
         self.close_connection = True
 
     def _handle_completions(self, req: dict) -> None:
@@ -722,6 +1013,9 @@ class OpenAIHandler(BaseHTTPRequestHandler):
                              f"the {st.cfg.seq_len}-token context")
             return
         max_tokens = room if max_tokens is None else min(max_tokens, room)
+        # wall-clock budget counted from HERE (admission), not from first
+        # token: queue time burns budget too, by design
+        deadline = Deadline.start(st.request_timeout)
 
         cid = _completion_id()
         created = int(time.time())
@@ -786,10 +1080,16 @@ class OpenAIHandler(BaseHTTPRequestHandler):
             # runs the BATCHED speculative verify (Batcher._serve);
             # singletons speculate on the solo path either way.
             if stream:
-                self._stream_batched(base, sampler, prompt_tokens, max_tokens)
+                self._stream_batched(base, sampler, prompt_tokens, max_tokens,
+                                     deadline=deadline)
             else:
                 try:
-                    row = st.batcher.submit(prompt_tokens, max_tokens, sampler)
+                    row = st.batcher.submit(prompt_tokens, max_tokens, sampler,
+                                            deadline=deadline)
+                except LifecycleError:
+                    raise  # do_POST speaks its status (504/503) — must
+                    # outrank the RuntimeError catch below (LifecycleError
+                    # IS a RuntimeError)
                 except RuntimeError as e:
                     # one poisoned batch must not reset K connections: every
                     # waiter gets its own 500
@@ -819,12 +1119,26 @@ class OpenAIHandler(BaseHTTPRequestHandler):
         text_parts: list = []
         finish_reason = "length"
         n_generated = 0
+        client_gone = False
 
         def emit_chunk(delta: dict, finish=None) -> None:
-            chunk = dict(base, object="chat.completion.chunk",
-                         choices=[{"index": 0, "delta": delta, "finish_reason": finish}])
-            self.wfile.write(b"data: " + json.dumps(chunk).encode() + b"\n\n")
-            self.wfile.flush()
+            nonlocal client_gone
+            if client_gone:
+                return
+            try:
+                faults.fire("stream")
+                chunk = dict(base, object="chat.completion.chunk",
+                             choices=[{"index": 0, "delta": delta,
+                                       "finish_reason": finish}])
+                self.wfile.write(b"data: " + json.dumps(chunk).encode()
+                                 + b"\n\n")
+                self.wfile.flush()
+            except (BrokenPipeError, ConnectionResetError,
+                    faults.FaultInjected):
+                # dead socket: stop decoding at the next token boundary but
+                # DON'T raise out of the locked loop — the prefix session
+                # still gets stored (the conversation may reconnect)
+                client_gone = True
 
         if stream:
             emit_chunk({"role": "assistant"})
@@ -832,6 +1146,7 @@ class OpenAIHandler(BaseHTTPRequestHandler):
         # incremental UTF-8: a multi-byte character split across byte-fallback
         # tokens must not be decoded per piece (that would emit U+FFFD pairs)
         utf8 = codecs.getincrementaldecoder("utf-8")("replace")
+        interrupted = None  # "timeout" when the deadline ends the decode
         with st.lock:
             prev = prompt_tokens[-1]
             stop_ids = st.stop_token_ids()
@@ -855,9 +1170,19 @@ class OpenAIHandler(BaseHTTPRequestHandler):
                 if hit_stop:
                     finish_reason = "stop"
                     break
+                if client_gone:
+                    break  # abandon the generator at a token boundary
+                if deadline is not None and deadline.expired():
+                    interrupted = "timeout"
+                    break
             st.store_prefix_session(history, st.engine.final_session)
 
-        if not detector.stopped:
+        if interrupted == "timeout":
+            if not stream:
+                raise deadline.error()  # -> 504 via do_POST
+            emit_chunk({"content": f"\n[error: {deadline.error()}]"})
+            finish_reason = "timeout"
+        elif not detector.stopped:
             # flush text withheld as a possible stop-string prefix — on EOS or
             # length it is legitimate output, only a stop-string hit eats it —
             # plus the replacement char for any dangling incomplete UTF-8 bytes
@@ -869,8 +1194,12 @@ class OpenAIHandler(BaseHTTPRequestHandler):
 
         if stream:
             emit_chunk({}, finish=finish_reason)
-            self.wfile.write(b"data: [DONE]\n\n")
-            self.wfile.flush()
+            if not client_gone:
+                try:
+                    self.wfile.write(b"data: [DONE]\n\n")
+                    self.wfile.flush()
+                except (BrokenPipeError, ConnectionResetError):
+                    pass
             self.close_connection = True
         else:
             self._json(200, dict(base, choices=[{
@@ -889,11 +1218,25 @@ def create_server(state: ServerState, host: str = "0.0.0.0", port: int = 9990):
     return ThreadingHTTPServer((host, port), handler)
 
 
+def drain_and_shutdown(state: ServerState, srv, drain_timeout_s: float) -> bool:
+    """SIGTERM graceful drain: stop admitting (new requests 503 at the
+    gate, /ready flips 503 so the balancer stops routing here), wait up to
+    ``drain_timeout_s`` for in-flight requests, then stop the listener.
+    Returns True when the drain completed with nothing in flight (a False
+    means live requests were cut off at the timeout)."""
+    state.begin_drain()
+    idle = state.gate.wait_idle(drain_timeout_s)
+    srv.shutdown()
+    return idle
+
+
 def serve(args) -> None:
     """Start the server from parsed CLI args (the ``serve`` mode of
     ``dllama_tpu.cli``, analogous to launching the reference's dllama-api
     binary with the same flag set, `dllama-api.cpp:357-362`)."""
-    from dllama_tpu.cli import load_engine
+    import signal
+
+    from dllama_tpu.cli import load_engine, write_pid_file
 
     engine, tok, cfg = load_engine(args)
     state = ServerState(
@@ -909,11 +1252,38 @@ def serve(args) -> None:
         batch_window_ms=getattr(args, "batch_window", 0.0),
         batch_max=getattr(args, "batch_max", 8),
         batch_chunk=getattr(args, "batch_chunk", 8),
+        request_timeout=getattr(args, "request_timeout", 0.0),
+        queue_depth=getattr(args, "queue_depth", 64),
     )
     srv = create_server(state, host=args.host, port=args.port)
+    pid_path = getattr(args, "pid_file", None)
+    if pid_path:
+        write_pid_file(pid_path)
+    drain_timeout_s = getattr(args, "drain_timeout", 30.0)
+
+    def _on_sigterm(_signum, _frame):
+        # drain OFF the signal frame: srv.shutdown() blocks until
+        # serve_forever exits, and wait_idle may sleep for the full drain
+        # window — neither belongs in a signal handler
+        print(f"⛔ SIGTERM: draining (up to {drain_timeout_s:.0f}s) ...")
+        threading.Thread(
+            target=drain_and_shutdown, args=(state, srv, drain_timeout_s),
+            daemon=True, name="dllama-drain").start()
+
+    try:
+        signal.signal(signal.SIGTERM, _on_sigterm)
+    except ValueError:
+        pass  # not the main thread (embedded/test use): no signal hook
     print(f"📡 listening on {args.host}:{args.port} "
           "(POST /v1/chat/completions, GET /v1/models)")
-    srv.serve_forever()
+    try:
+        srv.serve_forever()
+    finally:
+        if pid_path:
+            try:
+                os.remove(pid_path)
+            except OSError:
+                pass
 
 
 def main(argv=None) -> None:

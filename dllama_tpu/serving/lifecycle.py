@@ -1,0 +1,266 @@
+"""Per-request lifecycle primitives for the serving stack: deadlines,
+cancellation tokens, bounded admission, and a scheduler supervisor.
+
+PR 1's continuous batcher made the server fast; this layer makes it bounded
+under failure. Every way a request can end other than "finished" is a typed
+:class:`LifecycleError` carrying the HTTP status the handler should speak
+(429 queue overflow, 503 draining/scheduler-crash, 504 deadline), so no
+client ever observes an unbounded wait:
+
+* :class:`Deadline` — wall-clock budget from submit, enforced by the decode
+  loops BETWEEN chunks (a row never holds its slot past one chunk after
+  expiry).
+* :class:`CancelToken` — cooperative cancel (client disconnect, shutdown);
+  the scheduler releases a cancelled row's slot at the next chunk boundary.
+* :class:`AdmissionGate` — bounded in-flight counter: overflow is rejected
+  NOW with 429 + Retry-After instead of queuing unboundedly, and
+  ``begin_drain`` flips the gate to 503 for SIGTERM graceful shutdown.
+* :class:`Supervisor` — owns the scheduler thread: a crash runs the
+  ``on_crash`` hook (fail in-flight slots 503) and restarts the loop, so one
+  poisoned window can never leave every later ``submit()`` hanging on a dead
+  daemon.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+
+class LifecycleError(RuntimeError):
+    """A request ended by lifecycle policy rather than by decoding.
+
+    ``http_status``/``retry_after_s`` tell the handler what to speak; the
+    message is the client-facing error text.
+    """
+
+    http_status = 500
+    retry_after_s: float = None
+
+
+class QueueFull(LifecycleError):
+    """Admission rejected: the bounded queue is at capacity (HTTP 429)."""
+
+    http_status = 429
+
+    def __init__(self, depth: int, capacity: int, retry_after_s: float):
+        super().__init__(
+            f"server at capacity ({depth}/{capacity} requests in flight); "
+            "retry later")
+        self.retry_after_s = retry_after_s
+
+
+class ServerDraining(LifecycleError):
+    """Admission rejected: the server is draining for shutdown (HTTP 503)."""
+
+    http_status = 503
+    retry_after_s = 30.0
+
+    def __init__(self):
+        super().__init__("server is draining for shutdown")
+
+
+class SchedulerCrashed(LifecycleError):
+    """The scheduler thread died with this request in flight (HTTP 503).
+    The supervisor restarts the thread; the REQUEST is not retried — replay
+    is the client's call, not the server's."""
+
+    http_status = 503
+    retry_after_s = 1.0
+
+    def __init__(self, cause: BaseException):
+        super().__init__(f"scheduler crashed mid-request: {cause!r}; "
+                         "scheduler restarted, retry the request")
+        self.cause = cause
+
+
+class DeadlineExceeded(LifecycleError):
+    """The request's wall-clock budget expired mid-decode (HTTP 504)."""
+
+    http_status = 504
+
+    def __init__(self, budget_s: float):
+        super().__init__(
+            f"request exceeded its {budget_s:.1f}s deadline (--request-"
+            "timeout); partial output discarded, slot released")
+        self.budget_s = budget_s
+
+
+class RequestCancelled(LifecycleError):
+    """The client went away (or shutdown forced the row out); no response
+    channel exists, the error just resolves the slot's waiter."""
+
+    def __init__(self, reason: str):
+        super().__init__(f"request cancelled: {reason}")
+        self.reason = reason
+
+
+class Deadline:
+    """Wall-clock budget counted from construction (i.e. from submit)."""
+
+    __slots__ = ("budget_s", "expires_at")
+
+    def __init__(self, budget_s: float):
+        self.budget_s = budget_s
+        self.expires_at = time.monotonic() + budget_s
+
+    @classmethod
+    def start(cls, budget_s) -> "Deadline":
+        """None/0/negative budget means no deadline."""
+        return cls(budget_s) if budget_s and budget_s > 0 else None
+
+    def remaining(self) -> float:
+        return self.expires_at - time.monotonic()
+
+    def expired(self) -> bool:
+        return time.monotonic() >= self.expires_at
+
+    def error(self) -> DeadlineExceeded:
+        return DeadlineExceeded(self.budget_s)
+
+
+class CancelToken:
+    """Cooperative cancellation flag, set once with a reason."""
+
+    def __init__(self):
+        self._event = threading.Event()
+        self.reason: str = None
+
+    def cancel(self, reason: str) -> None:
+        if not self._event.is_set():
+            self.reason = reason
+            self._event.set()
+
+    @property
+    def cancelled(self) -> bool:
+        return self._event.is_set()
+
+    def error(self) -> RequestCancelled:
+        return RequestCancelled(self.reason or "cancelled")
+
+
+class AdmissionGate:
+    """Bounded in-flight request counter with drain support.
+
+    ``acquire`` either admits (incrementing the in-flight count) or raises
+    :class:`QueueFull` / :class:`ServerDraining` — it NEVER blocks, which is
+    the whole point: backpressure is a fast typed rejection the client can
+    act on, not an invisible queue. ``retry_after`` scales with how loaded
+    the gate is, seeded by an EWMA of recent request service times.
+    """
+
+    def __init__(self, capacity: int):
+        self.capacity = max(1, capacity)
+        self._lock = threading.Lock()
+        self._idle = threading.Condition(self._lock)
+        self._inflight = 0
+        self._draining = False
+        self._service_ewma_s = 1.0  # optimistic prior; updated per release
+
+    @property
+    def depth(self) -> int:
+        return self._inflight
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def retry_after_s(self) -> float:
+        """Seconds a 429'd client should wait: one EWMA service time per
+        queued request ahead of it, floored at 1s so clients never busy-spin."""
+        return max(1.0, self._service_ewma_s * self._inflight)
+
+    def acquire(self) -> float:
+        """Admit one request; returns its admit timestamp (pass back to
+        ``release`` for the service-time EWMA)."""
+        with self._lock:
+            if self._draining:
+                raise ServerDraining()
+            if self._inflight >= self.capacity:
+                raise QueueFull(self._inflight, self.capacity,
+                                self.retry_after_s())
+            self._inflight += 1
+            return time.monotonic()
+
+    def release(self, admitted_at: float = None) -> None:
+        with self._lock:
+            self._inflight = max(0, self._inflight - 1)
+            if admitted_at is not None:
+                dt = max(0.0, time.monotonic() - admitted_at)
+                self._service_ewma_s += 0.2 * (dt - self._service_ewma_s)
+            if self._inflight == 0:
+                self._idle.notify_all()
+
+    def begin_drain(self) -> None:
+        """Stop admitting; in-flight requests keep running."""
+        with self._lock:
+            self._draining = True
+
+    def wait_idle(self, timeout_s: float) -> bool:
+        """Block until nothing is in flight (or timeout). True when idle —
+        the SIGTERM drain's exit condition."""
+        deadline = time.monotonic() + timeout_s
+        with self._lock:
+            while self._inflight > 0:
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    return False
+                self._idle.wait(left)
+            return True
+
+
+class Supervisor:
+    """Owns a daemon thread running ``target`` and restarts it on crash.
+
+    ``target`` is a long-running loop (the server scheduler); a normal
+    return ends supervision (the drain path). An exception runs
+    ``on_crash(exc)`` — which must fail the in-flight work so no waiter
+    hangs — then restarts ``target`` after a short pause. ``alive`` is the
+    readiness probe's scheduler-liveness answer.
+    """
+
+    def __init__(self, target, on_crash, name: str = "supervised",
+                 restart_delay_s: float = 0.05, max_restarts: int = None):
+        self._target = target
+        self._on_crash = on_crash
+        self._name = name
+        self._restart_delay_s = restart_delay_s
+        self._max_restarts = max_restarts  # None = unlimited
+        self._lock = threading.Lock()
+        self._thread: threading.Thread = None
+        self.crash_count = 0
+        self._stopped = False
+
+    def start(self) -> None:
+        """Idempotent: starts the loop thread on first call."""
+        with self._lock:
+            if self._thread is not None or self._stopped:
+                return
+            self._thread = threading.Thread(
+                target=self._run, daemon=True, name=self._name)
+            self._thread.start()
+
+    def _run(self) -> None:
+        while not self._stopped:
+            try:
+                self._target()
+                return  # clean exit: drain finished
+            except BaseException as e:  # noqa: BLE001 — supervision IS the catch
+                self.crash_count += 1
+                try:
+                    self._on_crash(e)
+                except Exception:  # noqa: BLE001 — crash hook must not kill
+                    pass  # the supervisor; liveness beats accounting here
+                if (self._max_restarts is not None
+                        and self.crash_count > self._max_restarts):
+                    return
+                time.sleep(self._restart_delay_s)
+
+    @property
+    def alive(self) -> bool:
+        t = self._thread
+        return t is not None and t.is_alive()
+
+    def stop(self) -> None:
+        """Stop restarting (the running iteration finishes on its own)."""
+        self._stopped = True

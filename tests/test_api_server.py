@@ -59,6 +59,38 @@ def test_stop_detector_no_stops_passthrough():
     assert d.feed("anything") == ("anything", False)
 
 
+def test_stop_detector_flush_releases_partial_prefix():
+    # a dangling possible-prefix is legitimate output when the stream ends
+    # on EOS/length (only an actual stop hit may eat it), and flush drains
+    d = StopDetector(["STOP"])
+    assert d.feed("abST") == ("ab", False)
+    assert d.flush() == "ST"
+    assert d.flush() == ""
+
+
+def test_stop_detector_flush_after_stop_is_empty():
+    d = StopDetector(["END"])
+    out, stopped = d.feed("the END tail")
+    assert (out, stopped) == ("the ", True)
+    assert d.flush() == ""  # the hold died with the stop hit
+    assert d.feed("more") == ("", True)  # stopped detectors stay stopped
+
+
+def test_stop_detector_longest_partial_held_across_stops():
+    # with several stops, the LONGEST tail that prefixes any of them is
+    # withheld — flushing exactly that tail at end of stream
+    d = StopDetector(["abcd", "cd"])
+    assert d.feed("xabc") == ("x", False)
+    assert d.flush() == "abc"
+
+
+def test_stop_detector_single_char_stop_holds_nothing():
+    # a 1-char stop has no proper prefix: nothing is ever withheld
+    d = StopDetector(["\n"])
+    assert d.feed("line") == ("line", False)
+    assert d.flush() == ""
+
+
 # ---------------------------------------------------------------------------
 # Server integration (tiny synthetic model, real HTTP over localhost)
 # ---------------------------------------------------------------------------

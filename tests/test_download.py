@@ -1,0 +1,117 @@
+"""Downloader robustness: retry with backoff on transient errors, HTTP Range
+resume from a partial ``.part`` file, atomic rename on completion — against a
+local HTTP server that misbehaves on demand (no network needed)."""
+
+import http.server
+import threading
+import urllib.error
+
+import pytest
+
+from dllama_tpu.convert.download import download_file
+
+pytestmark = pytest.mark.faults
+
+PAYLOAD = bytes(range(256)) * 64  # 16 KiB, recognizable at any offset
+
+
+class _FlakyHandler(http.server.BaseHTTPRequestHandler):
+    fails_left = 0  # 503s served before behaving
+    hits = 0
+    ranges_seen: list = []
+
+    def log_message(self, *args):
+        pass
+
+    def do_GET(self):
+        cls = type(self)
+        cls.hits += 1
+        if self.path == "/missing":
+            self.send_response(404)
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+            return
+        if cls.fails_left > 0:
+            cls.fails_left -= 1
+            self.send_response(503)
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+            return
+        start = 0
+        rng = self.headers.get("Range")
+        if rng:
+            cls.ranges_seen.append(rng)
+            start = int(rng.split("=", 1)[1].rstrip("-"))
+            if start >= len(PAYLOAD):
+                self.send_response(416)
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+                return
+            self.send_response(206)
+        else:
+            self.send_response(200)
+        body = PAYLOAD[start:]
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+
+@pytest.fixture()
+def local_http():
+    _FlakyHandler.fails_left = 0
+    _FlakyHandler.hits = 0
+    _FlakyHandler.ranges_seen = []
+    srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), _FlakyHandler)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    yield srv.server_address[1]
+    srv.shutdown()
+
+
+def test_download_plain(local_http, tmp_path):
+    dest = tmp_path / "model.m"
+    download_file(f"http://127.0.0.1:{local_http}/model.m", str(dest))
+    assert dest.read_bytes() == PAYLOAD
+    assert not (tmp_path / "model.m.part").exists()  # renamed, not copied
+
+
+def test_download_retries_transient_503(local_http, tmp_path):
+    _FlakyHandler.fails_left = 2
+    dest = tmp_path / "model.m"
+    download_file(f"http://127.0.0.1:{local_http}/model.m", str(dest),
+                  retries=4, backoff_s=0.01)
+    assert dest.read_bytes() == PAYLOAD
+    assert _FlakyHandler.hits == 3  # 2 failures + 1 success
+
+
+def test_download_resumes_from_partial(local_http, tmp_path):
+    dest = tmp_path / "model.m"
+    (tmp_path / "model.m.part").write_bytes(PAYLOAD[:5000])
+    download_file(f"http://127.0.0.1:{local_http}/model.m", str(dest),
+                  retries=1, backoff_s=0.01)
+    assert _FlakyHandler.ranges_seen == ["bytes=5000-"]
+    assert dest.read_bytes() == PAYLOAD  # stitched, not restarted
+
+
+def test_download_416_means_already_complete(local_http, tmp_path):
+    dest = tmp_path / "model.m"
+    (tmp_path / "model.m.part").write_bytes(PAYLOAD)  # fully fetched .part
+    download_file(f"http://127.0.0.1:{local_http}/model.m", str(dest),
+                  retries=1, backoff_s=0.01)
+    assert dest.read_bytes() == PAYLOAD
+
+
+def test_download_fails_fast_on_404(local_http, tmp_path):
+    with pytest.raises(urllib.error.HTTPError):
+        download_file(f"http://127.0.0.1:{local_http}/missing",
+                      str(tmp_path / "x"), retries=5, backoff_s=0.01)
+    assert _FlakyHandler.hits == 1  # 404 is not retried
+
+
+def test_download_exhausted_retries_keeps_partial(local_http, tmp_path):
+    _FlakyHandler.fails_left = 99
+    dest = tmp_path / "model.m"
+    with pytest.raises(RuntimeError, match="download failed"):
+        download_file(f"http://127.0.0.1:{local_http}/model.m", str(dest),
+                      retries=2, backoff_s=0.01)
+    assert not dest.exists()
+    assert _FlakyHandler.hits == 3  # initial try + 2 retries

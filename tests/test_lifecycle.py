@@ -1,0 +1,536 @@
+"""Request-lifecycle chaos suite: deadlines, cancellation, backpressure,
+supervised scheduler recovery — driven by the deterministic fault-injection
+harness (dllama_tpu.faults) so every failure path runs CPU-only.
+
+The contract under test: whatever breaks (injected engine faults, dead
+client sockets, queue overflow, a crashed scheduler thread), the server
+answers BOUNDED — a typed 429/503/504 or a RuntimeError — never a hang.
+Every test that waits does so with an explicit timeout and asserts the
+worker thread actually finished.
+"""
+
+import http.client
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from dllama_tpu import faults
+from dllama_tpu.cli import write_pid_file
+from dllama_tpu.serving.lifecycle import (
+    AdmissionGate,
+    CancelToken,
+    Deadline,
+    DeadlineExceeded,
+    QueueFull,
+    RequestCancelled,
+    SchedulerCrashed,
+    ServerDraining,
+    Supervisor,
+)
+
+pytestmark = pytest.mark.faults
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    """The fault plan is process-global: never leak one across tests."""
+    faults.clear()
+    yield
+    faults.clear()
+
+
+def run_bounded(fn, timeout_s: float):
+    """Run ``fn`` on a thread and FAIL if it outlives ``timeout_s`` — the
+    chaos suite's no-hang assertion. Returns {'result': ...} or
+    {'error': ...}."""
+    out = {}
+
+    def runner():
+        try:
+            out["result"] = fn()
+        except BaseException as e:  # noqa: BLE001 — the test inspects it
+            out["error"] = e
+
+    t = threading.Thread(target=runner, daemon=True)
+    t.start()
+    t.join(timeout_s)
+    assert not t.is_alive(), f"operation hung past its {timeout_s}s bound"
+    return out
+
+
+# ---------------------------------------------------------------------------
+# fault spec parsing + firing schedule (pure, no jax)
+# ---------------------------------------------------------------------------
+
+def test_fault_spec_parse_defaults():
+    plan = faults.FaultPlan.parse("admit:raise")
+    with pytest.raises(faults.FaultInjected) as ei:
+        plan.fire("admit")
+    assert ei.value.site == "admit"
+    plan.fire("step_chunk")  # other sites untouched
+
+
+def test_fault_schedule_every_after_times():
+    plan = faults.FaultPlan.parse("step_chunk:raise:every=2,after=1,times=2")
+    fired = []
+    for call in range(1, 10):
+        try:
+            plan.fire("step_chunk")
+        except faults.FaultInjected:
+            fired.append(call)
+    # skip call 1 (after=1), then every 2nd of the remainder, capped at 2
+    assert fired == [3, 5]
+    assert plan.counters()["step_chunk"] == (9, 2)
+
+
+def test_fault_slow_action_sleeps():
+    plan = faults.FaultPlan.parse("stream:slow:delay_ms=40")
+    t0 = time.monotonic()
+    plan.fire("stream")
+    assert time.monotonic() - t0 >= 0.03
+
+
+@pytest.mark.parametrize("spec", [
+    "nosuchsite:raise",          # unknown site
+    "admit:explode",             # unknown action
+    "admit",                     # missing action
+    "admit:raise:bogus=1",       # unknown option
+    "admit:raise:every=0",       # every must be >= 1
+])
+def test_fault_spec_rejects_bad(spec):
+    with pytest.raises(ValueError):
+        faults.FaultPlan.parse(spec)
+
+
+def test_fault_install_clear_roundtrip():
+    faults.install("admit:raise")
+    with pytest.raises(faults.FaultInjected):
+        faults.fire("admit")
+    faults.clear()
+    faults.fire("admit")  # no-op again
+
+
+# ---------------------------------------------------------------------------
+# lifecycle primitives (pure, no jax)
+# ---------------------------------------------------------------------------
+
+def test_deadline_start_none_for_no_budget():
+    assert Deadline.start(None) is None
+    assert Deadline.start(0.0) is None
+    assert Deadline.start(-1.0) is None
+
+
+def test_deadline_expiry_and_error():
+    dl = Deadline.start(0.01)
+    assert not dl.expired() or dl.remaining() <= 0
+    time.sleep(0.02)
+    assert dl.expired()
+    err = dl.error()
+    assert isinstance(err, DeadlineExceeded)
+    assert err.http_status == 504
+
+
+def test_cancel_token_first_reason_wins():
+    c = CancelToken()
+    assert not c.cancelled
+    c.cancel("client gone")
+    c.cancel("later reason")
+    assert c.cancelled
+    err = c.error()
+    assert isinstance(err, RequestCancelled)
+    assert "client gone" in str(err)
+
+
+def test_admission_gate_overflow_and_release():
+    gate = AdmissionGate(2)
+    t1, t2 = gate.acquire(), gate.acquire()
+    with pytest.raises(QueueFull) as ei:
+        gate.acquire()
+    assert ei.value.http_status == 429
+    assert ei.value.retry_after_s >= 1.0
+    gate.release(t1)
+    gate.acquire()  # capacity freed
+    gate.release(t2)
+
+
+def test_admission_gate_drain_rejects_503():
+    gate = AdmissionGate(4)
+    ticket = gate.acquire()
+    gate.begin_drain()
+    with pytest.raises(ServerDraining) as ei:
+        gate.acquire()
+    assert ei.value.http_status == 503
+    assert not gate.wait_idle(0.05)  # one still in flight
+    gate.release(ticket)
+    assert gate.wait_idle(1.0)
+
+
+def test_admission_gate_wait_idle_wakes_on_release():
+    gate = AdmissionGate(4)
+    ticket = gate.acquire()
+    threading.Timer(0.05, gate.release, args=(ticket,)).start()
+    t0 = time.monotonic()
+    assert gate.wait_idle(5.0)
+    assert time.monotonic() - t0 < 4.0  # woke on notify, not timeout
+
+
+def test_supervisor_restarts_until_clean_exit():
+    crashes = []
+    done = threading.Event()
+    attempts = {"n": 0}
+
+    def target():
+        attempts["n"] += 1
+        if attempts["n"] < 3:
+            raise RuntimeError("boom")
+        done.set()
+
+    sup = Supervisor(target, crashes.append, restart_delay_s=0.01)
+    sup.start()
+    sup.start()  # idempotent
+    assert done.wait(5.0), "supervised loop never reached its clean run"
+    assert sup.crash_count == 2
+    assert len(crashes) == 2
+
+
+def test_supervisor_max_restarts_gives_up():
+    def target():
+        raise RuntimeError("always")
+
+    sup = Supervisor(target, lambda e: None, restart_delay_s=0.01,
+                     max_restarts=2)
+    sup.start()
+    deadline = time.monotonic() + 5.0
+    while sup.alive and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert not sup.alive
+    assert sup.crash_count == 3  # initial crash + 2 restarts
+
+
+def test_supervisor_crash_hook_errors_do_not_kill_it():
+    done = threading.Event()
+    attempts = {"n": 0}
+
+    def target():
+        attempts["n"] += 1
+        if attempts["n"] < 2:
+            raise RuntimeError("boom")
+        done.set()
+
+    def bad_hook(_e):
+        raise ValueError("hook is broken too")
+
+    sup = Supervisor(target, bad_hook, restart_delay_s=0.01)
+    sup.start()
+    assert done.wait(5.0)
+
+
+def test_write_pid_file_atomic(tmp_path):
+    path = tmp_path / "server.pid"
+    write_pid_file(str(path))
+    assert int(path.read_text()) == os.getpid()
+    # no tmp litter left behind
+    assert [p.name for p in tmp_path.iterdir()] == ["server.pid"]
+    write_pid_file(str(path))  # overwrite is fine
+
+
+# ---------------------------------------------------------------------------
+# server integration (tiny synthetic model, real HTTP over localhost)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def engine_bits():
+    from dllama_tpu.models import llama
+    from dllama_tpu.runtime.generate import Engine
+    from dllama_tpu.runtime.sampler import SamplerConfig
+
+    from tests.test_api_server import make_tokenizer
+    from tests.test_llama_forward import tiny_cfg
+
+    tok = make_tokenizer()
+    cfg = tiny_cfg(vocab_size=tok.vocab_size, seq_len=512, dim=32, kv_dim=16,
+                   head_size=8, hidden_dim=64)
+    params = llama.random_params(cfg, seed=13)
+    engine = Engine(cfg, params, SamplerConfig(temperature=0.0, seed=1))
+    return engine, tok, cfg
+
+
+def make_state(engine_bits, **kw):
+    from dllama_tpu.serving.api_server import ServerState
+
+    engine, tok, cfg = engine_bits
+    return ServerState(engine, tok, cfg, model_name="tiny-test",
+                       template="llama3", **kw)
+
+
+def start_server(state):
+    from dllama_tpu.serving.api_server import create_server
+
+    srv = create_server(state, host="127.0.0.1", port=0)
+    port = srv.server_address[1]
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv, port
+
+
+def http_req(port, method, path, body=None, timeout=120):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    conn.request(method, path,
+                 body=json.dumps(body) if body is not None else None,
+                 headers={"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    data = resp.read()
+    headers = dict(resp.getheaders())
+    conn.close()
+    return resp.status, data, headers
+
+
+def chat_body(**kw):
+    body = {
+        "model": "tiny-test",
+        "messages": [{"role": "user", "content": "hello world"}],
+        "max_tokens": 8,
+        "temperature": 0.0,
+    }
+    body.update(kw)
+    return body
+
+
+def greedy():
+    from dllama_tpu.runtime.sampler import SamplerConfig
+
+    return SamplerConfig(temperature=0.0, seed=1)
+
+
+def test_http_429_queue_full_with_retry_after(engine_bits):
+    state = make_state(engine_bits, queue_depth=2)
+    srv, port = start_server(state)
+    try:
+        tickets = [state.gate.acquire(), state.gate.acquire()]
+        status, data, headers = http_req(port, "POST", "/v1/chat/completions",
+                                         chat_body(), timeout=30)
+        assert status == 429
+        assert int(headers["Retry-After"]) >= 1
+        assert "capacity" in json.loads(data)["error"]["message"]
+        for t in tickets:
+            state.gate.release(t)
+        status, _, _ = http_req(port, "POST", "/v1/chat/completions",
+                                chat_body())
+        assert status == 200
+    finally:
+        srv.shutdown()
+
+
+def test_health_vs_ready_split(engine_bits):
+    state = make_state(engine_bits)
+    srv, port = start_server(state)
+    try:
+        status, data, _ = http_req(port, "GET", "/ready", timeout=30)
+        assert status == 200
+        info = json.loads(data)
+        assert info["status"] == "ready"
+        for key in ("draining", "scheduler_alive", "scheduler_crashes",
+                    "inflight", "queue_capacity", "queue_depth",
+                    "slots_occupied", "slots_total"):
+            assert key in info
+        state.begin_drain()
+        # liveness stays 200 (don't restart a draining process) ...
+        status, _, _ = http_req(port, "GET", "/health", timeout=30)
+        assert status == 200
+        # ... readiness flips 503 so the balancer stops routing here
+        status, data, _ = http_req(port, "GET", "/ready", timeout=30)
+        assert status == 503
+        assert json.loads(data)["draining"] is True
+        # and new work is rejected at the gate
+        status, _, headers = http_req(port, "POST", "/v1/chat/completions",
+                                      chat_body(), timeout=30)
+        assert status == 503
+        assert "Retry-After" in headers
+    finally:
+        srv.shutdown()
+
+
+def test_request_timeout_504(engine_bits):
+    state = make_state(engine_bits, request_timeout=0.0001)
+    srv, port = start_server(state)
+    try:
+        status, data, _ = http_req(port, "POST", "/v1/chat/completions",
+                                   chat_body(max_tokens=32))
+        assert status == 504
+        assert "deadline" in json.loads(data)["error"]["message"]
+    finally:
+        srv.shutdown()
+
+
+def test_sigterm_drain_finishes_inflight(engine_bits):
+    from dllama_tpu.serving.api_server import drain_and_shutdown
+
+    state = make_state(engine_bits)
+    srv, port = start_server(state)
+    results = {}
+    # hold the request in flight deterministically (one slow prefill) so the
+    # drain provably overlaps it
+    faults.install("prefill:slow:delay_ms=300,times=1")
+
+    def long_request():
+        results["resp"] = http_req(port, "POST", "/v1/chat/completions",
+                                   chat_body(max_tokens=32))
+
+    t = threading.Thread(target=long_request, daemon=True)
+    t.start()
+    # wait until the request is actually admitted before draining
+    deadline = time.monotonic() + 30.0
+    while state.gate.depth == 0 and time.monotonic() < deadline:
+        time.sleep(0.005)
+    assert state.gate.depth == 1, "request never admitted"
+    idle = drain_and_shutdown(state, srv, drain_timeout_s=120.0)
+    t.join(timeout=30.0)
+    assert not t.is_alive()
+    assert idle, "drain timed out with the request still in flight"
+    assert results["resp"][0] == 200  # the in-flight request COMPLETED
+    # the listener is down: new connections fail
+    srv.server_close()
+    with pytest.raises(OSError):
+        http_req(port, "GET", "/health", timeout=2)
+
+
+def test_solo_stream_write_failure_cancels_and_keeps_session(engine_bits):
+    # stream:raise simulates the SSE socket dying on the 2nd write: the
+    # handler must stop decoding at a token boundary, still store the
+    # prefix session, and leave the server healthy for the next request
+    state = make_state(engine_bits)
+    srv, port = start_server(state)
+    try:
+        faults.install("stream:raise:after=1")
+        status, data, _ = http_req(port, "POST", "/v1/chat/completions",
+                                   chat_body(stream=True, max_tokens=16))
+        assert status == 200
+        assert b"[DONE]" not in data  # stream was cut, not completed
+        faults.clear()
+        assert len(state._sessions) == 1  # disconnect still cached the KV
+        status, _, _ = http_req(port, "POST", "/v1/chat/completions",
+                                chat_body())
+        assert status == 200
+    finally:
+        srv.shutdown()
+
+
+# -- batcher (continuous scheduler) chaos -----------------------------------
+
+@pytest.fixture()
+def batch_state(engine_bits):
+    return make_state(engine_bits, batch_window_ms=5.0, batch_max=4,
+                      batch_chunk=2)
+
+
+def _slot(batcher, prompt, steps, streaming=False, deadline=None,
+          cancel=None):
+    return batcher._Slot(list(prompt), steps, greedy(), streaming,
+                         deadline=deadline, cancel=cancel)
+
+
+def test_step_chunk_fault_fails_waiters_then_recovers(engine_bits,
+                                                      batch_state):
+    # injected step_chunk raise inside the continuous pool: EVERY waiter of
+    # that batch resolves with an error (nobody hangs), and the very next
+    # batch on the same scheduler succeeds
+    _, tok, _ = engine_bits
+    prompt = tok.encode("hello world", add_bos=True)
+    b = batch_state.batcher
+    faults.install("step_chunk:raise:times=1")
+    s1, s2 = _slot(b, prompt, 8), _slot(b, prompt, 8)
+    out = run_bounded(lambda: b._serve_continuous([s1, s2]), 120.0)
+    assert "error" not in out
+    for s in (s1, s2):
+        assert s.done.is_set()
+        assert isinstance(s.error, RuntimeError)
+    s3, s4 = _slot(b, prompt, 8), _slot(b, prompt, 8)
+    out = run_bounded(lambda: b._serve_continuous([s3, s4]), 120.0)
+    assert "error" not in out
+    for s in (s3, s4):
+        assert s.error is None
+        assert len(s.tokens) >= 1
+    assert b.occupancy() == (0, 4)
+
+
+def test_cancel_mid_decode_frees_slot_within_one_chunk(engine_bits,
+                                                       batch_state):
+    _, tok, _ = engine_bits
+    prompt = tok.encode("hello world", add_bos=True)
+    b = batch_state.batcher
+    cancel = CancelToken()
+    s_long = _slot(b, prompt, 64, streaming=True, cancel=cancel)
+    s_short = _slot(b, prompt, 8)
+    done = {}
+
+    def serve():
+        b._serve_continuous([s_long, s_short])
+        done["occupancy"] = b.occupancy()
+
+    t = threading.Thread(target=serve, daemon=True)
+    t.start()
+    first = s_long.queue.get(timeout=60.0)  # one real burst arrived
+    assert isinstance(first, list) and first
+    cancel.cancel("client disconnected mid-stream")
+    t.join(timeout=120.0)
+    assert not t.is_alive(), "pool never drained after cancellation"
+    assert isinstance(s_long.error, RequestCancelled)
+    assert len(s_long.tokens) < 64  # cancelled well before its budget
+    assert s_short.error is None and len(s_short.tokens) >= 1
+    assert done["occupancy"] == (0, 4)  # the cancelled slot was released
+
+
+def test_expired_deadline_rejected_before_decode(engine_bits, batch_state):
+    _, tok, _ = engine_bits
+    prompt = tok.encode("hello world", add_bos=True)
+    dl = Deadline.start(1e-6)
+    time.sleep(0.001)
+    out = run_bounded(
+        lambda: batch_state.batcher.submit(prompt, 8, greedy(), deadline=dl),
+        60.0)
+    assert isinstance(out.get("error"), DeadlineExceeded)
+
+
+def test_scheduler_crash_503_then_recovers_on_restart(engine_bits,
+                                                      batch_state):
+    # the scheduler site fires at the top of the window, OUTSIDE the serve
+    # paths' own catches: the loop thread genuinely dies, the supervisor's
+    # on_crash fails the in-flight window 503, and the restarted thread
+    # serves the next request
+    state = batch_state
+    srv, port = start_server(state)
+    try:
+        faults.install("scheduler:raise:times=1")
+        status, data, headers = http_req(port, "POST", "/v1/chat/completions",
+                                         chat_body())
+        assert status == 503
+        assert "Retry-After" in headers
+        assert "scheduler crashed" in json.loads(data)["error"]["message"]
+        assert state.batcher.crash_count == 1
+        status, _, _ = http_req(port, "POST", "/v1/chat/completions",
+                                chat_body())
+        assert status == 200, "restarted scheduler did not serve"
+        assert state.batcher.scheduler_alive
+    finally:
+        srv.shutdown()
+
+
+def test_dead_scheduler_never_leaves_submit_blocked(engine_bits):
+    # supervisor exhausted (max_restarts=0 via a plan that ALWAYS raises):
+    # submit() must give up with a typed error once the thread is gone, not
+    # block forever on slot.done
+    state = make_state(engine_bits, batch_window_ms=5.0, batch_max=4,
+                       batch_chunk=2)
+    b = state.batcher
+    faults.install("scheduler:raise")  # every window dies
+    _, tok, _ = engine_bits
+    prompt = tok.encode("hello world", add_bos=True)
+    # monkey-free: build the supervisor with no restarts by submitting once
+    # (starts it), then stopping restarts before the next submit
+    out = run_bounded(lambda: b.submit(prompt, 4, greedy()), 60.0)
+    assert isinstance(out.get("error"), SchedulerCrashed)
+    b._supervisor.stop()  # now the thread dies for good on the next crash
+    out = run_bounded(lambda: b.submit(prompt, 4, greedy()), 60.0)
+    assert isinstance(out.get("error"), SchedulerCrashed)
