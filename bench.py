@@ -544,6 +544,97 @@ def run_decode_bench(cfg_dict: dict, bench_steps: int = None, quant_ok: bool = F
         return (wall * 1000.0 / max(1, nfaults),
                 f"{weights}-faults{nfaults}{cfg_tag}")
 
+    # BENCH_INTEGRITY=1 measures the numeric-health watchdog two ways.
+    # (1) Overhead: batched decode with checks on vs a second engine built
+    #     with numeric_checks=False — the per-row isfinite AND rides the
+    #     fused decode scan, so the target is < 1% (CPU numbers are noisy;
+    #     the number is REPORTED, the bench does not fail on it).
+    # (2) Quarantine replay: a slot-pool run is repeated with
+    #     ``logits:nan:row=1`` installed — the poisoned row must finish
+    #     "error" and every sibling row's stream must be BIT-IDENTICAL to
+    #     the clean run (per-row sampler chains + per-row cache slabs mean
+    #     corruption must never cross rows). A divergence fails the bench.
+    if _env_count("BENCH_INTEGRITY"):
+        from dllama_tpu import faults as _faults
+
+        B = max(2, min(batch or 4, 8))
+        isteps = max(16, min(bench_steps, cfg.seq_len - 8) // 2)
+        greedy = SamplerConfig(temperature=0.0, seed=0)
+
+        def _timed_batch(e):
+            e.generate_batch([[1]] * B, steps=isteps, sampler=greedy)
+            best = None
+            for _ in range(3):
+                t1 = time.perf_counter()
+                out = e.generate_batch([[1]] * B, steps=isteps,
+                                       sampler=greedy)
+                eff = ((time.perf_counter() - t1) * 1000.0
+                       / max(1, len(out[0])) / B)
+                best = eff if best is None else min(best, eff)
+            return best
+
+        log(f"integrity: timing watchdog overhead (B={B}, {isteps} steps)")
+        on_ms = _timed_batch(eng)
+        # second engine without the watchdog: rebuild params (the first
+        # Engine may have fused this frame's reference away)
+        if weights in ("q40", "q80"):
+            params2 = llama.device_random_quant_params(cfg, kind=weights,
+                                                       seed=0)
+        else:
+            params2 = llama.device_random_params(cfg, seed=0, mesh=mesh)
+        eng_off = Engine(cfg, params2, SamplerConfig(temperature=0.0),
+                         cache_dtype=cache_dtype, mesh=mesh,
+                         decode_chunk=bench_steps, numeric_checks=False)
+        del params2
+        off_ms = _timed_batch(eng_off)
+        overhead = (on_ms - off_ms) / off_ms * 100.0
+        log(f"watchdog overhead: on {on_ms:.4f} vs off {off_ms:.4f} "
+            f"ms/token effective = {overhead:+.2f}% (target < 1%)")
+
+        def _pool_run(e, fault_spec=None):
+            """Admit B sampled rows, drain, return (streams, finishes)."""
+            if fault_spec:
+                _faults.install(fault_spec)
+            try:
+                sess = e.batch_session(B, chunk=8)
+                slots = [sess.admit([1], steps=isteps,
+                                    sampler=SamplerConfig(temperature=0.8,
+                                                          seed=100 + i))
+                         for i in range(B)]
+                streams = {b: [] for b in slots}
+                fins = {}
+                while len(fins) < B:
+                    for b, burst in sess.step_chunk().items():
+                        streams[b].extend(burst)
+                        if sess.is_done(b) and b not in fins:
+                            fins[b] = sess.finish_reason(b)
+                            sess.release(b)
+                sess.close()
+            finally:
+                if fault_spec:
+                    _faults.clear()
+            return ([streams[b] for b in slots], [fins[b] for b in slots])
+
+        log("integrity: quarantine replay (clean, then logits:nan:row=1)")
+        clean_streams, clean_fins = _pool_run(eng)
+        pois_streams, pois_fins = _pool_run(eng, "logits:nan:row=1")
+        if pois_fins[1] != "error":
+            raise RuntimeError(
+                f"poisoned row finished {pois_fins[1]!r}, want 'error' "
+                f"(finishes: {pois_fins})")
+        diverged = [i for i in range(B)
+                    if i != 1 and pois_streams[i] != clean_streams[i]]
+        if diverged:
+            raise RuntimeError(
+                f"sibling rows {diverged} diverged from the clean run "
+                "under a row-1 poisoning — quarantine is not row-isolated")
+        log(f"quarantine replay: row 1 finished 'error' after "
+            f"{len(pois_streams[1])} tokens; {B - 1} sibling rows "
+            f"bit-identical (finishes: {pois_fins})")
+        return (on_ms,
+                f"{weights}-integrity-b{B}-overhead"
+                f"{overhead:.2f}pct{cfg_tag}")
+
     # BENCH_SPEC=K measures speculative decoding (prompt-lookup drafts of up
     # to K tokens, exact greedy): solo generate_spec, or — with BENCH_BATCH —
     # generate_batch_spec (draft_len+1 positions x B rows per weight pass).
@@ -642,7 +733,9 @@ def main() -> None:
     choice = os.environ.get("BENCH_MODEL", "")
     err_phase = ("prefill" if _prefill_count()
                  else "serve" if _env_count("BENCH_CONTINUOUS")
-                 else "faults" if _env_count("BENCH_FAULTS") else "decode")
+                 else "faults" if _env_count("BENCH_FAULTS")
+                 else "integrity" if _env_count("BENCH_INTEGRITY")
+                 else "decode")
     err_metric = {"tiny": "tinyllama_1.1b", "llama3": "llama3_8b",
                   "moe": "mixtral_lite", "grok": "grok1_lite",
                   "smoke": "smoke"}.get(
@@ -723,7 +816,8 @@ def main() -> None:
     choice = os.environ.get("BENCH_MODEL", "")
     if choice == "smoke" or (not choice and platform == "cpu"
                              and (_env_count("BENCH_CONTINUOUS")
-                                  or _env_count("BENCH_FAULTS"))):
+                                  or _env_count("BENCH_FAULTS")
+                                  or _env_count("BENCH_INTEGRITY"))):
         # the continuous-vs-static comparison measures SCHEDULING, so the
         # CPU default is a shape small enough to replay inside CI budgets
         name, cfg_dict = "smoke", SMOKE_SERVE
@@ -761,7 +855,9 @@ def main() -> None:
 
     phase = ("prefill" if _prefill_count()
              else "serve" if _env_count("BENCH_CONTINUOUS")
-             else "faults" if _env_count("BENCH_FAULTS") else "decode")
+             else "faults" if _env_count("BENCH_FAULTS")
+             else "integrity" if _env_count("BENCH_INTEGRITY")
+             else "decode")
     result = {
         "metric": f"{name}_{phase}_ms_per_token",
         "value": round(ms, 3),

@@ -23,6 +23,14 @@ import time
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="dllama_tpu")
     sub = p.add_subparsers(dest="mode", required=True)
+    # offline artifact check: no tokenizer, no engine, no device — reads the
+    # whole file once against the embedded integrity section (or, on a
+    # legacy file without one, proves the size/offset arithmetic only)
+    vp = sub.add_parser(
+        "verify", help="verify a .m weight file's integrity checksums")
+    vp.add_argument("--model", required=True)
+    vp.add_argument("--json", action="store_true",
+                    help="print the full verification report as JSON")
     for mode in ("inference", "generate", "chat", "serve", "worker"):
         sp = sub.add_parser(mode)
         if mode == "serve":  # the dllama-api surface (`src/apps/dllama-api`)
@@ -507,6 +515,55 @@ def run_worker(args) -> None:
         run_generate(args, show_stats=False)
 
 
+def run_verify(args) -> int:
+    """``verify`` mode: open + fully checksum a `.m` file, exit 0/1.
+
+    Three outcomes:
+    * structural rejection (truncated/hostile file) — the open itself
+      raises, we print the FormatError (which names the first bad tensor
+      and byte offset for truncation) and exit 1;
+    * checksum mismatch — the report names every failing tensor with its
+      byte offset and both CRCs, first corrupt tensor first; exit 1;
+    * clean — exit 0 (a legacy file without an integrity section passes
+      with the size/offset guarantee only, and says so).
+    """
+    import json as json_mod
+
+    from dllama_tpu.formats.spec import FormatError
+    from dllama_tpu.formats.weights import WeightFileReader
+
+    try:
+        with WeightFileReader(args.model) as reader:
+            report = reader.verify()
+    except FormatError as e:
+        if args.json:
+            print(json_mod.dumps(
+                {"path": args.model, "ok": False, "error": str(e)}))
+        else:
+            print(f"❌ {args.model}: {e}")
+        return 1
+    if args.json:
+        print(json_mod.dumps(report))
+        return 0 if report["ok"] else 1
+    if not report["has_integrity"]:
+        print(f"⚠️  {args.model}: no integrity section (legacy file) — "
+              f"size/offset layout of {report['tensors']} tensors "
+              f"({report['payload_bytes']} payload bytes) is consistent, "
+              "but payload bytes are UNVERIFIED")
+        return 0
+    if report["ok"]:
+        print(f"✅ {args.model}: {report['tensors']} tensors, "
+              f"{report['payload_bytes']} payload bytes, all checksums OK")
+        return 0
+    for f in report["failures"]:
+        print(f"❌ {args.model}: tensor {f['name']!r} corrupt at byte "
+              f"offset {f['offset']} ({f['nbytes']} bytes): stored "
+              f"crc32 {f['expected_crc32']}, "
+              f"computed {f['actual_crc32']}")
+    print(f"{len(report['failures'])} of {report['tensors']} tensors failed")
+    return 1
+
+
 def main(argv=None) -> None:
     # DLLAMA_PLATFORM=cpu|tpu forces the JAX backend via jax.config — unlike
     # the JAX_PLATFORMS env var this works even when a sitecustomize has
@@ -517,6 +574,9 @@ def main(argv=None) -> None:
 
         jax.config.update("jax_platforms", platform)
     args = build_parser().parse_args(argv)
+    if args.mode == "verify":
+        # pure host-side file check: no device, no distributed init
+        raise SystemExit(run_verify(args))
     maybe_init_distributed(args)
     if args.mode == "chat":
         run_chat(args)

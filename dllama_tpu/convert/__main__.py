@@ -6,7 +6,12 @@ Commands (reference tooling in parentheses):
   grok1 <folder> <floatType>             Grok-1 shards -> .m    (convert-grok-1.py)
   tokenizer-sp <model> <name>            SentencePiece -> .t    (convert-tokenizer-sentencepiece.py)
   tokenizer-llama3 <model> <name>        tiktoken ranks -> .t   (convert-tokenizer-llama3.py)
-  download <model>                       fetch prequantized     (download-model.py)
+  download <model> [--sha256 HEX]        fetch prequantized     (download-model.py)
+
+Weight converters append a trailing per-tensor crc32 integrity section to
+the `.m` file by default (old readers ignore it — tensors are addressed by
+offset from the header); pass ``--no-checksums`` to write the bare legacy
+layout.
 """
 
 from __future__ import annotations
@@ -16,6 +21,11 @@ import sys
 
 def main(argv=None) -> None:
     argv = list(sys.argv[1:] if argv is None else argv)
+    if "--no-checksums" in argv:
+        argv.remove("--no-checksums")
+        from dllama_tpu.formats import weights
+
+        weights.DEFAULT_WRITE_CHECKSUMS = False
     if not argv:
         print(__doc__)
         raise SystemExit(1)

@@ -6,11 +6,20 @@ launch script for the TPU CLI.
 Transfers are multi-GB, so a transient network error must not restart from
 byte zero: each fetch streams into ``<path>.part``, retries with exponential
 backoff + jitter, resumes with an HTTP ``Range`` request from wherever the
-partial file stopped, and only renames onto the final path once complete."""
+partial file stopped, and only renames onto the final path once complete.
+
+Integrity: a premature EOF used to look exactly like completion (``read()``
+returns empty either way) and would rename a torso into place. Now the final
+size is checked against the server's ``Content-Length``/``Content-Range``
+total before the rename — short reads resume on the next retry, an
+overshoot deletes the ``.part`` and fails — and an optional
+``expected_sha256`` (CLI ``--sha256``) verifies the full payload, deleting
+the ``.part`` on mismatch (corrupt bytes cannot be resumed)."""
 
 from __future__ import annotations
 
 import errno
+import hashlib
 import os
 import random
 import stat
@@ -49,12 +58,26 @@ ALIASES = {
 RETRYABLE_HTTP = (408, 429, 500, 502, 503, 504)
 
 
-def _fetch_once(url: str, part_path: str, chunk_size: int) -> None:
+def _expected_total(resp, offset: int) -> int:
+    """The server's claim of the FULL file size, from ``Content-Range``
+    (206: ``bytes start-end/total``) or ``Content-Length`` (200). -1 when
+    the server does not say (chunked 200, or a 206 with ``*`` total)."""
+    if resp.status == 206:
+        rng = resp.headers.get("Content-Range", "")
+        total = rng.rpartition("/")[2].strip()
+        return int(total) if total.isdigit() else -1
+    length = resp.headers.get("Content-Length")
+    return offset + int(length) if length and length.isdigit() else -1
+
+
+def _fetch_once(url: str, part_path: str, chunk_size: int) -> int:
     """One streaming attempt into ``part_path``, resuming with an HTTP
     ``Range`` request from the partial file's current size. Raises on any
     network/HTTP error (the caller owns retry policy); an HTTP 416 with
     bytes on disk means the file is already complete (resume offset == total
-    length) and returns cleanly."""
+    length) and returns cleanly. Returns the server-declared full size in
+    bytes (-1 when unknown) so the caller can verify the bytes on disk
+    before renaming — a premature EOF reads exactly like completion here."""
     offset = os.path.getsize(part_path) if os.path.exists(part_path) else 0
     req = urllib.request.Request(url)
     if offset > 0:
@@ -63,12 +86,13 @@ def _fetch_once(url: str, part_path: str, chunk_size: int) -> None:
         resp = urllib.request.urlopen(req, timeout=60)
     except urllib.error.HTTPError as e:
         if e.code == 416 and offset > 0:
-            return  # nothing left past our offset: the .part IS the file
+            return -1  # nothing left past our offset: the .part IS the file
         raise
     with resp:
         if offset > 0 and resp.status != 206:
             # server ignored the Range (some mirrors do): restart from zero
             offset = 0
+        total = _expected_total(resp, offset)
         mode = "ab" if offset > 0 else "wb"
         done = offset
         with open(part_path, mode) as f:
@@ -81,13 +105,39 @@ def _fetch_once(url: str, part_path: str, chunk_size: int) -> None:
                 if (done // (8192 * 1024)) != ((done - len(chunk)) // (8192 * 1024)):
                     sys.stdout.write(f"\rDownloaded {done // 1024} kB")
                     sys.stdout.flush()
+    return total
+
+
+class ShortDownload(ConnectionError):
+    """Fewer bytes on disk than the server's declared total: a premature
+    EOF the stream loop cannot tell from completion. ConnectionError so the
+    retry loop treats it as the transient it is — the next attempt resumes
+    from the bytes already in the ``.part``."""
+
+
+def _sha256_file(path: str, chunk_size: int = 1 << 20) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        while True:
+            chunk = f.read(chunk_size)
+            if not chunk:
+                break
+            h.update(chunk)
+    return h.hexdigest()
 
 
 def download_file(url: str, path: str, retries: int = 5,
-                  backoff_s: float = 1.0, chunk_size: int = 1 << 20) -> None:
+                  backoff_s: float = 1.0, chunk_size: int = 1 << 20,
+                  expected_sha256: str = None) -> None:
     """Fetch ``url`` to ``path``: stream into ``path.part``, retry transient
     failures with exponential backoff + jitter (resuming via Range from the
-    bytes already on disk), atomically rename into place when complete."""
+    bytes already on disk), atomically rename into place when complete.
+
+    Before the rename, the bytes on disk are verified against the server's
+    declared size — a short read retries (resuming), an overshoot deletes
+    the ``.part`` and raises — and against ``expected_sha256`` when given
+    (mismatch deletes the ``.part`` and raises: corrupt bytes cannot be
+    resumed, only refetched)."""
     print(f"📄 {url}")
     part_path = path + ".part"
     last_err = None
@@ -99,7 +149,24 @@ def download_file(url: str, path: str, retries: int = 5,
             sys.stdout.flush()
             time.sleep(delay)
         try:
-            _fetch_once(url, part_path, chunk_size)
+            total = _fetch_once(url, part_path, chunk_size)
+            size = os.path.getsize(part_path)
+            if total >= 0 and size != total:
+                if size < total:
+                    raise ShortDownload(
+                        f"got {size} of {total} bytes (premature EOF)")
+                os.remove(part_path)
+                raise RuntimeError(
+                    f"download corrupt: {url} produced {size} bytes but the "
+                    f"server declared {total} — partial file deleted")
+            if expected_sha256 is not None:
+                actual = _sha256_file(part_path, chunk_size)
+                if actual != expected_sha256.lower():
+                    os.remove(part_path)
+                    raise RuntimeError(
+                        f"download corrupt: {url} sha256 {actual} != "
+                        f"expected {expected_sha256.lower()} — partial "
+                        "file deleted")
             os.replace(part_path, path)  # atomic: readers never see a torso
             sys.stdout.write(" ✅\n")
             return
@@ -119,7 +186,10 @@ def download_file(url: str, path: str, retries: int = 5,
         f"partial bytes kept at {part_path} — rerun to resume")
 
 
-def download_model(name: str, dest_root: str = "models") -> tuple:
+def download_model(name: str, dest_root: str = "models",
+                   expected_sha256: str = None) -> tuple:
+    """Fetch a published model + tokenizer pair. ``expected_sha256``
+    applies to the MODEL file (the multi-GB artifact worth pinning)."""
     name = ALIASES.get(name.replace("-", "_"), name.replace("-", "_"))
     if name not in MODELS:
         raise SystemExit(
@@ -130,19 +200,28 @@ def download_model(name: str, dest_root: str = "models") -> tuple:
     model_path = os.path.join(dir_path, f"dllama_model_{name}.m")
     tok_path = os.path.join(dir_path, f"dllama_tokenizer_{name}.t")
     model_url, tok_url = MODELS[name]
-    download_file(model_url, model_path)
+    download_file(model_url, model_path, expected_sha256=expected_sha256)
     download_file(tok_url, tok_path)
     return model_path, tok_path
 
 
 def main(argv: list) -> None:
     if not argv:
-        print("Usage: python -m dllama_tpu.convert download <model>")
+        print("Usage: python -m dllama_tpu.convert download <model> "
+              "[--sha256 HEX]")
         print("Available models:")
         for m in MODELS:
             print(f"  {m}")
         raise SystemExit(1)
-    model_path, tok_path = download_model(argv[0])
+    expected_sha256 = None
+    if "--sha256" in argv:
+        i = argv.index("--sha256")
+        if i + 1 >= len(argv):
+            raise SystemExit("--sha256 needs a hex digest argument")
+        expected_sha256 = argv[i + 1]
+        argv = argv[:i] + argv[i + 2:]
+    model_path, tok_path = download_model(
+        argv[0], expected_sha256=expected_sha256)
     command = (
         f"python -m dllama_tpu.cli inference --model {model_path} "
         f"--tokenizer {tok_path} --steps 64 --prompt \"Hello world\""

@@ -15,13 +15,24 @@ Spec grammar (sites separated by ``;``)::
 
 * ``site`` — where the hook fires. The wired seams are ``admit`` and
   ``step_chunk`` (BatchSession), ``prefill`` (Engine), ``stream`` (the SSE
-  writer), and ``scheduler`` (top of every server scheduler window — the
-  supervisor-restart drill).
-* ``action`` — ``raise`` (throw :class:`FaultInjected`) or ``slow`` (sleep
-  ``delay_ms``, default 50).
+  writer), ``scheduler`` (top of every server scheduler window — the
+  supervisor-restart drill), ``weights_open`` / ``weights_read``
+  (WeightFileReader — the artifact-integrity drills), and ``logits``
+  (every decode dispatch — the numeric-health drill).
+* ``action`` — ``raise`` (throw :class:`FaultInjected`), ``slow`` (sleep
+  ``delay_ms``, default 50), or a *data* action the seam itself interprets:
+  ``truncate`` (weights_open: pretend the file is ``drop`` bytes short,
+  default 1), ``bitflip`` (weights_read: flip one bit of tensor byte
+  ``byte``, default 0, before the checksum check), ``nan`` (logits: poison
+  decode row ``row``, default 0, with NaN before the watchdog check).
 * options — ``every=N`` fire on every Nth call (default every call),
   ``after=N`` skip the first N calls, ``times=N`` fire at most N times,
-  ``delay_ms=X`` for ``slow``.
+  ``delay_ms=X`` for ``slow``, ``row=N`` / ``byte=N`` / ``drop=N`` for the
+  data actions.
+
+``raise``/``slow`` act inside :func:`fire`; a data action that fires is
+*returned* to the caller as ``{"action": ..., "row": ..., "byte": ...,
+"drop": ...}`` (first match wins) and the seam applies the corruption.
 
 The hot-path cost when no plan is installed is one global ``is None`` check.
 """
@@ -32,8 +43,9 @@ import os
 import threading
 import time
 
-SITES = ("admit", "step_chunk", "prefill", "stream", "scheduler")
-ACTIONS = ("raise", "slow")
+SITES = ("admit", "step_chunk", "prefill", "stream", "scheduler",
+         "weights_open", "weights_read", "logits")
+ACTIONS = ("raise", "slow", "truncate", "bitflip", "nan")
 
 
 class FaultInjected(RuntimeError):
@@ -50,10 +62,11 @@ class _Point:
     """One ``site:action`` rule with its deterministic firing schedule."""
 
     __slots__ = ("site", "action", "every", "after", "times", "delay_ms",
-                 "calls", "fired")
+                 "row", "byte", "drop", "calls", "fired")
 
     def __init__(self, site: str, action: str, every: int = 1, after: int = 0,
-                 times: int = 0, delay_ms: float = 50.0):
+                 times: int = 0, delay_ms: float = 50.0, row: int = 0,
+                 byte: int = 0, drop: int = 1):
         if site not in SITES:
             raise ValueError(f"unknown fault site {site!r} (known: {SITES})")
         if action not in ACTIONS:
@@ -65,6 +78,7 @@ class _Point:
         self.every, self.after = every, after
         self.times = times  # 0 = unlimited
         self.delay_ms = delay_ms
+        self.row, self.byte, self.drop = row, byte, drop
         self.calls = 0  # calls seen at this site
         self.fired = 0  # times this point actually fired
 
@@ -108,24 +122,35 @@ class FaultPlan:
                             f"bad fault option {kv!r} in {part!r}")
                     k, v = kv.split("=", 1)
                     k = k.strip()
-                    if k not in ("every", "after", "times", "delay_ms"):
+                    if k not in ("every", "after", "times", "delay_ms",
+                                 "row", "byte", "drop"):
                         raise ValueError(f"unknown fault option {k!r}")
                     opts[k] = float(v) if k == "delay_ms" else int(v)
             points.append(_Point(site, action, **opts))
         return cls(points)
 
-    def fire(self, site: str) -> None:
-        """Run every matching point's decision for one call at ``site``."""
+    def fire(self, site: str) -> dict | None:
+        """Run every matching point's decision for one call at ``site``.
+
+        ``raise`` points raise, ``slow`` points sleep; the first *data* point
+        (truncate/bitflip/nan) that fires is returned for the seam to apply.
+        """
         sleep_ms = 0.0
+        data: dict | None = None
         with self._lock:
             for p in self._points:
                 if p.site != site or not p.should_fire():
                     continue
                 if p.action == "raise":
                     raise FaultInjected(site)
-                sleep_ms = max(sleep_ms, p.delay_ms)
+                if p.action == "slow":
+                    sleep_ms = max(sleep_ms, p.delay_ms)
+                elif data is None:
+                    data = {"action": p.action, "row": p.row,
+                            "byte": p.byte, "drop": p.drop}
         if sleep_ms > 0:
             time.sleep(sleep_ms / 1000.0)
+        return data
 
     def counters(self) -> dict:
         """{site: (calls, fired)} — test/bench introspection."""
@@ -165,8 +190,10 @@ def active() -> FaultPlan:
     return _plan
 
 
-def fire(site: str) -> None:
-    """The seam hook: no-op unless a plan names ``site``."""
+def fire(site: str) -> dict | None:
+    """The seam hook: no-op unless a plan names ``site``. Returns the first
+    matching *data* action's parameters (see :meth:`FaultPlan.fire`)."""
     plan = _plan if _env_loaded else active()
     if plan is not None:
-        plan.fire(site)
+        return plan.fire(site)
+    return None

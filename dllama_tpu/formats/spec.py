@@ -25,6 +25,14 @@ MAGIC_KV = 0x0A00ABCD
 MAGIC_OLD_LLAMA = 0xABCD00
 MAGIC_OLD_GROK1 = 0xABCD01
 
+# headers are ~120 bytes in practice; anything past this is a hostile/corrupt file
+MAX_HEADER_SIZE = 1 << 16
+
+
+class FormatError(ValueError):
+    """A malformed, hostile, or corrupt `.m` file. ValueError subclass so
+    callers that predate the integrity work keep catching it."""
+
 
 class ArchType(IntEnum):
     LLAMA = 0xABCD00
@@ -87,18 +95,55 @@ class ModelSpec:
         return self.n_experts > 0
 
     def validate(self) -> None:
-        assert self.dim % self.n_heads == 0
-        assert (self.dim * self.n_kv_heads) % self.n_heads == 0
-        assert self.n_heads % self.n_kv_heads == 0
-        if self.is_moe:
-            assert 0 < self.n_active_experts <= self.n_experts
+        for field in ("dim", "hidden_dim", "n_layers", "n_heads", "n_kv_heads",
+                      "vocab_size", "seq_len"):
+            v = getattr(self, field)
+            if v <= 0:
+                raise FormatError(f"bad header field {field}: {v} (must be positive)")
+        if self.n_experts < 0 or self.n_active_experts < 0:
+            raise FormatError(
+                f"bad header field nExperts/nActiveExperts: "
+                f"{self.n_experts}/{self.n_active_experts}")
+        if self.dim % self.n_heads != 0:
+            raise FormatError(
+                f"bad header field dim: {self.dim} not divisible by nHeads={self.n_heads}")
+        if (self.dim * self.n_kv_heads) % self.n_heads != 0:
+            raise FormatError(
+                f"bad header field nKvHeads: kv_dim not integral for "
+                f"dim={self.dim}, nHeads={self.n_heads}, nKvHeads={self.n_kv_heads}")
+        if self.n_heads % self.n_kv_heads != 0:
+            raise FormatError(
+                f"bad header field nKvHeads: {self.n_kv_heads} does not divide "
+                f"nHeads={self.n_heads}")
+        if self.is_moe and not 0 < self.n_active_experts <= self.n_experts:
+            raise FormatError(
+                f"bad header field nActiveExperts: {self.n_active_experts} "
+                f"(nExperts={self.n_experts})")
+        if self.weights_float_type not in (blocks.F32, blocks.F16, blocks.Q40, blocks.Q80):
+            raise FormatError(
+                f"bad header field weightsFloatType: {self.weights_float_type} "
+                f"(known: F32=0, F16=1, Q40=2, Q80=3)")
 
 
-def parse_header(data: bytes) -> ModelSpec:
-    """Parse a `.m` header from the first bytes of the file."""
-    (magic,) = struct.unpack_from("<i", data, 0)
+def parse_header(data, file_size: int | None = None) -> ModelSpec:
+    """Parse a `.m` header from the first bytes of the file.
+
+    ``data`` is any buffer covering at least the header. Hostile or corrupt
+    headers raise :class:`FormatError` naming the offending field — never a
+    bare ``struct.error`` and never a silently-garbage spec. ``file_size``
+    (when known) lets the ``headerSize``-past-EOF check run.
+    """
+    try:
+        (magic,) = struct.unpack_from("<i", data, 0)
+    except struct.error:
+        raise FormatError(f"file too short for a header magic ({len(data)} bytes)") from None
     if magic in (MAGIC_OLD_LLAMA, MAGIC_OLD_GROK1):
-        fields = struct.unpack_from("<9i", data, 4)
+        try:
+            fields = struct.unpack_from("<9i", data, 4)
+        except struct.error:
+            raise FormatError(
+                f"header truncated: old-format header needs 40 bytes, have {len(data)}"
+            ) from None
         dim, hidden_dim, n_layers, n_heads, n_kv_heads, n_experts, n_active, vocab, seq = fields
         spec = ModelSpec(
             arch=ArchType(magic),
@@ -114,12 +159,54 @@ def parse_header(data: bytes) -> ModelSpec:
             header_size=4 + 9 * 4,
         )
     elif magic == MAGIC_KV:
-        (header_size,) = struct.unpack_from("<i", data, 4)
+        try:
+            (header_size,) = struct.unpack_from("<i", data, 4)
+        except struct.error:
+            raise FormatError("header truncated: missing headerSize") from None
+        if header_size < 16 or header_size > MAX_HEADER_SIZE:
+            raise FormatError(
+                f"bad header field headerSize: {header_size} "
+                f"(want 16..{MAX_HEADER_SIZE})")
+        if (header_size - 8) % 8 != 0:
+            raise FormatError(
+                f"bad header field headerSize: {header_size} does not hold "
+                f"whole (key, value) int32 pairs")
+        if file_size is not None and header_size > file_size:
+            raise FormatError(
+                f"bad header field headerSize: {header_size} runs past "
+                f"end of file ({file_size} bytes)")
+        if header_size > len(data):
+            raise FormatError(
+                f"header truncated: headerSize={header_size} but only "
+                f"{len(data)} bytes available")
         n_kv_ints = (header_size - 8) // 4
         values = struct.unpack_from(f"<{n_kv_ints}i", data, 8)
-        kv = {HeaderKey(values[i]): values[i + 1] for i in range(0, n_kv_ints, 2)}
+        try:
+            kv = {HeaderKey(values[i]): values[i + 1] for i in range(0, n_kv_ints, 2)}
+        except ValueError:
+            bad = [values[i] for i in range(0, n_kv_ints, 2)
+                   if values[i] not in HeaderKey._value2member_map_]
+            raise FormatError(f"unknown header key(s): {bad}") from None
+        try:
+            required = {}
+            for key in (HeaderKey.ARCH_TYPE, HeaderKey.DIM, HeaderKey.HIDDEN_DIM,
+                        HeaderKey.N_LAYERS, HeaderKey.N_HEADS,
+                        HeaderKey.VOCAB_SIZE, HeaderKey.SEQ_LEN):
+                required[key] = kv[key]
+        except KeyError as e:
+            raise FormatError(f"missing required header field {e.args[0].name}") from None
+        try:
+            arch = ArchType(kv[HeaderKey.ARCH_TYPE])
+        except ValueError:
+            raise FormatError(
+                f"bad header field archType: {kv[HeaderKey.ARCH_TYPE]:#x}") from None
+        try:
+            hidden_act = HiddenAct(kv.get(HeaderKey.HIDDEN_ACT, HiddenAct.SILU))
+        except ValueError:
+            raise FormatError(
+                f"bad header field hiddenAct: {kv[HeaderKey.HIDDEN_ACT]}") from None
         spec = ModelSpec(
-            arch=ArchType(kv[HeaderKey.ARCH_TYPE]),
+            arch=arch,
             dim=kv[HeaderKey.DIM],
             hidden_dim=kv[HeaderKey.HIDDEN_DIM],
             n_layers=kv[HeaderKey.N_LAYERS],
@@ -129,7 +216,7 @@ def parse_header(data: bytes) -> ModelSpec:
             n_active_experts=kv.get(HeaderKey.N_ACTIVE_EXPERTS, 0),
             vocab_size=kv[HeaderKey.VOCAB_SIZE],
             seq_len=kv[HeaderKey.SEQ_LEN],
-            hidden_act=HiddenAct(kv.get(HeaderKey.HIDDEN_ACT, HiddenAct.SILU)),
+            hidden_act=hidden_act,
             # rope_theta is stored as a plain int in the reference format
             # (`/root/reference/src/transformer.cpp:240`)
             rope_theta=float(kv.get(HeaderKey.ROPE_THETA, 10000)),
@@ -138,7 +225,7 @@ def parse_header(data: bytes) -> ModelSpec:
             header_size=8 + n_kv_ints * 4,
         )
     else:
-        raise ValueError(f"unsupported model file magic 0x{magic & 0xFFFFFFFF:X}")
+        raise FormatError(f"unsupported model file magic 0x{magic & 0xFFFFFFFF:X}")
     spec.validate()
     return spec
 

@@ -35,18 +35,91 @@ computes ``y[d] = sum_n w[d,n] * x[n]``, `/root/reference/src/funcs.cpp:157-197`
 Reading is mmap-backed and lazy so a 70B file never materializes twice in host RAM;
 callers can also restrict to a shard's row range (tensor-parallel loading) via the
 ``rows`` argument of :func:`read_tensor_rows`.
+
+**Integrity section.** :class:`ModelWriter` appends (by default) a trailing
+section after the last tensor::
+
+    b"DLCK" | u32 version=1 | u32 n_tensors | u64 payload_size
+            | u32 crc32 per tensor (plan order) | u32 crc32 of the section itself
+
+The reference loader reads tensors sequentially by offset and never checks the
+file size, so checksummed files stay loadable there; readers that predate the
+section simply see trailing bytes. This reader validates sizes/offsets at open
+(truncation is caught before any mmap read, naming the first cut tensor) and
+CRC-checks each tensor lazily on first read (disable with
+``DLLAMA_WEIGHTS_VERIFY=0``). :meth:`WeightFileReader.verify` checks the whole
+file — that is what ``python -m dllama_tpu.cli verify`` drives.
 """
 
 from __future__ import annotations
 
 import dataclasses
 import mmap
+import os
+import struct
+import zlib
 from typing import Iterator
 
 import numpy as np
 
-from dllama_tpu.formats.spec import ArchType, ModelSpec, parse_header, write_header
+from dllama_tpu import faults
+from dllama_tpu.formats.spec import (
+    MAX_HEADER_SIZE,
+    ArchType,
+    FormatError,
+    ModelSpec,
+    parse_header,
+    write_header,
+)
 from dllama_tpu.quants import blocks
+
+INTEGRITY_TAG = b"DLCK"
+INTEGRITY_VERSION = 1
+_SEC_FIXED = struct.calcsize("<4sIIQ")  # tag + version + n_tensors + payload_size
+
+
+class ChecksumError(FormatError):
+    """A tensor's bytes do not match the CRC recorded at write time."""
+
+    def __init__(self, path: str, name: str, offset: int, expected: int, actual: int):
+        super().__init__(
+            f"checksum mismatch in {path}: tensor {name!r} at byte offset {offset} "
+            f"(crc32 {actual:#010x}, recorded {expected:#010x}) — file is corrupt")
+        self.tensor_name = name
+        self.offset = offset
+
+
+def build_integrity_section(crcs: list[int], payload_size: int) -> bytes:
+    """Serialize the trailing integrity section (self-checksummed)."""
+    sec = struct.pack(f"<4sIIQ{len(crcs)}I", INTEGRITY_TAG, INTEGRITY_VERSION,
+                      len(crcs), payload_size, *crcs)
+    return sec + struct.pack("<I", zlib.crc32(sec))
+
+
+def parse_integrity_section(extra: bytes, n_tensors: int, payload_size: int) -> list[int]:
+    """Parse + validate trailing bytes as an integrity section, returning the
+    per-tensor CRC table. Raises FormatError on any inconsistency."""
+    if len(extra) < _SEC_FIXED + 4 or bytes(extra[:4]) != INTEGRITY_TAG:
+        raise FormatError(
+            f"{len(extra)} trailing bytes after the last tensor are not an "
+            f"integrity section (expected {INTEGRITY_TAG!r} tag)")
+    _, version, n, payload = struct.unpack_from("<4sIIQ", extra, 0)
+    if version != INTEGRITY_VERSION:
+        raise FormatError(f"unsupported integrity section version {version}")
+    if n != n_tensors:
+        raise FormatError(
+            f"integrity section covers {n} tensors, plan has {n_tensors}")
+    if payload != payload_size:
+        raise FormatError(
+            f"integrity section records payload of {payload} bytes, "
+            f"tensor plan ends at {payload_size}")
+    if len(extra) != _SEC_FIXED + 4 * n + 4:
+        raise FormatError(
+            f"integrity section is {len(extra)} bytes, want {_SEC_FIXED + 4 * n + 4}")
+    (self_crc,) = struct.unpack_from("<I", extra, _SEC_FIXED + 4 * n)
+    if zlib.crc32(bytes(extra[: _SEC_FIXED + 4 * n])) != self_crc:
+        raise FormatError("integrity section fails its own checksum")
+    return list(struct.unpack_from(f"<{n}I", extra, _SEC_FIXED))
 
 
 @dataclasses.dataclass(frozen=True)
@@ -106,21 +179,58 @@ def tensor_plan(spec: ModelSpec) -> list[TensorEntry]:
 
 
 class WeightFileReader:
-    """mmap-backed reader for `.m` files."""
+    """mmap-backed reader for `.m` files with strict open-time validation and
+    lazy per-tensor CRC verification (when the file carries an integrity
+    section)."""
 
     def __init__(self, path: str):
         self.path = path
         self._file = open(path, "rb")
-        self._mm = mmap.mmap(self._file.fileno(), 0, access=mmap.ACCESS_READ)
-        self._buf = np.frombuffer(self._mm, dtype=np.uint8)
-        self.spec = parse_header(self._mm[: 4096])
-        self.entries = tensor_plan(self.spec)
-        end = self.entries[-1].offset + self.entries[-1].nbytes
-        if end != len(self._buf):
-            raise ValueError(
-                f"model file size mismatch: plan ends at {end}, file has {len(self._buf)} bytes"
-            )
-        self._by_name = {e.name: e for e in self.entries}
+        try:
+            try:
+                self._mm = mmap.mmap(self._file.fileno(), 0, access=mmap.ACCESS_READ)
+            except ValueError:
+                raise FormatError(f"empty weight file: {path}") from None
+        except BaseException:
+            self._file.close()
+            raise
+        try:
+            self._buf = np.frombuffer(self._mm, dtype=np.uint8)
+            fv = faults.fire("weights_open")
+            if fv is not None and fv["action"] == "truncate":
+                self._buf = self._buf[: max(0, len(self._buf) - max(1, fv["drop"]))]
+            # a bytes COPY of the header region: if parse_header raises, its
+            # traceback (held by the caller) must not pin a view of the mmap
+            # and turn the cleanup close() into a BufferError
+            self.spec = parse_header(bytes(self._buf[:MAX_HEADER_SIZE]),
+                                     file_size=len(self._buf))
+            self.entries = tensor_plan(self.spec)
+            end = self.entries[-1].offset + self.entries[-1].nbytes
+            if end > len(self._buf):
+                bad = next(e for e in self.entries
+                           if e.offset + e.nbytes > len(self._buf))
+                raise FormatError(
+                    f"truncated model file {path}: {len(self._buf)} bytes on disk "
+                    f"but tensor {bad.name!r} spans bytes "
+                    f"[{bad.offset}, {bad.offset + bad.nbytes}) — file ends "
+                    f"{end - len(self._buf)} bytes early")
+            self.tensor_crcs: list[int] | None = None
+            if end < len(self._buf):
+                self.tensor_crcs = parse_integrity_section(
+                    self._buf[end:].tobytes(), len(self.entries), end)
+            self._by_name = {e.name: e for e in self.entries}
+            self._index = {e.name: i for i, e in enumerate(self.entries)}
+            self._verified: set = set()
+            self._lazy_verify = (
+                self.tensor_crcs is not None
+                and os.environ.get("DLLAMA_WEIGHTS_VERIFY", "1") != "0")
+        except BaseException:
+            self.close()
+            raise
+
+    @property
+    def has_integrity(self) -> bool:
+        return self.tensor_crcs is not None
 
     def close(self) -> None:
         self._buf = None  # release the exported mmap buffer before closing it
@@ -136,27 +246,56 @@ class WeightFileReader:
     def entry(self, name: str) -> TensorEntry:
         return self._by_name[name]
 
+    def _raw_view(self, e: TensorEntry) -> np.ndarray:
+        """The tensor's file bytes, with the ``weights_read:bitflip`` fault
+        seam applied (on a copy) so corruption drills exercise detection."""
+        raw = self._buf[e.offset : e.offset + e.nbytes]
+        fv = faults.fire("weights_read")
+        if fv is not None and fv["action"] == "bitflip":
+            raw = raw.copy()
+            raw[min(max(0, fv["byte"]), e.nbytes - 1)] ^= 1
+        return raw
+
+    def _checked_raw(self, e: TensorEntry) -> np.ndarray:
+        """Raw bytes after the lazy first-read CRC check (whole tensor, even
+        when the caller only wants a row band — integrity beats shard
+        locality, and it is a read+crc32 with no dequantization)."""
+        raw = self._raw_view(e)
+        if self._lazy_verify and e.name not in self._verified:
+            expected = self.tensor_crcs[self._index[e.name]]
+            actual = zlib.crc32(raw)
+            if actual != expected:
+                # drop the mmap view before raising: a caller holding the
+                # exception (and so this frame) must not pin the buffer and
+                # turn a later close() into a BufferError
+                del raw
+                raise ChecksumError(self.path, e.name, e.offset, expected, actual)
+            self._verified.add(e.name)
+        return raw
+
     def read_tensor(self, name: str, dtype=np.float32) -> np.ndarray:
         """Full tensor, dequantized to ``dtype``, shaped ``[d, n]`` (or ``[n]``)."""
         e = self._by_name[name]
-        raw = self._buf[e.offset : e.offset + e.nbytes]
+        raw = self._checked_raw(e)
         x = blocks.decode_tensor(raw, e.float_type, e.d * e.n)
         return x.reshape(e.shape).astype(dtype, copy=False)
 
     def read_raw(self, name: str) -> np.ndarray:
         """The tensor's undecoded file bytes (uint8 view into the mmap) —
         the input to lossless quantized repacking (ops.qmatmul.repack_q40)."""
-        e = self._by_name[name]
-        return self._buf[e.offset : e.offset + e.nbytes]
+        return self._checked_raw(self._by_name[name])
 
     def read_tensor_rows(self, name: str, rows: slice, dtype=np.float32) -> np.ndarray:
         """Dequantize only a row band — the unit of tensor-parallel sharded loading.
 
         Equivalent to the reference ``RowMatmulSlice.splitWeights`` row-band copy
         (`/root/reference/src/transformer.cpp:25-42`) but done lazily at load time so
-        each host only ever touches its own shard's bytes.
+        each host only ever touches its own shard's bytes. The first touch of a
+        checksummed tensor CRC-verifies the whole tensor.
         """
         e = self._by_name[name]
+        if self._lazy_verify and e.name not in self._verified:
+            self._checked_raw(e)
         start, stop, step = rows.indices(e.d)
         assert step == 1
         rb = blocks.row_bytes(e.float_type, e.n)
@@ -164,22 +303,63 @@ class WeightFileReader:
         x = blocks.decode_tensor(raw, e.float_type, (stop - start) * e.n)
         return x.reshape(stop - start, e.n).astype(dtype, copy=False)
 
+    def verify(self) -> dict:
+        """Check every tensor against the integrity section (no dequantization).
+
+        Returns a report dict; ``failures`` lists corrupt tensors in plan order
+        (so the first element is the first bad tensor by byte offset). Files
+        without an integrity section pass with ``has_integrity: False`` —
+        open-time size/offset validation is then the only guarantee.
+        """
+        failures = []
+        for i, e in enumerate(self.entries):
+            if self.tensor_crcs is None:
+                break
+            actual = zlib.crc32(self._raw_view(e))
+            expected = self.tensor_crcs[i]
+            if actual != expected:
+                failures.append({
+                    "name": e.name, "offset": e.offset, "nbytes": e.nbytes,
+                    "expected_crc32": f"{expected:#010x}",
+                    "actual_crc32": f"{actual:#010x}",
+                })
+            else:
+                self._verified.add(e.name)
+        return {
+            "path": self.path,
+            "ok": not failures,
+            "has_integrity": self.has_integrity,
+            "tensors": len(self.entries),
+            "payload_bytes": self.entries[-1].offset + self.entries[-1].nbytes,
+            "failures": failures,
+        }
+
     def iter_tensors(self, dtype=np.float32) -> Iterator[tuple[str, np.ndarray]]:
         for e in self.entries:
             yield e.name, self.read_tensor(e.name, dtype)
+
+
+#: process-wide default for ModelWriter(checksums=None); the converter CLI's
+#: ``--no-checksums`` flag flips it.
+DEFAULT_WRITE_CHECKSUMS = True
 
 
 class ModelWriter:
     """Streaming `.m` writer: header first, then tensors appended strictly in
     plan order — a 70B conversion never holds more than one tensor in RAM
     (the reference converters stream the same way,
-    `/root/reference/converter/convert-hf.py:92-125`)."""
+    `/root/reference/converter/convert-hf.py:92-125`). Unless ``checksums``
+    is disabled, per-tensor CRC32s are accumulated as tensors stream through
+    and a trailing integrity section is appended on close (the reference
+    loader ignores trailing bytes, so such files stay reference-loadable)."""
 
-    def __init__(self, path: str, spec: ModelSpec):
+    def __init__(self, path: str, spec: ModelSpec, checksums: bool | None = None):
         header = write_header(spec)
         self.spec = dataclasses.replace(spec, header_size=len(header))
         self.plan = tensor_plan(self.spec)
         self._i = 0
+        self._checksums = DEFAULT_WRITE_CHECKSUMS if checksums is None else checksums
+        self._crcs: list[int] = []
         self._f = open(path, "wb")
         self._f.write(header)
 
@@ -190,7 +370,10 @@ class ModelWriter:
         x = np.asarray(x, dtype=np.float32)
         if x.size != e.d * e.n:
             raise ValueError(f"{e.name}: expected {e.d}x{e.n} values, got shape {x.shape}")
-        self._f.write(blocks.encode_tensor(x.reshape(-1), e.float_type))
+        raw = blocks.encode_tensor(x.reshape(-1), e.float_type)
+        self._f.write(raw)
+        if self._checksums:
+            self._crcs.append(zlib.crc32(raw))
         self._i += 1
 
     def close(self) -> None:
@@ -198,6 +381,9 @@ class ModelWriter:
             missing = self.plan[self._i].name
             self._f.close()
             raise ValueError(f"model file incomplete: next expected tensor is {missing!r}")
+        if self._checksums:
+            payload = self.plan[-1].offset + self.plan[-1].nbytes
+            self._f.write(build_integrity_section(self._crcs, payload))
         self._f.close()
 
     def __enter__(self):

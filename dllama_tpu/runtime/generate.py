@@ -33,6 +33,19 @@ PREFILL_BUCKETS = (8, 16, 32, 64, 128, 256, 512, 1024, 2048, 4096, 8192)
 DECODE_CHUNK = 64  # fused-loop chunk size: one compile serves any steps count
 
 
+class NumericHealthError(RuntimeError):
+    """The decode-step watchdog saw non-finite logits (NaN/Inf from corrupt
+    weights, a bad kernel, or hardware error). Solo decode fails fast with
+    this; a BatchSession quarantines the poisoned row instead (finish reason
+    ``"error"``) and the server maps it to a 500 / ``finish_reason:"error"``
+    SSE event."""
+
+    def __init__(self, where: str):
+        super().__init__(f"non-finite logits detected {where}; "
+                         f"output is unusable from this point")
+        self.where = where
+
+
 def prefill_bucket(n: int) -> int:
     for b in PREFILL_BUCKETS:
         if n <= b:
@@ -96,16 +109,24 @@ class Engine:
         fuse_quant: bool = True,
         tp_compress: bool = False,
         decode_chunk: int = DECODE_CHUNK,
+        numeric_checks: bool = True,
     ):
         """``mesh``: a 1-D ``tp`` Mesh (see parallel.mesh.tp_mesh) to run
         tensor-parallel — params are placed with the reference's row/col
         slicing as NamedShardings and XLA emits the AllReduces the reference
-        hand-rolls as broadcast+gather+root-sum."""
+        hand-rolls as broadcast+gather+root-sum.
+
+        ``numeric_checks``: fuse the numeric-health watchdog — an
+        ``isfinite(logits)`` per-row flag — into every decode step (plus the
+        ``logits:nan`` fault-injection seam). Elementwise over [B, vocab],
+        dwarfed by the [vocab, dim] classifier matmul; BENCH_INTEGRITY
+        measures the overhead (<1% target). Off only for that A/B."""
         if decode_chunk < 1:
             raise ValueError(f"decode_chunk must be >= 1, got {decode_chunk}")
         self.cfg = cfg
         self.sampler_cfg = sampler_cfg
         self.mesh = mesh
+        self.numeric_checks = numeric_checks
         self._tp_compress = tp_compress
         # fused-loop chunk: one host round trip per chunk of tokens. Bigger
         # chunks amortize dispatch/sync latency (dominant on tunneled or
@@ -210,11 +231,26 @@ class Engine:
         # tensor-parallel into full replication with zero collectives.
         # temperature/topp are traced scalars (see sampler.sample_dynamic): one
         # compile serves every per-request sampler setting.
+        def _health(logits, poison, ok):
+            """Watchdog + fault seam, fused into every decode program: poison
+            FIRST (injection must look like a real numeric blowup to the
+            check), then fold the row's isfinite flag into ``ok``. Compiles
+            to elementwise+reduce over the logits the program already holds."""
+            if not numeric_checks:
+                return logits, ok
+            nan = jnp.asarray(jnp.nan, logits.dtype)
+            if logits.ndim == 2 and poison.ndim == 1:  # [B, vocab] rows
+                logits = jnp.where(poison[:, None], nan, logits)
+                return logits, ok & jnp.all(jnp.isfinite(logits), axis=-1)
+            logits = jnp.where(poison, nan, logits)
+            return logits, ok & jnp.all(jnp.isfinite(logits))
+
         @partial(jax.jit, donate_argnums=(2,))
-        def _decode_step(params, rope, cache, token, pos, key, temp, topp):
+        def _decode_step(params, rope, cache, token, pos, key, temp, topp, poison):
             logits, cache = fwd(cfg, params, rope, token[None], cache, pos)
+            logits, ok = _health(logits, poison, jnp.bool_(True))
             nxt = sample_dynamic(logits[0], key, temp, topp)
-            return nxt, cache
+            return nxt, ok, cache
 
         @partial(jax.jit, donate_argnums=(2,))
         def _prefill(params, rope, cache, padded_tokens, n_tokens, pos):
@@ -230,27 +266,30 @@ class Engine:
             return jax.lax.dynamic_index_in_dim(logits, n_tokens - 1, keepdims=False), cache
 
         @partial(jax.jit, donate_argnums=(2,), static_argnames=("n_steps",))
-        def _decode_loop(params, rope, cache, token, pos, key, temp, topp, n_steps):
+        def _decode_loop(params, rope, cache, token, pos, key, temp, topp,
+                         poison, n_steps):
             """N decode steps fused into ONE device program (lax.scan over
             steps, sampling on device). The host sees one dispatch per N
             tokens instead of per token — essential when host<->device launch
-            latency rivals the step itself."""
+            latency rivals the step itself. ``ok`` accumulates the watchdog
+            flag across the chunk's steps."""
 
             def body(carry, _):
-                cache, token, pos, key = carry
+                cache, token, pos, key, ok = carry
                 key, sub = jax.random.split(key)
                 logits, cache = fwd(cfg, params, rope, token[None], cache, pos)
+                logits, ok = _health(logits, poison, ok)
                 nxt = sample_dynamic(logits[0], sub, temp, topp)
-                return (cache, nxt, pos + 1, key), nxt
+                return (cache, nxt, pos + 1, key, ok), nxt
 
-            (cache, token, pos, key), toks = jax.lax.scan(
-                body, (cache, token, pos, key), length=n_steps
+            (cache, token, pos, key, ok), toks = jax.lax.scan(
+                body, (cache, token, pos, key, jnp.bool_(True)), length=n_steps
             )
-            return toks, cache
+            return toks, cache, ok
 
         @partial(jax.jit, donate_argnums=(2,), static_argnames=("n_steps",))
         def _decode_loop_batch(params, rope, cache, tokens, pos, keys, temps,
-                               topps, n_steps):
+                               topps, poison, n_steps):
             """N batched decode steps fused into one program: every step
             streams the weights ONCE for all B sequences (llama.forward_batched)
             and samples each row on device. A row whose own context fills
@@ -262,22 +301,30 @@ class Engine:
             its OWN sampler chain and settings, split once per step exactly
             like the solo paths' ``key, sub = split(key)`` — a sampled row
             seeded like a solo request emits the solo request's exact stream
-            (the server batches mixed-sampler requests on this invariant)."""
+            (the server batches mixed-sampler requests on this invariant).
+
+            ``ok`` [B] accumulates each row's watchdog flag over the chunk;
+            a poisoned row's garbage stays confined to its own row (per-row
+            sampling, per-row cache slab) — siblings are bit-identical."""
 
             def body(carry, _):
-                cache, toks, pos_, keys_ = carry
+                cache, toks, pos_, keys_, ok = carry
                 logits, cache = fwd_b(cfg, params, rope, toks, cache, pos_)
+                logits, ok = _health(logits, poison, ok)
                 split = jax.vmap(jax.random.split)(keys_)  # [B, 2, 2]
                 keys_, subs = split[:, 0], split[:, 1]
                 nxt = jax.vmap(sample_dynamic)(logits, subs, temps, topps
                                                ).astype(jnp.int32)
                 pos_ = jnp.minimum(pos_ + 1, jnp.int32(cfg.seq_len - 1))
-                return (cache, nxt, pos_, keys_), nxt
+                return (cache, nxt, pos_, keys_, ok), nxt
 
-            (cache, toks, pos, keys), out = jax.lax.scan(
-                body, (cache, tokens, pos, keys), length=n_steps
+            (cache, toks, pos, keys, ok), out = jax.lax.scan(
+                body,
+                (cache, tokens, pos, keys,
+                 jnp.ones(tokens.shape, jnp.bool_)),
+                length=n_steps,
             )
-            return out, cache, keys  # out [n_steps, B]
+            return out, cache, keys, ok  # out [n_steps, B], ok [B]
 
         bsh = (None if self._batch_cache_sharding is None else
                {"k": self._batch_cache_sharding, "v": self._batch_cache_sharding})
@@ -327,6 +374,11 @@ class Engine:
 
         self._decode_step = partial(_decode_step, self.params, self.rope)
         self._prefill = partial(_prefill, self.params, self.rope)
+        # preallocated watchdog/poison flags: python bools would retrace on
+        # value change, and a fresh device array per token is host overhead
+        self._flag_false = jnp.zeros((), jnp.bool_)
+        self._flag_true = jnp.ones((), jnp.bool_)
+        self._no_poison: dict = {}  # B -> cached all-False [B] flags
         self._decode_loop = partial(_decode_loop, self.params, self.rope)
         self._decode_loop_batch = partial(_decode_loop_batch, self.params, self.rope)
         self._verify_step = partial(_verify_step, self.params, self.rope)
@@ -446,6 +498,24 @@ class Engine:
         self._key, sub = jax.random.split(self._key)
         return sub
 
+    def _poison_flag(self) -> jax.Array:
+        """Scalar ``logits:nan`` fault seam for the solo decode programs."""
+        fv = faults.fire("logits")
+        if fv is not None and fv["action"] == "nan":
+            return self._flag_true
+        return self._flag_false
+
+    def _poison_rows(self, B: int) -> jax.Array:
+        """[B] ``logits:nan`` fault seam for the batched decode programs —
+        ``row=N`` selects which row gets poisoned."""
+        flags = self._no_poison.get(B)
+        if flags is None:
+            flags = self._no_poison[B] = jnp.zeros((B,), jnp.bool_)
+        fv = faults.fire("logits")
+        if fv is not None and fv["action"] == "nan":
+            flags = flags.at[min(max(fv["row"], 0), B - 1)].set(True)
+        return flags
+
     def prefill(self, cache: dict, tokens: list, pos: int = 0) -> tuple:
         """Run the prompt starting at ``pos``. Returns (last_logits, cache).
 
@@ -537,8 +607,9 @@ class Engine:
                 return
         for _ in range(max(steps, 0)):
             t1 = time.perf_counter()
-            token, cache = self._decode_step(
-                cache, token, jnp.int32(pos), next_key(), temp, topp
+            token, ok, cache = self._decode_step(
+                cache, token, jnp.int32(pos), next_key(), temp, topp,
+                self._poison_flag()
             )
             # the call above returns as soon as the program is enqueued; the
             # dispatch wall time is host+launch overhead ("transfer"), the
@@ -546,6 +617,9 @@ class Engine:
             t2 = time.perf_counter()
             token.block_until_ready()
             t3 = time.perf_counter()
+            if not bool(ok):
+                # fail fast: the sampled token is garbage — don't emit it
+                raise NumericHealthError(f"at decode position {pos}")
             tok_int = int(token)
             t4 = time.perf_counter()
             dt = (t4 - t1) * 1000.0
@@ -628,10 +702,14 @@ class Engine:
             # prefill_bucket(r) >= r, so full chunks resolve to chunk_size
             n = min(chunk_size, prefill_bucket(remaining))
             n = min(n, self.cfg.seq_len - pos)  # never write cache out of range
-            chunk, cache = self._decode_loop(
-                cache, token, jnp.int32(pos), next_key(), temp, topp, n_steps=n
+            chunk, cache, ok = self._decode_loop(
+                cache, token, jnp.int32(pos), next_key(), temp, topp,
+                self._poison_flag(), n_steps=n
             )
             take = min(n, remaining)
+            if not bool(ok):
+                raise NumericHealthError(
+                    f"in fused decode chunk starting at position {pos}")
             chunk_list = [int(t) for t in np.asarray(chunk)]
             toks.extend(chunk_list[:take])
             token = chunk[-1]
@@ -683,6 +761,13 @@ class Engine:
         list of per-row tokens decoded so far THIS chunk (garbage past a
         row's own budget already trimmed) — the server's batched SSE
         streaming hook; tokens arrive in decode_chunk-sized bursts.
+
+        Numeric health: ``self.row_health`` holds, after the call, one bool
+        per row — False once the watchdog saw non-finite logits in that row
+        (its tokens are garbage from that chunk on; siblings are unaffected).
+        The caller decides the policy (the server maps False to
+        ``finish_reason:"error"``); this fixed-membership path keeps
+        decoding, unlike BatchSession's quarantine.
         """
         if not prompts or any(not p for p in prompts):
             raise ValueError("generate_batch needs non-empty prompts")
@@ -712,6 +797,7 @@ class Engine:
             for b in range(B)
         ]
         out: list = [[] for _ in range(B)]
+        self.row_health = [True] * B
         if steps <= 0:
             self.decode_ms = 0.0
             return out
@@ -719,11 +805,15 @@ class Engine:
         t1 = time.perf_counter()
         while remaining > 0:
             n = min(self.decode_chunk, prefill_bucket(remaining))
-            chunk, cache, keys = self._decode_loop_batch(
-                cache, tokens, pos, keys, temps, topps, n_steps=n
+            chunk, cache, keys, ok = self._decode_loop_batch(
+                cache, tokens, pos, keys, temps, topps,
+                self._poison_rows(B), n_steps=n
             )
             take = min(n, remaining)
             arr = np.asarray(chunk)  # [n, B]
+            okh = np.asarray(ok)  # [B]
+            for b in range(B):
+                self.row_health[b] = self.row_health[b] and bool(okh[b])
             done = steps - remaining  # tokens every row was offered so far
             fresh: list = [[] for _ in range(B)]
             for b in range(B):
@@ -792,6 +882,7 @@ class Engine:
         ngram: int = 3,
         sampler: Optional[SamplerConfig] = None,
         on_step=None,
+        row_cancel=None,
     ) -> tuple:
         """Batched GREEDY decode with prompt-lookup speculative drafting:
         every verify step scores draft_len+1 candidate positions for ALL B
@@ -823,6 +914,14 @@ class Engine:
         the server's batched-spec SSE hook. Unlike generate_batch's
         on_chunk, bursts here are final (budget- and stop-truncated
         already) and arrive every 1..draft_len+1 tokens.
+
+        ``row_cancel(b) -> bool``: re-checked for every unfinished row
+        between verify launches; True marks the row done on the spot — a
+        cancelled/expired request stops consuming verify work at the next
+        launch boundary instead of riding to batch end (the row then
+        re-verifies its pending token in place like any finished row, which
+        is how speculation's fixed row set is preserved). Its emissions up
+        to the cancellation stand.
 
         Cache safety mirrors generate_spec: rejected/pad slots hold garbage
         K/V that later steps overwrite before any query attends them; a
@@ -865,6 +964,12 @@ class Engine:
 
         t1 = time.perf_counter()
         while not all(done):
+            if row_cancel is not None:
+                for b in range(B):
+                    if not done[b] and row_cancel(b):
+                        done[b] = True
+                if all(done):
+                    break
             # shared static T, shrunk so the most context-constrained ACTIVE
             # row's write window stays in range (T values bucket to at most
             # draft_len+1 distinct compiles)
@@ -1115,6 +1220,7 @@ class _SlotState:
     offered: int = 0  # tokens the fused chunks have offered this row so far
     done: bool = False  # budget/stop reached; pinned in place until release()
     emitted: int = 0  # tokens actually kept (post budget/stop truncation)
+    finish: Optional[str] = None  # "stop" | "length" | "error" once done
 
 
 class BatchSession:
@@ -1188,12 +1294,20 @@ class BatchSession:
                    if st is not None and not st.done)
 
     def is_done(self, slot: int) -> bool:
-        """True once the row hit its stop token or budget (it no longer
-        receives tokens; release() it to free the slab)."""
+        """True once the row hit its stop token, budget, or quarantine (it no
+        longer receives tokens; release() it to free the slab)."""
         st = self._slots[slot]
         if st is None:
             raise ValueError(f"slot {slot} is not occupied")
         return st.done
+
+    def finish_reason(self, slot: int) -> Optional[str]:
+        """Why the row finished: ``"stop"``, ``"length"``, ``"error"``
+        (watchdog quarantine), or None while still live / after cancel()."""
+        st = self._slots[slot]
+        if st is None:
+            raise ValueError(f"slot {slot} is not occupied")
+        return st.finish
 
     # -- lifecycle --------------------------------------------------------
     def admit(self, prompt_tokens: list, steps: int,
@@ -1247,17 +1361,25 @@ class BatchSession:
         budget = min(room, steps)
         self._slots[slot] = _SlotState(
             room=room, budget=budget, stop_tokens=tuple(stop_tokens),
-            done=budget <= 0)
+            done=budget <= 0, finish="length" if budget <= 0 else None)
         return slot
 
     def step_chunk(self) -> dict:
         """Run ONE fused chunk over the pool and return {slot: fresh tokens}
         for every live row — each list is already truncated at the row's own
-        budget and (inclusively) at its first stop token, and is never empty:
-        a live row always nets at least one token per chunk, so staggered
-        admission can never starve a row. Rows that just finished are marked
-        done (``is_done``) and skip future chunks until released. Returns {}
-        without touching the device when nothing is live."""
+        budget and (inclusively) at its first stop token, and is never empty
+        UNLESS the row was quarantined: a healthy live row always nets at
+        least one token per chunk, so staggered admission can never starve a
+        row. Rows that just finished are marked done (``is_done``) and skip
+        future chunks until released; ``finish_reason`` says why. Returns {}
+        without touching the device when nothing is live.
+
+        Quarantine: a row whose watchdog flag went non-finite this chunk is
+        marked done with finish reason ``"error"`` and emits NOTHING from the
+        chunk (its tokens are garbage) — its slot frees at this chunk
+        boundary like any finished row, and every other row's stream is
+        bit-identical to a run without the poisoned neighbour (per-row
+        sampler chains and cache slabs; nothing crosses rows)."""
         if self._closed:
             raise RuntimeError("batch session is closed")
         live = [b for b, st in enumerate(self._slots)
@@ -1266,10 +1388,12 @@ class BatchSession:
             return {}
         faults.fire("step_chunk")
         t1 = time.perf_counter()
-        chunk, self.cache, self._keys = self.eng._decode_loop_batch(
+        chunk, self.cache, self._keys, ok = self.eng._decode_loop_batch(
             self.cache, self._tokens, self._pos, self._keys, self._temps,
-            self._topps, n_steps=self.chunk)
+            self._topps, self.eng._poison_rows(self.max_batch),
+            n_steps=self.chunk)
         arr = np.asarray(chunk)  # [chunk, B]
+        okh = np.asarray(ok)  # [B]
         self._tokens = chunk[-1]
         # mirror the in-program per-row pin across chunk boundaries
         self._pos = jnp.minimum(self._pos + self.chunk,
@@ -1278,6 +1402,11 @@ class BatchSession:
         fresh: dict = {}
         for b in live:
             st = self._slots[b]
+            if not okh[b]:
+                st.done = True
+                st.finish = "error"
+                fresh[b] = []
+                continue
             # a context-exhausted row pinned at its last slot: tokens past
             # its room are garbage — generate_batch's exact accounting
             keep = max(0, min(self.chunk, st.room - st.offered))
@@ -1290,10 +1419,13 @@ class BatchSession:
                     break
             toks = toks[:take]
             st.emitted += len(toks)
-            if (st.emitted >= st.budget
-                    or (st.stop_tokens and toks
-                        and toks[-1] in st.stop_tokens)):
+            if st.emitted >= st.budget:
                 st.done = True
+                st.finish = "length"
+            elif (st.stop_tokens and toks
+                    and toks[-1] in st.stop_tokens):
+                st.done = True
+                st.finish = "stop"
             fresh[b] = toks
         return fresh
 

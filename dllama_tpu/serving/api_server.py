@@ -38,6 +38,7 @@ import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from dllama_tpu import faults
+from dllama_tpu.runtime.generate import NumericHealthError
 from dllama_tpu.runtime.sampler import SamplerConfig
 from dllama_tpu.serving.lifecycle import (
     AdmissionGate,
@@ -294,8 +295,9 @@ class Batcher:
                 s.queue.put(None)
             s.done.set()
         except Exception as e:  # noqa: BLE001
-            self._resolve_err(s, e if isinstance(e, LifecycleError)
-                              else RuntimeError(f"decode failed: {e!r}"))
+            self._resolve_err(
+                s, e if isinstance(e, (LifecycleError, NumericHealthError))
+                else RuntimeError(f"decode failed: {e!r}"))
 
     @staticmethod
     def _resolve_err(s, err) -> None:
@@ -309,7 +311,7 @@ class Batcher:
     def _fail(self, slots, e) -> None:
         """Resolve every waiter with an error — ALWAYS on failure (a waiter
         left hanging would hang its HTTP connection)."""
-        err = (e if isinstance(e, LifecycleError)
+        err = (e if isinstance(e, (LifecycleError, NumericHealthError))
                else RuntimeError(f"batched decode failed: {e!r}"))
         for s in slots:
             self._resolve_err(s, err)
@@ -328,9 +330,11 @@ class Batcher:
         reuse a handful of compiled batch sizes.
 
         Lifecycle: cancelled/expired requests are resolved BEFORE the batch
-        forms; mid-verify cancellation is not plumbed here (speculation's
-        drafting arithmetic assumes a fixed row set) — a dead row rides to
-        batch end, the price of this fast path."""
+        forms, AND mid-verify via ``row_cancel``: between verify launches a
+        row whose client died (or whose deadline expired) stops decoding —
+        the fixed row set speculation needs is preserved (the cancelled row
+        keeps its slot but spends no more launches on new tokens), and its
+        waiter is resolved with the typed error right after the batch."""
         batch = [s for s in batch if not self._reap_slot(s)]
         if not batch:
             return
@@ -343,6 +347,10 @@ class Batcher:
                     if s.queue is not None and fresh[i]:
                         s.queue.put(fresh[i])
 
+            def row_cancel(i):
+                return (i < len(batch)
+                        and batch[i].lifecycle_error() is not None)
+
             # explicit greedy sampler: the ENGINE default may be sampled
             # (CLI --temperature 0.8) and would trip the greedy-only
             # guard even though every REQUEST in this batch is greedy
@@ -353,8 +361,11 @@ class Batcher:
                 draft_len=self.state.spec_draft,
                 sampler=SamplerConfig(temperature=0.0, seed=0),
                 on_step=on_step,
+                row_cancel=row_cancel,
             )
             for s, row in zip(batch, rows):
+                if self._reap_slot(s):
+                    continue  # cancelled/expired mid-verify: typed error
                 s.tokens = row[: s.steps]
                 if s.queue is not None:
                     s.queue.put(None)
@@ -423,8 +434,17 @@ class Batcher:
                     if sess.is_done(b):
                         # free the slab NOW — the next waiter admits into
                         # it on this very loop pass
+                        quarantined = sess.finish_reason(b) == "error"
                         sess.release(b)
                         del slot_map[b]
+                        if quarantined:
+                            # numeric-health quarantine: THIS row's logits
+                            # went non-finite; its waiter gets the typed
+                            # error (500 / finish_reason "error"), siblings
+                            # decode on bit-identically
+                            self._resolve_err(s, NumericHealthError(
+                                "in pooled decode row; row quarantined"))
+                            continue
                         if s.queue is not None:
                             s.queue.put(None)
                         s.done.set()
@@ -950,6 +970,12 @@ class OpenAIHandler(BaseHTTPRequestHandler):
         except DeadlineExceeded as e:
             emit_chunk({"content": f"\n[error: {e}]"})
             finish_reason = "timeout"
+        except NumericHealthError as e:
+            # quarantined row: what was streamed before the blowup stands
+            # (those chunks were finite); the stream ends with
+            # finish_reason "error" so the client knows not to trust more
+            emit_chunk({"content": f"\n[error: {e}]"})
+            finish_reason = "error"
         except RuntimeError as e:
             emit_chunk({"content": f"\n[error: {e}]"})
         tail = utf8.decode(b"", True)
@@ -1046,12 +1072,18 @@ class OpenAIHandler(BaseHTTPRequestHandler):
             except Exception as e:  # noqa: BLE001
                 self._error(500, f"batched n-sampling failed: {e!r}")
                 return
+            row_health = getattr(st.engine, "row_health", None)
             choices, total = [], 0
             for idx, row in enumerate(rows):
                 text, finish, n_gen = decode_token_row(
                     tok, prompt_tokens[-1], row[:max_tokens],
                     st.stop_token_ids(), stops)
                 total += n_gen
+                if row_health is not None and not row_health[idx]:
+                    # this choice's logits went non-finite mid-decode: its
+                    # text is untrustworthy from the blowup point — flag it
+                    # instead of failing the healthy sibling choices
+                    finish = "error"
                 choices.append({
                     "index": idx,
                     "message": {"role": "assistant", "content": text},
@@ -1147,6 +1179,7 @@ class OpenAIHandler(BaseHTTPRequestHandler):
         # tokens must not be decoded per piece (that would emit U+FFFD pairs)
         utf8 = codecs.getincrementaldecoder("utf-8")("replace")
         interrupted = None  # "timeout" when the deadline ends the decode
+        health_err = None  # NumericHealthError when the watchdog trips
         with st.lock:
             prev = prompt_tokens[-1]
             stop_ids = st.stop_token_ids()
@@ -1154,30 +1187,43 @@ class OpenAIHandler(BaseHTTPRequestHandler):
             history = list(prompt_tokens)
             stream_iter = st.open_stream(prompt_tokens, feed_tokens, session,
                                          max_tokens, sampler)
-            for tok_id, _stats in stream_iter:
-                n_generated += 1
-                history.append(tok_id)
-                if tok_id in stop_ids:
-                    finish_reason = "stop"
-                    break
-                piece = utf8.decode(tok.decode_piece(prev, tok_id))
-                prev = tok_id
-                out, hit_stop = detector.feed(piece)
-                if out:
-                    text_parts.append(out)
-                    if stream:
-                        emit_chunk({"content": out})
-                if hit_stop:
-                    finish_reason = "stop"
-                    break
-                if client_gone:
-                    break  # abandon the generator at a token boundary
-                if deadline is not None and deadline.expired():
-                    interrupted = "timeout"
-                    break
-            st.store_prefix_session(history, st.engine.final_session)
+            try:
+                for tok_id, _stats in stream_iter:
+                    n_generated += 1
+                    history.append(tok_id)
+                    if tok_id in stop_ids:
+                        finish_reason = "stop"
+                        break
+                    piece = utf8.decode(tok.decode_piece(prev, tok_id))
+                    prev = tok_id
+                    out, hit_stop = detector.feed(piece)
+                    if out:
+                        text_parts.append(out)
+                        if stream:
+                            emit_chunk({"content": out})
+                    if hit_stop:
+                        finish_reason = "stop"
+                        break
+                    if client_gone:
+                        break  # abandon the generator at a token boundary
+                    if deadline is not None and deadline.expired():
+                        interrupted = "timeout"
+                        break
+            except NumericHealthError as e:
+                # the watchdog tripped: everything emitted so far was
+                # finite, but the session's KV state is poisoned — do NOT
+                # cache it for the next turn of this conversation
+                health_err = e
+            if health_err is None:
+                st.store_prefix_session(history, st.engine.final_session)
 
-        if interrupted == "timeout":
+        if health_err is not None:
+            if not stream:
+                self._error(500, f"decode failed: {health_err}")
+                return
+            emit_chunk({"content": f"\n[error: {health_err}]"})
+            finish_reason = "error"
+        elif interrupted == "timeout":
             if not stream:
                 raise deadline.error()  # -> 504 via do_POST
             emit_chunk({"content": f"\n[error: {deadline.error()}]"})

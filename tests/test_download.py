@@ -17,6 +17,8 @@ PAYLOAD = bytes(range(256)) * 64  # 16 KiB, recognizable at any offset
 
 class _FlakyHandler(http.server.BaseHTTPRequestHandler):
     fails_left = 0  # 503s served before behaving
+    short_next = False  # declare the full length but send only half, once
+    lie_total = 0  # nonzero: Content-Range declares this (wrong) full size
     hits = 0
     ranges_seen: list = []
 
@@ -48,17 +50,29 @@ class _FlakyHandler(http.server.BaseHTTPRequestHandler):
                 self.end_headers()
                 return
             self.send_response(206)
+            total = cls.lie_total or len(PAYLOAD)
+            self.send_header(
+                "Content-Range", f"bytes {start}-{len(PAYLOAD) - 1}/{total}")
         else:
             self.send_response(200)
         body = PAYLOAD[start:]
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()
+        if cls.short_next:
+            # premature EOF: HTTP/1.0 closes the socket after the handler
+            # returns, and a chunked read() then sees b"" — byte-for-byte
+            # indistinguishable from completion at the stream level
+            cls.short_next = False
+            self.wfile.write(body[: len(body) // 2])
+            return
         self.wfile.write(body)
 
 
 @pytest.fixture()
 def local_http():
     _FlakyHandler.fails_left = 0
+    _FlakyHandler.short_next = False
+    _FlakyHandler.lie_total = 0
     _FlakyHandler.hits = 0
     _FlakyHandler.ranges_seen = []
     srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), _FlakyHandler)
@@ -115,3 +129,50 @@ def test_download_exhausted_retries_keeps_partial(local_http, tmp_path):
                       retries=2, backoff_s=0.01)
     assert not dest.exists()
     assert _FlakyHandler.hits == 3  # initial try + 2 retries
+
+
+def test_download_short_read_detected_and_resumed(local_http, tmp_path):
+    """A premature EOF reads exactly like completion at the stream level —
+    only the declared-size check catches it. The short torso must NOT be
+    renamed into place; the retry resumes from the bytes on disk."""
+    _FlakyHandler.short_next = True
+    dest = tmp_path / "model.m"
+    download_file(f"http://127.0.0.1:{local_http}/model.m", str(dest),
+                  retries=2, backoff_s=0.01)
+    assert dest.read_bytes() == PAYLOAD
+    assert _FlakyHandler.hits == 2
+    assert _FlakyHandler.ranges_seen == [f"bytes={len(PAYLOAD) // 2}-"]
+
+
+def test_download_overshoot_deletes_part_and_fails(local_http, tmp_path):
+    """More bytes on disk than the server's declared total: resuming cannot
+    fix that, so the `.part` is deleted and the download fails loudly
+    instead of renaming a corrupt file into place."""
+    _FlakyHandler.lie_total = len(PAYLOAD) // 2
+    dest = tmp_path / "model.m"
+    (tmp_path / "model.m.part").write_bytes(PAYLOAD[:5000])
+    with pytest.raises(RuntimeError, match="download corrupt"):
+        download_file(f"http://127.0.0.1:{local_http}/model.m", str(dest),
+                      retries=3, backoff_s=0.01)
+    assert _FlakyHandler.hits == 1  # corruption is terminal, not retried
+    assert not dest.exists()
+    assert not (tmp_path / "model.m.part").exists()
+
+
+def test_download_sha256_verified_ok(local_http, tmp_path):
+    import hashlib
+
+    dest = tmp_path / "model.m"
+    download_file(f"http://127.0.0.1:{local_http}/model.m", str(dest),
+                  expected_sha256=hashlib.sha256(PAYLOAD).hexdigest().upper())
+    assert dest.read_bytes() == PAYLOAD  # case-insensitive digest accepted
+
+
+def test_download_sha256_mismatch_deletes_part(local_http, tmp_path):
+    dest = tmp_path / "model.m"
+    with pytest.raises(RuntimeError, match="sha256"):
+        download_file(f"http://127.0.0.1:{local_http}/model.m", str(dest),
+                      retries=3, backoff_s=0.01, expected_sha256="0" * 64)
+    assert _FlakyHandler.hits == 1  # corrupt bytes cannot be resumed
+    assert not dest.exists()
+    assert not (tmp_path / "model.m.part").exists()

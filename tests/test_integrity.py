@@ -1,0 +1,515 @@
+"""End-to-end integrity: checksummed `.m` artifacts, hostile-header
+rejection, the numeric-health watchdog, and poisoned-slot quarantine.
+
+The corrupt-file corpus pins the open-time contract — a truncated or
+bit-flipped file is REJECTED with the first bad tensor's name and byte
+offset, never silently loaded — and the watchdog tests pin the serving
+contract: a decode row whose logits go non-finite finishes with
+``finish_reason "error"`` while every sibling row stays bit-identical to a
+clean run.
+"""
+
+import json
+import struct
+import threading
+import zlib
+from argparse import Namespace
+
+import numpy as np
+import pytest
+
+from dllama_tpu import faults
+from dllama_tpu.formats.spec import FormatError, parse_header, write_header
+from dllama_tpu.formats.weights import (
+    ChecksumError,
+    ModelWriter,
+    WeightFileReader,
+    tensor_plan,
+    write_model,
+)
+from dllama_tpu.quants import blocks
+from tests.test_formats import random_tensors, tiny_spec
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_faults():
+    """A failing fault test must not poison later tests in the process."""
+    yield
+    faults.clear()
+
+
+def _write(tmp_path, wft=blocks.Q40, checksums=None, name="m.m", seed=0):
+    spec = tiny_spec(wft=wft)
+    tensors = random_tensors(spec, seed=seed)
+    path = str(tmp_path / name)
+    with ModelWriter(path, spec, checksums=checksums) as w:
+        for e in w.plan:
+            w.write_next(e.name, tensors[e.name])
+    return path, spec, tensors
+
+
+# ---------------------------------------------------------------------------
+# The integrity section: write, verify, reference-loadability
+# ---------------------------------------------------------------------------
+
+def test_checksummed_file_roundtrips_and_verifies(tmp_path):
+    path, spec, tensors = _write(tmp_path)
+    with WeightFileReader(path) as r:
+        assert r.has_integrity
+        report = r.verify()
+        assert report["ok"] and not report["failures"]
+        assert report["tensors"] == len(r.entries)
+        # normal reads still work (and are CRC-checked on first touch)
+        got = r.read_tensor("token_embedding")
+        np.testing.assert_array_equal(
+            got.reshape(-1), tensors["token_embedding"])
+
+
+def test_section_is_pure_suffix_reference_layout_unchanged(tmp_path):
+    """The checksummed file is the legacy file plus trailing bytes — the
+    reference loader reads tensors sequentially by offset and never checks
+    the file size, so checksummed artifacts stay loadable there."""
+    with_path, _, _ = _write(tmp_path, name="with.m", checksums=True)
+    without_path, _, _ = _write(tmp_path, name="without.m", checksums=False)
+    with_bytes = open(with_path, "rb").read()
+    without_bytes = open(without_path, "rb").read()
+    assert with_bytes[: len(without_bytes)] == without_bytes
+    assert len(with_bytes) > len(without_bytes)
+    assert with_bytes[len(without_bytes):][:4] == b"DLCK"
+
+
+def test_legacy_file_without_section_still_loads(tmp_path):
+    path, _, tensors = _write(tmp_path, checksums=False)
+    with WeightFileReader(path) as r:
+        assert not r.has_integrity
+        report = r.verify()
+        assert report["ok"] and not report["has_integrity"]
+        got = r.read_tensor("rms_final")
+        np.testing.assert_array_equal(got, tensors["rms_final"])
+
+
+def test_write_model_defaults_to_checksums(tmp_path):
+    spec = tiny_spec(wft=blocks.F32)
+    path = str(tmp_path / "d.m")
+    write_model(path, spec, random_tensors(spec))
+    with WeightFileReader(path) as r:
+        assert r.has_integrity
+
+
+# ---------------------------------------------------------------------------
+# Corrupt-file corpus: every rejection names what is wrong
+# ---------------------------------------------------------------------------
+
+def test_truncated_mid_tensor_names_first_cut_tensor(tmp_path):
+    path, spec, _ = _write(tmp_path)
+    with WeightFileReader(path) as r:
+        # cut mid-way through the SECOND tensor: the error must name it (not
+        # the last one) with its byte span
+        bad = r.entries[1]
+    with open(path, "r+b") as f:
+        f.truncate(bad.offset + bad.nbytes // 2)
+    with pytest.raises(FormatError) as ei:
+        WeightFileReader(path)
+    msg = str(ei.value)
+    assert bad.name in msg and str(bad.offset) in msg and "truncated" in msg
+
+
+def test_truncation_inside_integrity_section_rejected(tmp_path):
+    path, _, _ = _write(tmp_path)
+    size = __import__("os").path.getsize(path)
+    with open(path, "r+b") as f:
+        f.truncate(size - 1)
+    with pytest.raises(FormatError, match="integrity section"):
+        WeightFileReader(path)
+
+
+def test_trailing_garbage_rejected(tmp_path):
+    path, _, _ = _write(tmp_path, checksums=False)
+    with open(path, "ab") as f:
+        f.write(b"\x00" * 32)
+    with pytest.raises(FormatError, match="integrity section"):
+        WeightFileReader(path)
+
+
+def test_section_self_checksum_detects_section_corruption(tmp_path):
+    path, _, _ = _write(tmp_path)
+    size = __import__("os").path.getsize(path)
+    with open(path, "r+b") as f:
+        f.seek(size - 10)  # inside the CRC table
+        b = f.read(1)
+        f.seek(size - 10)
+        f.write(bytes([b[0] ^ 0xFF]))
+    with pytest.raises(FormatError, match="its own checksum"):
+        WeightFileReader(path)
+
+
+def _flip_byte(path, file_offset):
+    with open(path, "r+b") as f:
+        f.seek(file_offset)
+        b = f.read(1)
+        f.seek(file_offset)
+        f.write(bytes([b[0] ^ 0x01]))
+
+
+def test_bitflip_caught_on_first_read(tmp_path):
+    path, _, _ = _write(tmp_path)
+    with WeightFileReader(path) as r:
+        e = r.entry("layers.0.w1")
+    _flip_byte(path, e.offset + 5)
+    with WeightFileReader(path) as r:
+        with pytest.raises(ChecksumError) as ei:
+            r.read_tensor("layers.0.w1")
+        assert ei.value.tensor_name == "layers.0.w1"
+        assert ei.value.offset == e.offset
+        # sibling tensors still verify and read fine
+        r.read_tensor("layers.1.w1")
+        r.read_tensor_rows("layers.0.wq", slice(0, 8))
+
+
+def test_bitflip_caught_by_verify_report(tmp_path):
+    path, _, _ = _write(tmp_path)
+    with WeightFileReader(path) as r:
+        e = r.entry("layers.1.w2")
+    _flip_byte(path, e.offset)
+    with WeightFileReader(path) as r:
+        report = r.verify()
+    assert not report["ok"]
+    assert [f["name"] for f in report["failures"]] == ["layers.1.w2"]
+    assert report["failures"][0]["offset"] == e.offset
+
+
+def test_row_band_read_verifies_whole_tensor(tmp_path):
+    """Corruption OUTSIDE the requested band is still caught: shard loading
+    must not skip verification of the bytes it happens not to touch."""
+    path, _, _ = _write(tmp_path)
+    with WeightFileReader(path) as r:
+        e = r.entry("layers.0.w1")
+    _flip_byte(path, e.offset + e.nbytes - 1)  # last byte: outside rows 0..8
+    with WeightFileReader(path) as r:
+        with pytest.raises(ChecksumError):
+            r.read_tensor_rows("layers.0.w1", slice(0, 8))
+
+
+def test_lazy_verify_env_opt_out(tmp_path, monkeypatch):
+    path, _, _ = _write(tmp_path)
+    with WeightFileReader(path) as r:
+        e = r.entry("layers.0.w1")
+    _flip_byte(path, e.offset + 5)
+    monkeypatch.setenv("DLLAMA_WEIGHTS_VERIFY", "0")
+    with WeightFileReader(path) as r:
+        r.read_tensor("layers.0.w1")  # opted out: no raise
+        assert not r.verify()["ok"]  # explicit verify still catches it
+
+
+def test_empty_file_rejected(tmp_path):
+    path = tmp_path / "empty.m"
+    path.write_bytes(b"")
+    with pytest.raises(FormatError, match="empty"):
+        WeightFileReader(str(path))
+
+
+def test_bad_magic_rejected(tmp_path):
+    path = tmp_path / "junk.m"
+    path.write_bytes(b"\xde\xad\xbe\xef" + b"\x00" * 64)
+    with pytest.raises(FormatError, match="magic"):
+        WeightFileReader(str(path))
+
+
+def test_header_shorter_than_magic_rejected():
+    with pytest.raises(FormatError, match="too short"):
+        parse_header(b"\x01\x02")
+
+
+def test_negative_dim_rejected():
+    spec = tiny_spec()
+    spec.dim = -64
+    with pytest.raises(FormatError, match="dim"):
+        parse_header(write_header(spec) + b"\x00" * 64)
+
+
+def test_zero_layers_rejected():
+    spec = tiny_spec()
+    spec.n_layers = 0
+    with pytest.raises(FormatError, match="n_layers"):
+        parse_header(write_header(spec) + b"\x00" * 64)
+
+
+def test_unknown_float_type_rejected():
+    spec = tiny_spec()
+    spec.weights_float_type = 9
+    with pytest.raises(FormatError, match="weightsFloatType"):
+        parse_header(write_header(spec) + b"\x00" * 64)
+
+
+def test_unknown_header_key_rejected():
+    raw = bytearray(write_header(tiny_spec()))
+    # overwrite the first KV pair's key with a key id that does not exist
+    struct.pack_into("<i", raw, 8, 999)
+    with pytest.raises(FormatError, match="unknown header key"):
+        parse_header(bytes(raw) + b"\x00" * 64)
+
+
+def test_header_size_past_eof_rejected():
+    raw = bytearray(write_header(tiny_spec()))
+    struct.pack_into("<i", raw, 4, 8 + 8 * 200)  # valid shape, beyond EOF
+    with pytest.raises(FormatError, match="past|truncated"):
+        parse_header(bytes(raw), file_size=len(raw))
+
+
+def test_header_size_unaligned_rejected():
+    raw = bytearray(write_header(tiny_spec()))
+    struct.pack_into("<i", raw, 4, 8 + 12)  # not whole (key, value) pairs
+    with pytest.raises(FormatError, match="headerSize"):
+        parse_header(bytes(raw))
+
+
+# ---------------------------------------------------------------------------
+# cli verify
+# ---------------------------------------------------------------------------
+
+def test_cli_verify_clean_corrupt_and_json(tmp_path, capsys):
+    from dllama_tpu.cli import run_verify
+
+    path, _, _ = _write(tmp_path)
+    assert run_verify(Namespace(model=path, json=False)) == 0
+    assert "checksums OK" in capsys.readouterr().out
+
+    with WeightFileReader(path) as r:
+        e = r.entry("layers.0.wq")
+    _flip_byte(path, e.offset + 3)
+    assert run_verify(Namespace(model=path, json=False)) == 1
+    out = capsys.readouterr().out
+    assert "layers.0.wq" in out and str(e.offset) in out
+
+    assert run_verify(Namespace(model=path, json=True)) == 1
+    report = json.loads(capsys.readouterr().out)
+    assert report["failures"][0]["name"] == "layers.0.wq"
+
+    # structural rejection (truncation) also exits 1 and names the tensor
+    with open(path, "r+b") as f:
+        f.truncate(e.offset + 1)
+    assert run_verify(Namespace(model=path, json=False)) == 1
+    assert "truncated" in capsys.readouterr().out
+
+
+def test_cli_verify_legacy_file_warns_but_passes(tmp_path, capsys):
+    from dllama_tpu.cli import run_verify
+
+    path, _, _ = _write(tmp_path, checksums=False)
+    assert run_verify(Namespace(model=path, json=False)) == 0
+    assert "UNVERIFIED" in capsys.readouterr().out
+
+
+# ---------------------------------------------------------------------------
+# Fault seams: weights_open / weights_read drills
+# ---------------------------------------------------------------------------
+
+@pytest.mark.faults
+def test_fault_weights_open_truncate(tmp_path):
+    path, _, _ = _write(tmp_path)
+    # drop enough bytes to cut into the LAST tensor (past the ~112-byte
+    # integrity section), so the open-time size check trips
+    faults.install("weights_open:truncate:drop=4096")
+    with pytest.raises(FormatError, match="truncated"):
+        WeightFileReader(path)
+    faults.clear()
+    with WeightFileReader(path) as r:  # no fault: same file opens clean
+        assert r.verify()["ok"]
+
+
+@pytest.mark.faults
+def test_fault_weights_read_bitflip(tmp_path):
+    path, _, _ = _write(tmp_path)
+    faults.install("weights_read:bitflip:byte=7,times=1")
+    with WeightFileReader(path) as r:
+        with pytest.raises(ChecksumError) as ei:
+            r.read_tensor("token_embedding")
+        assert ei.value.tensor_name == "token_embedding"
+        # the flip was applied to a COPY and the budget (times=1) is spent:
+        # the same tensor now reads clean from the pristine mmap
+        r.read_tensor("token_embedding")
+    faults.clear()
+
+
+# ---------------------------------------------------------------------------
+# Numeric-health watchdog: solo fail-fast, batch row_health, quarantine
+# ---------------------------------------------------------------------------
+
+from dllama_tpu.models import llama  # noqa: E402
+from dllama_tpu.runtime.generate import Engine, NumericHealthError  # noqa: E402
+from dllama_tpu.runtime.sampler import SamplerConfig  # noqa: E402
+from tests.test_continuous_batching import CFG, _drain, _solo  # noqa: E402
+
+
+@pytest.mark.faults
+def test_solo_generate_fails_fast_on_nonfinite_logits():
+    params = llama.random_params(CFG, seed=0, dtype=np.float32)
+    eng = Engine(CFG, params, SamplerConfig(temperature=0.0))
+    clean = [t for t, _ in eng.generate([5, 9, 3], steps=6)]
+    assert len(clean) == 6
+    # poison the THIRD decode dispatch: the first two decode tokens must
+    # still be emitted, then the generator raises instead of yielding junk
+    faults.install("logits:nan:after=2")
+    got = []
+    with pytest.raises(NumericHealthError, match="decode position"):
+        for t, _ in eng.generate([5, 9, 3], steps=6):
+            got.append(t)
+    faults.clear()
+    # prefix before the blowup is the clean stream; the poisoned token is
+    # never emitted
+    assert got == clean[: len(got)]
+    assert len(got) < 6
+
+
+@pytest.mark.faults
+def test_numeric_checks_off_engine_does_not_raise():
+    params = llama.random_params(CFG, seed=0, dtype=np.float32)
+    eng = Engine(CFG, params, SamplerConfig(temperature=0.0),
+                 numeric_checks=False)
+    faults.install("logits:nan")
+    toks = [t for t, _ in eng.generate([5, 9, 3], steps=4)]
+    faults.clear()
+    assert len(toks) == 4  # no watchdog: garbage flows (the A/B baseline)
+
+
+@pytest.mark.faults
+def test_generate_batch_row_health_flags_only_poisoned_row():
+    params = llama.random_params(CFG, seed=1, dtype=np.float32)
+    eng = Engine(CFG, params, SamplerConfig(temperature=0.0))
+    clean = eng.generate_batch([[5, 9, 3], [7]], steps=8)
+    assert eng.row_health == [True, True]
+    faults.install("logits:nan:row=0")
+    got = eng.generate_batch([[5, 9, 3], [7]], steps=8)
+    faults.clear()
+    assert eng.row_health == [False, True]
+    assert got[1] == clean[1]  # the healthy row is untouched
+
+
+@pytest.mark.faults
+def test_quarantine_siblings_bit_identical_and_slot_reusable():
+    """THE acceptance test: a poisoned pool row finishes "error" while its
+    siblings' streams stay bit-identical to a clean run, and the
+    quarantined slab admits a fresh healthy row afterwards."""
+    params = llama.random_params(CFG, seed=2, dtype=np.float32)
+    samplers = [SamplerConfig(temperature=0.9, topp=0.95, seed=7),
+                SamplerConfig(temperature=0.0, seed=1),
+                SamplerConfig(temperature=1.3, topp=0.8, seed=42)]
+    prompts = [[5, 9, 3], [7], [1, 2, 3, 4, 5, 6, 11]]
+
+    def pool_run(poison):
+        eng = Engine(CFG, params, SamplerConfig(temperature=0.0))
+        if poison:
+            faults.install("logits:nan:row=1")
+        sess = eng.batch_session(max_batch=3, chunk=4)
+        slots = [sess.admit(list(p), steps=12, sampler=s)
+                 for p, s in zip(prompts, samplers)]
+        toks = _drain(sess, slots)
+        fins = [sess.finish_reason(b) for b in slots]
+        faults.clear()
+        return sess, slots, [toks[b] for b in slots], fins
+
+    sess, slots, clean, clean_fins = pool_run(poison=False)
+    sess.close()
+    assert clean_fins == ["length", "length", "length"]
+
+    sess, slots, poisoned, fins = pool_run(poison=True)
+    assert fins[1] == "error"  # quarantined, typed
+    assert poisoned[1] == []   # poisoned from the first chunk: no output
+    assert poisoned[0] == clean[0] and poisoned[2] == clean[2]  # bit-identical
+
+    # the slab is FREE and healthy after release: a fresh row admitted into
+    # it matches its solo stream
+    sess.release(slots[1])
+    reuse = sess.admit([7], steps=10,
+                       sampler=SamplerConfig(temperature=0.8, seed=11))
+    assert reuse == slots[1]
+    got = _drain(sess, [reuse])[reuse]
+    sess.close()
+    assert got == _solo(params, [7], 10, SamplerConfig(temperature=0.8, seed=11))
+
+
+def test_row_cancel_mid_verify_preserves_siblings():
+    """ROADMAP follow-up: the batched-speculation fast path honors
+    cancellation between verify launches — the cancelled row stops early,
+    the surviving rows' streams are unchanged."""
+    params = llama.random_params(CFG, seed=1, dtype=np.float32)
+    prompts = [[5, 9, 3, 5, 9, 3, 5, 9], [7, 7, 7, 7, 7]]
+
+    eng = Engine(CFG, params, SamplerConfig(temperature=0.0))
+    clean, _ = eng.generate_batch_spec(prompts, steps=12, draft_len=4)
+    assert len(clean[0]) == 12
+
+    emitted = [0, 0]
+
+    def on_step(fresh):
+        for b, burst in enumerate(fresh):
+            emitted[b] += len(burst)
+
+    eng2 = Engine(CFG, params, SamplerConfig(temperature=0.0))
+    got, _ = eng2.generate_batch_spec(
+        prompts, steps=12, draft_len=4, on_step=on_step,
+        row_cancel=lambda b: b == 0 and emitted[0] >= 1)
+    assert got[0] == clean[0][: len(got[0])]  # stopped at a launch boundary
+    assert len(got[0]) < len(clean[0])        # actually cancelled early
+    assert got[1] == clean[1]                 # sibling row unchanged
+
+
+# ---------------------------------------------------------------------------
+# HTTP mapping: quarantine -> 500 / finish_reason "error"
+# ---------------------------------------------------------------------------
+
+@pytest.mark.faults
+def test_http_solo_quarantine_500_then_recovers():
+    import http.client
+
+    from dllama_tpu.formats.tokenizer_file import TokenizerData
+    from dllama_tpu.serving.api_server import ServerState, create_server
+    from dllama_tpu.tokenizer.bpe import Tokenizer
+    from tests.test_llama_forward import tiny_cfg
+
+    vocab = [b"<unk>", b"<s>", b"</s>"]
+    vocab += [b"<0x%02X>" % b for b in range(256)]
+    vocab += [b" ", b"e", b"t", b"he", b" the", b"hello", b" world"]
+    scores = [0.0] * 259 + [-1.0, -2.0, -2.0, -1.5, -1.2, -1.1, -1.1]
+    tok = Tokenizer(TokenizerData(vocab=vocab, scores=scores, bos_id=1, eos_id=2))
+    cfg = tiny_cfg(vocab_size=tok.vocab_size, seq_len=512, dim=32, kv_dim=16,
+                   head_size=8, hidden_dim=64)
+    params = llama.random_params(cfg, seed=13)
+    engine = Engine(cfg, params, SamplerConfig(temperature=0.0, seed=1))
+    state = ServerState(engine, tok, cfg, model_name="tiny-test",
+                        template="llama3")
+    srv = create_server(state, host="127.0.0.1", port=0)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    port = srv.server_address[1]
+
+    def ask(body):
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=120)
+        conn.request("POST", "/v1/chat/completions", body=json.dumps(body),
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        data = resp.read()
+        conn.close()
+        return resp.status, data
+
+    body = {"model": "tiny-test", "temperature": 0.0, "max_tokens": 6,
+            "messages": [{"role": "user", "content": "hello world"}]}
+    try:
+        # first decode dispatch poisoned, once: this request 500s
+        faults.install("logits:nan:times=1")
+        status, data = ask(body)
+        assert status == 500
+        assert b"non-finite" in data
+        # the engine is NOT poisoned state-wise: the next request is clean
+        status, data = ask(body)
+        assert status == 200
+        assert json.loads(data)["choices"][0]["finish_reason"] in (
+            "stop", "length")
+        # streaming: the quarantine surfaces as finish_reason "error"
+        faults.install("logits:nan:times=1")
+        status, data = ask(dict(body, stream=True))
+        assert status == 200  # headers were already on the wire
+        assert b'"finish_reason": "error"' in data
+        assert b"data: [DONE]" in data
+    finally:
+        faults.clear()
+        srv.shutdown()

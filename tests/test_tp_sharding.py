@@ -102,7 +102,8 @@ def test_sharded_decode_step_emits_collectives():
     cache = eng.new_cache()
     lowered = eng._decode_step.func.lower(
         eng.params, eng.rope, cache, jnp.asarray(5, jnp.int32), jnp.int32(0),
-        jax.random.PRNGKey(0), jnp.float32(0.0), jnp.float32(0.9))
+        jax.random.PRNGKey(0), jnp.float32(0.0), jnp.float32(0.9),
+        jnp.zeros((), jnp.bool_))
     hlo = lowered.compile().as_text()
     assert hlo.count("all-reduce") > 0
     # and the weights really live sharded: 1/8th per device
@@ -182,6 +183,7 @@ def test_dense_tp_wire_estimate_matches_compiled_hlo_structure():
     txt = eng._decode_step.func.lower(
         eng.params, eng.rope, cache, jnp.asarray(3, jnp.int32), jnp.int32(0),
         jax.random.PRNGKey(0), jnp.float32(0.0), jnp.float32(0.9),
+        jnp.zeros((), jnp.bool_),
     ).compile().as_text()
 
     ops = re.findall(
