@@ -635,6 +635,128 @@ def run_decode_bench(cfg_dict: dict, bench_steps: int = None, quant_ok: bool = F
                 f"{weights}-integrity-b{B}-overhead"
                 f"{overhead:.2f}pct{cfg_tag}")
 
+    # BENCH_OBS=N measures the observability subsystem two ways.
+    # (1) Overhead: batched decode on the instrumented engine vs a second
+    #     engine built with metrics=None — every telemetry point on the hot
+    #     path is one `is not None` check plus a histogram observe per
+    #     CHUNK (not per token), so the budget is < 1% and the bench FAILS
+    #     above it (min-of-reps on identical work keeps CPU noise out).
+    # (2) Latency telemetry: N requests replayed through the REAL serving
+    #     scheduler on a FRESH registry, once per decode path (solo
+    #     sequential, spec all-greedy window, continuous sampled window),
+    #     reporting TTFT/TPOT p50/p95 per path from the histogram
+    #     reservoirs — the numbers RESULTS.md quotes. CPU-runnable
+    #     (BENCH_MODEL=smoke).
+    nobs = _env_count("BENCH_OBS")
+    if nobs:
+        import threading as _threading
+
+        from dllama_tpu import observability as _obs
+        from dllama_tpu.serving.api_server import ServerState
+
+        B = max(2, min(batch or 4, 8))
+        osteps = max(16, min(bench_steps, cfg.seq_len - 8) // 2)
+        greedy = SamplerConfig(temperature=0.0, seed=0)
+
+        def _timed_obs(e):
+            e.generate_batch([[1]] * B, steps=osteps, sampler=greedy)
+            best = None
+            for _ in range(5):
+                t1 = time.perf_counter()
+                out = e.generate_batch([[1]] * B, steps=osteps,
+                                       sampler=greedy)
+                eff = ((time.perf_counter() - t1) * 1000.0
+                       / max(1, len(out[0])) / B)
+                best = eff if best is None else min(best, eff)
+            return best
+
+        log(f"obs: timing telemetry overhead (B={B}, {osteps} steps)")
+        on_ms = _timed_obs(eng)
+        if weights in ("q40", "q80"):
+            params2 = llama.device_random_quant_params(cfg, kind=weights,
+                                                       seed=0)
+        else:
+            params2 = llama.device_random_params(cfg, seed=0, mesh=mesh)
+        eng_off = Engine(cfg, params2, SamplerConfig(temperature=0.0),
+                         cache_dtype=cache_dtype, mesh=mesh,
+                         decode_chunk=bench_steps, metrics=None)
+        del params2
+        off_ms = _timed_obs(eng_off)
+        overhead = (on_ms - off_ms) / off_ms * 100.0
+        log(f"telemetry overhead: on {on_ms:.4f} vs off {off_ms:.4f} "
+            f"ms/token effective = {overhead:+.2f}% (budget < 1%)")
+        if overhead >= 1.0:
+            raise RuntimeError(
+                f"telemetry overhead {overhead:+.2f}% exceeds the 1% "
+                "budget (instrumented vs metrics=None engine)")
+
+        class _ObsTok:
+            eos_id = -1  # no stops: rows run to budget (scheduler replay)
+
+            def piece_id(self, _b):
+                return -1
+
+        reg = _obs.MetricsRegistry()  # fresh: percentiles from THIS replay
+        st = ServerState(eng, _ObsTok(), cfg, model_name="bench",
+                         spec_draft=4, batch_window_ms=5.0, batch_max=B,
+                         batch_chunk=8, metrics=reg)
+        rng_o = __import__("numpy").random.default_rng(5)
+        oprompt = [int(t) for t in rng_o.integers(1, cfg.vocab_size, 6)]
+        rsteps = max(8, min(bench_steps // 4, cfg.seq_len - len(oprompt)))
+
+        def _one(i, sampler):
+            tr = _obs.RequestTrace(_obs.new_request_id())
+            tr.tokens_in = len(oprompt)
+            try:
+                row = st.batcher.submit(list(oprompt), rsteps, sampler,
+                                        trace=tr)
+                tr.tokens_out = len(row)
+                tr.finish_reason = "length"
+            except RuntimeError as e:
+                tr.finish_reason = "error"
+                log(f"obs replay request failed: {e!r}")
+            st.finish_request(tr)
+
+        # solo: sequential singletons; spec: concurrent all-greedy window
+        # (spec_draft=4 routes it to the batched verify); continuous:
+        # concurrent sampled window (mixed samplers can't speculate)
+        plans = [
+            ("solo", False, lambda i: greedy),
+            ("spec", True, lambda i: greedy),
+            ("continuous", True,
+             lambda i: SamplerConfig(temperature=0.8, seed=100 + i)),
+        ]
+        for pname, concurrent, mk in plans:
+            log(f"obs replay: {nobs} requests -> {pname} path")
+            if concurrent:
+                ths = [_threading.Thread(target=_one, args=(i, mk(i)),
+                                         daemon=True)
+                       for i in range(nobs)]
+                for t in ths:
+                    t.start()
+                for t in ths:
+                    t.join(timeout=300.0)
+            else:
+                for i in range(nobs):
+                    _one(i, mk(i))
+        for pname in ("solo", "spec", "continuous"):
+            n = st._m_ttft.count(path=pname)
+            if not n:
+                log(f"{pname:>10}: no requests routed here (window "
+                    "timing); see dllama_requests_path_total")
+                continue
+            log(f"{pname:>10}: n={n} TTFT p50 "
+                f"{st._m_ttft.percentile(50, path=pname):.1f} ms, p95 "
+                f"{st._m_ttft.percentile(95, path=pname):.1f} ms | TPOT "
+                f"p50 {st._m_tpot.percentile(50, path=pname):.2f} ms, p95 "
+                f"{st._m_tpot.percentile(95, path=pname):.2f} ms")
+        routed = {c["labels"].get("path"): c["value"]
+                  for c in reg.snapshot()
+                  .get("dllama_requests_path_total", {}).get("values", [])}
+        log(f"paths routed: {routed}")
+        return (on_ms,
+                f"{weights}-obs-b{B}-overhead{overhead:.2f}pct{cfg_tag}")
+
     # BENCH_SPEC=K measures speculative decoding (prompt-lookup drafts of up
     # to K tokens, exact greedy): solo generate_spec, or — with BENCH_BATCH —
     # generate_batch_spec (draft_len+1 positions x B rows per weight pass).
@@ -735,6 +857,7 @@ def main() -> None:
                  else "serve" if _env_count("BENCH_CONTINUOUS")
                  else "faults" if _env_count("BENCH_FAULTS")
                  else "integrity" if _env_count("BENCH_INTEGRITY")
+                 else "obs" if _env_count("BENCH_OBS")
                  else "decode")
     err_metric = {"tiny": "tinyllama_1.1b", "llama3": "llama3_8b",
                   "moe": "mixtral_lite", "grok": "grok1_lite",
@@ -817,7 +940,8 @@ def main() -> None:
     if choice == "smoke" or (not choice and platform == "cpu"
                              and (_env_count("BENCH_CONTINUOUS")
                                   or _env_count("BENCH_FAULTS")
-                                  or _env_count("BENCH_INTEGRITY"))):
+                                  or _env_count("BENCH_INTEGRITY")
+                                  or _env_count("BENCH_OBS"))):
         # the continuous-vs-static comparison measures SCHEDULING, so the
         # CPU default is a shape small enough to replay inside CI budgets
         name, cfg_dict = "smoke", SMOKE_SERVE
@@ -857,6 +981,7 @@ def main() -> None:
              else "serve" if _env_count("BENCH_CONTINUOUS")
              else "faults" if _env_count("BENCH_FAULTS")
              else "integrity" if _env_count("BENCH_INTEGRITY")
+             else "obs" if _env_count("BENCH_OBS")
              else "decode")
     result = {
         "metric": f"{name}_{phase}_ms_per_token",

@@ -112,6 +112,21 @@ def build_parser() -> argparse.ArgumentParser:
                 help="write the server pid here (atomic tmp+rename); "
                 "removed on shutdown",
             )
+            sp.add_argument(
+                "--log-json",
+                action="store_true",
+                help="emit one structured JSON line per finished request "
+                "(request id, path, TTFT/TPOT, token counts, finish "
+                "reason) to stderr; prompt TEXT is never logged — only "
+                "token counts and a sha256 digest — unless --log-prompts",
+            )
+            sp.add_argument(
+                "--log-prompts",
+                action="store_true",
+                help="include raw prompt text in --log-json records "
+                "(privacy default is OFF: logs carry counts and hashes "
+                "only)",
+            )
         sp.add_argument("--model", required=True)
         sp.add_argument("--tokenizer", required=True)
         sp.add_argument("--prompt", default=None)

@@ -62,7 +62,7 @@ from typing import Iterator
 
 import numpy as np
 
-from dllama_tpu import faults
+from dllama_tpu import faults, observability
 from dllama_tpu.formats.spec import (
     MAX_HEADER_SIZE,
     ArchType,
@@ -76,6 +76,17 @@ from dllama_tpu.quants import blocks
 INTEGRITY_TAG = b"DLCK"
 INTEGRITY_VERSION = 1
 _SEC_FIXED = struct.calcsize("<4sIIQ")  # tag + version + n_tensors + payload_size
+
+_REG = observability.default_registry()
+_M_CRC_FAIL = _REG.counter(
+    "dllama_weights_checksum_failures_total",
+    "Tensors whose bytes failed the recorded CRC32 (lazy read or verify)")
+_M_OPEN_FAIL = _REG.counter(
+    "dllama_weights_open_failures_total",
+    "Weight files rejected at open (empty/truncated/hostile header)")
+_M_VERIFIED = _REG.counter(
+    "dllama_weights_tensors_verified_total",
+    "Tensors that passed their CRC32 check")
 
 
 class ChecksumError(FormatError):
@@ -190,6 +201,7 @@ class WeightFileReader:
             try:
                 self._mm = mmap.mmap(self._file.fileno(), 0, access=mmap.ACCESS_READ)
             except ValueError:
+                _M_OPEN_FAIL.inc()
                 raise FormatError(f"empty weight file: {path}") from None
         except BaseException:
             self._file.close()
@@ -224,7 +236,9 @@ class WeightFileReader:
             self._lazy_verify = (
                 self.tensor_crcs is not None
                 and os.environ.get("DLLAMA_WEIGHTS_VERIFY", "1") != "0")
-        except BaseException:
+        except BaseException as e:
+            if isinstance(e, FormatError):
+                _M_OPEN_FAIL.inc()
             self.close()
             raise
 
@@ -269,8 +283,10 @@ class WeightFileReader:
                 # exception (and so this frame) must not pin the buffer and
                 # turn a later close() into a BufferError
                 del raw
+                _M_CRC_FAIL.inc()
                 raise ChecksumError(self.path, e.name, e.offset, expected, actual)
             self._verified.add(e.name)
+            _M_VERIFIED.inc()
         return raw
 
     def read_tensor(self, name: str, dtype=np.float32) -> np.ndarray:
@@ -318,6 +334,7 @@ class WeightFileReader:
             actual = zlib.crc32(self._raw_view(e))
             expected = self.tensor_crcs[i]
             if actual != expected:
+                _M_CRC_FAIL.inc()
                 failures.append({
                     "name": e.name, "offset": e.offset, "nbytes": e.nbytes,
                     "expected_crc32": f"{expected:#010x}",
@@ -325,6 +342,7 @@ class WeightFileReader:
                 })
             else:
                 self._verified.add(e.name)
+                _M_VERIFIED.inc()
         return {
             "path": self.path,
             "ok": not failures,

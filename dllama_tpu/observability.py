@@ -1,0 +1,606 @@
+"""Dependency-free serving telemetry: metrics, request traces, structured logs.
+
+Three cooperating pieces, all stdlib-only (matching the repo's no-deps style):
+
+* ``MetricsRegistry`` — hand-rolled Counter / Gauge / Histogram families with
+  Prometheus text exposition (``render()``) and a JSON snapshot (``snapshot()``)
+  for the ``/stats`` endpoint.  Metric handles are get-or-create so every layer
+  (server, scheduler, engine, weights I/O) can register against the shared
+  default registry without import-order coupling.  The hot path of a disabled
+  component is a single ``is not None`` check, mirroring ``faults.fire``.
+
+* ``RequestTrace`` — per-request phase marks (queue wait, prefill, decode,
+  first/last token) accumulated lock-free by whichever thread owns the phase
+  (HTTP handler or scheduler) and read once at completion.  ``finish`` turns
+  the marks into derived latencies (TTFT, TPOT, queue-wait) plus Chrome
+  trace-event spans.
+
+* Trace/log emitters — ``DLLAMA_TRACE=<path>`` streams Chrome trace events
+  (JSON Array Format: one event per line, ``]`` intentionally omitted as the
+  format allows, loadable by Perfetto and chrome://tracing), and
+  ``log_json_line`` prints one structured JSON log line per request.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import math
+import os
+import threading
+import time
+import uuid
+from typing import Callable, Dict, Iterable, List, Optional, Sequence, Tuple
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "RequestTrace",
+    "default_registry",
+    "configure_trace",
+    "trace_path",
+    "emit_trace_events",
+    "log_json_line",
+    "prompt_digest",
+    "new_request_id",
+    "LATENCY_BUCKETS_MS",
+]
+
+# Default latency buckets (milliseconds). Wide enough for CPU-smoke prefill
+# (hundreds of ms) down to per-chunk decode on hardware (single-digit ms).
+LATENCY_BUCKETS_MS: Tuple[float, ...] = (
+    1.0, 2.0, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0, 500.0,
+    1000.0, 2500.0, 5000.0, 10000.0, 30000.0,
+)
+
+_RESERVOIR_CAP = 2048  # per-series ring of raw samples, for percentiles
+
+
+def _escape_label(value: object) -> str:
+    return (
+        str(value)
+        .replace("\\", "\\\\")
+        .replace('"', '\\"')
+        .replace("\n", "\\n")
+    )
+
+
+def _fmt_value(v: float) -> str:
+    if v == math.inf:
+        return "+Inf"
+    if v == -math.inf:
+        return "-Inf"
+    f = float(v)
+    if f.is_integer() and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
+
+
+class _Metric:
+    """Common family machinery: label keying, child storage, exposition."""
+
+    kind = "untyped"
+
+    def __init__(self, name: str, help: str, labelnames: Tuple[str, ...],
+                 registry: "MetricsRegistry"):
+        self.name = name
+        self.help = help
+        self.labelnames = labelnames
+        self._registry = registry
+        self._lock = registry._lock
+        self._children: Dict[Tuple[str, ...], object] = {}
+
+    def _key(self, labels: Dict[str, object]) -> Tuple[str, ...]:
+        if set(labels) != set(self.labelnames):
+            raise ValueError(
+                f"{self.name}: expected labels {self.labelnames}, got {tuple(labels)}"
+            )
+        return tuple(str(labels[n]) for n in self.labelnames)
+
+    def _label_str(self, key: Tuple[str, ...], extra: str = "") -> str:
+        parts = [f'{n}="{_escape_label(v)}"' for n, v in zip(self.labelnames, key)]
+        if extra:
+            parts.append(extra)
+        return "{" + ",".join(parts) + "}" if parts else ""
+
+    # Subclasses implement render_into(lines) and snapshot_values().
+
+
+class Counter(_Metric):
+    kind = "counter"
+
+    def inc(self, amount: float = 1.0, **labels: object) -> None:
+        key = self._key(labels)
+        with self._lock:
+            self._children[key] = self._children.get(key, 0.0) + amount
+
+    def value(self, **labels: object) -> float:
+        key = self._key(labels)
+        with self._lock:
+            return float(self._children.get(key, 0.0))
+
+    def total(self) -> float:
+        with self._lock:
+            return float(sum(self._children.values()))
+
+    def render_into(self, lines: List[str]) -> None:
+        with self._lock:
+            items = sorted(self._children.items())
+        if not items and not self.labelnames:
+            items = [((), 0.0)]
+        for key, v in items:
+            lines.append(f"{self.name}{self._label_str(key)} {_fmt_value(v)}")
+
+    def snapshot_values(self) -> List[dict]:
+        with self._lock:
+            items = sorted(self._children.items())
+        return [
+            {"labels": dict(zip(self.labelnames, key)), "value": v}
+            for key, v in items
+        ]
+
+
+class Gauge(_Metric):
+    kind = "gauge"
+
+    def set(self, value: float, **labels: object) -> None:
+        key = self._key(labels)
+        with self._lock:
+            self._children[key] = float(value)
+
+    def inc(self, amount: float = 1.0, **labels: object) -> None:
+        key = self._key(labels)
+        with self._lock:
+            cur = self._children.get(key, 0.0)
+            self._children[key] = (cur if isinstance(cur, float) else 0.0) + amount
+
+    def set_function(self, fn: Callable[[], float], **labels: object) -> None:
+        """Callback gauge: ``fn`` is sampled at render/snapshot time.
+
+        Re-registering replaces the previous callback, so short-lived owners
+        (test fixtures, benches) can safely rebind the same series.
+        """
+        key = self._key(labels)
+        with self._lock:
+            self._children[key] = fn
+
+    def value(self, **labels: object) -> float:
+        key = self._key(labels)
+        with self._lock:
+            v = self._children.get(key, 0.0)
+        return self._resolve(v)
+
+    @staticmethod
+    def _resolve(v: object) -> float:
+        if callable(v):
+            try:
+                return float(v())
+            except Exception:
+                return float("nan")  # stale callback (owner torn down)
+        return float(v)  # type: ignore[arg-type]
+
+    def render_into(self, lines: List[str]) -> None:
+        with self._lock:
+            items = sorted(self._children.items())
+        if not items and not self.labelnames:
+            items = [((), 0.0)]
+        for key, v in items:
+            val = self._resolve(v)
+            if math.isnan(val):
+                continue
+            lines.append(f"{self.name}{self._label_str(key)} {_fmt_value(val)}")
+
+    def snapshot_values(self) -> List[dict]:
+        with self._lock:
+            items = sorted(self._children.items())
+        out = []
+        for key, v in items:
+            val = self._resolve(v)
+            if math.isnan(val):
+                continue
+            out.append({"labels": dict(zip(self.labelnames, key)), "value": val})
+        return out
+
+
+class _HistChild:
+    __slots__ = ("bucket_counts", "sum", "count", "samples", "_ring")
+
+    def __init__(self, n_buckets: int):
+        self.bucket_counts = [0] * n_buckets  # cumulative at render time only
+        self.sum = 0.0
+        self.count = 0
+        self.samples: List[float] = []
+        self._ring = 0
+
+
+class Histogram(_Metric):
+    kind = "histogram"
+
+    def __init__(self, name, help, labelnames, registry,
+                 buckets: Sequence[float] = LATENCY_BUCKETS_MS):
+        super().__init__(name, help, labelnames, registry)
+        bs = sorted(float(b) for b in buckets)
+        if not bs or bs[-1] != math.inf:
+            bs.append(math.inf)
+        self.buckets: Tuple[float, ...] = tuple(bs)
+
+    def observe(self, value: float, **labels: object) -> None:
+        key = self._key(labels)
+        v = float(value)
+        with self._lock:
+            child = self._children.get(key)
+            if child is None:
+                child = _HistChild(len(self.buckets))
+                self._children[key] = child
+            for i, b in enumerate(self.buckets):
+                if v <= b:
+                    child.bucket_counts[i] += 1
+                    break
+            child.sum += v
+            child.count += 1
+            if len(child.samples) < _RESERVOIR_CAP:
+                child.samples.append(v)
+            else:
+                child.samples[child._ring % _RESERVOIR_CAP] = v
+            child._ring += 1
+
+    def count(self, **labels: object) -> int:
+        key = self._key(labels)
+        with self._lock:
+            child = self._children.get(key)
+            return child.count if child is not None else 0
+
+    def total_count(self) -> int:
+        with self._lock:
+            return sum(c.count for c in self._children.values())
+
+    def percentile(self, p: float, **labels: object) -> float:
+        """Percentile over the raw-sample reservoir (nan when empty)."""
+        key = self._key(labels)
+        with self._lock:
+            child = self._children.get(key)
+            samples = list(child.samples) if child is not None else []
+        if not samples:
+            return float("nan")
+        samples.sort()
+        idx = min(len(samples) - 1, max(0, int(round((p / 100.0) * (len(samples) - 1)))))
+        return samples[idx]
+
+    def render_into(self, lines: List[str]) -> None:
+        with self._lock:
+            items = sorted(
+                (k, list(c.bucket_counts), c.sum, c.count)
+                for k, c in self._children.items()
+            )
+        for key, bucket_counts, total, count in items:
+            cum = 0
+            for b, n in zip(self.buckets, bucket_counts):
+                cum += n
+                extra = f'le="{_fmt_value(b)}"'
+                lines.append(
+                    f"{self.name}_bucket{self._label_str(key, extra)} {cum}"
+                )
+            lines.append(f"{self.name}_sum{self._label_str(key)} {_fmt_value(total)}")
+            lines.append(f"{self.name}_count{self._label_str(key)} {count}")
+
+    def snapshot_values(self) -> List[dict]:
+        with self._lock:
+            items = sorted(
+                (k, c.sum, c.count, list(c.samples))
+                for k, c in self._children.items()
+            )
+        out = []
+        for key, total, count, samples in items:
+            samples.sort()
+
+            def pct(p: float) -> Optional[float]:
+                if not samples:
+                    return None
+                i = min(len(samples) - 1,
+                        max(0, int(round((p / 100.0) * (len(samples) - 1)))))
+                return samples[i]
+
+            out.append({
+                "labels": dict(zip(self.labelnames, key)),
+                "count": count,
+                "sum": round(total, 3),
+                "p50": pct(50), "p95": pct(95), "p99": pct(99),
+            })
+        return out
+
+
+class MetricsRegistry:
+    """Get-or-create registry of metric families with Prometheus exposition."""
+
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+        self._metrics: Dict[str, _Metric] = {}
+
+    def _get_or_create(self, cls, name: str, help: str,
+                       labelnames: Iterable[str], **kw) -> _Metric:
+        labelnames = tuple(labelnames)
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is not None:
+                if not isinstance(m, cls) or m.labelnames != labelnames:
+                    raise ValueError(
+                        f"metric {name!r} re-registered as {cls.kind} "
+                        f"labels={labelnames}, existing {m.kind} labels={m.labelnames}"
+                    )
+                return m
+            m = cls(name, help, labelnames, self, **kw)
+            self._metrics[name] = m
+            return m
+
+    def counter(self, name: str, help: str = "",
+                labelnames: Iterable[str] = ()) -> Counter:
+        return self._get_or_create(Counter, name, help, labelnames)  # type: ignore[return-value]
+
+    def gauge(self, name: str, help: str = "",
+              labelnames: Iterable[str] = ()) -> Gauge:
+        return self._get_or_create(Gauge, name, help, labelnames)  # type: ignore[return-value]
+
+    def histogram(self, name: str, help: str = "",
+                  labelnames: Iterable[str] = (),
+                  buckets: Sequence[float] = LATENCY_BUCKETS_MS) -> Histogram:
+        return self._get_or_create(Histogram, name, help, labelnames,
+                                   buckets=buckets)  # type: ignore[return-value]
+
+    def render(self) -> str:
+        """Prometheus text exposition format 0.0.4."""
+        lines: List[str] = []
+        with self._lock:
+            metrics = sorted(self._metrics.values(), key=lambda m: m.name)
+        for m in metrics:
+            lines.append(f"# HELP {m.name} {m.help}")
+            lines.append(f"# TYPE {m.name} {m.kind}")
+            m.render_into(lines)
+        return "\n".join(lines) + "\n"
+
+    def snapshot(self) -> Dict[str, dict]:
+        """JSON-friendly dump for /stats: histograms carry p50/p95/p99."""
+        out: Dict[str, dict] = {}
+        with self._lock:
+            metrics = sorted(self._metrics.values(), key=lambda m: m.name)
+        for m in metrics:
+            out[m.name] = {"kind": m.kind, "help": m.help,
+                           "values": m.snapshot_values()}
+        return out
+
+
+_DEFAULT = MetricsRegistry()
+
+
+def default_registry() -> MetricsRegistry:
+    return _DEFAULT
+
+
+# ---------------------------------------------------------------------------
+# Chrome trace-event output (DLLAMA_TRACE=<path>)
+
+_trace_lock = threading.Lock()
+_trace_path: Optional[str] = None
+_trace_file = None
+_trace_env_checked = False
+
+# Wall-clock anchor so monotonic phase marks land on the epoch timeline.
+_T0_MONO = time.monotonic()
+_T0_EPOCH_US = int(time.time() * 1e6)
+
+
+def _mono_to_us(t_mono: float) -> int:
+    return _T0_EPOCH_US + int((t_mono - _T0_MONO) * 1e6)
+
+
+def configure_trace(path: Optional[str]) -> None:
+    """Point span output at ``path`` (truncates), or disable with None."""
+    global _trace_path, _trace_file, _trace_env_checked
+    with _trace_lock:
+        if _trace_file is not None:
+            try:
+                _trace_file.close()
+            except OSError:
+                pass
+            _trace_file = None
+        _trace_path = path or None
+        _trace_env_checked = True
+        if _trace_path:
+            # Chrome JSON Array Format: open bracket now, one event per line,
+            # closing bracket optional per the spec — Perfetto loads it as-is.
+            _trace_file = open(_trace_path, "w", encoding="utf-8")
+            _trace_file.write("[\n")
+            _trace_file.flush()
+
+
+def trace_path() -> Optional[str]:
+    global _trace_env_checked
+    if not _trace_env_checked:
+        env = os.environ.get("DLLAMA_TRACE")
+        if env:
+            configure_trace(env)  # sets _trace_env_checked
+        else:
+            _trace_env_checked = True
+    return _trace_path
+
+
+def emit_trace_events(events: List[dict]) -> None:
+    if trace_path() is None or not events:
+        return
+    with _trace_lock:
+        f = _trace_file
+        if f is None:
+            return
+        try:
+            for e in events:
+                f.write(json.dumps(e, separators=(",", ":")) + ",\n")
+            f.flush()
+        except OSError:
+            pass
+
+
+# ---------------------------------------------------------------------------
+# Structured JSON logs
+
+_log_lock = threading.Lock()
+
+
+def log_json_line(record: dict, stream=None) -> None:
+    """One JSON object per line; safe under concurrent request threads."""
+    import sys
+    out = stream if stream is not None else sys.stdout
+    line = json.dumps(record, separators=(",", ":"), sort_keys=True)
+    with _log_lock:
+        try:
+            out.write(line + "\n")
+            out.flush()
+        except (OSError, ValueError):
+            pass
+
+
+def prompt_digest(text: str) -> str:
+    """Privacy-preserving prompt identifier: short sha256, never the text."""
+    return hashlib.sha256(text.encode("utf-8", "replace")).hexdigest()[:16]
+
+
+def new_request_id() -> str:
+    return "req-" + uuid.uuid4().hex[:20]
+
+
+def sanitize_request_id(raw: Optional[str]) -> str:
+    """Honor a client X-Request-Id if it is sane, else mint one."""
+    if raw:
+        rid = "".join(c for c in raw.strip() if c.isprintable() and c not in '",\\')
+        if 0 < len(rid) <= 128:
+            return rid
+    return new_request_id()
+
+
+# ---------------------------------------------------------------------------
+# Per-request trace
+
+class RequestTrace:
+    """Phase marks for one request; each field is written by exactly one
+    thread (handler or scheduler) and read after completion, so no lock."""
+
+    __slots__ = (
+        "request_id", "t0", "path", "t_start", "prefill_ms",
+        "t_first", "t_last", "admission_depth", "queue_depth",
+        "tokens_in", "tokens_out", "finish_reason", "status",
+        "prompt_sha", "prompt_text", "model",
+    )
+
+    def __init__(self, request_id: str):
+        self.request_id = request_id
+        self.t0 = time.monotonic()
+        self.path: Optional[str] = None       # solo | spec | continuous | n_batch
+        self.t_start: Optional[float] = None  # decode admitted / lock acquired
+        self.prefill_ms: Optional[float] = None
+        self.t_first: Optional[float] = None  # first token produced
+        self.t_last: Optional[float] = None
+        self.admission_depth: int = 0         # gate depth at admission
+        self.queue_depth: int = 0             # batcher backlog at enqueue
+        self.tokens_in: int = 0
+        self.tokens_out: int = 0
+        self.finish_reason: Optional[str] = None
+        self.status: int = 0
+        self.prompt_sha: Optional[str] = None
+        #: raw prompt text — ONLY populated when the server runs with
+        #: --log-prompts; never written to logs otherwise (privacy default)
+        self.prompt_text: Optional[str] = None
+        self.model: Optional[str] = None
+
+    # -- marks (cheap; called from scheduler/handler hot paths) --
+
+    def mark_start(self, path: str) -> None:
+        if self.t_start is None:
+            self.t_start = time.monotonic()
+        self.path = path
+
+    def mark_prefill(self, ms: float) -> None:
+        self.prefill_ms = ms
+
+    def mark_token(self) -> None:
+        now = time.monotonic()
+        if self.t_first is None:
+            self.t_first = now
+        self.t_last = now
+
+    # -- derived latencies --
+
+    @property
+    def queue_wait_ms(self) -> Optional[float]:
+        if self.t_start is None:
+            return None
+        return (self.t_start - self.t0) * 1e3
+
+    @property
+    def ttft_ms(self) -> Optional[float]:
+        if self.t_first is None:
+            return None
+        return (self.t_first - self.t0) * 1e3
+
+    @property
+    def tpot_ms(self) -> Optional[float]:
+        if self.t_first is None or self.t_last is None or self.tokens_out < 2:
+            return None
+        return (self.t_last - self.t_first) * 1e3 / (self.tokens_out - 1)
+
+    # -- emission --
+
+    def record(self) -> dict:
+        r = {
+            "event": "request",
+            "request_id": self.request_id,
+            "path": self.path,
+            "status": self.status,
+            "finish_reason": self.finish_reason,
+            "tokens_in": self.tokens_in,
+            "tokens_out": self.tokens_out,
+            "admission_depth": self.admission_depth,
+            "queue_depth": self.queue_depth,
+            "queue_wait_ms": _r(self.queue_wait_ms),
+            "prefill_ms": _r(self.prefill_ms),
+            "ttft_ms": _r(self.ttft_ms),
+            "tpot_ms": _r(self.tpot_ms),
+            "total_ms": _r((time.monotonic() - self.t0) * 1e3),
+        }
+        if self.prompt_sha:
+            r["prompt_sha256"] = self.prompt_sha
+        if self.model:
+            r["model"] = self.model
+        return r
+
+    def trace_events(self) -> List[dict]:
+        """Chrome complete-events ('ph':'X'), one track per request so child
+        spans (queue_wait / prefill / decode) nest under the request span."""
+        end = time.monotonic()
+        pid = os.getpid()
+        tid = int(hashlib.sha1(self.request_id.encode()).hexdigest()[:6], 16)
+        args = {"request_id": self.request_id, "path": self.path,
+                "tokens_in": self.tokens_in, "tokens_out": self.tokens_out,
+                "finish_reason": self.finish_reason}
+
+        def ev(name: str, t_a: float, t_b: float, extra: Optional[dict] = None) -> dict:
+            return {
+                "name": name, "ph": "X", "pid": pid, "tid": tid,
+                "ts": _mono_to_us(t_a),
+                "dur": max(1, int((t_b - t_a) * 1e6)),
+                "cat": "request", "args": extra or {},
+            }
+
+        events = [ev("request", self.t0, end, args)]
+        if self.t_start is not None:
+            events.append(ev("queue_wait", self.t0, self.t_start))
+            if self.prefill_ms is not None:
+                pf_end = min(end, self.t_start + self.prefill_ms / 1e3)
+                events.append(ev("prefill", self.t_start, pf_end))
+        if self.t_first is not None and self.t_last is not None:
+            events.append(ev("decode", self.t_first, min(end, self.t_last),
+                             {"tokens": self.tokens_out}))
+        return events
+
+
+def _r(v: Optional[float]) -> Optional[float]:
+    return None if v is None else round(v, 3)

@@ -24,13 +24,16 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from dllama_tpu import faults
+from dllama_tpu import faults, observability
 from dllama_tpu.models import llama
 from dllama_tpu.models.config import ModelConfig
 from dllama_tpu.runtime.sampler import SamplerConfig, sample_dynamic
 
 PREFILL_BUCKETS = (8, 16, 32, 64, 128, 256, 512, 1024, 2048, 4096, 8192)
 DECODE_CHUNK = 64  # fused-loop chunk size: one compile serves any steps count
+
+#: sentinel for Engine(metrics=...): "the shared default registry"
+DEFAULT_METRICS = object()
 
 
 class NumericHealthError(RuntimeError):
@@ -110,6 +113,7 @@ class Engine:
         tp_compress: bool = False,
         decode_chunk: int = DECODE_CHUNK,
         numeric_checks: bool = True,
+        metrics=DEFAULT_METRICS,
     ):
         """``mesh``: a 1-D ``tp`` Mesh (see parallel.mesh.tp_mesh) to run
         tensor-parallel — params are placed with the reference's row/col
@@ -120,9 +124,45 @@ class Engine:
         ``isfinite(logits)`` per-row flag — into every decode step (plus the
         ``logits:nan`` fault-injection seam). Elementwise over [B, vocab],
         dwarfed by the [vocab, dim] classifier matmul; BENCH_INTEGRITY
-        measures the overhead (<1% target). Off only for that A/B."""
+        measures the overhead (<1% target). Off only for that A/B.
+
+        ``metrics``: an observability.MetricsRegistry to record prefill /
+        decode-chunk wall times, spec-decode acceptance, and watchdog
+        quarantines into. Defaults to the shared default registry; pass
+        ``None`` to disable all engine telemetry (the BENCH_OBS A/B
+        baseline) — the disabled hot path is a single ``is not None``
+        check per handle."""
         if decode_chunk < 1:
             raise ValueError(f"decode_chunk must be >= 1, got {decode_chunk}")
+        if metrics is DEFAULT_METRICS:
+            metrics = observability.default_registry()
+        self.metrics = metrics
+        if metrics is not None:
+            self._m_prefill = metrics.histogram(
+                "dllama_prefill_ms", "Prompt prefill wall time per request")
+            self._m_step = metrics.histogram(
+                "dllama_decode_step_ms",
+                "Per-token decode wall time (solo streaming path)")
+            self._m_chunk = metrics.histogram(
+                "dllama_decode_chunk_ms",
+                "Fused decode-chunk wall time (fused/batched/pooled paths)")
+            self._m_quarantine = metrics.counter(
+                "dllama_numeric_quarantines_total",
+                "Rows/streams stopped by the numeric-health watchdog")
+            self._m_spec_steps = metrics.counter(
+                "dllama_spec_verify_steps_total",
+                "Speculative-decode verify launches")
+            self._m_spec_accepted = metrics.counter(
+                "dllama_spec_drafts_accepted_total",
+                "Draft tokens accepted by speculative verify")
+            self._m_spec_emitted = metrics.counter(
+                "dllama_spec_tokens_emitted_total",
+                "Tokens emitted by speculative decode paths")
+        else:
+            self._m_prefill = self._m_step = self._m_chunk = None
+            self._m_quarantine = None
+            self._m_spec_steps = self._m_spec_accepted = None
+            self._m_spec_emitted = None
         self.cfg = cfg
         self.sampler_cfg = sampler_cfg
         self.mesh = mesh
@@ -584,6 +624,8 @@ class Engine:
             token = jnp.asarray(prompt_tokens[0], jnp.int32)
         token.block_until_ready()
         self.prefill_ms = (time.perf_counter() - t0) * 1000.0
+        if self._m_prefill is not None and len(prompt_tokens) > 1:
+            self._m_prefill.observe(self.prefill_ms)
 
         tok_int: Optional[int] = None
         if len(prompt_tokens) > 1:
@@ -619,10 +661,14 @@ class Engine:
             t3 = time.perf_counter()
             if not bool(ok):
                 # fail fast: the sampled token is garbage — don't emit it
+                if self._m_quarantine is not None:
+                    self._m_quarantine.inc()
                 raise NumericHealthError(f"at decode position {pos}")
             tok_int = int(token)
             t4 = time.perf_counter()
             dt = (t4 - t1) * 1000.0
+            if self._m_step is not None:
+                self._m_step.observe(dt)
             pos += 1
             self.final_session = Session(cache, pos, pending_token=tok_int)
             yield tok_int, TokenStats(
@@ -687,6 +733,8 @@ class Engine:
             first = []
         token.block_until_ready()
         self.prefill_ms = prefill_ms = (time.perf_counter() - t0) * 1000.0
+        if self._m_prefill is not None and len(prompt_tokens) > 1:
+            self._m_prefill.observe(prefill_ms)
 
         # run the scan in BUCKETED chunk sizes so distinct `steps` values reuse
         # a handful of compiles (like prefill); overshooting the last chunk is
@@ -697,6 +745,7 @@ class Engine:
         remaining = steps
         chunk_size = self.decode_chunk
         while remaining > 0:
+            tc = time.perf_counter()
             # tail chunks reuse prefill buckets for compile sharing, but never
             # exceed the caller's chunk size (it bounds program size/latency);
             # prefill_bucket(r) >= r, so full chunks resolve to chunk_size
@@ -708,9 +757,13 @@ class Engine:
             )
             take = min(n, remaining)
             if not bool(ok):
+                if self._m_quarantine is not None:
+                    self._m_quarantine.inc()
                 raise NumericHealthError(
                     f"in fused decode chunk starting at position {pos}")
             chunk_list = [int(t) for t in np.asarray(chunk)]
+            if self._m_chunk is not None:
+                self._m_chunk.observe((time.perf_counter() - tc) * 1000.0)
             toks.extend(chunk_list[:take])
             token = chunk[-1]
             pos += take
@@ -804,6 +857,7 @@ class Engine:
         remaining = steps
         t1 = time.perf_counter()
         while remaining > 0:
+            tc = time.perf_counter()
             n = min(self.decode_chunk, prefill_bucket(remaining))
             chunk, cache, keys, ok = self._decode_loop_batch(
                 cache, tokens, pos, keys, temps, topps,
@@ -812,7 +866,12 @@ class Engine:
             take = min(n, remaining)
             arr = np.asarray(chunk)  # [n, B]
             okh = np.asarray(ok)  # [B]
+            if self._m_chunk is not None:
+                self._m_chunk.observe((time.perf_counter() - tc) * 1000.0)
             for b in range(B):
+                if self.row_health[b] and not bool(okh[b]) \
+                        and self._m_quarantine is not None:
+                    self._m_quarantine.inc()
                 self.row_health[b] = self.row_health[b] and bool(okh[b])
             done = steps - remaining  # tokens every row was offered so far
             fresh: list = [[] for _ in range(B)]
@@ -862,6 +921,8 @@ class Engine:
         pend = [int(p[-1]) for p in prompts]
         poss = [len(p) - 1 for p in prompts]
         self.prefill_ms = (time.perf_counter() - t0) * 1000.0
+        if self._m_prefill is not None:
+            self._m_prefill.observe(self.prefill_ms)
         return cache, pend, poss
 
     def batch_session(self, max_batch: int,
@@ -1020,9 +1081,14 @@ class Engine:
             if on_step is not None:
                 on_step(fresh)
         self.decode_ms = (time.perf_counter() - t1) * 1000.0
+        emitted_total = sum(len(r) for r in out)
+        if self._m_spec_steps is not None:
+            self._m_spec_steps.inc(verify_steps)
+            self._m_spec_accepted.inc(accepted)
+            self._m_spec_emitted.inc(emitted_total)
         return out, {"verify_steps": verify_steps,
                      "accepted_drafts": accepted,
-                     "emitted": sum(len(r) for r in out)}
+                     "emitted": emitted_total}
 
     def generate_spec(
         self,
@@ -1179,6 +1245,10 @@ class Engine:
                         break
                 out = out[:take]
                 commit(states[take - 1])
+                if self._m_spec_steps is not None:
+                    self._m_spec_steps.inc()
+                    self._m_spec_accepted.inc(m)
+                    self._m_spec_emitted.inc(take)
                 index.extend([token] + draft[:m])
                 # (on a truncated batch the generator is about to return /
                 # exit, so the pending token is never fed again)
@@ -1350,7 +1420,10 @@ class BatchSession:
             self.cache = self.eng._batch_cache_insert(
                 self.cache, single, jnp.int32(slot))
             del single
-        self.prefill_ms += (time.perf_counter() - t0) * 1000.0
+        admit_ms = (time.perf_counter() - t0) * 1000.0
+        self.prefill_ms += admit_ms
+        if self.eng._m_prefill is not None and len(prompt_tokens) > 1:
+            self.eng._m_prefill.observe(admit_ms)
         pos0 = len(prompt_tokens) - 1
         self._tokens = self._tokens.at[slot].set(int(prompt_tokens[-1]))
         self._pos = self._pos.at[slot].set(pos0)
@@ -1398,13 +1471,18 @@ class BatchSession:
         # mirror the in-program per-row pin across chunk boundaries
         self._pos = jnp.minimum(self._pos + self.chunk,
                                 jnp.int32(self.eng.cfg.seq_len - 1))
-        self.decode_ms += (time.perf_counter() - t1) * 1000.0
+        chunk_ms = (time.perf_counter() - t1) * 1000.0
+        self.decode_ms += chunk_ms
+        if self.eng._m_chunk is not None:
+            self.eng._m_chunk.observe(chunk_ms)
         fresh: dict = {}
         for b in live:
             st = self._slots[b]
             if not okh[b]:
                 st.done = True
                 st.finish = "error"
+                if self.eng._m_quarantine is not None:
+                    self.eng._m_quarantine.inc()
                 fresh[b] = []
                 continue
             # a context-exhausted row pinned at its last slot: tokens past

@@ -37,7 +37,8 @@ import time
 import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
-from dllama_tpu import faults
+from dllama_tpu import faults, observability
+from dllama_tpu.observability import RequestTrace
 from dllama_tpu.runtime.generate import NumericHealthError
 from dllama_tpu.runtime.sampler import SamplerConfig
 from dllama_tpu.serving.lifecycle import (
@@ -173,10 +174,10 @@ class Batcher:
 
     class _Slot:
         __slots__ = ("prompt", "steps", "sampler", "tokens", "error", "done",
-                     "queue", "deadline", "cancel")
+                     "queue", "deadline", "cancel", "trace")
 
         def __init__(self, prompt, steps, sampler, streaming: bool,
-                     deadline=None, cancel=None):
+                     deadline=None, cancel=None, trace=None):
             self.prompt, self.steps, self.sampler = prompt, steps, sampler
             self.tokens = None
             self.error = None
@@ -191,6 +192,22 @@ class Batcher:
             #: socket dies; the scheduler releases the row's slot at the
             #: next chunk boundary instead of decoding for a dead socket
             self.cancel = cancel
+            #: observability.RequestTrace — the scheduler marks routing
+            #: (mark_start: which path served this request), prefill and
+            #: token times on it; the HTTP handler owns emission
+            self.trace = trace
+
+        def mark_start(self, path: str) -> None:
+            if self.trace is not None:
+                self.trace.mark_start(path)
+
+        def mark_prefill(self, ms: float) -> None:
+            if self.trace is not None:
+                self.trace.mark_prefill(ms)
+
+        def mark_token(self) -> None:
+            if self.trace is not None:
+                self.trace.mark_token()
 
         def lifecycle_error(self):
             """None, or the typed error that should resolve this request
@@ -220,6 +237,18 @@ class Batcher:
         self.chunk = max(1, chunk)
         self._lock = threading.Lock()
         self._arrivals: queue_mod.Queue = queue_mod.Queue()
+        # scheduler-layer telemetry (shares the server's registry): which
+        # path served each request, and how full the slot pool ran
+        reg = state.metrics
+        self._m_path = reg.counter(
+            "dllama_requests_path_total",
+            "Completions served, by decode path (solo/spec/continuous)",
+            ("path",))
+        self._m_occupancy = reg.histogram(
+            "dllama_batch_occupancy",
+            "Occupied slots of the pooled decode session, observed per "
+            "fused chunk",
+            buckets=tuple(float(i) for i in range(1, self.max_batch + 1)))
         #: lifecycle.Supervisor owning the scheduler thread: a crashed loop
         #: fails its window's slots 503 and restarts instead of leaving
         #: every later submit() hanging on a dead daemon
@@ -270,6 +299,8 @@ class Batcher:
             if err is not None:
                 self._resolve_err(s, err)
                 return
+            s.mark_start("solo")
+            self._m_path.inc(path="solo")
             session, feed = st.take_prefix_session(s.prompt)
             history = list(s.prompt)
             stream = st.open_stream(s.prompt, feed, session, s.steps,
@@ -279,6 +310,7 @@ class Batcher:
             for t, _ in stream:
                 history.append(t)
                 toks.append(t)
+                s.mark_token()
                 if s.queue is not None:
                     s.queue.put([t])
                 err = s.lifecycle_error()
@@ -286,6 +318,7 @@ class Batcher:
                     break  # abandon the generator at a token boundary;
                     # final_session is refreshed before every yield, so the
                     # stored state matches exactly what was consumed
+            s.mark_prefill(getattr(st.engine, "prefill_ms", 0.0) or 0.0)
             st.store_prefix_session(history, st.engine.final_session)
             if err is not None:
                 self._resolve_err(s, err)
@@ -339,13 +372,18 @@ class Batcher:
         if not batch:
             return
         try:
+            for s in batch:
+                s.mark_start("spec")
+                self._m_path.inc(path="spec")
             prompts, row_steps = padded_batch(
                 [s.prompt for s in batch], [s.steps for s in batch])
 
             def on_step(fresh):
                 for i, s in enumerate(batch):
-                    if s.queue is not None and fresh[i]:
-                        s.queue.put(fresh[i])
+                    if fresh[i]:
+                        s.mark_token()
+                        if s.queue is not None:
+                            s.queue.put(fresh[i])
 
             def row_cancel(i):
                 return (i < len(batch)
@@ -363,7 +401,9 @@ class Batcher:
                 on_step=on_step,
                 row_cancel=row_cancel,
             )
+            prefill_ms = getattr(self.state.engine, "prefill_ms", 0.0) or 0.0
             for s, row in zip(batch, rows):
+                s.mark_prefill(prefill_ms)
                 if self._reap_slot(s):
                     continue  # cancelled/expired mid-verify: typed error
                 s.tokens = row[: s.steps]
@@ -418,17 +458,25 @@ class Batcher:
                         self._resolve_err(s, err)
                 while waiting and sess.free_slots:
                     s = waiting.pop(0)
+                    s.mark_start("continuous")
+                    self._m_path.inc(path="continuous")
+                    pre_admit_ms = sess.prefill_ms
                     try:
                         b = sess.admit(s.prompt, s.steps, sampler=s.sampler,
                                        stop_tokens=stop_ids)
                     except Exception as e:  # noqa: BLE001 — this row only
                         self._fail([s], e)
                         continue
+                    s.mark_prefill(sess.prefill_ms - pre_admit_ms)
                     s.tokens = []
                     slot_map[b] = s
+                if slot_map:
+                    self._m_occupancy.observe(float(len(slot_map)))
                 for b, burst in sess.step_chunk().items():
                     s = slot_map[b]
                     s.tokens.extend(burst)
+                    if burst:
+                        s.mark_token()
                     if s.queue is not None and burst:
                         s.queue.put(burst)
                     if sess.is_done(b):
@@ -553,12 +601,13 @@ class Batcher:
 
     def submit(self, prompt_tokens: list, max_tokens: int,
                sampler: SamplerConfig, deadline: Deadline = None,
-               cancel: CancelToken = None) -> list:
+               cancel: CancelToken = None, trace=None) -> list:
         """Blocks until this request's tokens are decoded (by the scheduler
         thread's pool). Thread-safe; raises the decode's failure as
         RuntimeError (typed LifecycleError for deadline/cancel/crash)."""
         slot = self._Slot(list(prompt_tokens), max_tokens, sampler,
-                          streaming=False, deadline=deadline, cancel=cancel)
+                          streaming=False, deadline=deadline, cancel=cancel,
+                          trace=trace)
         self._enqueue(slot)
         self._wait_resolution(slot)
         if slot.error is not None:
@@ -567,13 +616,14 @@ class Batcher:
 
     def submit_stream(self, prompt_tokens: list, max_tokens: int,
                       sampler: SamplerConfig, deadline: Deadline = None,
-                      cancel: CancelToken = None):
+                      cancel: CancelToken = None, trace=None):
         """Yields bursts (lists) of token ids as the pool decodes — from
         admission, not from batch completion. Raises the decode failure as
         RuntimeError. A set ``cancel`` token ends the generator (the
         scheduler releases the row's slot at its next chunk boundary)."""
         slot = self._Slot(list(prompt_tokens), max_tokens, sampler,
-                          streaming=True, deadline=deadline, cancel=cancel)
+                          streaming=True, deadline=deadline, cancel=cancel,
+                          trace=trace)
         self._enqueue(slot)
         while True:
             try:
@@ -603,7 +653,9 @@ class ServerState:
                  default_seed: int = None, spec_draft: int = 0,
                  session_cache: int = 2, batch_window_ms: float = 0.0,
                  batch_max: int = 8, batch_chunk: int = 8,
-                 request_timeout: float = 0.0, queue_depth: int = 64):
+                 request_timeout: float = 0.0, queue_depth: int = 64,
+                 metrics=None, log_json: bool = False,
+                 log_prompts: bool = False, log_stream=None):
         """``default_seed``: seed for requests that send none — None means a
         fresh time-based seed per request (the launch-flag --seed plumbs in
         here so an operator can make the whole server reproducible).
@@ -619,7 +671,14 @@ class ServerState:
         its decode row is released at the next chunk boundary.
         ``queue_depth``: max concurrent requests admitted (--queue-depth);
         overflow is rejected 429 + Retry-After instead of queuing
-        unboundedly."""
+        unboundedly.
+        ``metrics``: observability.MetricsRegistry to register server-layer
+        series on (None = the process-wide default registry, which the
+        engine/lifecycle/weights layers already share — one /metrics scrape
+        covers all four layers). ``log_json``: emit one structured JSON
+        line per finished request to ``log_stream`` (default stderr).
+        ``log_prompts``: include raw prompt text in those logs — OFF by
+        default; logs carry only token counts and a sha256 prompt digest."""
         self.engine = engine
         self.tokenizer = tokenizer
         self.cfg = cfg
@@ -638,6 +697,48 @@ class ServerState:
         #: door rather than an unbounded pile of blocked HTTP threads
         self.gate = AdmissionGate(queue_depth)
         self.lock = threading.Lock()  # engine serves one request at a time
+        # -- observability: server-layer series (HTTP + per-request latency).
+        # Registered BEFORE the batcher so its scheduler-layer handles share
+        # the same registry instance.
+        self.metrics = (metrics if metrics is not None
+                        else observability.default_registry())
+        self.log_json = bool(log_json)
+        self.log_prompts = bool(log_prompts)
+        self.log_stream = log_stream
+        self.started_at = time.time()
+        reg = self.metrics
+        self._m_http = reg.counter(
+            "dllama_http_requests_total",
+            "HTTP responses written, by route and status code",
+            ("route", "code"))
+        self._m_ttft = reg.histogram(
+            "dllama_ttft_ms",
+            "Time to first token (from request arrival), by decode path",
+            ("path",))
+        self._m_tpot = reg.histogram(
+            "dllama_tpot_ms",
+            "Mean time per output token after the first, by decode path",
+            ("path",))
+        self._m_queue_wait = reg.histogram(
+            "dllama_queue_wait_ms",
+            "Arrival-to-scheduling wait (admission + batching window)")
+        self._m_tokens_in = reg.counter(
+            "dllama_prompt_tokens_total", "Prompt tokens accepted")
+        self._m_tokens_out = reg.counter(
+            "dllama_completion_tokens_total", "Completion tokens generated")
+        self._m_sse_disconnect = reg.counter(
+            "dllama_sse_disconnects_total",
+            "Streaming responses whose client vanished mid-stream (the "
+            "decode row is cancelled at its next chunk boundary)")
+        reg.gauge("dllama_batch_queue_depth",
+                  "Arrivals waiting for the batch scheduler").set_function(
+            lambda: float(self.batcher.queue_depth())
+            if self.batcher is not None else 0.0)
+        reg.gauge("dllama_slots_occupied",
+                  "Occupied slots of the live pooled decode session"
+                  ).set_function(
+            lambda: float(self.batcher.occupancy()[0])
+            if self.batcher is not None else 0.0)
         # --batch-window > 0: requests (greedy or sampled, streaming or
         # not) that arrive within the window share a continuously batched
         # slot-pool decode (Batcher) — single-device or tensor-parallel
@@ -785,6 +886,44 @@ class ServerState:
             "slots_total": total,
         }
 
+    def finish_request(self, trace: RequestTrace) -> None:
+        """Per-request telemetry sink, called once per completion request
+        (success, typed rejection, or failure alike): observe the latency
+        histograms, append the request's spans to the DLLAMA_TRACE file,
+        and emit the structured JSON log line (--log-json). Prompt text
+        never reaches the log unless --log-prompts: the record carries
+        token counts and a sha256 digest instead."""
+        path = trace.path or "none"
+        if trace.ttft_ms is not None:
+            self._m_ttft.observe(trace.ttft_ms, path=path)
+        if trace.tpot_ms is not None:
+            self._m_tpot.observe(trace.tpot_ms, path=path)
+        if trace.queue_wait_ms is not None:
+            self._m_queue_wait.observe(trace.queue_wait_ms)
+        if trace.tokens_in:
+            self._m_tokens_in.inc(trace.tokens_in)
+        if trace.tokens_out:
+            self._m_tokens_out.inc(trace.tokens_out)
+        observability.emit_trace_events(trace.trace_events())
+        if self.log_json:
+            rec = trace.record()
+            if self.log_prompts and trace.prompt_text is not None:
+                rec["prompt"] = trace.prompt_text
+            observability.log_json_line(rec, stream=self.log_stream)
+
+    def stats(self) -> dict:
+        """JSON stats for GET /stats: the readiness picture plus latency
+        percentiles (served from each histogram's raw-sample reservoir) —
+        the human-curl view of what /metrics exposes for scrapers."""
+        _, info = self.readiness()
+        snap = self.metrics.snapshot()
+        return {
+            "model": self.model_name,
+            "uptime_s": round(time.time() - self.started_at, 1),
+            "load": info,
+            "metrics": snap,
+        }
+
     def build_prompt(self, messages: list) -> str:
         """Render a full conversation (the API is stateless: each request
         carries all messages, same as the reference, `dllama-api.cpp:173-181`)."""
@@ -816,18 +955,57 @@ class OpenAIHandler(BaseHTTPRequestHandler):
         pass
 
     # -- helpers ----------------------------------------------------------
+    #: every HTTP response path funnels through here or _send_sse_headers,
+    #: so the request-id echo and the http-requests counter cover 200s,
+    #: SSE streams, and every 4xx/5xx alike
+    _KNOWN_ROUTES = ("/v1/chat/completions", "/chat/completions",
+                     "/v1/models", "/health", "/healthz", "/ready",
+                     "/metrics", "/stats")
+
+    def _route(self) -> str:
+        """Route label for the HTTP counter: known paths verbatim, anything
+        else bucketed as "other" so probe scans can't explode cardinality."""
+        p = self.path.split("?", 1)[0]
+        return p if p in self._KNOWN_ROUTES else "other"
+
+    def _begin_request(self) -> None:
+        """Per-request handler state: the request id (client-supplied
+        X-Request-Id when sane, freshly minted otherwise) echoed on EVERY
+        response, and the not-yet-emitted trace for POSTs."""
+        self._rid = observability.sanitize_request_id(
+            self.headers.get("X-Request-Id"))
+        self._trace = None
+
+    def _count(self, code: int) -> None:
+        self.state._m_http.inc(route=self._route(), code=str(code))
+        if self._trace is not None and self._trace.status == 0:
+            self._trace.status = code
+
     def _json(self, code: int, obj: dict, headers: dict = None) -> None:
         body = json.dumps(obj).encode()
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
+        self.send_header("X-Request-Id", self._rid)
         for k, v in (headers or {}).items():
             self.send_header(k, v)
         self.end_headers()
+        self._count(code)
         self.wfile.write(body)
 
+    def _send_sse_headers(self) -> None:
+        self.send_response(200)
+        self.send_header("Content-Type", "text/event-stream")
+        self.send_header("Cache-Control", "no-cache")
+        self.send_header("Connection", "close")
+        self.send_header("X-Request-Id", self._rid)
+        self.end_headers()
+        self._count(200)
+
     def _error(self, code: int, message: str) -> None:
-        self._json(code, {"error": {"message": message, "type": "invalid_request_error"}})
+        self._json(code, {"error": {"message": message,
+                                    "type": "invalid_request_error",
+                                    "request_id": self._rid}})
 
     def _lifecycle_error(self, e: LifecycleError) -> None:
         """Speak a typed lifecycle rejection: its own HTTP status (429
@@ -837,16 +1015,19 @@ class OpenAIHandler(BaseHTTPRequestHandler):
         if e.retry_after_s is not None:
             headers["Retry-After"] = str(max(1, int(round(e.retry_after_s))))
         self._json(e.http_status,
-                   {"error": {"message": str(e), "type": "server_error"}},
+                   {"error": {"message": str(e), "type": "server_error",
+                              "request_id": self._rid}},
                    headers=headers)
 
     # -- routes -----------------------------------------------------------
     def do_GET(self):
+        self._begin_request()
+        st = self.state
         if self.path == "/v1/models":
             self._json(200, {
                 "object": "list",
                 "data": [{
-                    "id": self.state.model_name,
+                    "id": st.model_name,
                     "object": "model",
                     "created": int(time.time()),
                     "owned_by": "dllama_tpu",
@@ -855,16 +1036,40 @@ class OpenAIHandler(BaseHTTPRequestHandler):
         elif self.path in ("/health", "/healthz"):
             # LIVENESS: 200 whenever the process can answer — a draining or
             # scheduler-crashed server is still alive (don't restart it);
-            # readiness is /ready's job
-            self._json(200, {"status": "ok"})
+            # readiness is /ready's job. The body carries the same load
+            # picture as /ready so one curl answers "alive AND why".
+            _, info = st.readiness()
+            self._json(200, {
+                "status": "ok",
+                "scheduler_alive": info["scheduler_alive"],
+                "crash_count": info["scheduler_crashes"],
+                "queue_depth": info["queue_depth"],
+            })
         elif self.path == "/ready":
             # READINESS: should a load balancer send traffic here?
-            ready, info = self.state.readiness()
+            ready, info = st.readiness()
+            info["crash_count"] = info["scheduler_crashes"]
             self._json(200 if ready else 503, info)
+        elif self.path == "/metrics":
+            # Prometheus text exposition (hand-rolled, stdlib only): every
+            # layer's series — server/scheduler (this file), lifecycle gate,
+            # engine decode, weight integrity — off one registry
+            body = st.metrics.render().encode()
+            self.send_response(200)
+            self.send_header("Content-Type",
+                             "text/plain; version=0.0.4; charset=utf-8")
+            self.send_header("Content-Length", str(len(body)))
+            self.send_header("X-Request-Id", self._rid)
+            self.end_headers()
+            self._count(200)
+            self.wfile.write(body)
+        elif self.path == "/stats":
+            self._json(200, st.stats())
         else:
             self._error(404, f"unknown path {self.path}")
 
     def do_POST(self):
+        self._begin_request()
         if self.path not in ("/v1/chat/completions", "/chat/completions"):
             self._error(404, f"unknown path {self.path}")
             return
@@ -874,6 +1079,11 @@ class OpenAIHandler(BaseHTTPRequestHandler):
         except (ValueError, json.JSONDecodeError) as e:
             self._error(400, f"bad JSON body: {e}")
             return
+        # one trace per completion attempt — ALSO for typed rejections
+        # (429/503/504), so rejected request ids still appear in the
+        # structured log and the latency histograms stay success-only
+        trace = self._trace = RequestTrace(self._rid)
+        trace.model = self.state.model_name
         # bounded admission at the door: gate capacity covers EVERY in-
         # flight completion (solo and batched alike), so overflow is an
         # immediate 429 + Retry-After and a draining server answers 503
@@ -882,9 +1092,12 @@ class OpenAIHandler(BaseHTTPRequestHandler):
             admitted_at = self.state.gate.acquire()
         except LifecycleError as e:
             self._lifecycle_error(e)
+            trace.finish_reason = "rejected"
+            self.state.finish_request(trace)
             return
+        trace.admission_depth = self.state.gate.depth
         try:
-            self._handle_completions(req)
+            self._handle_completions(req, trace)
         except LifecycleError as e:
             # typed lifecycle end that escaped before any bytes were
             # written (non-streaming deadline/crash): speak its status
@@ -898,10 +1111,11 @@ class OpenAIHandler(BaseHTTPRequestHandler):
             # per-request catch (`dllama-api.cpp:347-351`)
         finally:
             self.state.gate.release(admitted_at)
+            self.state.finish_request(trace)
 
     def _stream_batched(self, base: dict, sampler: SamplerConfig,
                         prompt_tokens: list, max_tokens: int,
-                        deadline: Deadline = None) -> None:
+                        deadline: Deadline = None, trace=None) -> None:
         """SSE streaming from the shared pool decode: bursts of up to
         batch-chunk tokens per event instead of one event per token (the
         granularity trade for sharing one device program across concurrent
@@ -916,11 +1130,7 @@ class OpenAIHandler(BaseHTTPRequestHandler):
         st = self.state
         tok = st.tokenizer
         cancel = CancelToken()
-        self.send_response(200)
-        self.send_header("Content-Type", "text/event-stream")
-        self.send_header("Cache-Control", "no-cache")
-        self.send_header("Connection", "close")
-        self.end_headers()
+        self._send_sse_headers()
 
         client_gone = False
 
@@ -938,6 +1148,7 @@ class OpenAIHandler(BaseHTTPRequestHandler):
                 self.wfile.flush()
             except (BrokenPipeError, ConnectionResetError,
                     faults.FaultInjected):
+                st._m_sse_disconnect.inc()
                 client_gone = True
                 cancel.cancel("client disconnected mid-stream")
 
@@ -946,13 +1157,15 @@ class OpenAIHandler(BaseHTTPRequestHandler):
         stop_ids = st.stop_token_ids()
         prev = prompt_tokens[-1]
         finish_reason = "length"
+        n_generated = 0
         try:
             for burst in st.batcher.submit_stream(prompt_tokens, max_tokens,
                                                   sampler, deadline=deadline,
-                                                  cancel=cancel):
+                                                  cancel=cancel, trace=trace):
                 parts = []
                 stopped = False
                 for t in burst:
+                    n_generated += 1
                     if t in stop_ids:
                         stopped = True
                         break
@@ -982,6 +1195,9 @@ class OpenAIHandler(BaseHTTPRequestHandler):
         if tail:
             emit_chunk({"content": tail})
         emit_chunk({}, finish=finish_reason)
+        if trace is not None:
+            trace.finish_reason = finish_reason
+            trace.tokens_out = n_generated
         if not client_gone:
             try:
                 self.wfile.write(b"data: [DONE]\n\n")
@@ -990,7 +1206,7 @@ class OpenAIHandler(BaseHTTPRequestHandler):
                 pass
         self.close_connection = True
 
-    def _handle_completions(self, req: dict) -> None:
+    def _handle_completions(self, req: dict, trace: RequestTrace) -> None:
         st = self.state
         messages = req.get("messages")
         if not isinstance(messages, list) or not messages:
@@ -1033,6 +1249,12 @@ class OpenAIHandler(BaseHTTPRequestHandler):
         tok = st.tokenizer
         prompt = st.build_prompt(messages)
         prompt_tokens = tok.encode(prompt, add_bos=True)
+        trace.tokens_in = len(prompt_tokens)
+        trace.prompt_sha = observability.prompt_digest(prompt)
+        if st.log_prompts:
+            trace.prompt_text = prompt
+        if st.batcher is not None:
+            trace.queue_depth = st.batcher.queue_depth()
         room = st.cfg.seq_len - len(prompt_tokens)
         if room <= 0:
             self._error(400, f"prompt of {len(prompt_tokens)} tokens exceeds "
@@ -1064,11 +1286,14 @@ class OpenAIHandler(BaseHTTPRequestHandler):
                 ] + [SamplerConfig(temperature=0.0, seed=0)] * (
                     len(prompts) - n_choices)
                 with st.lock:
+                    trace.mark_start("n_batch")
                     rows = st.engine.generate_batch(
                         prompts, max_tokens,
                         samplers=samplers, stop_tokens=st.stop_token_ids(),
                         row_steps=row_steps,
                     )[:n_choices]
+                    trace.mark_prefill(
+                        getattr(st.engine, "prefill_ms", 0.0) or 0.0)
             except Exception as e:  # noqa: BLE001
                 self._error(500, f"batched n-sampling failed: {e!r}")
                 return
@@ -1089,6 +1314,8 @@ class OpenAIHandler(BaseHTTPRequestHandler):
                     "message": {"role": "assistant", "content": text},
                     "finish_reason": finish,
                 })
+            trace.tokens_out = total
+            trace.finish_reason = choices[0]["finish_reason"]
             self._json(200, dict(base, choices=choices, usage={
                 "prompt_tokens": len(prompt_tokens),
                 "completion_tokens": total,
@@ -1113,11 +1340,11 @@ class OpenAIHandler(BaseHTTPRequestHandler):
             # singletons speculate on the solo path either way.
             if stream:
                 self._stream_batched(base, sampler, prompt_tokens, max_tokens,
-                                     deadline=deadline)
+                                     deadline=deadline, trace=trace)
             else:
                 try:
                     row = st.batcher.submit(prompt_tokens, max_tokens, sampler,
-                                            deadline=deadline)
+                                            deadline=deadline, trace=trace)
                 except LifecycleError:
                     raise  # do_POST speaks its status (504/503) — must
                     # outrank the RuntimeError catch below (LifecycleError
@@ -1129,6 +1356,8 @@ class OpenAIHandler(BaseHTTPRequestHandler):
                     return
                 text, finish_reason, n_generated = decode_token_row(
                     tok, prompt_tokens[-1], row, st.stop_token_ids(), stops)
+                trace.tokens_out = n_generated
+                trace.finish_reason = finish_reason
                 self._json(200, dict(base, choices=[{
                     "index": 0,
                     "message": {"role": "assistant", "content": text},
@@ -1141,11 +1370,7 @@ class OpenAIHandler(BaseHTTPRequestHandler):
             return
 
         if stream:
-            self.send_response(200)
-            self.send_header("Content-Type", "text/event-stream")
-            self.send_header("Cache-Control", "no-cache")
-            self.send_header("Connection", "close")
-            self.end_headers()
+            self._send_sse_headers()
 
         detector = StopDetector(stops)
         text_parts: list = []
@@ -1170,6 +1395,7 @@ class OpenAIHandler(BaseHTTPRequestHandler):
                 # dead socket: stop decoding at the next token boundary but
                 # DON'T raise out of the locked loop — the prefix session
                 # still gets stored (the conversation may reconnect)
+                st._m_sse_disconnect.inc()
                 client_gone = True
 
         if stream:
@@ -1181,6 +1407,7 @@ class OpenAIHandler(BaseHTTPRequestHandler):
         interrupted = None  # "timeout" when the deadline ends the decode
         health_err = None  # NumericHealthError when the watchdog trips
         with st.lock:
+            trace.mark_start("solo")
             prev = prompt_tokens[-1]
             stop_ids = st.stop_token_ids()
             session, feed_tokens = st.take_prefix_session(prompt_tokens)
@@ -1190,6 +1417,7 @@ class OpenAIHandler(BaseHTTPRequestHandler):
             try:
                 for tok_id, _stats in stream_iter:
                     n_generated += 1
+                    trace.mark_token()
                     history.append(tok_id)
                     if tok_id in stop_ids:
                         finish_reason = "stop"
@@ -1214,10 +1442,13 @@ class OpenAIHandler(BaseHTTPRequestHandler):
                 # finite, but the session's KV state is poisoned — do NOT
                 # cache it for the next turn of this conversation
                 health_err = e
+            trace.mark_prefill(getattr(st.engine, "prefill_ms", 0.0) or 0.0)
             if health_err is None:
                 st.store_prefix_session(history, st.engine.final_session)
 
+        trace.tokens_out = n_generated
         if health_err is not None:
+            trace.finish_reason = "error"
             if not stream:
                 self._error(500, f"decode failed: {health_err}")
                 return
@@ -1238,6 +1469,7 @@ class OpenAIHandler(BaseHTTPRequestHandler):
                 if stream:
                     emit_chunk({"content": tail})
 
+        trace.finish_reason = finish_reason
         if stream:
             emit_chunk({}, finish=finish_reason)
             if not client_gone:
@@ -1300,6 +1532,8 @@ def serve(args) -> None:
         batch_chunk=getattr(args, "batch_chunk", 8),
         request_timeout=getattr(args, "request_timeout", 0.0),
         queue_depth=getattr(args, "queue_depth", 64),
+        log_json=getattr(args, "log_json", False),
+        log_prompts=getattr(args, "log_prompts", False),
     )
     srv = create_server(state, host=args.host, port=args.port)
     pid_path = getattr(args, "pid_file", None)
@@ -1321,7 +1555,7 @@ def serve(args) -> None:
     except ValueError:
         pass  # not the main thread (embedded/test use): no signal hook
     print(f"📡 listening on {args.host}:{args.port} "
-          "(POST /v1/chat/completions, GET /v1/models)")
+          "(POST /v1/chat/completions, GET /v1/models /metrics /stats)")
     try:
         srv.serve_forever()
     finally:

@@ -26,6 +26,26 @@ from __future__ import annotations
 import threading
 import time
 
+from .. import observability
+
+# Module-level metric handles against the shared default registry: created at
+# import so every series is visible on /metrics from the first scrape, not
+# only after its first failure.
+_REG = observability.default_registry()
+_M_REJECTIONS = _REG.counter(
+    "dllama_admission_rejections_total",
+    "Requests rejected at the admission gate, by reason",
+    ("reason",))
+_M_CRASHES = _REG.counter(
+    "dllama_scheduler_crashes_total",
+    "Supervised scheduler thread crashes (each one restarts the loop)")
+_M_DEADLINES = _REG.counter(
+    "dllama_deadline_expirations_total",
+    "Requests whose wall-clock budget (--request-timeout) expired")
+_M_INFLIGHT = _REG.gauge(
+    "dllama_inflight_requests",
+    "Requests currently admitted past the gate")
+
 
 class LifecycleError(RuntimeError):
     """A request ended by lifecycle policy rather than by decoding.
@@ -84,6 +104,7 @@ class DeadlineExceeded(LifecycleError):
             f"request exceeded its {budget_s:.1f}s deadline (--request-"
             "timeout); partial output discarded, slot released")
         self.budget_s = budget_s
+        _M_DEADLINES.inc()
 
 
 class RequestCancelled(LifecycleError):
@@ -175,16 +196,20 @@ class AdmissionGate:
         ``release`` for the service-time EWMA)."""
         with self._lock:
             if self._draining:
+                _M_REJECTIONS.inc(reason="draining")
                 raise ServerDraining()
             if self._inflight >= self.capacity:
+                _M_REJECTIONS.inc(reason="queue_full")
                 raise QueueFull(self._inflight, self.capacity,
                                 self.retry_after_s())
             self._inflight += 1
+            _M_INFLIGHT.set(self._inflight)
             return time.monotonic()
 
     def release(self, admitted_at: float = None) -> None:
         with self._lock:
             self._inflight = max(0, self._inflight - 1)
+            _M_INFLIGHT.set(self._inflight)
             if admitted_at is not None:
                 dt = max(0.0, time.monotonic() - admitted_at)
                 self._service_ewma_s += 0.2 * (dt - self._service_ewma_s)
@@ -247,6 +272,7 @@ class Supervisor:
                 return  # clean exit: drain finished
             except BaseException as e:  # noqa: BLE001 — supervision IS the catch
                 self.crash_count += 1
+                _M_CRASHES.inc()
                 try:
                     self._on_crash(e)
                 except Exception:  # noqa: BLE001 — crash hook must not kill

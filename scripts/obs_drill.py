@@ -1,0 +1,201 @@
+"""CI observability fault drill: faults must move counters.
+
+Boots the tiny synthetic server in-process, scrapes /metrics, then fires
+one fault of each class the chaos suite knows — queue overflow (429),
+scheduler crash (503), poisoned logits (quarantine, 500), deadline expiry
+(504) — and scrapes again. The drill PASSES only if every injected fault
+produced a nonzero counter delta: an outage class with no metric movement
+is an outage an operator cannot alert on, and that is the regression this
+lane exists to catch.
+
+Artifacts written to --out-dir (uploaded by CI):
+    metrics_before.txt / metrics_after.txt   raw Prometheus expositions
+    deltas.json                              per-counter deltas + verdict
+    trace.jsonl                              Chrome/Perfetto request spans
+    requests.jsonl                           structured JSON request logs
+
+Usage:  JAX_PLATFORMS=cpu python scripts/obs_drill.py [--out-dir obs-drill]
+Exit 0 only if every fault class moved its counter.
+"""
+
+from __future__ import annotations
+
+import argparse
+import http.client
+import json
+import os
+import sys
+import threading
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+# counter -> the fault class whose visibility it proves
+WATCHED = {
+    "dllama_admission_rejections_total": "queue overflow (429)",
+    "dllama_scheduler_crashes_total": "scheduler crash (503)",
+    "dllama_numeric_quarantines_total": "poisoned logits (quarantine)",
+    "dllama_deadline_expirations_total": "deadline expiry (504)",
+    "dllama_http_requests_total": "request accounting",
+}
+
+
+def parse_exposition(text: str) -> dict:
+    """Family name -> summed value across its series (labels collapsed:
+    the drill asserts movement, not attribution)."""
+    totals: dict = {}
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        sample, _, value = line.rpartition(" ")
+        name = sample.partition("{")[0]
+        # fold histogram series into their family's count
+        for suffix in ("_bucket", "_sum", "_count"):
+            if name.endswith(suffix):
+                name = name[: -len(suffix)]
+                break
+        try:
+            totals[name] = totals.get(name, 0.0) + float(value)
+        except ValueError:
+            pass
+    return totals
+
+
+def request(port, method, path, body=None, timeout=120):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    conn.request(method, path,
+                 body=json.dumps(body) if body is not None else None,
+                 headers={"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    data = resp.read()
+    conn.close()
+    return resp.status, data
+
+
+def chat(**kw):
+    body = {"model": "drill", "max_tokens": 8, "temperature": 0.0,
+            "messages": [{"role": "user", "content": "observability drill"}]}
+    body.update(kw)
+    return body
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--out-dir", default="obs-drill")
+    args = ap.parse_args()
+    os.makedirs(args.out_dir, exist_ok=True)
+
+    from dllama_tpu import faults, observability
+    from dllama_tpu.models import llama
+    from dllama_tpu.runtime.generate import Engine
+    from dllama_tpu.runtime.sampler import SamplerConfig
+    from dllama_tpu.serving.api_server import ServerState, create_server
+    from tests.test_api_server import make_tokenizer
+    from tests.test_llama_forward import tiny_cfg
+
+    observability.configure_trace(os.path.join(args.out_dir, "trace.jsonl"))
+    log_stream = open(os.path.join(args.out_dir, "requests.jsonl"), "w")
+
+    tok = make_tokenizer()
+    cfg = tiny_cfg(vocab_size=tok.vocab_size, seq_len=512, dim=32, kv_dim=16,
+                   head_size=8, hidden_dim=64)
+    params = llama.random_params(cfg, seed=13)
+    engine = Engine(cfg, params, SamplerConfig(temperature=0.0, seed=1))
+    state = ServerState(engine, tok, cfg, model_name="drill",
+                        template="llama3", batch_window_ms=5.0, batch_max=4,
+                        queue_depth=4, log_json=True, log_stream=log_stream)
+    srv = create_server(state, host="127.0.0.1", port=0)
+    port = srv.server_address[1]
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+
+    def scrape(fname: str) -> dict:
+        status, data = request(port, "GET", "/metrics", timeout=30)
+        assert status == 200, f"/metrics returned {status}"
+        text = data.decode()
+        with open(os.path.join(args.out_dir, fname), "w") as f:
+            f.write(text)
+        return parse_exposition(text)
+
+    def expect(label: str, want: int, got: int) -> None:
+        ok = "ok" if got == want else f"UNEXPECTED (wanted {want})"
+        print(f"  {label}: HTTP {got} [{ok}]")
+
+    try:
+        # warm-up: one healthy request so latency series exist
+        status, _ = request(port, "POST", "/v1/chat/completions", chat())
+        expect("healthy request", 200, status)
+        before = scrape("metrics_before.txt")
+
+        print("firing fault classes:")
+        # queue overflow -> 429
+        tickets = [state.gate.acquire() for _ in range(4)]
+        try:
+            status, _ = request(port, "POST", "/v1/chat/completions", chat(),
+                                timeout=30)
+            expect("queue overflow", 429, status)
+        finally:
+            for t in tickets:
+                state.gate.release(t)
+
+        # scheduler crash -> 503 (supervisor restarts it)
+        faults.install("scheduler:raise:times=1")
+        status, _ = request(port, "POST", "/v1/chat/completions", chat())
+        faults.clear()
+        expect("scheduler crash", 503, status)
+
+        # poisoned logits -> numeric quarantine -> 500
+        faults.install("logits:nan:after=2")
+        status, _ = request(port, "POST", "/v1/chat/completions", chat())
+        faults.clear()
+        expect("poisoned logits", 500, status)
+
+        # deadline expiry -> 504
+        state.request_timeout = 0.0001
+        status, _ = request(port, "POST", "/v1/chat/completions",
+                            chat(max_tokens=32))
+        state.request_timeout = 0.0
+        expect("deadline expiry", 504, status)
+
+        # prove the server still serves after the whole gauntlet
+        status, _ = request(port, "POST", "/v1/chat/completions", chat())
+        expect("post-gauntlet request", 200, status)
+
+        after = scrape("metrics_after.txt")
+    finally:
+        srv.shutdown()
+        observability.configure_trace(None)
+        log_stream.close()
+
+    deltas = {name: after.get(name, 0.0) - before.get(name, 0.0)
+              for name in WATCHED}
+    failures = [f"{name} ({why}) did not move"
+                for name, why in WATCHED.items() if deltas[name] <= 0]
+
+    trace_file = os.path.join(args.out_dir, "trace.jsonl")
+    raw = open(trace_file).read()
+    events = [json.loads(l.rstrip(","))
+              for l in raw.splitlines()[1:] if l.strip()]
+    n_requests = sum(1 for e in events if e.get("name") == "request")
+    if not raw.startswith("[\n") or n_requests < 5:
+        failures.append(
+            f"trace.jsonl malformed or sparse ({n_requests} request spans)")
+
+    verdict = {"ok": not failures, "deltas": deltas, "failures": failures,
+               "trace_request_spans": n_requests}
+    with open(os.path.join(args.out_dir, "deltas.json"), "w") as f:
+        json.dump(verdict, f, indent=2, sort_keys=True)
+
+    print("\ncounter deltas:")
+    for name, d in sorted(deltas.items()):
+        print(f"  {name}: +{d:g}")
+    print(f"trace spans: {n_requests} requests -> {trace_file}")
+    if failures:
+        for msg in failures:
+            print(f"FAIL: {msg}", file=sys.stderr)
+        return 1
+    print("observability drill: every fault class moved a counter")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
