@@ -375,41 +375,132 @@ def run_decode_bench(cfg_dict: dict, bench_steps: int = None, quant_ok: bool = F
     # this frame's reference so the unfused originals free immediately
     del params
 
-    # BENCH_PREFILL=N measures bucketed-prefill throughput on an N-token
-    # prompt: queue R async prefill dispatches, one host sync at the end
-    # (the ~70 ms tunnel round trip amortizes over R), report ms per prompt
-    # token. The reference has no prefill path at all — it feeds prompts one
-    # token per infer() at full decode cost — so this is a dimension where
-    # the MXU-bound batched pass is orders of magnitude ahead by design.
+    # BENCH_PREFILL=N replays the PREFILL STALL: a near-max-length N-token
+    # prompt is admitted into a pool whose resident rows are mid-decode, and
+    # the measurement is the residents' INTER-TOKEN GAP — monolithic
+    # admission stalls every resident for the whole prefill, chunked
+    # admission (admit_begin + one prefill_step per tick) bounds the stall
+    # to one prefill piece plus one decode chunk. A capacity phase counts
+    # rows resident at the SAME modeled HBM budget with uniform vs bucketed
+    # slot KV. CPU-runnable (BENCH_MODEL=smoke); the gate FAILS the bench if
+    # a chunked-mode resident gap exceeds 2x the per-tick chunk budget, or
+    # if bucketed pools don't admit strictly more short rows than uniform.
+    # BENCH_PREFILL_CHUNK overrides the piece size (default chunk * pool);
+    # BENCH_PREFILL_OUT writes the full report JSON for CI artifacts.
     pf = _prefill_count()
     if pf:
         import numpy as np
 
-        pf = min(pf, cfg.seq_len - 1)
-        toks = [int(t) for t in
-                np.random.default_rng(0).integers(1, cfg.vocab_size, pf)]
-        log(f"prefill warmup ({pf} tokens, incl. compile)...")
-        # ONE cache allocated outside the timed region, CHAINED through the
-        # calls: _prefill donates its cache argument, so each call reuses
-        # the same HBM buffer in place — no per-call allocation and no
-        # cache-size-dependent zero-fill (new_cache()) inside the timing
-        cache = eng.new_cache()
+        S = cfg.seq_len
+        pf = min(pf, S - 1)
+        B = max(2, min(batch or 4, 8))
+        chunk = 8
+        pchunk = _env_count("BENCH_PREFILL_CHUNK") or chunk * B
+        rng = np.random.default_rng(0)
+        long_prompt = [int(t) for t in rng.integers(1, cfg.vocab_size, pf)]
+        res_prompt = [int(t) for t in rng.integers(1, cfg.vocab_size, 6)]
+        greedy = SamplerConfig(temperature=0.0, seed=0)
+        res_steps = (S - len(res_prompt)) // chunk * chunk
+        new_steps = 2 * chunk
+
+        def _stall_replay(chunked):
+            """One admission of the long prompt into a busy pool; returns
+            (resident gaps ms, decode tick ms, prefill piece ms)."""
+            sess = eng.batch_session(
+                B, chunk=chunk, prefill_chunk=pchunk if chunked else 0)
+            residents = [sess.admit(list(res_prompt), steps=res_steps,
+                                    sampler=greedy) for _ in range(B - 1)]
+            last, gaps, ticks, pieces = {}, [], [], []
+
+            def tick():
+                t0 = time.perf_counter()
+                fresh = sess.step_chunk()
+                now = time.perf_counter()
+                ticks.append((now - t0) * 1000.0)
+                for h in residents:
+                    if fresh.get(h):
+                        if h in last:
+                            gaps.append((now - last[h]) * 1000.0)
+                        last[h] = now
+
+            tick()  # anchor every resident's clock...
+            tick()  # ...and record one steady-state gap before the stall
+            if chunked:
+                nh = sess.admit_begin(long_prompt, steps=new_steps,
+                                      sampler=greedy)
+                while not sess.is_done(nh):
+                    t0 = time.perf_counter()
+                    if sess.prefill_step() is not None:
+                        pieces.append((time.perf_counter() - t0) * 1000.0)
+                    tick()
+            else:
+                nh = sess.admit(long_prompt, steps=new_steps, sampler=greedy)
+                while not sess.is_done(nh):
+                    tick()
+            sess.close()
+            return gaps, ticks, pieces
+
+        def _capacity(bucketed):
+            """Rows admitted before the modeled budget (B * seq_len KV
+            token-slots — identical both ways) says no. 1-token prompts:
+            the shortest request, where bucketing's win is largest."""
+            sess = eng.batch_session(B, chunk=chunk, bucket_kv=bucketed,
+                                     min_bucket=16)
+            n = 0
+            while sess.can_admit(1, chunk) and n < 4096:
+                sess.admit_begin([1], steps=chunk, sampler=greedy)
+                n += 1
+            sess.close()
+            return n
+
+        def _pct(xs, p):
+            ys = sorted(xs)
+            return ys[min(len(ys) - 1, int(round(p / 100.0 * (len(ys) - 1))))]
+
+        log(f"prefill stall replay: {pf}-token prompt into a busy pool "
+            f"(B={B}, chunk={chunk}, prefill_chunk={pchunk}); warmup...")
         t0 = time.perf_counter()
-        logits, cache = eng.prefill(cache, toks)
-        jax.block_until_ready(logits)
+        _stall_replay(True)  # compiles pool decode + every prefill bucket
+        _stall_replay(False)
         log(f"warmup done in {time.perf_counter() - t0:.1f}s")
-        R = 4
-        times = []
-        for rep in range(3):
-            t1 = time.perf_counter()
-            for _ in range(R):
-                logits, cache = eng.prefill(cache, toks)
-            jax.block_until_ready(logits)
-            ms_tok = (time.perf_counter() - t1) * 1000.0 / R / pf
-            times.append(ms_tok)
-            log(f"rep {rep}: {ms_tok:.4f} ms/prompt-token "
-                f"({1000.0 / ms_tok:.0f} tok/s prefill)")
-        return min(times), f"{weights}-prefill{pf}{cfg_tag}"
+        mono_gaps, _, _ = _stall_replay(False)
+        ch_gaps, ch_ticks, ch_pieces = _stall_replay(True)
+        budget_ms = _pct(ch_pieces, 50) + _pct(ch_ticks, 50)
+        gate_ms = 2.0 * budget_ms
+        mono_p99, ch_p99 = _pct(mono_gaps, 99), _pct(ch_gaps, 99)
+        log(f"resident inter-token gap p99: monolithic {mono_p99:.1f} ms "
+            f"vs chunked {ch_p99:.1f} ms (worst {max(ch_gaps):.1f} ms; "
+            f"tick budget {budget_ms:.1f} ms, gate {gate_ms:.1f} ms)")
+        rows_uni, rows_bkt = _capacity(False), _capacity(True)
+        log(f"rows resident at fixed HBM budget ({B * S} KV token-slots): "
+            f"uniform {rows_uni} vs bucketed {rows_bkt}")
+        report = {
+            "prompt_tokens": pf, "pool": B, "decode_chunk": chunk,
+            "prefill_chunk": pchunk,
+            "monolithic_gap_p99_ms": round(mono_p99, 3),
+            "chunked_gap_p99_ms": round(ch_p99, 3),
+            "chunked_gap_max_ms": round(max(ch_gaps), 3),
+            "tick_budget_ms": round(budget_ms, 3),
+            "gate_ms": round(gate_ms, 3),
+            "budget_kv_tokens": B * S,
+            "rows_uniform": rows_uni, "rows_bucketed": rows_bkt,
+        }
+        out_path = os.environ.get("BENCH_PREFILL_OUT")
+        if out_path:
+            with open(out_path, "w") as f:
+                json.dump(report, f, indent=2)
+            log(f"report written to {out_path}")
+        if ch_p99 > gate_ms:
+            raise RuntimeError(
+                f"chunked prefill left a resident-row gap of {ch_p99:.1f} "
+                f"ms p99, over the 2x chunk budget gate of {gate_ms:.1f} "
+                f"ms: {report}")
+        if rows_bkt <= rows_uni:
+            raise RuntimeError(
+                f"bucketed slot KV admitted {rows_bkt} rows vs uniform "
+                f"{rows_uni} at the same budget — must be strictly more: "
+                f"{report}")
+        return ch_p99, f"{weights}-prefillstall{pf}-b{B}{cfg_tag}"
 
     # BENCH_CONTINUOUS=N replays a staggered-arrival serving workload of N
     # requests through BOTH schedulers — the continuous slot pool
@@ -941,9 +1032,11 @@ def main() -> None:
                              and (_env_count("BENCH_CONTINUOUS")
                                   or _env_count("BENCH_FAULTS")
                                   or _env_count("BENCH_INTEGRITY")
-                                  or _env_count("BENCH_OBS"))):
-        # the continuous-vs-static comparison measures SCHEDULING, so the
-        # CPU default is a shape small enough to replay inside CI budgets
+                                  or _env_count("BENCH_OBS")
+                                  or _prefill_count())):
+        # the scheduling replays (continuous-vs-static, fault boundedness,
+        # prefill stall) measure SCHEDULING, so the CPU default is a shape
+        # small enough to replay inside CI budgets
         name, cfg_dict = "smoke", SMOKE_SERVE
     elif choice == "tiny" or (not choice and platform == "cpu"):
         name, cfg_dict = "tinyllama_1.1b", TINYLLAMA_1_1B

@@ -79,6 +79,39 @@ def build_parser() -> argparse.ArgumentParser:
                 "larger N amortizes dispatch overhead",
             )
             sp.add_argument(
+                "--prefill-chunk",
+                type=int,
+                default=-1,
+                metavar="N",
+                help="prompt tokens consumed per scheduler tick during "
+                "pooled admission: a long prompt no longer stalls resident "
+                "rows for its whole prefill — the pool keeps decoding "
+                "between N-token pieces, and each piece is bit-identical "
+                "to monolithic prefill; -1 = auto (batch-chunk x "
+                "batch-max, one decode-chunk's worth of compute), "
+                "0 = monolithic",
+            )
+            sp.add_argument(
+                "--kv-buckets",
+                type=int,
+                default=1,
+                metavar="0|1",
+                help="length-bucketed KV slot pools (power-of-two ladders "
+                "up to seq-len) instead of one uniform full-context slab: "
+                "short rows occupy small slabs, so strictly more rows fit "
+                "the same HBM budget; rows that outgrow a bucket migrate "
+                "to the next slab mid-flight; 0 = uniform full-context "
+                "slots (pre-bucketing behavior)",
+            )
+            sp.add_argument(
+                "--kv-bucket-min",
+                type=int,
+                default=0,
+                metavar="N",
+                help="smallest KV bucket context length (rounded up to a "
+                "power of two); 0 = auto (max(16, 2x batch-chunk))",
+            )
+            sp.add_argument(
                 "--request-timeout",
                 type=float,
                 default=0.0,

@@ -43,8 +43,8 @@ import os
 import threading
 import time
 
-SITES = ("admit", "step_chunk", "prefill", "stream", "scheduler",
-         "weights_open", "weights_read", "logits")
+SITES = ("admit", "step_chunk", "prefill", "prefill_chunk", "stream",
+         "scheduler", "weights_open", "weights_read", "logits")
 ACTIONS = ("raise", "slow", "truncate", "bitflip", "nan")
 
 

@@ -755,9 +755,16 @@ def forward(
     return logits, {"k": new_k, "v": new_v}
 
 
-def init_batch_cache(cfg: ModelConfig, batch: int, cache_dtype=jnp.float32) -> dict:
-    """KV cache for ``batch`` independent sequences: [L, B, S, kv, hd]."""
-    shape = (cfg.n_layers, batch, cfg.seq_len, cfg.n_kv_heads, cfg.head_size)
+def init_batch_cache(cfg: ModelConfig, batch: int, cache_dtype=jnp.float32,
+                     seq_len: int = None) -> dict:
+    """KV cache for ``batch`` independent sequences: [L, B, S, kv, hd].
+
+    ``seq_len`` overrides the context length of the slab (default
+    ``cfg.seq_len``) — the bucketed slot pools allocate short-context slabs
+    for short rows; attention masks by ``pos``, so a slab shorter than the
+    model context is exact as long as every row's pos stays inside it."""
+    S = cfg.seq_len if seq_len is None else seq_len
+    shape = (cfg.n_layers, batch, S, cfg.n_kv_heads, cfg.head_size)
     return {"k": jnp.zeros(shape, cache_dtype), "v": jnp.zeros(shape, cache_dtype)}
 
 

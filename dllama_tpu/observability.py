@@ -24,6 +24,7 @@ Three cooperating pieces, all stdlib-only (matching the repo's no-deps style):
 from __future__ import annotations
 
 import hashlib
+import itertools
 import json
 import math
 import os
@@ -45,7 +46,11 @@ __all__ = [
     "log_json_line",
     "prompt_digest",
     "new_request_id",
+    "next_span_id",
+    "scheduler_trace_event",
+    "SCHEDULER_TID",
     "LATENCY_BUCKETS_MS",
+    "TOKEN_BUCKETS",
 ]
 
 # Default latency buckets (milliseconds). Wide enough for CPU-smoke prefill
@@ -53,6 +58,16 @@ __all__ = [
 LATENCY_BUCKETS_MS: Tuple[float, ...] = (
     1.0, 2.0, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0, 500.0,
     1000.0, 2500.0, 5000.0, 10000.0, 30000.0,
+)
+
+# Token-COUNT buckets: powers of two, matching the engine's prefill/KV
+# bucket ladder, so a token histogram reads directly as "which KV bucket
+# would this request land in". Token series must NOT reuse the
+# latency-tuned boundaries above — a 30-token prompt and a 30ms chunk are
+# different axes.
+TOKEN_BUCKETS: Tuple[float, ...] = (
+    1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0, 256.0, 512.0,
+    1024.0, 2048.0, 4096.0, 8192.0, 16384.0,
 )
 
 _RESERVOIR_CAP = 2048  # per-series ring of raw samples, for percentiles
@@ -468,6 +483,33 @@ def new_request_id() -> str:
     return "req-" + uuid.uuid4().hex[:20]
 
 
+# Monotonic span-id allocator for trace tracks. Tid 0 is the scheduler's
+# track; every RequestTrace takes the next id at construction, so
+# concurrent requests get DISTINCT, stable, collision-free tracks (the old
+# hashed-request-id tid could collide and scattered tracks randomly across
+# the tid space, which kept request spans from nesting under the scheduler
+# track group in Perfetto).
+SCHEDULER_TID = 0
+_span_ids = itertools.count(1)
+_span_lock = threading.Lock()
+
+
+def next_span_id() -> int:
+    with _span_lock:
+        return next(_span_ids)
+
+
+def scheduler_trace_event(name: str, t_a: float, t_b: float,
+                          args: Optional[dict] = None) -> dict:
+    """A complete-event on the scheduler track (tid 0): batcher windows and
+    other engine-wide phases, under which per-request tracks group."""
+    return {
+        "name": name, "ph": "X", "pid": os.getpid(), "tid": SCHEDULER_TID,
+        "ts": _mono_to_us(t_a), "dur": max(1, int((t_b - t_a) * 1e6)),
+        "cat": "scheduler", "args": args or {},
+    }
+
+
 def sanitize_request_id(raw: Optional[str]) -> str:
     """Honor a client X-Request-Id if it is sane, else mint one."""
     if raw:
@@ -485,14 +527,17 @@ class RequestTrace:
     thread (handler or scheduler) and read after completion, so no lock."""
 
     __slots__ = (
-        "request_id", "t0", "path", "t_start", "prefill_ms",
+        "request_id", "span_id", "t0", "path", "t_start", "prefill_ms",
         "t_first", "t_last", "admission_depth", "queue_depth",
         "tokens_in", "tokens_out", "finish_reason", "status",
-        "prompt_sha", "prompt_text", "model",
+        "prompt_sha", "prompt_text", "model", "prefill_chunks",
     )
 
     def __init__(self, request_id: str):
         self.request_id = request_id
+        #: this request's trace track: a real allocated span id (see
+        #: next_span_id), never a hash of the request id
+        self.span_id = next_span_id()
         self.t0 = time.monotonic()
         self.path: Optional[str] = None       # solo | spec | continuous | n_batch
         self.t_start: Optional[float] = None  # decode admitted / lock acquired
@@ -510,6 +555,8 @@ class RequestTrace:
         #: --log-prompts; never written to logs otherwise (privacy default)
         self.prompt_text: Optional[str] = None
         self.model: Optional[str] = None
+        #: (t_begin, t_end) monotonic pairs, one per chunked-prefill piece
+        self.prefill_chunks: List[tuple] = []
 
     # -- marks (cheap; called from scheduler/handler hot paths) --
 
@@ -520,6 +567,12 @@ class RequestTrace:
 
     def mark_prefill(self, ms: float) -> None:
         self.prefill_ms = ms
+
+    def mark_prefill_chunk(self, t_begin: float, t_end: float) -> None:
+        """One incremental prefill piece ran for this request (chunked
+        admission): a child span per piece shows exactly where the prompt's
+        consumption interleaved with the pool's decode chunks."""
+        self.prefill_chunks.append((t_begin, t_end))
 
     def mark_token(self) -> None:
         now = time.monotonic()
@@ -574,10 +627,15 @@ class RequestTrace:
 
     def trace_events(self) -> List[dict]:
         """Chrome complete-events ('ph':'X'), one track per request so child
-        spans (queue_wait / prefill / decode) nest under the request span."""
+        spans (queue_wait / prefill / decode) nest under the request span.
+        The track's tid is the request's allocated ``span_id`` — sequential
+        and collision-free, so concurrent request tracks line up right
+        after the scheduler track (tid 0) instead of scattering across the
+        hashed tid space — plus a thread_name metadata event so Perfetto
+        labels the track with the request id."""
         end = time.monotonic()
         pid = os.getpid()
-        tid = int(hashlib.sha1(self.request_id.encode()).hexdigest()[:6], 16)
+        tid = self.span_id
         args = {"request_id": self.request_id, "path": self.path,
                 "tokens_in": self.tokens_in, "tokens_out": self.tokens_out,
                 "finish_reason": self.finish_reason}
@@ -590,12 +648,19 @@ class RequestTrace:
                 "cat": "request", "args": extra or {},
             }
 
-        events = [ev("request", self.t0, end, args)]
+        events = [
+            {"name": "thread_name", "ph": "M", "pid": pid, "tid": tid,
+             "args": {"name": f"req {self.request_id}"}},
+            ev("request", self.t0, end, args),
+        ]
         if self.t_start is not None:
             events.append(ev("queue_wait", self.t0, self.t_start))
-            if self.prefill_ms is not None:
+            if self.prefill_ms is not None and not self.prefill_chunks:
                 pf_end = min(end, self.t_start + self.prefill_ms / 1e3)
                 events.append(ev("prefill", self.t_start, pf_end))
+        for i, (t_a, t_b) in enumerate(self.prefill_chunks):
+            events.append(ev("prefill_chunk", t_a, min(end, t_b),
+                             {"chunk": i}))
         if self.t_first is not None and self.t_last is not None:
             events.append(ev("decode", self.t_first, min(end, self.t_last),
                              {"tokens": self.tokens_out}))

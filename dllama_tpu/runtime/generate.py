@@ -146,6 +146,12 @@ class Engine:
             self._m_chunk = metrics.histogram(
                 "dllama_decode_chunk_ms",
                 "Fused decode-chunk wall time (fused/batched/pooled paths)")
+            self._m_prefill_chunk = metrics.histogram(
+                "dllama_prefill_chunk_ms",
+                "Incremental prefill chunk wall time (chunked admission)")
+            self._m_migrations = metrics.counter(
+                "dllama_kv_migrations_total",
+                "Pooled rows migrated to the next larger KV bucket")
             self._m_quarantine = metrics.counter(
                 "dllama_numeric_quarantines_total",
                 "Rows/streams stopped by the numeric-health watchdog")
@@ -160,6 +166,7 @@ class Engine:
                 "Tokens emitted by speculative decode paths")
         else:
             self._m_prefill = self._m_step = self._m_chunk = None
+            self._m_prefill_chunk = self._m_migrations = None
             self._m_quarantine = None
             self._m_spec_steps = self._m_spec_accepted = None
             self._m_spec_emitted = None
@@ -372,10 +379,41 @@ class Engine:
             lambda b: llama.init_batch_cache(cfg, b, cache_dtype),
             static_argnums=0, out_shardings=bsh,
         )
+        self._bucket_cache_init = jax.jit(
+            lambda b, s: llama.init_batch_cache(cfg, b, cache_dtype, seq_len=s),
+            static_argnums=(0, 1), out_shardings=bsh,
+        )
         self._batch_cache_insert = jax.jit(
+            # A single-sequence cache [L, S, kv, hd] into row ``b`` of a slot
+            # slab [L, B, ctx, kv, hd]. The slab may be a short-context bucket:
+            # only the slab's own context window is copied — by construction
+            # the row's prefill never wrote past it (admission places rows in
+            # a bucket that covers the prompt).
             lambda bc, c, b: jax.tree.map(
                 lambda s, x: jax.lax.dynamic_update_slice(
-                    s, x[:, None], (0, b, 0, 0, 0)), bc, c),
+                    s, jax.lax.slice_in_dim(x, 0, s.shape[2], axis=1)[:, None],
+                    (0, b, 0, 0, 0)), bc, c),
+            donate_argnums=0,
+        )
+        self._bucket_cache_migrate = jax.jit(
+            # Row ``sb`` of a small-bucket slab into row ``db`` of the next
+            # bucket's slab: the copied prefix is the row's entire attended
+            # history (pos < src ctx), positions past it are garbage the row
+            # overwrites before attending — migration is exact.
+            lambda dst, src, sb, db: jax.tree.map(
+                lambda d, s: jax.lax.dynamic_update_slice(
+                    d, jax.lax.dynamic_slice_in_dim(s, sb, 1, axis=1),
+                    (0, db, 0, 0, 0)), dst, src),
+            donate_argnums=0,
+        )
+        self._bucket_cache_grow = jax.jit(
+            # Carry an exhausted pool's rows into a double-capacity slab
+            # (same context): rows keep their indices, the new tail rows are
+            # zero/free. src is NOT donated — on allocation failure the pool
+            # must survive untouched.
+            lambda dst, src: jax.tree.map(
+                lambda d, s: jax.lax.dynamic_update_slice(
+                    d, s, (0, 0, 0, 0, 0)), dst, src),
             donate_argnums=0,
         )
 
@@ -556,18 +594,40 @@ class Engine:
             flags = flags.at[min(max(fv["row"], 0), B - 1)].set(True)
         return flags
 
-    def prefill(self, cache: dict, tokens: list, pos: int = 0) -> tuple:
+    def prefill(self, cache: dict, tokens: list, pos: int = 0,
+                chunk: Optional[int] = None) -> tuple:
         """Run the prompt starting at ``pos``. Returns (last_logits, cache).
 
         Tail-padding to a bucket is safe: padded queries produce garbage
         logits we never read, and padded cache slots sit at positions a
         causal query never attends before a real decode overwrites them.
+
+        ``chunk`` splits the prompt into pieces of at most that many tokens,
+        each its own bucketed forward at an advancing ``pos`` into the SAME
+        cache. Causal attention reads chunk N-1's K/V exactly as the fused
+        forward computed them (every forward writes the cache before
+        attending), so the chunked result is bit-identical to the monolithic
+        one — the split only bounds how long one dispatch can occupy the
+        device while a serving pool has resident rows waiting to decode.
         """
         if not 0 < pos + len(tokens) <= self.cfg.seq_len:
             raise ValueError(
                 f"prompt of {len(tokens)} tokens at pos {pos} exceeds seq_len {self.cfg.seq_len}"
             )
         faults.fire("prefill")
+        if chunk is not None and chunk < 1:
+            raise ValueError(f"prefill chunk must be >= 1, got {chunk}")
+        if chunk is None or chunk >= len(tokens):
+            return self._prefill_piece(cache, tokens, pos)
+        logits = None
+        for i in range(0, len(tokens), chunk):
+            faults.fire("prefill_chunk")
+            logits, cache = self._prefill_piece(cache, tokens[i:i + chunk],
+                                                pos + i)
+        return logits, cache
+
+    def _prefill_piece(self, cache: dict, tokens, pos: int) -> tuple:
+        """One bucketed prefill forward (validated by the callers)."""
         # clamp the padded bucket to the remaining context: an out-of-range
         # dynamic_update_slice start would be silently clamped by XLA, writing
         # K/V into wrong slots with wrong rope angles
@@ -926,14 +986,29 @@ class Engine:
         return cache, pend, poss
 
     def batch_session(self, max_batch: int,
-                      chunk: Optional[int] = None) -> "BatchSession":
+                      chunk: Optional[int] = None,
+                      bucket_kv: bool = False,
+                      min_bucket: Optional[int] = None,
+                      prefill_chunk: int = 0,
+                      kv_budget=None) -> "BatchSession":
         """Open a persistent slot-pool decode session (continuous batching):
-        one resident [L, max_batch, S, kv, hd] donated batch cache whose rows
-        are admitted, stepped, and released INDEPENDENTLY — see BatchSession.
+        resident donated batch cache slabs whose rows are admitted, stepped,
+        and released INDEPENDENTLY — see BatchSession.
         ``chunk`` is the fused steps per ``step_chunk`` call (defaults to the
-        engine's decode_chunk); (max_batch, chunk) picks the single
-        _decode_loop_batch compile every chunk of the session reuses."""
-        return BatchSession(self, max_batch, chunk)
+        engine's decode_chunk). With ``bucket_kv=False`` (the default) the
+        session is the classic single [L, max_batch, S, kv, hd] slab and
+        (max_batch, chunk) picks the single _decode_loop_batch compile every
+        chunk reuses; ``bucket_kv=True`` replaces it with power-of-two
+        length-bucketed slot pools (from ``min_bucket`` up to seq_len) under
+        the SAME modeled HBM budget of max_batch*seq_len KV token-slots, so
+        short requests stop paying full-context HBM and strictly more rows
+        fit. ``prefill_chunk`` > 0 sets the default token budget of
+        prefill_step() for chunked (admit_begin) admissions. ``kv_budget``
+        is an optional external accountant (serving.lifecycle.KVBudget) that
+        mirrors reservations/occupancy into gauges."""
+        return BatchSession(self, max_batch, chunk, bucket_kv=bucket_kv,
+                            min_bucket=min_bucket, prefill_chunk=prefill_chunk,
+                            kv_budget=kv_budget)
 
     def generate_batch_spec(
         self, prompts: list, steps: int,
@@ -1282,48 +1357,122 @@ class Engine:
 
 @dataclasses.dataclass
 class _SlotState:
-    """Host-side bookkeeping for one occupied BatchSession slot."""
+    """Host-side bookkeeping for one admitted BatchSession row."""
 
     room: int  # feeds the row's remaining context allows (S - admit pos)
     budget: int  # min(room, the caller's step budget)
     stop_tokens: tuple
+    reserved: int  # KV token-slots reserved against the session budget
     offered: int = 0  # tokens the fused chunks have offered this row so far
     done: bool = False  # budget/stop reached; pinned in place until release()
     emitted: int = 0  # tokens actually kept (post budget/stop truncation)
     finish: Optional[str] = None  # "stop" | "length" | "error" once done
+    prefilling: bool = False  # admit_begin()ed, prompt not fully consumed
+    prefill_ms: float = 0.0  # accumulated admission-prefill wall time
+
+
+class _PendingPrefill:
+    """A chunked admission's in-flight prompt state (admit_begin)."""
+
+    __slots__ = ("prompt", "scfg", "cache", "cursor")
+
+    def __init__(self, prompt: list, scfg: SamplerConfig, cache: dict):
+        self.prompt = prompt
+        self.scfg = scfg
+        self.cache = cache  # single-sequence [L, S, kv, hd] being filled
+        self.cursor = 0  # prompt-prefix tokens already prefilled
+
+
+class _BucketPool:
+    """One context bucket's slot pool: a [L, cap, ctx, kv, hd] donated slab
+    plus host-side per-row decode state (numpy mirrors, shipped to the
+    device per fused chunk). ``ctx`` may be shorter than the model context:
+    attention masks by ``pos`` and clamps writes to the slab, so a short
+    slab is exact as long as every live row's position stays inside it —
+    the session migrates rows out before they outgrow it."""
+
+    __slots__ = ("ctx", "cap", "cache", "tokens", "pos", "keys", "temps",
+                 "topps", "rows")
+
+    def __init__(self, eng: Engine, ctx: int, cap: int):
+        self.ctx = ctx
+        self.cap = cap
+        self.cache = eng._bucket_cache_init(cap, ctx)
+        self.tokens = np.zeros((cap,), np.int32)
+        # free rows pin at the slab's last slot, like exhausted rows
+        self.pos = np.full((cap,), ctx - 1, np.int32)
+        self.keys = np.zeros((cap, 2), np.uint32)
+        self.temps = np.zeros((cap,), np.float32)
+        self.topps = np.ones((cap,), np.float32)
+        self.rows: list = [None] * cap  # handle occupying each row
+
+    def grow(self, eng: Engine) -> None:
+        """Double the pool's capacity in place: rows keep their indices (no
+        handle in the session moves), the new tail rows start free/pinned.
+        Doubling bounds the retraces of the pool's decode program to
+        log2(rows) for the whole session."""
+        new_cap = self.cap * 2
+        bigger = eng._bucket_cache_init(new_cap, self.ctx)
+        self.cache = eng._bucket_cache_grow(bigger, self.cache)
+        pad = new_cap - self.cap
+        self.tokens = np.concatenate(
+            [self.tokens, np.zeros((pad,), np.int32)])
+        self.pos = np.concatenate(
+            [self.pos, np.full((pad,), self.ctx - 1, np.int32)])
+        self.keys = np.concatenate(
+            [self.keys, np.zeros((pad, 2), np.uint32)])
+        self.temps = np.concatenate(
+            [self.temps, np.zeros((pad,), np.float32)])
+        self.topps = np.concatenate(
+            [self.topps, np.ones((pad,), np.float32)])
+        self.rows.extend([None] * pad)
+        self.cap = new_cap
 
 
 class BatchSession:
-    """Slot-pool decode over ONE resident donated batch cache — the
+    """Slot-pool decode over resident donated batch cache slabs — the
     continuous-batching primitive. Where ``generate_batch`` forms a batch
     once and runs it to completion (a long row holds the device while short
-    rows' slots idle), a BatchSession lets rows join (``admit``), step
-    (``step_chunk``), and leave (``release``) independently BETWEEN fused
-    decode chunks: the serving scheduler admits newly arrived requests into
-    freed slots while its neighbours keep decoding.
+    rows' slots idle), a BatchSession lets rows join (``admit`` /
+    ``admit_begin``), step (``step_chunk``), and leave (``release``)
+    independently BETWEEN fused decode chunks: the serving scheduler admits
+    newly arrived requests into freed capacity while their neighbours keep
+    decoding.
 
     Row math is EXACTLY generate_batch's: every chunk is one
-    ``_decode_loop_batch`` program over all ``max_batch`` rows, each row
-    running its OWN sampler chain (key split once per step) — so a row
-    admitted mid-flight emits a stream BIT-IDENTICAL to a solo ``generate``
-    call with the same SamplerConfig, no matter what its neighbours are
-    doing. Free/finished rows ride along pinned in place (pos clamped at
-    seq_len-1, feeding token 0) exactly like context-exhausted rows in
-    generate_batch: their writes are garbage at slots no live query attends.
+    ``_decode_loop_batch`` program per occupied pool, each row running its
+    OWN sampler chain (key split once per step) — so a row admitted
+    mid-flight emits a stream BIT-IDENTICAL to a solo ``generate`` call
+    with the same SamplerConfig, no matter what its neighbours are doing.
+    Free/finished rows ride along pinned in place (pos clamped at the
+    slab's last slot, feeding token 0) exactly like context-exhausted rows
+    in generate_batch: their writes are garbage at slots no live query
+    attends.
+
+    Two residency layouts share this class. ``bucket_kv=False`` (default)
+    is the classic single [L, max_batch, S, kv, hd] slab: handles ARE slot
+    indices 0..max_batch-1 and one compile serves the whole session.
+    ``bucket_kv=True`` shards residency into power-of-two context buckets
+    under the SAME modeled HBM budget (max_batch * seq_len KV token-slots):
+    a row is admitted into the smallest slab covering its prompt plus one
+    decode chunk, reserves its worst-case bucket (prompt+steps) against the
+    budget, and MIGRATES to the next bucket just before outgrowing its
+    slab — so short requests stop paying full-context HBM and strictly
+    more rows fit at fixed memory. One decode program per occupied
+    (bucket, capacity) shape; capacities double, bounding retraces.
 
     Slot-slab reuse needs no clearing: admitting a multi-token prompt
-    overwrites the slot's whole [L, S, kv, hd] slab (_batch_cache_insert),
-    and a 1-token prompt starts at pos 0 where overwrite-before-attend
-    holds — every position <= pos is written by the CURRENT occupant before
-    any of its queries attends it; stale garbage sits only at masked
-    positions.
-
-    One compile serves the whole session: B = max_batch and n_steps = chunk
-    are fixed, so the first step_chunk pays the trace and every later chunk
-    reuses it regardless of which rows are live.
+    overwrites the slot's whole attended window (_batch_cache_insert), and
+    a 1-token prompt starts at pos 0 where overwrite-before-attend holds —
+    every position <= pos is written by the CURRENT occupant before any of
+    its queries attends it; stale garbage sits only at masked positions.
+    Migration copies the row's whole slab, i.e. its entire attended
+    history, so the invariant carries across buckets.
     """
 
-    def __init__(self, eng: Engine, max_batch: int, chunk: Optional[int] = None):
+    def __init__(self, eng: Engine, max_batch: int, chunk: Optional[int] = None,
+                 bucket_kv: bool = False, min_bucket: Optional[int] = None,
+                 prefill_chunk: int = 0, kv_budget=None):
         if max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
         chunk = eng.decode_chunk if chunk is None else chunk
@@ -1332,61 +1481,161 @@ class BatchSession:
         self.eng = eng
         self.max_batch = max_batch
         self.chunk = chunk
+        self.bucket_kv = bool(bucket_kv)
+        self.prefill_chunk = max(0, int(prefill_chunk))
         S = eng.cfg.seq_len
-        self.cache = eng._batch_cache_init(max_batch)
-        self._tokens = jnp.zeros((max_batch,), jnp.int32)
-        # free slots pin at the last cache slot, like exhausted rows
-        self._pos = jnp.full((max_batch,), S - 1, jnp.int32)
-        self._keys = jnp.stack(
-            [jax.random.PRNGKey(0) for _ in range(max_batch)])
-        self._temps = jnp.zeros((max_batch,), jnp.float32)
-        self._topps = jnp.ones((max_batch,), jnp.float32)
-        self._slots: list = [None] * max_batch
+        if self.bucket_kv:
+            # the bucket ladder: powers of two from min_bucket (default: a
+            # couple of decode chunks — smaller slabs would migrate every
+            # other chunk) up to the full model context
+            lo = int(min_bucket) if min_bucket else max(16, 2 * chunk)
+            lo = max(2, min(lo, S))
+            b = 1
+            while b < lo:
+                b *= 2
+            ladder = []
+            while b < S:
+                ladder.append(b)
+                b *= 2
+            ladder.append(S)
+            self.buckets = tuple(ladder)
+        else:
+            self.buckets = (S,)
+        #: modeled HBM budget in KV token-slots — what the uniform slab
+        #: spends as max_batch full-context rows; bucketed admission packs
+        #: strictly more short rows into the same budget
+        self.budget_tokens = max_batch * S
+        self._reserved_tokens = 0
+        self._budget = kv_budget  # duck-typed lifecycle.KVBudget mirror
+        self._pools: dict = {}  # ctx -> _BucketPool
+        self._slots: dict = {}  # handle -> _SlotState
+        self._where: dict = {}  # handle -> (pool, row)
+        self._prefills: dict = {}  # handle -> _PendingPrefill (FIFO)
+        self._next_handle = 0
         self._closed = False
+        self.migrations = 0  # rows moved to a larger bucket, this session
         self.decode_ms = 0.0  # cumulative fused-chunk wall time
         self.prefill_ms = 0.0  # cumulative admit-prefill wall time
+        if not self.bucket_kv:
+            # the classic resident slab, pre-allocated so the pool never
+            # grows and handles stay the historical slot indices 0..B-1
+            self._pools[S] = _BucketPool(eng, S, max_batch)
 
     # -- introspection ----------------------------------------------------
     @property
+    def cache(self):
+        """The uniform-mode resident slab. Bucketed sessions keep one slab
+        per occupied bucket; there is no single cache to point at."""
+        if self._closed or self.bucket_kv:
+            return None
+        return self._pools[self.eng.cfg.seq_len].cache
+
+    @property
     def free_slots(self) -> list:
-        """Indices admit() can take right now."""
-        return [b for b, st in enumerate(self._slots) if st is None]
+        """Row indices admit() can take right now (uniform mode: the actual
+        free slot indices, the historical contract). Bucketed sessions
+        admit by KV budget, not row count — prefer ``can_admit``; here the
+        number of smallest-bucket reservations that still fit is returned
+        as pseudo-indices so ``if sess.free_slots:`` keeps meaning "can
+        admit something"."""
+        if not self.bucket_kv:
+            pool = self._pools[self.eng.cfg.seq_len]
+            return [b for b, h in enumerate(pool.rows) if h is None]
+        n = (self.budget_tokens - self._reserved_tokens) // self.buckets[0]
+        return list(range(max(0, n)))
 
     @property
     def occupied(self) -> list:
-        """Admitted-and-not-released slot indices (done rows included)."""
-        return [b for b, st in enumerate(self._slots) if st is not None]
+        """Admitted-and-not-released handles (done + mid-prefill included)."""
+        return sorted(self._slots)
 
     @property
     def num_live(self) -> int:
         """Rows the next step_chunk will actually advance."""
-        return sum(1 for st in self._slots
-                   if st is not None and not st.done)
+        return sum(1 for st in self._slots.values()
+                   if not st.done and not st.prefilling)
+
+    @property
+    def pending_prefills(self) -> list:
+        """Handles admitted via admit_begin whose prompts are still being
+        consumed, oldest first."""
+        return list(self._prefills)
+
+    @property
+    def reserved_tokens(self) -> int:
+        """KV token-slots currently reserved against ``budget_tokens``."""
+        return self._reserved_tokens
+
+    def _state(self, slot: int) -> _SlotState:
+        st = self._slots.get(slot)
+        if st is None:
+            raise ValueError(f"slot {slot} is not occupied")
+        return st
 
     def is_done(self, slot: int) -> bool:
         """True once the row hit its stop token, budget, or quarantine (it no
         longer receives tokens; release() it to free the slab)."""
-        st = self._slots[slot]
-        if st is None:
-            raise ValueError(f"slot {slot} is not occupied")
-        return st.done
+        return self._state(slot).done
 
     def finish_reason(self, slot: int) -> Optional[str]:
         """Why the row finished: ``"stop"``, ``"length"``, ``"error"``
         (watchdog quarantine), or None while still live / after cancel()."""
-        st = self._slots[slot]
-        if st is None:
-            raise ValueError(f"slot {slot} is not occupied")
-        return st.finish
+        return self._state(slot).finish
+
+    def prefill_ms_of(self, slot: int) -> float:
+        """Wall time this row's admission prefill has consumed so far."""
+        return self._state(slot).prefill_ms
+
+    # -- capacity ---------------------------------------------------------
+    def _need_ctx(self, prompt_len: int, steps: int) -> int:
+        """Context slots the row can reach: its final write position + 1."""
+        S = self.eng.cfg.seq_len
+        return max(prompt_len, min(S, prompt_len - 1 + max(0, steps)))
+
+    def _bucket_for(self, n: int) -> int:
+        for b in self.buckets:
+            if b >= n:
+                return b
+        return self.buckets[-1]
+
+    def can_admit(self, prompt_len: int, steps: int) -> bool:
+        """True when the session's modeled KV budget (and the external
+        kv_budget, if any) has room for this request's WORST-CASE bucket —
+        admission reserves the bucket covering prompt+steps up front so a
+        later migration can never oversubscribe. The capacity win over the
+        uniform slab comes from short requests reserving small buckets
+        instead of a full-context row."""
+        if self._closed:
+            return False
+        need = self._bucket_for(self._need_ctx(prompt_len, steps))
+        if self._reserved_tokens + need > self.budget_tokens:
+            return False
+        if self._budget is not None and not self._budget.can_fit(need):
+            return False
+        return True
+
+    def _alloc_row(self, ctx: int) -> tuple:
+        """A free row in the ``ctx`` pool, materializing/growing it on
+        demand (bucketed mode; the uniform pool is pre-sized)."""
+        pool = self._pools.get(ctx)
+        if pool is None:
+            pool = self._pools[ctx] = _BucketPool(self.eng, ctx, 1)
+        for r in range(pool.cap):
+            if pool.rows[r] is None:
+                return pool, r
+        r = pool.cap
+        pool.grow(self.eng)
+        return pool, r
 
     # -- lifecycle --------------------------------------------------------
     def admit(self, prompt_tokens: list, steps: int,
               sampler: Optional[SamplerConfig] = None,
               stop_tokens: tuple = ()) -> int:
-        """Prefill ``prompt_tokens`` into a free slot and return its index.
+        """Prefill ``prompt_tokens`` into a free row and return its handle
+        (uniform mode: the slot index, the historical contract).
 
         The prompt's prefix runs through the engine's bucketed prefill into
-        a fresh single cache, written straight into the slot's slab (donated
+        a fresh single cache, written straight into the row's slab (donated
         in-place update); the last prompt token stays pending so the row's
         first fused step samples from the final-prompt-position logits with
         the FIRST key of a fresh PRNGKey(sampler.seed) chain — the exact
@@ -1395,116 +1644,281 @@ class BatchSession:
         private budget and stop set, checked per chunk like generate_batch's
         row_steps/stop_tokens.
 
-        Raises RuntimeError when no slot is free (check ``free_slots``).
+        Equivalent to ``admit_begin`` + prefill_step(handle, whole-prefix):
+        the entire prompt runs before this returns, stalling the pool for
+        the whole prefill — use admit_begin/prefill_step when resident rows
+        shouldn't wait. Raises RuntimeError when nothing can be admitted
+        (check ``can_admit`` / ``free_slots``).
         """
+        handle = self.admit_begin(prompt_tokens, steps, sampler=sampler,
+                                  stop_tokens=stop_tokens)
+        while self._slots[handle].prefilling:
+            self.prefill_step(handle, budget=len(prompt_tokens))
+        return handle
+
+    def admit_begin(self, prompt_tokens: list, steps: int,
+                    sampler: Optional[SamplerConfig] = None,
+                    stop_tokens: tuple = ()) -> int:
+        """Reserve a row for the prompt WITHOUT prefilling it: the prompt
+        is consumed incrementally by ``prefill_step`` calls, interleaved
+        with ``step_chunk``, so resident rows keep emitting tokens while a
+        long prompt fills its cache. Once live, the row's stream is
+        bit-identical to a monolithic admit() of the same request: the
+        chunked prefill runs the same bucketed forwards at the same
+        positions into the same slab, and the sampler chain starts from the
+        same fresh PRNGKey. 1-token prompts have nothing to prefill and go
+        live immediately."""
         if self._closed:
             raise RuntimeError("batch session is closed")
         if not prompt_tokens:
             raise ValueError("admit needs a non-empty prompt")
-        free = self.free_slots
-        if not free:
-            raise RuntimeError(
-                f"no free slot (max_batch={self.max_batch}); release a "
-                "finished row first")
-        faults.fire("admit")
-        slot = free[0]
         S = self.eng.cfg.seq_len
         if len(prompt_tokens) > S:
             raise ValueError(
                 f"prompt of {len(prompt_tokens)} tokens exceeds seq_len {S}")
+        if not self.can_admit(len(prompt_tokens), steps):
+            raise RuntimeError(
+                f"no free slot (max_batch={self.max_batch}, KV budget "
+                f"{self._reserved_tokens}/{self.budget_tokens} tokens); "
+                "release a finished row first")
+        faults.fire("admit")
         scfg = sampler if sampler is not None else self.eng.sampler_cfg
-        t0 = time.perf_counter()
-        if len(prompt_tokens) > 1:
-            single = self.eng.new_cache()
-            _, single = self.eng.prefill(single, list(prompt_tokens[:-1]), 0)
-            self.cache = self.eng._batch_cache_insert(
-                self.cache, single, jnp.int32(slot))
-            del single
-        admit_ms = (time.perf_counter() - t0) * 1000.0
-        self.prefill_ms += admit_ms
-        if self.eng._m_prefill is not None and len(prompt_tokens) > 1:
-            self.eng._m_prefill.observe(admit_ms)
-        pos0 = len(prompt_tokens) - 1
-        self._tokens = self._tokens.at[slot].set(int(prompt_tokens[-1]))
-        self._pos = self._pos.at[slot].set(pos0)
-        self._keys = self._keys.at[slot].set(jax.random.PRNGKey(scfg.seed))
-        self._temps = self._temps.at[slot].set(scfg.temperature)
-        self._topps = self._topps.at[slot].set(scfg.topp)
+        plen = len(prompt_tokens)
+        reserved = self._bucket_for(self._need_ctx(plen, steps))
+        # place optimistically small: enough for the prompt plus one decode
+        # chunk of headroom — early-stopping rows never touch a big slab;
+        # migration (covered by the reservation) grows the long-lived ones
+        place = self._bucket_for(min(reserved, plen + self.chunk))
+        pool, row = self._alloc_row(place)
+        handle = row if not self.bucket_kv else self._next_handle
+        self._next_handle += 1
+        self._reserved_tokens += reserved
+        if self._budget is not None:
+            self._budget.reserve(reserved)
+            self._budget.place(pool.ctx)
+        pos0 = plen - 1
         room = S - pos0
         budget = min(room, steps)
-        self._slots[slot] = _SlotState(
+        st = _SlotState(
             room=room, budget=budget, stop_tokens=tuple(stop_tokens),
+            reserved=reserved,
             done=budget <= 0, finish="length" if budget <= 0 else None)
-        return slot
+        self._slots[handle] = st
+        self._where[handle] = (pool, row)
+        pool.rows[row] = handle
+        if budget <= 0:
+            return handle  # never decodes; skip the prefill entirely
+        if plen == 1:
+            self._go_live(handle, prompt_tokens, scfg)
+        else:
+            faults.fire("prefill")
+            st.prefilling = True
+            self._prefills[handle] = _PendingPrefill(
+                list(prompt_tokens), scfg, self.eng.new_cache())
+        return handle
+
+    def prefill_step(self, handle: Optional[int] = None,
+                     budget: Optional[int] = None) -> Optional[tuple]:
+        """Advance ONE pending chunked admission by up to ``budget`` prompt
+        tokens (default: the session's prefill_chunk; the whole remaining
+        prefix when neither is set) — one bucketed prefill forward into the
+        admission's own single cache, synced before returning so the call
+        bounds the scheduler tick. Returns (handle, finished); ``finished``
+        True means the row just went live (its slab is written; the next
+        step_chunk decodes it). Returns None when nothing is pending.
+        Picks the OLDEST pending admission when ``handle`` is None — FIFO,
+        so one call per scheduler tick bounds every resident row's stall to
+        one prefill chunk of compute."""
+        if self._closed:
+            raise RuntimeError("batch session is closed")
+        if handle is None:
+            handle = next((h for h in self._prefills
+                           if not self._slots[h].done), None)
+            if handle is None:
+                return None
+        pf = self._prefills.get(handle)
+        if pf is None:
+            raise ValueError(f"slot {handle} has no pending prefill")
+        st = self._slots[handle]
+        prefix = pf.prompt[:-1]
+        n = budget if budget is not None else self.prefill_chunk
+        if n <= 0:
+            n = len(prefix) - pf.cursor
+        piece = prefix[pf.cursor:pf.cursor + n]
+        faults.fire("prefill_chunk")
+        t0 = time.perf_counter()
+        _, pf.cache = self.eng._prefill_piece(pf.cache, piece, pf.cursor)
+        jax.block_until_ready(pf.cache)
+        dt = (time.perf_counter() - t0) * 1000.0
+        self.prefill_ms += dt
+        st.prefill_ms += dt
+        if self.eng._m_prefill_chunk is not None:
+            self.eng._m_prefill_chunk.observe(dt)
+        pf.cursor += len(piece)
+        if pf.cursor < len(prefix):
+            return handle, False
+        # prefix complete: copy the filled single cache into the row's slab
+        pool, row = self._where[handle]
+        pool.cache = self.eng._batch_cache_insert(
+            pool.cache, pf.cache, jnp.int32(row))
+        del self._prefills[handle]
+        st.prefilling = False
+        self._go_live(handle, pf.prompt, pf.scfg)
+        if self.eng._m_prefill is not None:
+            self.eng._m_prefill.observe(st.prefill_ms)
+        return handle, True
+
+    def _go_live(self, handle: int, prompt_tokens: list,
+                 scfg: SamplerConfig) -> None:
+        """Arm the row's decode state: pending last prompt token, position,
+        fresh per-row sampler chain — the exact state a monolithic admit
+        leaves behind."""
+        pool, row = self._where[handle]
+        pool.tokens[row] = int(prompt_tokens[-1])
+        pool.pos[row] = len(prompt_tokens) - 1
+        pool.keys[row] = np.asarray(
+            jax.random.PRNGKey(scfg.seed), np.uint32)
+        pool.temps[row] = scfg.temperature
+        pool.topps[row] = scfg.topp
+
+    def _migrate(self, handle: int) -> None:
+        """Move a live row into the next bucket BEFORE it outgrows its
+        slab: copy its [L, 1, ctx, kv, hd] slab — its entire attended
+        history — into a row of the bigger pool and carry the host decode
+        state (pending token, position, sampler chain) unchanged, so the
+        stream continues bit-identically. Admission reserved the worst-case
+        bucket up front, so migration never oversubscribes the budget."""
+        src, srow = self._where[handle]
+        S = self.eng.cfg.seq_len
+        need = min(S, int(src.pos[srow]) + self.chunk + 1)
+        new_ctx = min(b for b in self.buckets
+                      if b > src.ctx and b >= need)
+        dst, drow = self._alloc_row(new_ctx)
+        dst.cache = self.eng._bucket_cache_migrate(
+            dst.cache, src.cache, jnp.int32(srow), jnp.int32(drow))
+        dst.tokens[drow] = src.tokens[srow]
+        dst.pos[drow] = src.pos[srow]
+        dst.keys[drow] = src.keys[srow]
+        dst.temps[drow] = src.temps[srow]
+        dst.topps[drow] = src.topps[srow]
+        dst.rows[drow] = handle
+        src.rows[srow] = None
+        src.pos[srow] = src.ctx - 1
+        self._where[handle] = (dst, drow)
+        self.migrations += 1
+        if self.eng._m_migrations is not None:
+            self.eng._m_migrations.inc()
+        if self._budget is not None:
+            self._budget.migrate(src.ctx, dst.ctx)
 
     def step_chunk(self) -> dict:
-        """Run ONE fused chunk over the pool and return {slot: fresh tokens}
-        for every live row — each list is already truncated at the row's own
-        budget and (inclusively) at its first stop token, and is never empty
-        UNLESS the row was quarantined: a healthy live row always nets at
-        least one token per chunk, so staggered admission can never starve a
-        row. Rows that just finished are marked done (``is_done``) and skip
-        future chunks until released; ``finish_reason`` says why. Returns {}
-        without touching the device when nothing is live.
+        """Run ONE fused chunk over every occupied pool and return
+        {handle: fresh tokens} for every live row — each list is already
+        truncated at the row's own budget and (inclusively) at its first
+        stop token, and is never empty UNLESS the row was quarantined: a
+        healthy live row always nets at least one token per chunk, so
+        staggered admission can never starve a row. Rows that just finished
+        are marked done (``is_done``) and skip future chunks until
+        released; ``finish_reason`` says why. Returns {} without touching
+        the device when nothing is live. Mid-prefill rows are skipped until
+        their prefill completes.
+
+        Bucketed sessions first migrate any live row that would outgrow its
+        slab within this chunk, then run one program per occupied bucket,
+        smallest first — a row migrated this tick decodes this tick, in its
+        new pool.
 
         Quarantine: a row whose watchdog flag went non-finite this chunk is
-        marked done with finish reason ``"error"`` and emits NOTHING from the
-        chunk (its tokens are garbage) — its slot frees at this chunk
+        marked done with finish reason ``"error"`` and emits NOTHING from
+        the chunk (its tokens are garbage) — its slot frees at this chunk
         boundary like any finished row, and every other row's stream is
         bit-identical to a run without the poisoned neighbour (per-row
         sampler chains and cache slabs; nothing crosses rows)."""
         if self._closed:
             raise RuntimeError("batch session is closed")
-        live = [b for b, st in enumerate(self._slots)
-                if st is not None and not st.done]
-        if not live:
+        if not any(not st.done and not st.prefilling
+                   for st in self._slots.values()):
             return {}
         faults.fire("step_chunk")
-        t1 = time.perf_counter()
-        chunk, self.cache, self._keys, ok = self.eng._decode_loop_batch(
-            self.cache, self._tokens, self._pos, self._keys, self._temps,
-            self._topps, self.eng._poison_rows(self.max_batch),
-            n_steps=self.chunk)
-        arr = np.asarray(chunk)  # [chunk, B]
-        okh = np.asarray(ok)  # [B]
-        self._tokens = chunk[-1]
-        # mirror the in-program per-row pin across chunk boundaries
-        self._pos = jnp.minimum(self._pos + self.chunk,
-                                jnp.int32(self.eng.cfg.seq_len - 1))
-        chunk_ms = (time.perf_counter() - t1) * 1000.0
-        self.decode_ms += chunk_ms
-        if self.eng._m_chunk is not None:
-            self.eng._m_chunk.observe(chunk_ms)
+        S = self.eng.cfg.seq_len
         fresh: dict = {}
-        for b in live:
-            st = self._slots[b]
-            if not okh[b]:
-                st.done = True
-                st.finish = "error"
-                if self.eng._m_quarantine is not None:
-                    self.eng._m_quarantine.inc()
-                fresh[b] = []
+        stepped: set = set()
+        while True:
+            todo = [c for c in sorted(self._pools) if c not in stepped]
+            if not todo:
+                break
+            ctx = todo[0]
+            stepped.add(ctx)
+            pool = self._pools[ctx]
+            if ctx < S:
+                # migrate rows that would outgrow this slab within the
+                # chunk; rows finishing inside it stay (their writes fit
+                # and nothing reads past them afterwards)
+                for r in range(pool.cap):
+                    h = pool.rows[r]
+                    if h is None:
+                        continue
+                    st = self._slots[h]
+                    if st.done or st.prefilling:
+                        continue
+                    useful = min(self.chunk, st.budget - st.emitted)
+                    p = int(pool.pos[r])
+                    if ((useful >= self.chunk and p + self.chunk >= ctx)
+                            or (useful < self.chunk and p + useful > ctx)):
+                        self._migrate(h)
+            live = [r for r in range(pool.cap)
+                    if pool.rows[r] is not None
+                    and not self._slots[pool.rows[r]].done
+                    and not self._slots[pool.rows[r]].prefilling]
+            if not live:
                 continue
-            # a context-exhausted row pinned at its last slot: tokens past
-            # its room are garbage — generate_batch's exact accounting
-            keep = max(0, min(self.chunk, st.room - st.offered))
-            st.offered += self.chunk
-            toks = [int(t) for t in arr[:keep, b]]
-            take = min(len(toks), st.budget - st.emitted)
-            for j in range(take):
-                if toks[j] in st.stop_tokens:
-                    take = j + 1
-                    break
-            toks = toks[:take]
-            st.emitted += len(toks)
-            if st.emitted >= st.budget:
-                st.done = True
-                st.finish = "length"
-            elif (st.stop_tokens and toks
-                    and toks[-1] in st.stop_tokens):
-                st.done = True
-                st.finish = "stop"
-            fresh[b] = toks
+            t1 = time.perf_counter()
+            chunk, pool.cache, keys, ok = self.eng._decode_loop_batch(
+                pool.cache, jnp.asarray(pool.tokens),
+                jnp.asarray(pool.pos), jnp.asarray(pool.keys),
+                jnp.asarray(pool.temps), jnp.asarray(pool.topps),
+                self.eng._poison_rows(pool.cap), n_steps=self.chunk)
+            arr = np.asarray(chunk)  # [chunk, cap]
+            okh = np.asarray(ok)  # [cap]
+            pool.tokens = np.array(chunk[-1])  # np.array: writable copies
+            pool.keys = np.array(keys)
+            # mirror the in-program per-row pin across chunk boundaries
+            pool.pos = np.minimum(pool.pos + self.chunk,
+                                  ctx - 1).astype(np.int32)
+            chunk_ms = (time.perf_counter() - t1) * 1000.0
+            self.decode_ms += chunk_ms
+            if self.eng._m_chunk is not None:
+                self.eng._m_chunk.observe(chunk_ms)
+            for r in live:
+                h = pool.rows[r]
+                st = self._slots[h]
+                if not okh[r]:
+                    st.done = True
+                    st.finish = "error"
+                    if self.eng._m_quarantine is not None:
+                        self.eng._m_quarantine.inc()
+                    fresh[h] = []
+                    continue
+                # a context-exhausted row pinned at its last slot: tokens
+                # past its room are garbage — generate_batch's accounting
+                keep = max(0, min(self.chunk, st.room - st.offered))
+                st.offered += self.chunk
+                toks = [int(t) for t in arr[:keep, r]]
+                take = min(len(toks), st.budget - st.emitted)
+                for j in range(take):
+                    if toks[j] in st.stop_tokens:
+                        take = j + 1
+                        break
+                toks = toks[:take]
+                st.emitted += len(toks)
+                if st.emitted >= st.budget:
+                    st.done = True
+                    st.finish = "length"
+                elif (st.stop_tokens and toks
+                        and toks[-1] in st.stop_tokens):
+                    st.done = True
+                    st.finish = "stop"
+                fresh[h] = toks
         return fresh
 
     def cancel(self, slot: int) -> None:
@@ -1513,30 +1927,61 @@ class BatchSession:
         the live set — exactly the state a budget-exhausted row reaches, so
         no new invariants: it rides along pinned until ``release()`` frees
         its slab (the serving scheduler releases at the same chunk boundary
-        it cancels at). Idempotent on an already-done row."""
-        st = self._slots[slot]
-        if st is None:
-            raise ValueError(f"slot {slot} is not occupied")
+        it cancels at). Cancelling a mid-prefill admission drops its
+        half-filled single cache immediately — the partially written slab
+        is garbage the next occupant overwrites before attending.
+        Idempotent on an already-done row."""
+        st = self._state(slot)
         st.done = True
+        pf = self._prefills.pop(slot, None)
+        if pf is not None:
+            st.prefilling = False
+            for leaf in jax.tree.leaves(pf.cache):
+                leaf.delete()
 
     def release(self, slot: int) -> None:
-        """Free the slot for the next admit(). The slab is NOT cleared (see
-        class docstring for why reuse is safe); the row re-pins at the last
-        cache slot like a free slot."""
-        if self._slots[slot] is None:
+        """Free the row for the next admission and return its KV
+        reservation to the budget. The slab is NOT cleared (see class
+        docstring for why reuse is safe); the row re-pins at its slab's
+        last slot like a free row."""
+        st = self._slots.pop(slot, None)
+        if st is None:
             raise ValueError(f"slot {slot} is not occupied")
-        self._slots[slot] = None
-        self._pos = self._pos.at[slot].set(self.eng.cfg.seq_len - 1)
+        pf = self._prefills.pop(slot, None)
+        if pf is not None:
+            for leaf in jax.tree.leaves(pf.cache):
+                leaf.delete()
+        pool, row = self._where.pop(slot)
+        pool.rows[row] = None
+        pool.pos[row] = pool.ctx - 1
+        self._reserved_tokens -= st.reserved
+        if self._budget is not None:
+            self._budget.release(st.reserved)
+            self._budget.unplace(pool.ctx)
 
     def close(self) -> None:
-        """Drop the resident batch cache's device buffers. Idempotent."""
+        """Drop every resident slab's (and pending prefill's) device
+        buffers and hand all reservations back to the external budget.
+        Idempotent."""
         if self._closed:
             return
         self._closed = True
-        for leaf in jax.tree.leaves(self.cache):
-            leaf.delete()
-        self.cache = None
-        self._slots = [None] * self.max_batch
+        if self._budget is not None:
+            for st in self._slots.values():
+                self._budget.release(st.reserved)
+            for pool, _ in self._where.values():
+                self._budget.unplace(pool.ctx)
+        for pf in self._prefills.values():
+            for leaf in jax.tree.leaves(pf.cache):
+                leaf.delete()
+        for pool in self._pools.values():
+            for leaf in jax.tree.leaves(pool.cache):
+                leaf.delete()
+            pool.cache = None
+        self._pools = {}
+        self._slots = {}
+        self._where = {}
+        self._prefills = {}
 
 
 class _NgramIndex:

@@ -46,6 +46,7 @@ from dllama_tpu.serving.lifecycle import (
     CancelToken,
     Deadline,
     DeadlineExceeded,
+    KVBudget,
     LifecycleError,
     SchedulerCrashed,
     Supervisor,
@@ -205,6 +206,10 @@ class Batcher:
             if self.trace is not None:
                 self.trace.mark_prefill(ms)
 
+        def mark_prefill_chunk(self, t_begin: float, t_end: float) -> None:
+            if self.trace is not None:
+                self.trace.mark_prefill_chunk(t_begin, t_end)
+
         def mark_token(self) -> None:
             if self.trace is not None:
                 self.trace.mark_token()
@@ -225,16 +230,33 @@ class Batcher:
     DEADLINE_GRACE_S = 5.0
 
     def __init__(self, state, window_ms: float = 15.0, max_batch: int = 8,
-                 chunk: int = 8):
+                 chunk: int = 8, prefill_chunk: int = -1,
+                 kv_buckets: bool = True, kv_bucket_min: int = 0):
         self.state = state
         self.window_s = window_ms / 1000.0
-        #: HBM bound: the pool KV cache is max_batch full-context caches
+        #: HBM bound: the pool's KV budget is max_batch full-context caches
         #: (--batch-max; size against seq_len x n_layers x kv x cache dtype)
         self.max_batch = max(1, max_batch)
         #: fused steps between admission checks (--batch-chunk): smaller =
         #: lower admission latency for mid-decode arrivals, larger = fewer
         #: host round trips per token
         self.chunk = max(1, chunk)
+        #: --prefill-chunk: prompt tokens consumed per scheduler tick while
+        #: a long prompt fills its cache (admit_begin/prefill_step).
+        #: < 0 = auto (one decode chunk's worth of token-forwards:
+        #: chunk * max_batch); 0 = monolithic admission (the pre-chunking
+        #: behavior: every resident row stalls for the whole prefill)
+        self.prefill_chunk = (self.chunk * self.max_batch
+                              if prefill_chunk < 0 else int(prefill_chunk))
+        #: --kv-buckets: length-bucketed slot pools under the same modeled
+        #: HBM budget (more resident rows for short traffic); off = the
+        #: classic uniform [L, max_batch, S, kv, hd] slab
+        self.kv_buckets = bool(kv_buckets)
+        self.kv_bucket_min = max(0, int(kv_bucket_min))
+        #: serving-side KV accountant, shared across pool sessions so the
+        #: dllama_kv_* gauges stay continuous between traffic bursts
+        self.kv_budget = KVBudget(
+            self.max_batch * int(getattr(state.cfg, "seq_len", 1)))
         self._lock = threading.Lock()
         self._arrivals: queue_mod.Queue = queue_mod.Queue()
         # scheduler-layer telemetry (shares the server's registry): which
@@ -437,16 +459,22 @@ class Batcher:
         st = self.state
         stop_ids = st.stop_token_ids()
         waiting = list(batch)
-        slot_map: dict = {}  # session slot index -> _Slot
+        slot_map: dict = {}  # session slot handle -> _Slot
         sess = None
         try:
-            sess = st.engine.batch_session(self.max_batch, chunk=self.chunk)
+            sess = st.engine.batch_session(
+                self.max_batch, chunk=self.chunk,
+                bucket_kv=self.kv_buckets,
+                min_bucket=self.kv_bucket_min or None,
+                prefill_chunk=self.prefill_chunk,
+                kv_budget=self.kv_budget)
             self._active_sess = sess
             while waiting or slot_map:
                 # lifecycle reap, BETWEEN chunks: a cancelled (client gone)
                 # or deadline-expired row is released NOW — its slab goes to
                 # the next waiter this very loop pass — and dead waiters
-                # never occupy a slot at all
+                # never occupy a slot at all (a mid-prefill row's half-built
+                # cache is dropped the same way)
                 waiting = [s for s in waiting if not self._reap_slot(s)]
                 for b in list(slot_map):
                     s = slot_map[b]
@@ -456,20 +484,46 @@ class Batcher:
                         sess.release(b)
                         del slot_map[b]
                         self._resolve_err(s, err)
-                while waiting and sess.free_slots:
+                while waiting and sess.can_admit(len(waiting[0].prompt),
+                                                 waiting[0].steps):
                     s = waiting.pop(0)
                     s.mark_start("continuous")
                     self._m_path.inc(path="continuous")
                     pre_admit_ms = sess.prefill_ms
                     try:
-                        b = sess.admit(s.prompt, s.steps, sampler=s.sampler,
-                                       stop_tokens=stop_ids)
+                        if self.prefill_chunk > 0:
+                            # chunked admission: reserve the row now, feed
+                            # the prompt one prefill_step per tick below —
+                            # resident rows keep decoding in between
+                            b = sess.admit_begin(
+                                s.prompt, s.steps, sampler=s.sampler,
+                                stop_tokens=stop_ids)
+                        else:
+                            b = sess.admit(s.prompt, s.steps,
+                                           sampler=s.sampler,
+                                           stop_tokens=stop_ids)
                     except Exception as e:  # noqa: BLE001 — this row only
                         self._fail([s], e)
                         continue
-                    s.mark_prefill(sess.prefill_ms - pre_admit_ms)
+                    if self.prefill_chunk <= 0:
+                        s.mark_prefill(sess.prefill_ms - pre_admit_ms)
                     s.tokens = []
                     slot_map[b] = s
+                # ONE incremental prefill piece per tick (FIFO): the oldest
+                # pending prompt advances by <= prefill_chunk tokens, so
+                # every resident row's inter-token gap is bounded by one
+                # prefill chunk + one decode chunk instead of a whole
+                # monolithic prompt
+                if self.prefill_chunk > 0:
+                    t_pf = time.monotonic()
+                    adv = sess.prefill_step()
+                    if adv is not None:
+                        b, finished = adv
+                        s = slot_map.get(b)
+                        if s is not None:
+                            s.mark_prefill_chunk(t_pf, time.monotonic())
+                            if finished:
+                                s.mark_prefill(sess.prefill_ms_of(b))
                 if slot_map:
                     self._m_occupancy.observe(float(len(slot_map)))
                 for b, burst in sess.step_chunk().items():
@@ -544,6 +598,7 @@ class Batcher:
             faults.fire("scheduler")
             window = [s for s in window if not self._reap_slot(s)]
             if window:
+                t_win = time.monotonic()
                 with self.state.lock:  # the engine serves one pool at a time
                     if len(window) == 1 and self._arrivals.empty():
                         self._serve_solo(window[0])
@@ -556,6 +611,12 @@ class Batcher:
                         self._serve_spec(window)
                     else:
                         self._serve_continuous(window)
+                # one span per routed window on the scheduler track (tid 0);
+                # request tracks (allocated span ids) group right under it
+                observability.emit_trace_events([
+                    observability.scheduler_trace_event(
+                        "scheduler_window", t_win, time.monotonic(),
+                        {"window": len(window)})])
             self._window = []
 
     def _on_crash(self, exc: BaseException) -> None:
@@ -653,6 +714,8 @@ class ServerState:
                  default_seed: int = None, spec_draft: int = 0,
                  session_cache: int = 2, batch_window_ms: float = 0.0,
                  batch_max: int = 8, batch_chunk: int = 8,
+                 prefill_chunk: int = -1, kv_buckets: int = 1,
+                 kv_bucket_min: int = 0,
                  request_timeout: float = 0.0, queue_depth: int = 64,
                  metrics=None, log_json: bool = False,
                  log_prompts: bool = False, log_stream=None):
@@ -672,6 +735,11 @@ class ServerState:
         ``queue_depth``: max concurrent requests admitted (--queue-depth);
         overflow is rejected 429 + Retry-After instead of queuing
         unboundedly.
+        ``prefill_chunk``: prompt tokens per incremental prefill piece in
+        the pooled path (--prefill-chunk; <0 = auto, 0 = monolithic).
+        ``kv_buckets``/``kv_bucket_min``: length-bucketed KV slot pools
+        (--kv-buckets/--kv-bucket-min) — more resident rows at the same
+        modeled HBM budget when traffic skews short.
         ``metrics``: observability.MetricsRegistry to register server-layer
         series on (None = the process-wide default registry, which the
         engine/lifecycle/weights layers already share — one /metrics scrape
@@ -726,6 +794,17 @@ class ServerState:
             "dllama_prompt_tokens_total", "Prompt tokens accepted")
         self._m_tokens_out = reg.counter(
             "dllama_completion_tokens_total", "Completion tokens generated")
+        # token-COUNT distributions: power-of-two buckets (TOKEN_BUCKETS),
+        # NOT the latency boundaries — each bucket reads directly as "which
+        # KV bucket would this request land in"
+        self._m_prompt_hist = reg.histogram(
+            "dllama_prompt_tokens",
+            "Prompt length per request, in power-of-two token buckets",
+            buckets=observability.TOKEN_BUCKETS)
+        self._m_completion_hist = reg.histogram(
+            "dllama_completion_tokens",
+            "Completion length per request, in power-of-two token buckets",
+            buckets=observability.TOKEN_BUCKETS)
         self._m_sse_disconnect = reg.counter(
             "dllama_sse_disconnects_total",
             "Streaming responses whose client vanished mid-stream (the "
@@ -748,7 +827,9 @@ class ServerState:
         # concurrency.
         self.batcher = (
             Batcher(self, batch_window_ms, max_batch=batch_max,
-                    chunk=batch_chunk)
+                    chunk=batch_chunk, prefill_chunk=prefill_chunk,
+                    kv_buckets=bool(kv_buckets),
+                    kv_bucket_min=kv_bucket_min)
             if batch_window_ms > 0 else None
         )
         # prefix cache: KV state + token history of recent completions, LRU.
@@ -902,8 +983,10 @@ class ServerState:
             self._m_queue_wait.observe(trace.queue_wait_ms)
         if trace.tokens_in:
             self._m_tokens_in.inc(trace.tokens_in)
+            self._m_prompt_hist.observe(float(trace.tokens_in))
         if trace.tokens_out:
             self._m_tokens_out.inc(trace.tokens_out)
+            self._m_completion_hist.observe(float(trace.tokens_out))
         observability.emit_trace_events(trace.trace_events())
         if self.log_json:
             rec = trace.record()
@@ -1530,6 +1613,9 @@ def serve(args) -> None:
         batch_window_ms=getattr(args, "batch_window", 0.0),
         batch_max=getattr(args, "batch_max", 8),
         batch_chunk=getattr(args, "batch_chunk", 8),
+        prefill_chunk=getattr(args, "prefill_chunk", -1),
+        kv_buckets=getattr(args, "kv_buckets", 1),
+        kv_bucket_min=getattr(args, "kv_bucket_min", 0),
         request_timeout=getattr(args, "request_timeout", 0.0),
         queue_depth=getattr(args, "queue_depth", 64),
         log_json=getattr(args, "log_json", False),

@@ -19,6 +19,9 @@ client ever observes an unbounded wait:
   ``on_crash`` hook (fail in-flight slots 503) and restarts the loop, so one
   poisoned window can never leave every later ``submit()`` hanging on a dead
   daemon.
+* :class:`KVBudget` — the batcher's KV admission accountant: per-bucket
+  residency and token-slot reservations against the session's modeled HBM
+  budget, published as gauges.
 """
 
 from __future__ import annotations
@@ -45,6 +48,16 @@ _M_DEADLINES = _REG.counter(
 _M_INFLIGHT = _REG.gauge(
     "dllama_inflight_requests",
     "Requests currently admitted past the gate")
+_M_KV_RESERVED = _REG.gauge(
+    "dllama_kv_tokens_reserved",
+    "KV token-slots reserved against the session's modeled HBM budget")
+_M_KV_BUDGET = _REG.gauge(
+    "dllama_kv_tokens_budget",
+    "The session's modeled HBM budget in KV token-slots (max_batch*seq_len)")
+_M_KV_ROWS = _REG.gauge(
+    "dllama_kv_bucket_rows",
+    "Rows resident per KV bucket context length",
+    ("bucket",))
 
 
 class LifecycleError(RuntimeError):
@@ -232,6 +245,72 @@ class AdmissionGate:
                     return False
                 self._idle.wait(left)
             return True
+
+
+class KVBudget:
+    """Serving-side KV admission accountant for a BatchSession.
+
+    The session enforces its own modeled HBM budget (``can_admit``); this
+    mirror keeps the SERVER's view — reservations against the budget and
+    rows resident per context bucket — and publishes it as gauges
+    (``dllama_kv_tokens_reserved``, ``dllama_kv_bucket_rows{bucket}``), so
+    an operator can see at a glance how many short rows the bucketed pools
+    are packing into the slab the uniform layout spends on max_batch
+    full-context rows. The runtime never imports serving: the session takes
+    this object duck-typed via ``batch_session(kv_budget=...)``.
+
+    Thread-safe; the scheduler thread mutates it while the metrics thread
+    reads. All methods are O(1).
+    """
+
+    def __init__(self, total_tokens: int):
+        self.total_tokens = max(1, int(total_tokens))
+        self._lock = threading.Lock()
+        self._reserved = 0
+        self._rows: dict = {}  # bucket ctx -> resident rows
+        _M_KV_BUDGET.set(self.total_tokens)
+        _M_KV_RESERVED.set(0)
+
+    @property
+    def reserved(self) -> int:
+        return self._reserved
+
+    def rows_by_bucket(self) -> dict:
+        with self._lock:
+            return dict(self._rows)
+
+    def can_fit(self, tokens: int) -> bool:
+        with self._lock:
+            return self._reserved + tokens <= self.total_tokens
+
+    def reserve(self, tokens: int) -> None:
+        with self._lock:
+            self._reserved += tokens
+            _M_KV_RESERVED.set(self._reserved)
+
+    def release(self, tokens: int) -> None:
+        with self._lock:
+            self._reserved = max(0, self._reserved - tokens)
+            _M_KV_RESERVED.set(self._reserved)
+
+    def place(self, bucket: int) -> None:
+        with self._lock:
+            self._rows[bucket] = self._rows.get(bucket, 0) + 1
+            _M_KV_ROWS.set(self._rows[bucket], bucket=str(bucket))
+
+    def unplace(self, bucket: int) -> None:
+        with self._lock:
+            self._rows[bucket] = max(0, self._rows.get(bucket, 0) - 1)
+            _M_KV_ROWS.set(self._rows[bucket], bucket=str(bucket))
+
+    def migrate(self, old_bucket: int, new_bucket: int) -> None:
+        """A row moved buckets: occupancy shifts, reservation unchanged
+        (admission reserved the worst-case bucket up front)."""
+        with self._lock:
+            self._rows[old_bucket] = max(0, self._rows.get(old_bucket, 0) - 1)
+            self._rows[new_bucket] = self._rows.get(new_bucket, 0) + 1
+            _M_KV_ROWS.set(self._rows[old_bucket], bucket=str(old_bucket))
+            _M_KV_ROWS.set(self._rows[new_bucket], bucket=str(new_bucket))
 
 
 class Supervisor:

@@ -491,16 +491,16 @@ def test_concurrent_greedy_requests_batch_into_one_decode():
         if state.batcher is not None:
             orig = engine.batch_session
 
-            def spy(max_batch, chunk=None):
-                sess = orig(max_batch, chunk)
-                orig_admit = sess.admit
+            def spy(max_batch, chunk=None, **skw):
+                sess = orig(max_batch, chunk, **skw)
+                orig_admit = sess.admit_begin  # admit() delegates here too
 
-                def admit(*a, **kw):
+                def admit_begin(*a, **kw):
                     slot = orig_admit(*a, **kw)
                     sizes.append(len(sess.occupied))
                     return slot
 
-                sess.admit = admit
+                sess.admit_begin = admit_begin
                 return sess
 
             engine.batch_session = spy
@@ -598,16 +598,16 @@ def test_concurrent_sampled_requests_batch_and_match_solo():
         if state.batcher is not None:
             orig = engine.batch_session
 
-            def spy(max_batch, chunk=None):
-                sess = orig(max_batch, chunk)
-                orig_admit = sess.admit
+            def spy(max_batch, chunk=None, **skw):
+                sess = orig(max_batch, chunk, **skw)
+                orig_admit = sess.admit_begin  # admit() delegates here too
 
-                def admit(*a, **kw):
+                def admit_begin(*a, **kw):
                     slot = orig_admit(*a, **kw)
                     sizes.append(len(sess.occupied))
                     return slot
 
-                sess.admit = admit
+                sess.admit_begin = admit_begin
                 return sess
 
             engine.batch_session = spy

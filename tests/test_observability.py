@@ -129,14 +129,87 @@ def test_trace_events_nest_under_request_span():
     tr.tokens_out = 2
     events = tr.trace_events()
     names = [e["name"] for e in events]
-    assert names[0] == "request"
+    # a thread_name metadata record labels the track; spans follow
+    assert names[0] == "thread_name" and events[0]["ph"] == "M"
+    assert names[1] == "request"
     assert {"queue_wait", "prefill", "decode"} <= set(names)
-    req = events[0]
-    for e in events:
+    req = events[1]
+    for e in events[1:]:
         # one track per request: child spans nest under the request span
         assert e["tid"] == req["tid"] and e["ph"] == "X"
         assert e["ts"] >= req["ts"]
         assert e["ts"] + e["dur"] <= req["ts"] + req["dur"] + 1
+    assert events[0]["tid"] == req["tid"]
+
+
+def test_span_ids_are_small_and_unique():
+    """Track ids are allocated sequentially per process — Perfetto shows
+    'req <id>' tracks instead of giant hashed tids — and never collide."""
+    a, b = RequestTrace("req-a"), RequestTrace("req-b")
+    assert isinstance(a.span_id, int) and isinstance(b.span_id, int)
+    assert a.span_id != b.span_id
+    assert b.span_id > a.span_id  # monotonic allocation
+    a.mark_start("solo")
+    a.mark_token()
+    assert all(e["tid"] == a.span_id for e in a.trace_events())
+    assert observability.next_span_id() > b.span_id
+
+
+def test_prefill_chunk_spans_replace_monolithic_prefill():
+    """Chunked admission: each prefill piece becomes its own child span
+    (numbered), and the single monolithic 'prefill' span is suppressed."""
+    import time
+
+    tr = RequestTrace("req-chunks")
+    tr.mark_start("continuous")
+    for _ in range(2):
+        t_a = time.monotonic()
+        time.sleep(0.002)
+        tr.mark_prefill_chunk(t_a, time.monotonic())
+    tr.mark_prefill(2.0)  # scheduler still records the total
+    tr.mark_token()
+    tr.tokens_out = 1
+    events = tr.trace_events()
+    names = [e["name"] for e in events]
+    assert names.count("prefill_chunk") == 2
+    assert "prefill" not in names
+    chunks = [e for e in events if e["name"] == "prefill_chunk"]
+    assert [c["args"]["chunk"] for c in chunks] == [0, 1]
+    req = [e for e in events if e["name"] == "request"][0]
+    for c in chunks:  # chunk spans nest inside the request span
+        assert c["tid"] == req["tid"]
+        assert c["ts"] >= req["ts"]
+        assert c["ts"] + c["dur"] <= req["ts"] + req["dur"] + 1
+
+
+def test_scheduler_trace_event_uses_reserved_track():
+    import time
+
+    t0 = time.monotonic()
+    ev = observability.scheduler_trace_event(
+        "scheduler_window", t0, t0 + 0.005, {"window": 3})
+    assert ev["tid"] == observability.SCHEDULER_TID == 0
+    assert ev["ph"] == "X" and ev["cat"] == "scheduler"
+    assert ev["args"] == {"window": 3}
+    assert ev["dur"] >= 4000  # microseconds
+    json.dumps(ev)
+
+
+def test_token_buckets_are_powers_of_two():
+    bk = observability.TOKEN_BUCKETS
+    assert all(b == 2.0 ** i for i, b in enumerate(bk))
+    assert bk[0] == 1.0 and bk[-1] >= 8192.0
+    reg = MetricsRegistry()
+    h = reg.histogram("t_tokens", "tokens", buckets=bk)
+    for v in (1, 3, 700):
+        h.observe(float(v))
+    assert h.count() == 3
+    # cumulative bucket lines render one sample per power-of-two boundary
+    lines = [l for l in reg.render().splitlines()
+             if l.startswith("t_tokens_bucket")]
+    assert len(lines) == len(bk) + 1  # +Inf bucket
+    counts = [float(l.rsplit(" ", 1)[1]) for l in lines]
+    assert counts == sorted(counts) and counts[-1] == 3
 
 def test_sanitize_request_id():
     assert observability.sanitize_request_id("abc-123_X") == "abc-123_X"
