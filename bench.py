@@ -97,6 +97,11 @@ def _seq_override() -> int:
     return _env_count("BENCH_SEQ")
 
 
+def _pct(xs, p):
+    ys = sorted(xs)
+    return ys[min(len(ys) - 1, int(round(p / 100.0 * (len(ys) - 1))))]
+
+
 def _run_probe(code: str, sentinel: str, timeout_s: int) -> tuple:
     """Run ``code`` in a subprocess -> (ok, failure_detail). The subprocess
     matters: a down TPU tunnel makes backend init hang in native code,
@@ -453,10 +458,6 @@ def run_decode_bench(cfg_dict: dict, bench_steps: int = None, quant_ok: bool = F
             sess.close()
             return n
 
-        def _pct(xs, p):
-            ys = sorted(xs)
-            return ys[min(len(ys) - 1, int(round(p / 100.0 * (len(ys) - 1))))]
-
         log(f"prefill stall replay: {pf}-token prompt into a busy pool "
             f"(B={B}, chunk={chunk}, prefill_chunk={pchunk}); warmup...")
         t0 = time.perf_counter()
@@ -501,6 +502,134 @@ def run_decode_bench(cfg_dict: dict, bench_steps: int = None, quant_ok: bool = F
                 f"{rows_uni} at the same budget — must be strictly more: "
                 f"{report}")
         return ch_p99, f"{weights}-prefillstall{pf}-b{B}{cfg_tag}"
+
+    # BENCH_PREFIX=N replays a SHARED-SYSTEM-PROMPT workload through the
+    # paged-KV radix prefix cache: N sequential requests whose prompts are
+    # one seq_len/2 system prefix plus a short unique tail (>=50% shared),
+    # measured as per-request TTFT (admit -> first token). The cold control
+    # replays the SAME lengths with fully unique prompts, so every
+    # admission pays full prefill. A capacity phase counts 1-token rows
+    # resident at the same modeled HBM budget paged vs uniform. CPU-runnable
+    # (BENCH_MODEL=smoke); the gate FAILS the bench unless warm TTFT p50 is
+    # strictly below cold, paged rows >= uniform rows, and the paged
+    # replays performed ZERO slab-migration copies (growth appends a page).
+    # BENCH_PREFIX_PAGE overrides the page size (default 16 tokens);
+    # BENCH_PREFIX_OUT writes the full report JSON for CI artifacts.
+    px = _env_count("BENCH_PREFIX")
+    if px:
+        import numpy as np
+
+        S = cfg.seq_len
+        n_req = max(4, min(px, 64))
+        B = max(2, min(batch or 4, 8))
+        chunk = 8
+        page = _env_count("BENCH_PREFIX_PAGE") or 16
+        rng = np.random.default_rng(0)
+        shared = [int(t) for t in rng.integers(1, cfg.vocab_size, S // 2)]
+        tail_len = max(4, S // 16)
+        greedy = SamplerConfig(temperature=0.0, seed=0)
+
+        def _prompts(share):
+            out = []
+            for i in range(n_req):
+                r = np.random.default_rng((1 if share else 100) + i)
+                tail = [int(t) for t in r.integers(1, cfg.vocab_size,
+                                                   tail_len)]
+                head = shared if share else [
+                    int(t) for t in r.integers(1, cfg.vocab_size,
+                                               len(shared))]
+                out.append(head + tail)
+            return out
+
+        def _ttft_replay(share):
+            """Sequential replay; returns (per-request TTFT ms, migrations,
+            prefix hit rate, evictions). A fresh session per replay: the
+            radix cache starts cold both ways."""
+            sess = eng.batch_session(B, chunk=chunk, prefill_chunk=4 * chunk,
+                                     kv_pages=page)
+            ttfts = []
+            for prompt in _prompts(share):
+                t0 = time.perf_counter()
+                h = sess.admit_begin(prompt, steps=chunk, sampler=greedy)
+                got = []
+                while not got and not sess.is_done(h):
+                    sess.prefill_step()
+                    got.extend(sess.step_chunk().get(h, []))
+                ttfts.append((time.perf_counter() - t0) * 1000.0)
+                while not sess.is_done(h):
+                    sess.prefill_step()
+                    sess.step_chunk()
+                sess.release(h)
+            stats = (sess.migrations, sess.prefix_hit_rate,
+                     sess.prefix_evictions)
+            sess.close()
+            return (ttfts,) + stats
+
+        def _capacity(paged):
+            """1-token rows admitted at the same modeled budget (B * seq_len
+            KV token-slots): paged reserves ceil(need/page) pages per row,
+            uniform burns a full-context slab row regardless."""
+            sess = eng.batch_session(B, chunk=chunk,
+                                     kv_pages=page if paged else 0)
+            n = 0
+            while sess.can_admit(1, chunk, [1]) and n < 4096:
+                sess.admit_begin([1], steps=chunk, sampler=greedy)
+                n += 1
+            migr = getattr(sess, "migrations", 0)
+            sess.close()
+            return n, migr
+
+        log(f"prefix cache replay: {n_req} requests, {len(shared)}-token "
+            f"shared prefix + {tail_len}-token tails (page={page}); warmup...")
+        t0 = time.perf_counter()
+        _ttft_replay(True)  # compiles prefill pieces + paged decode groups
+        log(f"warmup done in {time.perf_counter() - t0:.1f}s")
+        cold_ttfts, cold_migr, _, _ = _ttft_replay(False)
+        warm_ttfts, warm_migr, hit_rate, evictions = _ttft_replay(True)
+        warm = warm_ttfts[1:]  # request 0 seeds the cache: it IS the cold path
+        cold = cold_ttfts
+        warm_p50, warm_p99 = _pct(warm, 50), _pct(warm, 99)
+        cold_p50, cold_p99 = _pct(cold, 50), _pct(cold, 99)
+        log(f"TTFT p50: cold {cold_p50:.1f} ms vs warm {warm_p50:.1f} ms "
+            f"(p99 {cold_p99:.1f} vs {warm_p99:.1f}; hit rate "
+            f"{hit_rate:.2f}, {evictions} evictions)")
+        rows_uni, _ = _capacity(False)
+        rows_paged, cap_migr = _capacity(True)
+        log(f"rows resident at fixed HBM budget ({B * S} KV token-slots): "
+            f"uniform {rows_uni} vs paged {rows_paged}")
+        report = {
+            "requests": n_req, "shared_tokens": len(shared),
+            "tail_tokens": tail_len, "page_tokens": page, "pool": B,
+            "cold_ttft_p50_ms": round(cold_p50, 3),
+            "cold_ttft_p99_ms": round(cold_p99, 3),
+            "warm_ttft_p50_ms": round(warm_p50, 3),
+            "warm_ttft_p99_ms": round(warm_p99, 3),
+            "prefix_hit_rate": round(hit_rate, 4),
+            "prefix_evictions": evictions,
+            "budget_kv_tokens": B * S,
+            "rows_uniform": rows_uni, "rows_paged": rows_paged,
+            "migrations": cold_migr + warm_migr + cap_migr,
+        }
+        out_path = os.environ.get("BENCH_PREFIX_OUT")
+        if out_path:
+            with open(out_path, "w") as f:
+                json.dump(report, f, indent=2)
+            log(f"report written to {out_path}")
+        if warm_p50 >= cold_p50:
+            raise RuntimeError(
+                f"warm (prefix-cached) TTFT p50 {warm_p50:.1f} ms is not "
+                f"below cold {cold_p50:.1f} ms on >=50%-shared traffic: "
+                f"{report}")
+        if rows_paged < rows_uni:
+            raise RuntimeError(
+                f"paged KV admitted {rows_paged} rows vs uniform "
+                f"{rows_uni} at the same budget — must not be fewer: "
+                f"{report}")
+        if report["migrations"] != 0:
+            raise RuntimeError(
+                f"paged mode performed {report['migrations']} slab "
+                f"migration copies — growth must append pages: {report}")
+        return warm_p50, f"{weights}-prefix{n_req}-pg{page}{cfg_tag}"
 
     # BENCH_CONTINUOUS=N replays a staggered-arrival serving workload of N
     # requests through BOTH schedulers — the continuous slot pool
@@ -945,6 +1074,7 @@ def main() -> None:
     # metric name for the error path, resolvable without touching jax
     choice = os.environ.get("BENCH_MODEL", "")
     err_phase = ("prefill" if _prefill_count()
+                 else "prefix" if _env_count("BENCH_PREFIX")
                  else "serve" if _env_count("BENCH_CONTINUOUS")
                  else "faults" if _env_count("BENCH_FAULTS")
                  else "integrity" if _env_count("BENCH_INTEGRITY")
@@ -1033,6 +1163,7 @@ def main() -> None:
                                   or _env_count("BENCH_FAULTS")
                                   or _env_count("BENCH_INTEGRITY")
                                   or _env_count("BENCH_OBS")
+                                  or _env_count("BENCH_PREFIX")
                                   or _prefill_count())):
         # the scheduling replays (continuous-vs-static, fault boundedness,
         # prefill stall) measure SCHEDULING, so the CPU default is a shape
@@ -1071,6 +1202,7 @@ def main() -> None:
         ms, weights = run_decode_bench(cfg_dict, quant_ok=quant_ok)
 
     phase = ("prefill" if _prefill_count()
+             else "prefix" if _env_count("BENCH_PREFIX")
              else "serve" if _env_count("BENCH_CONTINUOUS")
              else "faults" if _env_count("BENCH_FAULTS")
              else "integrity" if _env_count("BENCH_INTEGRITY")

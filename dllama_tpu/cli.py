@@ -31,6 +31,12 @@ def build_parser() -> argparse.ArgumentParser:
     vp.add_argument("--model", required=True)
     vp.add_argument("--json", action="store_true",
                     help="print the full verification report as JSON")
+    vp.add_argument("--shard", default=None, metavar="I/N",
+                    help="verify only the row stripe host I of N actually "
+                    "loads (tensor-parallel sharded verify): with a DLRB "
+                    "row-band section the check reads ~1/N of the file's "
+                    "bytes; replicated 1-D tensors are always fully "
+                    "checked. Run once per host, e.g. --shard 0/4 ... 3/4")
     for mode in ("inference", "generate", "chat", "serve", "worker"):
         sp = sub.add_parser(mode)
         if mode == "serve":  # the dllama-api surface (`src/apps/dllama-api`)
@@ -110,6 +116,20 @@ def build_parser() -> argparse.ArgumentParser:
                 metavar="N",
                 help="smallest KV bucket context length (rounded up to a "
                 "power of two); 0 = auto (max(16, 2x batch-chunk))",
+            )
+            sp.add_argument(
+                "--kv-pages",
+                type=int,
+                default=0,
+                metavar="N",
+                help="paged KV: tokens per page of one preallocated arena "
+                "(halved until it divides seq-len) with per-row page "
+                "tables and a copy-on-write radix prefix cache — admits "
+                "alias cached shared-prompt pages and prefill only the "
+                "uncached tail, growing rows append pages (no slab "
+                "migration copies), eviction is LRU under the same "
+                "modeled HBM budget; overrides --kv-buckets; 0 = slab "
+                "modes (pre-paging behavior)",
             )
             sp.add_argument(
                 "--request-timeout",
@@ -574,15 +594,29 @@ def run_verify(args) -> int:
       byte offset and both CRCs, first corrupt tensor first; exit 1;
     * clean — exit 0 (a legacy file without an integrity section passes
       with the size/offset guarantee only, and says so).
+
+    ``--shard I/N`` restricts the check to host I's row stripe (the bytes
+    that host would actually map under N-way tensor parallelism), using the
+    DLRB row-band table when the file carries one.
     """
     import json as json_mod
 
     from dllama_tpu.formats.spec import FormatError
     from dllama_tpu.formats.weights import WeightFileReader
 
+    shard = None
+    if getattr(args, "shard", None):
+        try:
+            i, n = (int(v) for v in args.shard.split("/", 1))
+            if not 0 <= i < n:
+                raise ValueError
+        except ValueError:
+            print(f"❌ bad --shard {args.shard!r}: want I/N with 0 <= I < N")
+            return 1
+        shard = (i, n)
     try:
         with WeightFileReader(args.model) as reader:
-            report = reader.verify()
+            report = reader.verify(shard=shard)
     except FormatError as e:
         if args.json:
             print(json_mod.dumps(
@@ -600,11 +634,17 @@ def run_verify(args) -> int:
               "but payload bytes are UNVERIFIED")
         return 0
     if report["ok"]:
-        print(f"✅ {args.model}: {report['tensors']} tensors, "
-              f"{report['payload_bytes']} payload bytes, all checksums OK")
+        if shard is not None:
+            print(f"✅ {args.model}: shard {report['shard']} — "
+                  f"{report.get('bands_checked', 0)} row bands checked "
+                  f"({report['tensors']} tensors), all checksums OK")
+        else:
+            print(f"✅ {args.model}: {report['tensors']} tensors, "
+                  f"{report['payload_bytes']} payload bytes, all checksums OK")
         return 0
     for f in report["failures"]:
-        print(f"❌ {args.model}: tensor {f['name']!r} corrupt at byte "
+        where = (f" row band {f['band']}" if "band" in f else "")
+        print(f"❌ {args.model}: tensor {f['name']!r}{where} corrupt at byte "
               f"offset {f['offset']} ({f['nbytes']} bytes): stored "
               f"crc32 {f['expected_crc32']}, "
               f"computed {f['actual_crc32']}")

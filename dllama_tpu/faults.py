@@ -13,9 +13,11 @@ Spec grammar (sites separated by ``;``)::
 
     <site>:<action>[:key=val[,key=val...]]
 
-* ``site`` — where the hook fires. The wired seams are ``admit`` and
-  ``step_chunk`` (BatchSession), ``prefill`` (Engine), ``stream`` (the SSE
-  writer), ``scheduler`` (top of every server scheduler window — the
+* ``site`` — where the hook fires. The wired seams are ``admit``,
+  ``step_chunk``, ``prefix_match`` (the radix prefix-cache walk at paged
+  admission) and ``page_alloc`` (every KV page allocation) (BatchSession),
+  ``prefill`` / ``prefill_chunk`` (Engine), ``stream`` (the SSE writer),
+  ``scheduler`` (top of every server scheduler window — the
   supervisor-restart drill), ``weights_open`` / ``weights_read``
   (WeightFileReader — the artifact-integrity drills), and ``logits``
   (every decode dispatch — the numeric-health drill).
@@ -43,8 +45,9 @@ import os
 import threading
 import time
 
-SITES = ("admit", "step_chunk", "prefill", "prefill_chunk", "stream",
-         "scheduler", "weights_open", "weights_read", "logits")
+SITES = ("admit", "step_chunk", "prefill", "prefill_chunk", "prefix_match",
+         "page_alloc", "stream", "scheduler", "weights_open", "weights_read",
+         "logits")
 ACTIONS = ("raise", "slow", "truncate", "bitflip", "nan")
 
 

@@ -49,6 +49,21 @@ section simply see trailing bytes. This reader validates sizes/offsets at open
 CRC-checks each tensor lazily on first read (disable with
 ``DLLAMA_WEIGHTS_VERIFY=0``). :meth:`WeightFileReader.verify` checks the whole
 file — that is what ``python -m dllama_tpu.cli verify`` drives.
+
+**Row-band section (sharded verify).** After the DLCK section the writer
+appends a second trailing section::
+
+    b"DLRB" | u32 version=1 | u32 n_tensors | u32 band_rows
+            | per tensor (plan order): u32 n_bands | u32 crc32 per band
+            | u32 crc32 of the section itself
+
+Each band covers ``band_rows`` consecutive tensor rows (the unit
+``read_tensor_rows`` loads for a tensor-parallel shard), so a host can
+CRC-check ONLY the rows it actually maps: the lazy first-read check of a row
+band touches just the overlapping bands, and ``cli verify --shard I/N``
+checks one host's stripe of every tensor instead of the whole file. Files
+without the section fall back to whole-tensor verification; files without
+either section are validated by open-time size/offset arithmetic only.
 """
 
 from __future__ import annotations
@@ -76,6 +91,14 @@ from dllama_tpu.quants import blocks
 INTEGRITY_TAG = b"DLCK"
 INTEGRITY_VERSION = 1
 _SEC_FIXED = struct.calcsize("<4sIIQ")  # tag + version + n_tensors + payload_size
+
+ROW_BAND_TAG = b"DLRB"
+ROW_BAND_VERSION = 1
+#: rows per verification band: small enough that a 1/N shard of a big matmul
+#: tensor skips most of the file's bytes, large enough that the CRC table
+#: stays a rounding error next to the payload
+DEFAULT_ROW_BAND = 64
+_RB_FIXED = struct.calcsize("<4sIII")  # tag + version + n_tensors + band_rows
 
 _REG = observability.default_registry()
 _M_CRC_FAIL = _REG.counter(
@@ -131,6 +154,56 @@ def parse_integrity_section(extra: bytes, n_tensors: int, payload_size: int) -> 
     if zlib.crc32(bytes(extra[: _SEC_FIXED + 4 * n])) != self_crc:
         raise FormatError("integrity section fails its own checksum")
     return list(struct.unpack_from(f"<{n}I", extra, _SEC_FIXED))
+
+
+def build_row_band_section(band_crcs: list[list[int]], band_rows: int) -> bytes:
+    """Serialize the DLRB row-band CRC section (self-checksummed)."""
+    parts = [struct.pack("<4sIII", ROW_BAND_TAG, ROW_BAND_VERSION,
+                         len(band_crcs), band_rows)]
+    for crcs in band_crcs:
+        parts.append(struct.pack(f"<I{len(crcs)}I", len(crcs), *crcs))
+    sec = b"".join(parts)
+    return sec + struct.pack("<I", zlib.crc32(sec))
+
+
+def parse_row_band_section(extra: bytes,
+                           dims: list[int]) -> tuple[int, list[list[int]]]:
+    """Parse + validate the bytes after the DLCK section as a DLRB row-band
+    table, returning ``(band_rows, per-tensor band CRC lists)``. Band counts
+    are cross-checked against the plan's row dims (``dims``) so a hostile
+    table can never index out of a tensor."""
+    if len(extra) < _RB_FIXED + 4 or bytes(extra[:4]) != ROW_BAND_TAG:
+        raise FormatError(
+            f"{len(extra)} trailing bytes after the integrity section are "
+            f"not a row-band section (expected {ROW_BAND_TAG!r} tag)")
+    _, version, n, band_rows = struct.unpack_from("<4sIII", extra, 0)
+    if version != ROW_BAND_VERSION:
+        raise FormatError(f"unsupported row-band section version {version}")
+    if n != len(dims):
+        raise FormatError(
+            f"row-band section covers {n} tensors, plan has {len(dims)}")
+    if band_rows < 1:
+        raise FormatError(f"row-band section has band_rows={band_rows}")
+    off = _RB_FIXED
+    tables: list[list[int]] = []
+    for d in dims:
+        want = (d + band_rows - 1) // band_rows
+        if off + 4 * (want + 1) > len(extra):
+            raise FormatError("row-band integrity section truncated mid-table")
+        (nb,) = struct.unpack_from("<I", extra, off)
+        if nb != want:
+            raise FormatError(
+                f"row-band table {len(tables)} has {nb} bands, "
+                f"{d} rows at {band_rows}/band want {want}")
+        tables.append(list(struct.unpack_from(f"<{nb}I", extra, off + 4)))
+        off += 4 * (nb + 1)
+    if len(extra) != off + 4:
+        raise FormatError(
+            f"row-band integrity section is {len(extra)} bytes, want {off + 4}")
+    (self_crc,) = struct.unpack_from("<I", extra, off)
+    if zlib.crc32(bytes(extra[:off])) != self_crc:
+        raise FormatError("row-band section fails its own checksum")
+    return band_rows, tables
 
 
 @dataclasses.dataclass(frozen=True)
@@ -227,12 +300,22 @@ class WeightFileReader:
                     f"[{bad.offset}, {bad.offset + bad.nbytes}) — file ends "
                     f"{end - len(self._buf)} bytes early")
             self.tensor_crcs: list[int] | None = None
+            self.band_crcs: list[list[int]] | None = None
+            self.band_rows = 0
             if end < len(self._buf):
+                extra = self._buf[end:].tobytes()
+                # the DLCK section's length is fixed by the plan; anything
+                # after it must be the DLRB row-band table
+                dlck = _SEC_FIXED + 4 * len(self.entries) + 4
                 self.tensor_crcs = parse_integrity_section(
-                    self._buf[end:].tobytes(), len(self.entries), end)
+                    extra[:dlck], len(self.entries), end)
+                if len(extra) > dlck:
+                    self.band_rows, self.band_crcs = parse_row_band_section(
+                        extra[dlck:], [e.d for e in self.entries])
             self._by_name = {e.name: e for e in self.entries}
             self._index = {e.name: i for i, e in enumerate(self.entries)}
             self._verified: set = set()
+            self._verified_bands: dict = {}  # name -> set of checked bands
             self._lazy_verify = (
                 self.tensor_crcs is not None
                 and os.environ.get("DLLAMA_WEIGHTS_VERIFY", "1") != "0")
@@ -301,36 +384,116 @@ class WeightFileReader:
         the input to lossless quantized repacking (ops.qmatmul.repack_q40)."""
         return self._checked_raw(self._by_name[name])
 
+    def _rows_raw(self, e: TensorEntry, b0: int, b1: int) -> np.ndarray:
+        """Tensor bytes [b0, b1) with the ``weights_read:bitflip`` seam
+        applied when its (tensor-relative) target byte falls in range."""
+        raw = self._buf[e.offset + b0 : e.offset + b1]
+        fv = faults.fire("weights_read")
+        if fv is not None and fv["action"] == "bitflip":
+            k = min(max(0, fv["byte"]), e.nbytes - 1)
+            if b0 <= k < b1:
+                raw = raw.copy()
+                raw[k - b0] ^= 1
+        return raw
+
+    def _check_bands(self, e: TensorEntry, start: int, stop: int,
+                     failures: list | None = None) -> int:
+        """CRC the not-yet-verified DLRB bands overlapping rows
+        [start, stop). A mismatch raises :class:`ChecksumError` (the lazy
+        read path) unless ``failures`` is given (the verify report path,
+        which records and keeps scanning). Returns bands checked now."""
+        if stop <= start:
+            return 0
+        crcs = self.band_crcs[self._index[e.name]]
+        done = self._verified_bands.setdefault(e.name, set())
+        rb = blocks.row_bytes(e.float_type, e.n)
+        checked = 0
+        for b in range(start // self.band_rows,
+                       (stop - 1) // self.band_rows + 1):
+            if b in done:
+                continue
+            r0 = b * self.band_rows
+            r1 = min(e.d, r0 + self.band_rows)
+            raw = self._rows_raw(e, r0 * rb, r1 * rb)
+            actual = zlib.crc32(raw)
+            checked += 1
+            if actual != crcs[b]:
+                del raw
+                _M_CRC_FAIL.inc()
+                if failures is None:
+                    raise ChecksumError(self.path, e.name, e.offset + r0 * rb,
+                                        crcs[b], actual)
+                failures.append({
+                    "name": e.name, "band": b, "offset": e.offset + r0 * rb,
+                    "nbytes": (r1 - r0) * rb,
+                    "expected_crc32": f"{crcs[b]:#010x}",
+                    "actual_crc32": f"{actual:#010x}",
+                })
+                continue
+            done.add(b)
+            if len(done) == len(crcs):
+                self._verified.add(e.name)
+                _M_VERIFIED.inc()
+        return checked
+
     def read_tensor_rows(self, name: str, rows: slice, dtype=np.float32) -> np.ndarray:
         """Dequantize only a row band — the unit of tensor-parallel sharded loading.
 
         Equivalent to the reference ``RowMatmulSlice.splitWeights`` row-band copy
         (`/root/reference/src/transformer.cpp:25-42`) but done lazily at load time so
         each host only ever touches its own shard's bytes. The first touch of a
-        checksummed tensor CRC-verifies the whole tensor.
+        checksummed band CRC-verifies only the DLRB bands the slice overlaps
+        (sharded verify); files without a row-band table fall back to the
+        whole-tensor check.
         """
         e = self._by_name[name]
-        if self._lazy_verify and e.name not in self._verified:
-            self._checked_raw(e)
         start, stop, step = rows.indices(e.d)
         assert step == 1
+        if self._lazy_verify and e.name not in self._verified:
+            if self.band_crcs is not None:
+                self._check_bands(e, start, stop)
+            else:
+                self._checked_raw(e)
         rb = blocks.row_bytes(e.float_type, e.n)
         raw = self._buf[e.offset + start * rb : e.offset + stop * rb]
         x = blocks.decode_tensor(raw, e.float_type, (stop - start) * e.n)
         return x.reshape(stop - start, e.n).astype(dtype, copy=False)
 
-    def verify(self) -> dict:
-        """Check every tensor against the integrity section (no dequantization).
+    def shard_rows(self, e: TensorEntry, shard: int, n_shards: int) -> tuple:
+        """The row stripe host ``shard`` of ``n_shards`` loads from ``e``:
+        1-D tensors (d == 1) are replicated — every host reads them all."""
+        if e.d == 1:
+            return 0, 1
+        return e.d * shard // n_shards, e.d * (shard + 1) // n_shards
 
-        Returns a report dict; ``failures`` lists corrupt tensors in plan order
-        (so the first element is the first bad tensor by byte offset). Files
-        without an integrity section pass with ``has_integrity: False`` —
-        open-time size/offset validation is then the only guarantee.
+    def verify(self, shard: tuple | None = None) -> dict:
+        """Check tensors against the integrity sections (no dequantization).
+
+        Default: every tensor's whole-tensor CRC, failures in plan order (the
+        first element is the first bad tensor by byte offset). With
+        ``shard=(i, n)``: only the row stripe host i of n actually loads
+        (``shard_rows``; replicated 1-D tensors are always fully checked),
+        using the DLRB row-band table — a 1/n verify reads ~1/n of the
+        file's bytes. A sharded verify of a file WITHOUT a row-band table
+        falls back to whole-tensor CRCs of the shard's tensors (every
+        stripe is non-empty, so that is the whole file — honest, just not
+        cheap). Files without any integrity section pass with
+        ``has_integrity: False`` — open-time size/offset validation is then
+        the only guarantee.
         """
-        failures = []
+        failures: list = []
+        bands_checked = 0
+        use_bands = shard is not None and self.band_crcs is not None
         for i, e in enumerate(self.entries):
             if self.tensor_crcs is None:
                 break
+            lo, hi = ((0, e.d) if shard is None
+                      else self.shard_rows(e, shard[0], shard[1]))
+            if hi <= lo:
+                continue
+            if use_bands:
+                bands_checked += self._check_bands(e, lo, hi, failures)
+                continue
             actual = zlib.crc32(self._raw_view(e))
             expected = self.tensor_crcs[i]
             if actual != expected:
@@ -343,14 +506,20 @@ class WeightFileReader:
             else:
                 self._verified.add(e.name)
                 _M_VERIFIED.inc()
-        return {
+        report = {
             "path": self.path,
             "ok": not failures,
             "has_integrity": self.has_integrity,
+            "has_row_bands": self.band_crcs is not None,
             "tensors": len(self.entries),
             "payload_bytes": self.entries[-1].offset + self.entries[-1].nbytes,
             "failures": failures,
         }
+        if shard is not None:
+            report["shard"] = f"{shard[0]}/{shard[1]}"
+            report["row_band"] = self.band_rows
+            report["bands_checked"] = bands_checked
+        return report
 
     def iter_tensors(self, dtype=np.float32) -> Iterator[tuple[str, np.ndarray]]:
         for e in self.entries:
@@ -367,17 +536,22 @@ class ModelWriter:
     plan order — a 70B conversion never holds more than one tensor in RAM
     (the reference converters stream the same way,
     `/root/reference/converter/convert-hf.py:92-125`). Unless ``checksums``
-    is disabled, per-tensor CRC32s are accumulated as tensors stream through
-    and a trailing integrity section is appended on close (the reference
-    loader ignores trailing bytes, so such files stay reference-loadable)."""
+    is disabled, per-tensor CRC32s (and per-row-band CRC32s — the DLRB
+    section that makes ``verify --shard`` and first-read shard verification
+    cheap) are accumulated as tensors stream through and the trailing
+    integrity sections are appended on close (the reference loader ignores
+    trailing bytes, so such files stay reference-loadable)."""
 
-    def __init__(self, path: str, spec: ModelSpec, checksums: bool | None = None):
+    def __init__(self, path: str, spec: ModelSpec, checksums: bool | None = None,
+                 row_band: int = DEFAULT_ROW_BAND):
         header = write_header(spec)
         self.spec = dataclasses.replace(spec, header_size=len(header))
         self.plan = tensor_plan(self.spec)
         self._i = 0
         self._checksums = DEFAULT_WRITE_CHECKSUMS if checksums is None else checksums
+        self._row_band = max(1, int(row_band))
         self._crcs: list[int] = []
+        self._band_crcs: list[list[int]] = []
         self._f = open(path, "wb")
         self._f.write(header)
 
@@ -392,6 +566,10 @@ class ModelWriter:
         self._f.write(raw)
         if self._checksums:
             self._crcs.append(zlib.crc32(raw))
+            rb = blocks.row_bytes(e.float_type, e.n)
+            self._band_crcs.append([
+                zlib.crc32(raw[r0 * rb:min(e.d, r0 + self._row_band) * rb])
+                for r0 in range(0, e.d, self._row_band)])
         self._i += 1
 
     def close(self) -> None:
@@ -402,6 +580,8 @@ class ModelWriter:
         if self._checksums:
             payload = self.plan[-1].offset + self.plan[-1].nbytes
             self._f.write(build_integrity_section(self._crcs, payload))
+            self._f.write(build_row_band_section(self._band_crcs,
+                                                 self._row_band))
         self._f.close()
 
     def __enter__(self):

@@ -27,6 +27,7 @@ import numpy as np
 from dllama_tpu import faults, observability
 from dllama_tpu.models import llama
 from dllama_tpu.models.config import ModelConfig
+from dllama_tpu.runtime import paged_kv
 from dllama_tpu.runtime.sampler import SamplerConfig, sample_dynamic
 
 PREFILL_BUCKETS = (8, 16, 32, 64, 128, 256, 512, 1024, 2048, 4096, 8192)
@@ -164,12 +165,32 @@ class Engine:
             self._m_spec_emitted = metrics.counter(
                 "dllama_spec_tokens_emitted_total",
                 "Tokens emitted by speculative decode paths")
+            self._m_prefix_hits = metrics.counter(
+                "dllama_prefix_cache_hits_total",
+                "Paged admissions that aliased at least one cached KV page")
+            self._m_prefix_misses = metrics.counter(
+                "dllama_prefix_cache_misses_total",
+                "Paged admissions with no cached prefix page to alias")
+            self._m_prefix_tokens = metrics.counter(
+                "dllama_prefix_tokens_matched_total",
+                "Prompt tokens served from the radix prefix cache instead "
+                "of being re-prefilled")
+            self._m_cow = metrics.counter(
+                "dllama_kv_cow_copies_total",
+                "Boundary KV pages copied (copy-on-write) at paged admission")
+            self._m_prefix_evictions = metrics.counter(
+                "dllama_prefix_evictions_total",
+                "Refcount-zero prefix-cache pages evicted (LRU) to satisfy "
+                "an allocation")
         else:
             self._m_prefill = self._m_step = self._m_chunk = None
             self._m_prefill_chunk = self._m_migrations = None
             self._m_quarantine = None
             self._m_spec_steps = self._m_spec_accepted = None
             self._m_spec_emitted = None
+            self._m_prefix_hits = self._m_prefix_misses = None
+            self._m_prefix_tokens = self._m_cow = None
+            self._m_prefix_evictions = None
         self.cfg = cfg
         self.sampler_cfg = sampler_cfg
         self.mesh = mesh
@@ -373,6 +394,73 @@ class Engine:
             )
             return out, cache, keys, ok  # out [n_steps, B], ok [B]
 
+        @partial(jax.jit, donate_argnums=(2,), static_argnames=("n_steps",))
+        def _decode_loop_paged(params, rope, arena, tables, tokens, pos,
+                               keys, temps, topps, poison, n_steps):
+            """N batched decode steps over PAGED KV: the resident cache is
+            one arena of fixed-size token pages ``{k,v: [L, P, page, kv,
+            hd]}`` and ``tables`` [B, nb] maps each row's logical block b
+            to a physical page (scratch page 0 pads unallocated tails).
+
+            Each step gathers every row's pages into a contiguous
+            [L, B, nb*page, kv, hd] window — logical position i of the row
+            IS window index i, so ``forward_batched`` (rope by pos,
+            mask by pos, write-before-attend) runs on it unchanged and the
+            math is bit-identical to a bucketed slab of ctx=nb*page — then
+            scatters back ONLY the page containing the position this step
+            wrote. Aliased (prefix-cache) pages are never the written page:
+            a live row writes at pos >= prompt_len-1, strictly past every
+            fully-shared block, and pinned/done rows resolve to the scratch
+            page. Duplicate scatter indices (several pinned rows on
+            scratch) are harmless garbage-on-garbage.
+
+            Sampling/health semantics are _decode_loop_batch's exactly:
+            per-row key chains split once per step, per-row watchdog ``ok``
+            accumulation, pos clamped at the window's last slot."""
+            page = arena["k"].shape[2]
+            B, nb = tables.shape
+            W = nb * page
+
+            def gather(a):
+                w = jnp.take(a, tables, axis=1)  # [L, B, nb, page, kv, hd]
+                return w.reshape(a.shape[0], B, W, a.shape[3], a.shape[4])
+
+            def body(carry, _):
+                arena, toks, pos_, keys_, ok = carry
+                window = jax.tree.map(gather, arena)
+                logits, window = fwd_b(cfg, params, rope, toks, window, pos_)
+                logits, ok = _health(logits, poison, ok)
+                split = jax.vmap(jax.random.split)(keys_)
+                keys_, subs = split[:, 0], split[:, 1]
+                nxt = jax.vmap(sample_dynamic)(logits, subs, temps, topps
+                                               ).astype(jnp.int32)
+                wpos = jnp.clip(pos_, 0, W - 1)  # [B] position written
+                blk = wpos // page
+                phys = jnp.take_along_axis(tables, blk[:, None],
+                                           axis=1)[:, 0]  # [B]
+                off = blk * page
+
+                def scat(a, w):
+                    # per row: the page-sized slice of the updated window
+                    # holding this step's K/V write, back to its arena page
+                    pg = jax.vmap(
+                        lambda wb, o: jax.lax.dynamic_slice_in_dim(
+                            wb, o, page, axis=1),
+                        in_axes=(1, 0), out_axes=1)(w, off)
+                    return a.at[:, phys].set(pg)  # [L, B, page, kv, hd]
+
+                arena = jax.tree.map(scat, arena, window)
+                pos_ = jnp.minimum(pos_ + 1, jnp.int32(W - 1))
+                return (arena, nxt, pos_, keys_, ok), nxt
+
+            (arena, toks, pos, keys, ok), out = jax.lax.scan(
+                body,
+                (arena, tokens, pos, keys,
+                 jnp.ones(tokens.shape, jnp.bool_)),
+                length=n_steps,
+            )
+            return out, arena, keys, ok  # out [n_steps, B], ok [B]
+
         bsh = (None if self._batch_cache_sharding is None else
                {"k": self._batch_cache_sharding, "v": self._batch_cache_sharding})
         self._batch_cache_init = jax.jit(
@@ -414,6 +502,41 @@ class Engine:
             lambda dst, src: jax.tree.map(
                 lambda d, s: jax.lax.dynamic_update_slice(
                     d, s, (0, 0, 0, 0, 0)), dst, src),
+            donate_argnums=0,
+        )
+        self._page_to_single = jax.jit(
+            # Arena page ``p`` into a single-sequence staging cache at token
+            # offset ``off`` — how a paged admission preloads its aliased
+            # prefix before tail prefill. p/off are traced: ONE compile
+            # serves every (page, offset), dispatched once per aliased page.
+            lambda single, arena, p, off: jax.tree.map(
+                lambda s, a: jax.lax.dynamic_update_slice(
+                    s, jax.lax.dynamic_index_in_dim(a, p, axis=1,
+                                                    keepdims=False),
+                    (0, off, 0, 0)), single, arena),
+            donate_argnums=0,
+        )
+        self._single_to_page = jax.jit(
+            # Token block [off, off+page) of a filled staging cache into
+            # arena page ``p`` — a completed prefill's fresh tail blocks
+            # scattered into the pool (the staging cache is then dropped).
+            lambda arena, single, p, off: jax.tree.map(
+                lambda a, s: a.at[:, p].set(jax.lax.dynamic_slice(
+                    s, (0, off, 0, 0),
+                    (s.shape[0], a.shape[2], s.shape[2], s.shape[3]))),
+                arena, single),
+            donate_argnums=0,
+        )
+        self._page_copy = jax.jit(
+            # Arena page ``src`` duplicated into page ``dst``: the
+            # copy-on-write boundary — an admission whose prompt ends flush
+            # on a cached block takes a private copy of that block (its
+            # pending-token position will be rewritten by the first decode
+            # step) instead of re-prefilling up to page-1 tokens.
+            lambda arena, dst, src: jax.tree.map(
+                lambda a: a.at[:, dst].set(
+                    jax.lax.dynamic_index_in_dim(a, src, axis=1,
+                                                 keepdims=False)), arena),
             donate_argnums=0,
         )
 
@@ -459,6 +582,7 @@ class Engine:
         self._no_poison: dict = {}  # B -> cached all-False [B] flags
         self._decode_loop = partial(_decode_loop, self.params, self.rope)
         self._decode_loop_batch = partial(_decode_loop_batch, self.params, self.rope)
+        self._decode_loop_paged = partial(_decode_loop_paged, self.params, self.rope)
         self._verify_step = partial(_verify_step, self.params, self.rope)
         self._verify_batch = partial(_verify_batch, self.params, self.rope)
         self._verify_sampled = partial(_verify_sampled, self.params, self.rope)
@@ -990,7 +1114,8 @@ class Engine:
                       bucket_kv: bool = False,
                       min_bucket: Optional[int] = None,
                       prefill_chunk: int = 0,
-                      kv_budget=None) -> "BatchSession":
+                      kv_budget=None,
+                      kv_pages: int = 0) -> "BatchSession":
         """Open a persistent slot-pool decode session (continuous batching):
         resident donated batch cache slabs whose rows are admitted, stepped,
         and released INDEPENDENTLY — see BatchSession.
@@ -1002,13 +1127,19 @@ class Engine:
         length-bucketed slot pools (from ``min_bucket`` up to seq_len) under
         the SAME modeled HBM budget of max_batch*seq_len KV token-slots, so
         short requests stop paying full-context HBM and strictly more rows
-        fit. ``prefill_chunk`` > 0 sets the default token budget of
+        fit. ``kv_pages`` > 0 goes further: TRUE PAGED KV — one arena of
+        kv_pages-token pages under the same budget, per-row page tables, a
+        radix prefix cache aliasing shared prompt pages copy-on-write, and
+        zero migration copies (growing a row appends a page). 0 keeps the
+        bucketed/uniform slab modes as the degenerate configurations.
+        ``prefill_chunk`` > 0 sets the default token budget of
         prefill_step() for chunked (admit_begin) admissions. ``kv_budget``
         is an optional external accountant (serving.lifecycle.KVBudget) that
-        mirrors reservations/occupancy into gauges."""
+        mirrors reservations/occupancy into gauges (and, in paged mode,
+        owns the page free list + refcounts via ``attach_pages``)."""
         return BatchSession(self, max_batch, chunk, bucket_kv=bucket_kv,
                             min_bucket=min_bucket, prefill_chunk=prefill_chunk,
-                            kv_budget=kv_budget)
+                            kv_budget=kv_budget, kv_pages=kv_pages)
 
     def generate_batch_spec(
         self, prompts: list, steps: int,
@@ -1429,6 +1560,69 @@ class _BucketPool:
         self.cap = new_cap
 
 
+class _RowPages:
+    """One paged row's page-table state: ``blocks[b]`` is the physical
+    arena page holding logical token block b (aliased prefix pages first,
+    private tail pages appended as the row grows). ``outstanding`` is the
+    row's reserved-but-unallocated private page count (returned to the
+    allocator at release); ``cap_tokens`` its worst-case context
+    (admission's _need_ctx), the hard bound page appends never exceed."""
+
+    __slots__ = ("blocks", "outstanding", "cap_tokens", "plen")
+
+    def __init__(self, blocks: list, outstanding: int, cap_tokens: int,
+                 plen: int):
+        self.blocks = blocks
+        self.outstanding = outstanding
+        self.cap_tokens = cap_tokens
+        self.plen = plen
+
+
+class _PagedGroup:
+    """Host-side row state for one paged decode shape: every row whose page
+    table currently spans ``nb`` blocks shares one compiled decode program
+    (window = nb*page tokens). Unlike _BucketPool there is NO device cache
+    here — KV lives in the session-wide arena — so moving a growing row to
+    a wider group is a host-side table rewrite, never a device copy: the
+    bucket-migration copy is gone by construction. Free rows pin at the
+    window's last slot with an all-scratch table (their writes land on the
+    garbage page)."""
+
+    __slots__ = ("nb", "cap", "tables", "tokens", "pos", "keys", "temps",
+                 "topps", "rows")
+
+    def __init__(self, nb: int, cap: int, page: int):
+        self.nb = nb
+        self.cap = cap
+        self.tables = np.full((cap, nb), paged_kv.SCRATCH_PAGE, np.int32)
+        self.tokens = np.zeros((cap,), np.int32)
+        self.pos = np.full((cap,), nb * page - 1, np.int32)
+        self.keys = np.zeros((cap, 2), np.uint32)
+        self.temps = np.zeros((cap,), np.float32)
+        self.topps = np.ones((cap,), np.float32)
+        self.rows: list = [None] * cap
+
+    def grow(self, page: int) -> None:
+        """Double capacity in place (host arrays only; compile count per
+        group stays log2(rows) like _BucketPool.grow)."""
+        pad = self.cap
+        self.tables = np.concatenate(
+            [self.tables,
+             np.full((pad, self.nb), paged_kv.SCRATCH_PAGE, np.int32)])
+        self.tokens = np.concatenate(
+            [self.tokens, np.zeros((pad,), np.int32)])
+        self.pos = np.concatenate(
+            [self.pos, np.full((pad,), self.nb * page - 1, np.int32)])
+        self.keys = np.concatenate(
+            [self.keys, np.zeros((pad, 2), np.uint32)])
+        self.temps = np.concatenate(
+            [self.temps, np.zeros((pad,), np.float32)])
+        self.topps = np.concatenate(
+            [self.topps, np.ones((pad,), np.float32)])
+        self.rows.extend([None] * pad)
+        self.cap *= 2
+
+
 class BatchSession:
     """Slot-pool decode over resident donated batch cache slabs — the
     continuous-batching primitive. Where ``generate_batch`` forms a batch
@@ -1472,7 +1666,7 @@ class BatchSession:
 
     def __init__(self, eng: Engine, max_batch: int, chunk: Optional[int] = None,
                  bucket_kv: bool = False, min_bucket: Optional[int] = None,
-                 prefill_chunk: int = 0, kv_budget=None):
+                 prefill_chunk: int = 0, kv_budget=None, kv_pages: int = 0):
         if max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
         chunk = eng.decode_chunk if chunk is None else chunk
@@ -1481,9 +1675,18 @@ class BatchSession:
         self.eng = eng
         self.max_batch = max_batch
         self.chunk = chunk
-        self.bucket_kv = bool(bucket_kv)
+        self.paged = kv_pages > 0
+        self.bucket_kv = bool(bucket_kv) and not self.paged
         self.prefill_chunk = max(0, int(prefill_chunk))
         S = eng.cfg.seq_len
+        if self.paged:
+            # page size must divide the model context so logical blocks tile
+            # it exactly (a partial tail block would misplace the staging
+            # copies); halve the requested size until it does
+            page = max(1, int(kv_pages))
+            while S % page:
+                page //= 2
+            self.page = page
         if self.bucket_kv:
             # the bucket ladder: powers of two from min_bucket (default: a
             # couple of decode chunks — smaller slabs would migrate every
@@ -1516,7 +1719,35 @@ class BatchSession:
         self.migrations = 0  # rows moved to a larger bucket, this session
         self.decode_ms = 0.0  # cumulative fused-chunk wall time
         self.prefill_ms = 0.0  # cumulative admit-prefill wall time
-        if not self.bucket_kv:
+        # paged-mode telemetry (all stay 0 in slab modes)
+        self.prefix_hits = 0  # admits that aliased >= 1 cached page
+        self.prefix_misses = 0  # admits with nothing cached to alias
+        self.prefix_tokens_matched = 0  # prompt tokens served from cache
+        self.cow_copies = 0  # boundary pages privately copied at admit
+        self.prefix_evictions = 0  # cached pages LRU-evicted for allocs
+        self.regroups = 0  # host-side table moves (the ex-migrations)
+        if self.paged:
+            # ONE preallocated arena under the same modeled HBM budget the
+            # uniform slab spends (+1 scratch page): [L, P, page, kv, hd]
+            num_pages = self.budget_tokens // self.page + 1
+            self._arena = eng._bucket_cache_init(num_pages, self.page)
+            if kv_budget is not None and hasattr(kv_budget, "attach_pages"):
+                # the serving accountant owns the free list + refcounts
+                # (and publishes them as gauges); the session drives it
+                self._alloc = kv_budget.attach_pages(num_pages, self.page)
+            else:
+                self._alloc = paged_kv.PageAllocator(num_pages, self.page)
+            self._radix = paged_kv.RadixPrefixCache(self.page)
+            self._pgroups: dict = {}  # nb -> _PagedGroup
+            self._rowpages: dict = {}  # handle -> _RowPages
+            max_nb = S // self.page
+            ladder, nb = [], 1
+            while nb < max_nb:
+                ladder.append(nb)
+                nb *= 2
+            ladder.append(max_nb)
+            self._nb_ladder = tuple(ladder)
+        elif not self.bucket_kv:
             # the classic resident slab, pre-allocated so the pool never
             # grows and handles stay the historical slot indices 0..B-1
             self._pools[S] = _BucketPool(eng, S, max_batch)
@@ -1525,19 +1756,24 @@ class BatchSession:
     @property
     def cache(self):
         """The uniform-mode resident slab. Bucketed sessions keep one slab
-        per occupied bucket; there is no single cache to point at."""
-        if self._closed or self.bucket_kv:
+        per occupied bucket, paged sessions one page arena; neither has a
+        single per-session cache to point at."""
+        if self._closed or self.bucket_kv or self.paged:
             return None
         return self._pools[self.eng.cfg.seq_len].cache
 
     @property
     def free_slots(self) -> list:
         """Row indices admit() can take right now (uniform mode: the actual
-        free slot indices, the historical contract). Bucketed sessions
-        admit by KV budget, not row count — prefer ``can_admit``; here the
-        number of smallest-bucket reservations that still fit is returned
-        as pseudo-indices so ``if sess.free_slots:`` keeps meaning "can
-        admit something"."""
+        free slot indices, the historical contract). Bucketed/paged
+        sessions admit by KV budget, not row count — prefer ``can_admit``;
+        here the number of smallest admissions (one bucket / one page) that
+        still fit is returned as pseudo-indices so ``if sess.free_slots:``
+        keeps meaning "can admit something"."""
+        if self.paged:
+            n = (self._alloc.free_count + self._alloc.evictable_count
+                 - self._alloc.reserved_pages)
+            return list(range(max(0, n)))
         if not self.bucket_kv:
             pool = self._pools[self.eng.cfg.seq_len]
             return [b for b, h in enumerate(pool.rows) if h is None]
@@ -1565,6 +1801,33 @@ class BatchSession:
     def reserved_tokens(self) -> int:
         """KV token-slots currently reserved against ``budget_tokens``."""
         return self._reserved_tokens
+
+    @property
+    def prefix_hit_rate(self) -> float:
+        """Fraction of paged admits that aliased >= 1 cached page (0.0 in
+        slab modes and before any admission)."""
+        n = self.prefix_hits + self.prefix_misses
+        return self.prefix_hits / n if n else 0.0
+
+    def page_stats(self) -> dict:
+        """Paged-mode occupancy snapshot for /stats and /ready ({} in slab
+        modes): allocator page counts, radix-tree size, per-window resident
+        rows, and the prefix-cache counters."""
+        if not self.paged:
+            return {}
+        s = self._alloc.stats()
+        s["radix_nodes"] = len(self._radix)
+        s["rows_per_window"] = {
+            str(nb * self.page): sum(1 for h in g.rows if h is not None)
+            for nb, g in sorted(self._pgroups.items())}
+        s["prefix_hits"] = self.prefix_hits
+        s["prefix_misses"] = self.prefix_misses
+        s["prefix_hit_rate"] = self.prefix_hit_rate
+        s["prefix_tokens_matched"] = self.prefix_tokens_matched
+        s["cow_copies"] = self.cow_copies
+        s["prefix_evictions"] = self.prefix_evictions
+        s["regroups"] = self.regroups
+        return s
 
     def _state(self, slot: int) -> _SlotState:
         st = self._slots.get(slot)
@@ -1598,21 +1861,149 @@ class BatchSession:
                 return b
         return self.buckets[-1]
 
-    def can_admit(self, prompt_len: int, steps: int) -> bool:
+    def can_admit(self, prompt_len: int, steps: int,
+                  prompt_tokens: Optional[list] = None) -> bool:
         """True when the session's modeled KV budget (and the external
-        kv_budget, if any) has room for this request's WORST-CASE bucket —
-        admission reserves the bucket covering prompt+steps up front so a
-        later migration can never oversubscribe. The capacity win over the
-        uniform slab comes from short requests reserving small buckets
-        instead of a full-context row."""
+        kv_budget, if any) has room for this request's WORST-CASE need —
+        admission reserves the bucket (or private page count) covering
+        prompt+steps up front so later growth can never oversubscribe.
+        Paged sessions reserve only the pages the radix prefix cache can't
+        alias; pass ``prompt_tokens`` to let this check count the match
+        (without it the answer is conservative: zero match assumed)."""
         if self._closed:
             return False
+        if self.paged:
+            priv, full, _ = self._plan_pages(prompt_len, steps,
+                                             prompt_tokens)
+            # matched evictable pages would be pinned by this admit, leaving
+            # the availability pool — count them alongside the private need
+            pinned = sum(1 for n in full
+                         if self._alloc.refcount(n.page) == 0)
+            if not self._alloc.can_reserve(priv + pinned):
+                return False
+            if self._budget is not None and not self._budget.can_fit(
+                    priv * self.page):
+                return False
+            return True
         need = self._bucket_for(self._need_ctx(prompt_len, steps))
         if self._reserved_tokens + need > self.budget_tokens:
             return False
         if self._budget is not None and not self._budget.can_fit(need):
             return False
         return True
+
+    # -- paged-mode internals ---------------------------------------------
+    def _plan_pages(self, prompt_len: int, steps: int,
+                    prompt_tokens: Optional[list]) -> tuple:
+        """(private pages to reserve, aliasable full-prefix nodes, COW
+        boundary node) for a prospective paged admission. ``full`` nodes
+        cache blocks strictly below position prompt_len-1 (never written by
+        this row — safe to alias); the COW node, when the prompt ends flush
+        on the next cached block, is copied privately instead (its last
+        slot is the pending token's write target)."""
+        need = paged_kv.pages_for(
+            self._need_ctx(prompt_len, steps), self.page)
+        if prompt_tokens is None:
+            return need, [], None
+        path = self._radix.match(prompt_tokens)
+        nfull = min(len(path), (prompt_len - 1) // self.page)
+        full = path[:nfull]
+        cow = None
+        if len(path) > nfull and (nfull + 1) * self.page == prompt_len:
+            cow = path[nfull]
+        return need - nfull, full, cow
+
+    def _page_alloc(self, rp: _RowPages) -> int:
+        """One private arena page for ``rp``'s row, evicting LRU prefix-
+        cache pages if the free list is dry — guaranteed to succeed for a
+        reserved row (admission counted free + evictable)."""
+        faults.fire("page_alloc")
+        p = self._alloc.alloc()
+        if p is None:
+            freed = self._radix.evict(1, self._alloc)
+            self.prefix_evictions += freed
+            if self.eng._m_prefix_evictions is not None and freed:
+                self.eng._m_prefix_evictions.inc(freed)
+            p = self._alloc.alloc()
+        if p is None:
+            raise RuntimeError(
+                "paged KV pool exhausted despite admission reservation — "
+                "page accounting bug")
+        rp.outstanding = max(0, rp.outstanding - 1)
+        return p
+
+    def _nb_for(self, blocks: int) -> int:
+        for nb in self._nb_ladder:
+            if nb >= blocks:
+                return nb
+        return self._nb_ladder[-1]
+
+    def _alloc_prow(self, nb: int) -> tuple:
+        """A free row in the ``nb``-block group, materializing/growing it
+        on demand (mirrors _alloc_row)."""
+        g = self._pgroups.get(nb)
+        if g is None:
+            g = self._pgroups[nb] = _PagedGroup(nb, 1, self.page)
+        for r in range(g.cap):
+            if g.rows[r] is None:
+                return g, r
+        r = g.cap
+        g.grow(self.page)
+        return g, r
+
+    def _sync_table(self, handle: int) -> None:
+        """Mirror the row's logical block list into its group's device-
+        bound page table (scratch-padded past the allocated tail)."""
+        g, r = self._where[handle]
+        rp = self._rowpages[handle]
+        g.tables[r, :] = paged_kv.SCRATCH_PAGE
+        n = min(len(rp.blocks), g.nb)
+        g.tables[r, :n] = rp.blocks[:n]
+
+    def _regroup(self, handle: int, nb: int) -> None:
+        """Move a growing row to a wider window group. Pure host-side
+        state: the KV never moves (it lives in arena pages) — this is what
+        killed the bucket-migration copy."""
+        src, srow = self._where[handle]
+        dst, drow = self._alloc_prow(nb)
+        dst.tokens[drow] = src.tokens[srow]
+        dst.pos[drow] = src.pos[srow]
+        dst.keys[drow] = src.keys[srow]
+        dst.temps[drow] = src.temps[srow]
+        dst.topps[drow] = src.topps[srow]
+        dst.rows[drow] = handle
+        src.rows[srow] = None
+        src.pos[srow] = src.nb * self.page - 1
+        src.tables[srow, :] = paged_kv.SCRATCH_PAGE
+        self._where[handle] = (dst, drow)
+        self.regroups += 1
+        self._sync_table(handle)
+
+    def _finish_pages(self, handle: int, prompt_tokens: list,
+                      staging: Optional[dict] = None) -> None:
+        """Complete a paged row's table through its pending-token block:
+        allocate the private tail pages, scatter the staging cache's
+        prefilled blocks into them (``staging`` None on the no-prefill
+        paths — fully cached or 1-token prompts, whose block contents are
+        either aliased/COW-copied already or written by the first decode
+        step before anything attends them), then publish every fully-
+        prompt-covered block into the radix tree."""
+        rp = self._rowpages[handle]
+        plen = len(prompt_tokens)
+        total = (plen - 1) // self.page + 1
+        for b in range(len(rp.blocks), total):
+            p = self._page_alloc(rp)
+            if staging is not None and b * self.page < plen - 1:
+                self._arena = self.eng._single_to_page(
+                    self._arena, staging, jnp.int32(p),
+                    jnp.int32(b * self.page))
+            rp.blocks.append(p)
+        # blocks with (b+1)*page <= plen-1 hold immutable prompt KV (this
+        # row only writes at pos >= plen-1): cacheable for future admits
+        nins = (plen - 1) // self.page
+        for p in self._radix.insert(prompt_tokens, rp.blocks[:nins]):
+            self._alloc.hold(p)
+        self._sync_table(handle)
 
     def _alloc_row(self, ctx: int) -> tuple:
         """A free row in the ``ctx`` pool, materializing/growing it on
@@ -1676,13 +2067,17 @@ class BatchSession:
         if len(prompt_tokens) > S:
             raise ValueError(
                 f"prompt of {len(prompt_tokens)} tokens exceeds seq_len {S}")
-        if not self.can_admit(len(prompt_tokens), steps):
+        if not self.can_admit(len(prompt_tokens), steps,
+                              list(prompt_tokens) if self.paged else None):
             raise RuntimeError(
                 f"no free slot (max_batch={self.max_batch}, KV budget "
                 f"{self._reserved_tokens}/{self.budget_tokens} tokens); "
                 "release a finished row first")
         faults.fire("admit")
         scfg = sampler if sampler is not None else self.eng.sampler_cfg
+        if self.paged:
+            return self._admit_begin_paged(list(prompt_tokens), steps, scfg,
+                                           tuple(stop_tokens))
         plen = len(prompt_tokens)
         reserved = self._bucket_for(self._need_ctx(plen, steps))
         # place optimistically small: enough for the prompt plus one decode
@@ -1715,6 +2110,104 @@ class BatchSession:
             st.prefilling = True
             self._prefills[handle] = _PendingPrefill(
                 list(prompt_tokens), scfg, self.eng.new_cache())
+        return handle
+
+    def _admit_begin_paged(self, prompt_tokens: list, steps: int,
+                           scfg: SamplerConfig, stop_tokens: tuple) -> int:
+        """Paged admission: walk the radix tree, alias the cached prefix,
+        reserve only the private tail, and prefill only what the cache
+        can't serve. The aliased blocks all sit strictly below position
+        plen-1 — this row never writes there (write-before-attend starts at
+        the pending token), so sharing is read-only by construction and the
+        live stream stays bit-identical to a cold prefill."""
+        S = self.eng.cfg.seq_len
+        plen = len(prompt_tokens)
+        faults.fire("prefix_match")
+        priv, full, cow = self._plan_pages(plen, steps, prompt_tokens)
+        # pin the aliased prefix FIRST: pinning pulls evictable pages out
+        # of the availability pool, so the reservation check below is exact
+        # with the pins already in place
+        for n in full:
+            self._alloc.ref(n.page)
+        if not self._alloc.can_reserve(priv) or (
+                self._budget is not None
+                and not self._budget.can_fit(priv * self.page)):
+            for n in full:
+                self._alloc.unref(n.page)
+            raise RuntimeError(
+                f"no free KV pages ({self._alloc.free_count} free + "
+                f"{self._alloc.evictable_count} evictable, "
+                f"{self._alloc.reserved_pages} reserved, need {priv}); "
+                "release a finished row first")
+        self._alloc.reserve(priv)
+        reserved = priv * self.page
+        self._reserved_tokens += reserved
+        if self._budget is not None:
+            self._budget.reserve(reserved)
+        need_ctx = self._need_ctx(plen, steps)
+        rp = _RowPages([n.page for n in full], priv, need_ctx, plen)
+        # place in a window sized for the prompt plus one chunk of headroom
+        # — regroup (a host-side table move) widens the long-lived rows
+        place = min(need_ctx, plen + self.chunk)
+        g, row = self._alloc_prow(
+            self._nb_for(paged_kv.pages_for(place, self.page)))
+        handle = self._next_handle
+        self._next_handle += 1
+        pos0 = plen - 1
+        room = S - pos0
+        budget = min(room, steps)
+        st = _SlotState(
+            room=room, budget=budget, stop_tokens=stop_tokens,
+            reserved=reserved,
+            done=budget <= 0, finish="length" if budget <= 0 else None)
+        self._slots[handle] = st
+        self._where[handle] = (g, row)
+        self._rowpages[handle] = rp
+        g.rows[row] = handle
+        if budget <= 0:
+            return handle  # never decodes; pages stay pinned until release
+        cached = len(full) * self.page
+        if cow is not None:
+            # the prompt ends flush on a cached block whose last slot is
+            # this row's first write target: duplicate it privately
+            p = self._page_alloc(rp)
+            self._arena = self.eng._page_copy(
+                self._arena, jnp.int32(p), jnp.int32(cow.page))
+            rp.blocks.append(p)
+            cached = plen - 1
+            self.cow_copies += 1
+            if self.eng._m_cow is not None:
+                self.eng._m_cow.inc()
+        matched = min(cached, plen - 1)
+        if matched > 0:
+            self.prefix_hits += 1
+            self.prefix_tokens_matched += matched
+            if self.eng._m_prefix_hits is not None:
+                self.eng._m_prefix_hits.inc()
+                self.eng._m_prefix_tokens.inc(matched)
+        else:
+            self.prefix_misses += 1
+            if self.eng._m_prefix_misses is not None:
+                self.eng._m_prefix_misses.inc()
+        if plen == 1 or cached >= plen - 1:
+            # nothing left to prefill: every attended prefix position is
+            # aliased (or COW-copied) — allocate the tail and go live
+            self._finish_pages(handle, prompt_tokens)
+            self._go_live(handle, prompt_tokens, scfg)
+            return handle
+        faults.fire("prefill")
+        st.prefilling = True
+        staging = self.eng.new_cache()
+        for b, n in enumerate(full):
+            # preload the aliased blocks so the chunked prefill continues
+            # at ``cached`` over the exact KV a cold prefill would have
+            # written (the chunked==monolithic invariant then carries)
+            staging = self.eng._page_to_single(
+                staging, self._arena, jnp.int32(n.page),
+                jnp.int32(b * self.page))
+        pf = _PendingPrefill(prompt_tokens, scfg, staging)
+        pf.cursor = cached
+        self._prefills[handle] = pf
         return handle
 
     def prefill_step(self, handle: Optional[int] = None,
@@ -1757,10 +2250,16 @@ class BatchSession:
         pf.cursor += len(piece)
         if pf.cursor < len(prefix):
             return handle, False
-        # prefix complete: copy the filled single cache into the row's slab
-        pool, row = self._where[handle]
-        pool.cache = self.eng._batch_cache_insert(
-            pool.cache, pf.cache, jnp.int32(row))
+        # prefix complete: land the filled single cache in the row's KV
+        if self.paged:
+            # scatter the staging blocks into freshly allocated arena pages
+            # (the aliased prefix blocks are already in place) and publish
+            # the fully-covered ones to the radix tree
+            self._finish_pages(handle, pf.prompt, staging=pf.cache)
+        else:
+            pool, row = self._where[handle]
+            pool.cache = self.eng._batch_cache_insert(
+                pool.cache, pf.cache, jnp.int32(row))
         del self._prefills[handle]
         st.prefilling = False
         self._go_live(handle, pf.prompt, pf.scfg)
@@ -1840,6 +2339,8 @@ class BatchSession:
                    for st in self._slots.values()):
             return {}
         faults.fire("step_chunk")
+        if self.paged:
+            return self._step_chunk_paged()
         S = self.eng.cfg.seq_len
         fresh: dict = {}
         stepped: set = set()
@@ -1889,36 +2390,94 @@ class BatchSession:
             self.decode_ms += chunk_ms
             if self.eng._m_chunk is not None:
                 self.eng._m_chunk.observe(chunk_ms)
-            for r in live:
-                h = pool.rows[r]
-                st = self._slots[h]
-                if not okh[r]:
-                    st.done = True
-                    st.finish = "error"
-                    if self.eng._m_quarantine is not None:
-                        self.eng._m_quarantine.inc()
-                    fresh[h] = []
-                    continue
-                # a context-exhausted row pinned at its last slot: tokens
-                # past its room are garbage — generate_batch's accounting
-                keep = max(0, min(self.chunk, st.room - st.offered))
-                st.offered += self.chunk
-                toks = [int(t) for t in arr[:keep, r]]
-                take = min(len(toks), st.budget - st.emitted)
-                for j in range(take):
-                    if toks[j] in st.stop_tokens:
-                        take = j + 1
-                        break
-                toks = toks[:take]
-                st.emitted += len(toks)
-                if st.emitted >= st.budget:
-                    st.done = True
-                    st.finish = "length"
-                elif (st.stop_tokens and toks
-                        and toks[-1] in st.stop_tokens):
-                    st.done = True
-                    st.finish = "stop"
-                fresh[h] = toks
+            self._account_chunk(pool, live, arr, okh, fresh)
+        return fresh
+
+    def _account_chunk(self, pool, live: list, arr, okh, fresh: dict) -> None:
+        """Per-row bookkeeping for one fused chunk's output — shared by the
+        slab and paged dispatch paths (identical by design: the accounting
+        IS the bit-identity contract, only residency differs)."""
+        for r in live:
+            h = pool.rows[r]
+            st = self._slots[h]
+            if not okh[r]:
+                st.done = True
+                st.finish = "error"
+                if self.eng._m_quarantine is not None:
+                    self.eng._m_quarantine.inc()
+                fresh[h] = []
+                continue
+            # a context-exhausted row pinned at its last slot: tokens
+            # past its room are garbage — generate_batch's accounting
+            keep = max(0, min(self.chunk, st.room - st.offered))
+            st.offered += self.chunk
+            toks = [int(t) for t in arr[:keep, r]]
+            take = min(len(toks), st.budget - st.emitted)
+            for j in range(take):
+                if toks[j] in st.stop_tokens:
+                    take = j + 1
+                    break
+            toks = toks[:take]
+            st.emitted += len(toks)
+            if st.emitted >= st.budget:
+                st.done = True
+                st.finish = "length"
+            elif (st.stop_tokens and toks
+                    and toks[-1] in st.stop_tokens):
+                st.done = True
+                st.finish = "stop"
+            fresh[h] = toks
+
+    def _step_chunk_paged(self) -> dict:
+        """One fused chunk over every occupied window group. Phase 1
+        extends every live row's page table ahead of this chunk's writes
+        (appending pages — never copying — and regrouping rows whose table
+        outgrew their window, a pure host-side move); phase 2 runs one
+        gather-windowed program per occupied shape. A live write target is
+        therefore always allocated before dispatch; only the discarded
+        post-finish garbage steps ever land on the scratch page."""
+        fresh: dict = {}
+        for h, st in list(self._slots.items()):
+            if st.done or st.prefilling:
+                continue
+            g, r = self._where[h]
+            rp = self._rowpages[h]
+            p = int(g.pos[r])
+            needed = min(p + self.chunk + 1, rp.cap_tokens)
+            while len(rp.blocks) < paged_kv.pages_for(needed, self.page):
+                rp.blocks.append(self._page_alloc(rp))
+            nb = self._nb_for(len(rp.blocks))
+            if nb > g.nb:
+                self._regroup(h, nb)
+            else:
+                self._sync_table(h)
+        for nb in sorted(self._pgroups):
+            g = self._pgroups[nb]
+            live = [r for r in range(g.cap)
+                    if g.rows[r] is not None
+                    and not self._slots[g.rows[r]].done
+                    and not self._slots[g.rows[r]].prefilling]
+            if not live:
+                continue
+            W = nb * self.page
+            t1 = time.perf_counter()
+            chunk, self._arena, keys, ok = self.eng._decode_loop_paged(
+                self._arena, jnp.asarray(g.tables),
+                jnp.asarray(g.tokens), jnp.asarray(g.pos),
+                jnp.asarray(g.keys), jnp.asarray(g.temps),
+                jnp.asarray(g.topps), self.eng._poison_rows(g.cap),
+                n_steps=self.chunk)
+            arr = np.asarray(chunk)  # [chunk, cap]
+            okh = np.asarray(ok)  # [cap]
+            g.tokens = np.array(chunk[-1])
+            g.keys = np.array(keys)
+            # mirror the in-program per-row pin across chunk boundaries
+            g.pos = np.minimum(g.pos + self.chunk, W - 1).astype(np.int32)
+            chunk_ms = (time.perf_counter() - t1) * 1000.0
+            self.decode_ms += chunk_ms
+            if self.eng._m_chunk is not None:
+                self.eng._m_chunk.observe(chunk_ms)
+            self._account_chunk(g, live, arr, okh, fresh)
         return fresh
 
     def cancel(self, slot: int) -> None:
@@ -1953,11 +2512,23 @@ class BatchSession:
                 leaf.delete()
         pool, row = self._where.pop(slot)
         pool.rows[row] = None
-        pool.pos[row] = pool.ctx - 1
+        if self.paged:
+            # drop the row's holds: private pages published to the radix
+            # tree become evictable cache (their KV survives for future
+            # admits), unpublished ones go straight back to the free list
+            rp = self._rowpages.pop(slot)
+            for p in rp.blocks:
+                self._alloc.unref(p)
+            self._alloc.unreserve(rp.outstanding)
+            pool.pos[row] = pool.nb * self.page - 1
+            pool.tables[row, :] = paged_kv.SCRATCH_PAGE
+        else:
+            pool.pos[row] = pool.ctx - 1
         self._reserved_tokens -= st.reserved
         if self._budget is not None:
             self._budget.release(st.reserved)
-            self._budget.unplace(pool.ctx)
+            if not self.paged:
+                self._budget.unplace(pool.ctx)
 
     def close(self) -> None:
         """Drop every resident slab's (and pending prefill's) device
@@ -1969,8 +2540,9 @@ class BatchSession:
         if self._budget is not None:
             for st in self._slots.values():
                 self._budget.release(st.reserved)
-            for pool, _ in self._where.values():
-                self._budget.unplace(pool.ctx)
+            if not self.paged:
+                for pool, _ in self._where.values():
+                    self._budget.unplace(pool.ctx)
         for pf in self._prefills.values():
             for leaf in jax.tree.leaves(pf.cache):
                 leaf.delete()
@@ -1978,6 +2550,20 @@ class BatchSession:
             for leaf in jax.tree.leaves(pool.cache):
                 leaf.delete()
             pool.cache = None
+        if self.paged:
+            # hand every page back before the arena dies: the allocator may
+            # be the serving accountant's (KVBudget.attach_pages), and its
+            # gauges must not report cached pages of a deleted arena
+            for rp in self._rowpages.values():
+                for p in rp.blocks:
+                    self._alloc.unref(p)
+                self._alloc.unreserve(rp.outstanding)
+            self._radix.evict(self._alloc.num_pages, self._alloc)
+            for leaf in jax.tree.leaves(self._arena):
+                leaf.delete()
+            self._arena = None
+            self._pgroups = {}
+            self._rowpages = {}
         self._pools = {}
         self._slots = {}
         self._where = {}

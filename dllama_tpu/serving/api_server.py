@@ -231,7 +231,8 @@ class Batcher:
 
     def __init__(self, state, window_ms: float = 15.0, max_batch: int = 8,
                  chunk: int = 8, prefill_chunk: int = -1,
-                 kv_buckets: bool = True, kv_bucket_min: int = 0):
+                 kv_buckets: bool = True, kv_bucket_min: int = 0,
+                 kv_pages: int = 0):
         self.state = state
         self.window_s = window_ms / 1000.0
         #: HBM bound: the pool's KV budget is max_batch full-context caches
@@ -253,6 +254,10 @@ class Batcher:
         #: classic uniform [L, max_batch, S, kv, hd] slab
         self.kv_buckets = bool(kv_buckets)
         self.kv_bucket_min = max(0, int(kv_bucket_min))
+        #: --kv-pages: paged KV pool + radix prefix cache (tokens per page;
+        #: 0 = slab modes). Shared prompt prefixes are aliased
+        #: copy-on-write instead of re-prefilled, under the same budget
+        self.kv_pages = max(0, int(kv_pages))
         #: serving-side KV accountant, shared across pool sessions so the
         #: dllama_kv_* gauges stay continuous between traffic bursts
         self.kv_budget = KVBudget(
@@ -280,6 +285,11 @@ class Batcher:
         #: the live slot-pool session (while _serve_continuous runs):
         #: readiness reporting + crash cleanup
         self._active_sess = None
+        #: paged mode keeps ONE session resident across batch windows: the
+        #: arena IS the radix prefix cache, so closing it per window would
+        #: throw away every cached system prompt. Slab modes still open and
+        #: close per window (idle HBM freed); closed on crash cleanup.
+        self._keep_sess = None
 
     # -- introspection (readiness probe) ----------------------------------
     @property
@@ -304,6 +314,25 @@ class Batcher:
         between pool sessions."""
         sess = self._active_sess
         return (len(sess.occupied) if sess is not None else 0, self.max_batch)
+
+    def kv_info(self) -> dict:
+        """KV occupancy for /ready and /stats: token reservations, resident
+        rows per bucket (slab modes) and — in paged mode — page-pool state
+        plus the prefix-cache hit rate. The multi-replica router weighs
+        replicas by exactly this payload."""
+        info = {
+            "kv_tokens_reserved": self.kv_budget.reserved,
+            "kv_tokens_budget": self.kv_budget.total_tokens,
+            "kv_rows": {str(k): v for k, v in sorted(
+                self.kv_budget.rows_by_bucket().items()) if v},
+        }
+        if self.kv_pages > 0:
+            sess = self._active_sess or self._keep_sess
+            pages = (sess.page_stats() if sess is not None
+                     else self.kv_budget.page_stats())
+            info["kv_pages"] = pages
+            info["prefix_hit_rate"] = pages.get("prefix_hit_rate", 0.0)
+        return info
 
     def _serve_solo(self, s) -> None:
         """A batch of ONE delegates to the solo engine path, WITH prefix-
@@ -462,12 +491,17 @@ class Batcher:
         slot_map: dict = {}  # session slot handle -> _Slot
         sess = None
         try:
-            sess = st.engine.batch_session(
-                self.max_batch, chunk=self.chunk,
-                bucket_kv=self.kv_buckets,
-                min_bucket=self.kv_bucket_min or None,
-                prefill_chunk=self.prefill_chunk,
-                kv_budget=self.kv_budget)
+            sess = self._keep_sess
+            if sess is None:
+                sess = st.engine.batch_session(
+                    self.max_batch, chunk=self.chunk,
+                    bucket_kv=self.kv_buckets,
+                    min_bucket=self.kv_bucket_min or None,
+                    prefill_chunk=self.prefill_chunk,
+                    kv_budget=self.kv_budget,
+                    kv_pages=self.kv_pages)
+                if self.kv_pages > 0:
+                    self._keep_sess = sess
             self._active_sess = sess
             while waiting or slot_map:
                 # lifecycle reap, BETWEEN chunks: a cancelled (client gone)
@@ -484,8 +518,11 @@ class Batcher:
                         sess.release(b)
                         del slot_map[b]
                         self._resolve_err(s, err)
+                # paged sessions get the actual tokens so admission counts
+                # the radix prefix match (a warm prompt needs fewer pages)
                 while waiting and sess.can_admit(len(waiting[0].prompt),
-                                                 waiting[0].steps):
+                                                 waiting[0].steps,
+                                                 waiting[0].prompt):
                     s = waiting.pop(0)
                     s.mark_start("continuous")
                     self._m_path.inc(path="continuous")
@@ -557,9 +594,12 @@ class Batcher:
                         break
         except Exception as e:  # noqa: BLE001 — every waiter gets a 500
             self._fail(list(slot_map.values()) + waiting, e)
+            # a session that threw mid-window is suspect: never keep it
+            if sess is not None and sess is self._keep_sess:
+                self._keep_sess = None
         finally:
             self._active_sess = None
-            if sess is not None:
+            if sess is not None and sess is not self._keep_sess:
                 sess.close()
 
     def _scheduler_loop(self) -> None:
@@ -631,6 +671,9 @@ class Batcher:
             if not s.done.is_set():
                 self._resolve_err(s, err)
         sess, self._active_sess = self._active_sess, None
+        if sess is None:
+            sess = self._keep_sess
+        self._keep_sess = None
         if sess is not None:
             try:
                 sess.close()
@@ -715,7 +758,7 @@ class ServerState:
                  session_cache: int = 2, batch_window_ms: float = 0.0,
                  batch_max: int = 8, batch_chunk: int = 8,
                  prefill_chunk: int = -1, kv_buckets: int = 1,
-                 kv_bucket_min: int = 0,
+                 kv_bucket_min: int = 0, kv_pages: int = 0,
                  request_timeout: float = 0.0, queue_depth: int = 64,
                  metrics=None, log_json: bool = False,
                  log_prompts: bool = False, log_stream=None):
@@ -740,6 +783,10 @@ class ServerState:
         ``kv_buckets``/``kv_bucket_min``: length-bucketed KV slot pools
         (--kv-buckets/--kv-bucket-min) — more resident rows at the same
         modeled HBM budget when traffic skews short.
+        ``kv_pages``: tokens per KV page (--kv-pages; 0 = slab modes) —
+        paged KV pool with a copy-on-write radix prefix cache: shared
+        prompt prefixes are aliased instead of re-prefilled, and growing
+        rows append pages instead of migrating slabs.
         ``metrics``: observability.MetricsRegistry to register server-layer
         series on (None = the process-wide default registry, which the
         engine/lifecycle/weights layers already share — one /metrics scrape
@@ -829,7 +876,8 @@ class ServerState:
             Batcher(self, batch_window_ms, max_batch=batch_max,
                     chunk=batch_chunk, prefill_chunk=prefill_chunk,
                     kv_buckets=bool(kv_buckets),
-                    kv_bucket_min=kv_bucket_min)
+                    kv_bucket_min=kv_bucket_min,
+                    kv_pages=kv_pages)
             if batch_window_ms > 0 else None
         )
         # prefix cache: KV state + token history of recent completions, LRU.
@@ -953,6 +1001,9 @@ class ServerState:
         scheduler_alive = (batcher.scheduler_alive
                           if batcher is not None else True)
         ready = not self.gate.draining and scheduler_alive
+        kv = (batcher.kv_info() if batcher is not None
+              else {"kv_tokens_reserved": 0, "kv_tokens_budget": 0,
+                    "kv_rows": {}})
         return ready, {
             "status": "ready" if ready else "not_ready",
             "draining": self.gate.draining,
@@ -965,6 +1016,7 @@ class ServerState:
                             if batcher is not None else 0),
             "slots_occupied": occupied,
             "slots_total": total,
+            **kv,
         }
 
     def finish_request(self, trace: RequestTrace) -> None:
@@ -1616,6 +1668,7 @@ def serve(args) -> None:
         prefill_chunk=getattr(args, "prefill_chunk", -1),
         kv_buckets=getattr(args, "kv_buckets", 1),
         kv_bucket_min=getattr(args, "kv_bucket_min", 0),
+        kv_pages=getattr(args, "kv_pages", 0),
         request_timeout=getattr(args, "request_timeout", 0.0),
         queue_depth=getattr(args, "queue_depth", 64),
         log_json=getattr(args, "log_json", False),

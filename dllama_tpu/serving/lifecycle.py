@@ -21,7 +21,9 @@ client ever observes an unbounded wait:
   daemon.
 * :class:`KVBudget` — the batcher's KV admission accountant: per-bucket
   residency and token-slot reservations against the session's modeled HBM
-  budget, published as gauges.
+  budget, published as gauges. In paged mode it additionally OWNS the page
+  allocator (free list + per-page refcounts, ``attach_pages``) so page-level
+  occupancy is serving-side truth.
 """
 
 from __future__ import annotations
@@ -30,6 +32,7 @@ import threading
 import time
 
 from .. import observability
+from ..runtime import paged_kv
 
 # Module-level metric handles against the shared default registry: created at
 # import so every series is visible on /metrics from the first scrape, not
@@ -58,6 +61,13 @@ _M_KV_ROWS = _REG.gauge(
     "dllama_kv_bucket_rows",
     "Rows resident per KV bucket context length",
     ("bucket",))
+_M_KV_PAGES = _REG.gauge(
+    "dllama_kv_pages",
+    "Paged-KV arena pages by state (free / cached / held / reserved)",
+    ("state",))
+_M_KV_PAGES_TOTAL = _REG.gauge(
+    "dllama_kv_pages_total",
+    "Usable pages in the paged-KV arena (scratch page excluded)")
 
 
 class LifecycleError(RuntimeError):
@@ -268,8 +278,35 @@ class KVBudget:
         self._lock = threading.Lock()
         self._reserved = 0
         self._rows: dict = {}  # bucket ctx -> resident rows
+        self.pages: paged_kv.PageAllocator = None  # paged mode (attach_pages)
         _M_KV_BUDGET.set(self.total_tokens)
         _M_KV_RESERVED.set(0)
+
+    def attach_pages(self, num_pages: int,
+                     page_tokens: int) -> "paged_kv.PageAllocator":
+        """Adopt a paged session's free list + refcounts: the allocator
+        LIVES here so the serving accountant (and its gauges) always see
+        page-level truth, while the runtime session drives it duck-typed.
+        Called by BatchSession at construction in paged mode; a scheduler
+        restart re-attaches a fresh allocator for its fresh arena."""
+        with self._lock:
+            self.pages = paged_kv.PageAllocator(
+                num_pages, page_tokens, on_stats=self._publish_pages)
+            self._publish_pages(self.pages.stats())
+            return self.pages
+
+    @staticmethod
+    def _publish_pages(s: dict) -> None:
+        _M_KV_PAGES_TOTAL.set(s["pages_total"])
+        _M_KV_PAGES.set(s["pages_free"], state="free")
+        _M_KV_PAGES.set(s["pages_cached"], state="cached")
+        _M_KV_PAGES.set(s["pages_held"], state="held")
+        _M_KV_PAGES.set(s["pages_reserved"], state="reserved")
+
+    def page_stats(self) -> dict:
+        """The attached allocator's occupancy snapshot ({} in slab modes)."""
+        with self._lock:
+            return self.pages.stats() if self.pages is not None else {}
 
     @property
     def reserved(self) -> int:

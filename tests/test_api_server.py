@@ -140,6 +140,34 @@ def chat_body(**kw):
     return body
 
 
+def test_paged_kv_server_surfaces_occupancy():
+    """With --kv-pages active, /ready and /stats must carry the page-pool
+    and prefix-cache picture the multi-replica router weighs by."""
+    tok = make_tokenizer()
+    cfg = tiny_cfg(vocab_size=tok.vocab_size, seq_len=512, dim=32, kv_dim=16,
+                   head_size=8, hidden_dim=64)
+    params = llama.random_params(cfg, seed=13)
+    engine = Engine(cfg, params, SamplerConfig(temperature=0.0, seed=1))
+    state = ServerState(engine, tok, cfg, model_name="tiny-test",
+                        template="llama3", batch_window_ms=5.0, kv_pages=16)
+    srv = create_server(state, host="127.0.0.1", port=0)
+    port = srv.server_address[1]
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        status, _ = request(port, "POST", "/v1/chat/completions", chat_body())
+        assert status == 200
+        status, data = request(port, "GET", "/ready")
+        assert status == 200
+        info = json.loads(data)
+        assert "kv_pages" in info and "prefix_hit_rate" in info
+        assert info["kv_tokens_reserved"] == 0  # request finished: released
+        status, data = request(port, "GET", "/stats")
+        assert status == 200
+        assert "kv_pages" in json.loads(data)["load"]
+    finally:
+        srv.shutdown()
+
+
 def test_models_endpoint(server):
     status, data = request(server, "GET", "/v1/models")
     assert status == 200

@@ -178,16 +178,53 @@ def test_bitflip_caught_by_verify_report(tmp_path):
     assert report["failures"][0]["offset"] == e.offset
 
 
-def test_row_band_read_verifies_whole_tensor(tmp_path):
-    """Corruption OUTSIDE the requested band is still caught: shard loading
-    must not skip verification of the bytes it happens not to touch."""
+def test_row_band_read_verifies_only_touched_bands(tmp_path):
+    """Band-granular lazy verify: a row read CRC-checks exactly the row bands
+    it overlaps.  Corruption outside the read stripe does not fail that read,
+    but IS caught the moment the corrupt band is touched — and always by a
+    full ``verify()``."""
     path, _, _ = _write(tmp_path)
     with WeightFileReader(path) as r:
         e = r.entry("layers.0.w1")
-    _flip_byte(path, e.offset + e.nbytes - 1)  # last byte: outside rows 0..8
+        assert r.band_crcs is not None
+        band = r.band_rows
+    assert e.d > band, "fixture tensor must span at least two row bands"
+    _flip_byte(path, e.offset + e.nbytes - 1)  # last byte: in the LAST band
     with WeightFileReader(path) as r:
+        # Rows 0..8 live in band 0 — clean, so the read succeeds.
+        r.read_tensor_rows("layers.0.w1", slice(0, 8))
+        # Touching the corrupt band raises.
         with pytest.raises(ChecksumError):
-            r.read_tensor_rows("layers.0.w1", slice(0, 8))
+            r.read_tensor_rows("layers.0.w1", slice(e.d - 1, e.d))
+    # And an offline verify always catches it, whole-file or sharded onto
+    # the shard that owns the tail rows.
+    with WeightFileReader(path) as r:
+        report = r.verify()
+        assert not report["ok"]
+        assert "layers.0.w1" in [f["name"] for f in report["failures"]]
+    with WeightFileReader(path) as r:
+        report = r.verify(shard=(1, 2))
+        assert not report["ok"]
+        assert any(f["name"] == "layers.0.w1" and "band" in f
+                   for f in report["failures"])
+
+
+def test_sharded_verify_clean_covers_file(tmp_path):
+    """Every shard of a clean file verifies, each checking a nonzero slice
+    of the row-band table — the cooperative-cluster verify contract."""
+    path, _, _ = _write(tmp_path)
+    with WeightFileReader(path) as r:
+        assert r.band_crcs is not None
+        total = 0
+        for i in range(3):
+            report = r.verify(shard=(i, 3))
+            assert report["ok"] and report["row_band"] == r.band_rows
+            assert report["bands_checked"] > 0
+            total += report["bands_checked"]
+        # shards overlap only where a band straddles a stripe edge, so the
+        # union is at least every band once
+        assert total >= sum(
+            (e.d + r.band_rows - 1) // r.band_rows for e in r.entries)
 
 
 def test_lazy_verify_env_opt_out(tmp_path, monkeypatch):

@@ -16,6 +16,7 @@ import io
 import json
 import re
 import threading
+import time
 
 import pytest
 
@@ -587,6 +588,21 @@ def test_spec_path_emits_ttft(engine_bits):
     assert state.batcher._m_path.value(path="spec") == 2
 
 
+def _wait_log_record(buf: io.StringIO, request_id: str, timeout: float = 5.0):
+    """The JSON log line is emitted in the handler's ``finally`` — after the
+    response bytes are flushed — so a fast client can read the buffer before
+    the server thread writes the record. Poll briefly instead of racing."""
+    deadline = time.monotonic() + timeout
+    while True:
+        recs = [json.loads(l) for l in buf.getvalue().splitlines()]
+        hits = [r for r in recs if r["request_id"] == request_id]
+        if hits:
+            return hits[0]
+        if time.monotonic() > deadline:
+            raise AssertionError(f"no log record for {request_id!r}: {recs}")
+        time.sleep(0.01)
+
+
 def test_log_json_privacy_default(engine_bits):
     buf = io.StringIO()
     state = make_state(engine_bits, log_json=True, log_stream=buf)
@@ -596,8 +612,7 @@ def test_log_json_privacy_default(engine_bits):
                                   chat_body(),
                                   headers={"X-Request-Id": "priv-1"})
         assert status == 200
-        recs = [json.loads(l) for l in buf.getvalue().splitlines()]
-        rec = [r for r in recs if r["request_id"] == "priv-1"][0]
+        rec = _wait_log_record(buf, "priv-1")
         assert rec["event"] == "request" and rec["status"] == 200
         assert rec["tokens_in"] > 0 and rec["tokens_out"] > 0
         assert rec["ttft_ms"] >= 0.0
@@ -617,8 +632,7 @@ def test_log_prompts_opts_in_to_text(engine_bits):
                                   chat_body(),
                                   headers={"X-Request-Id": "priv-2"})
         assert status == 200
-        rec = [json.loads(l) for l in buf.getvalue().splitlines()
-               if json.loads(l)["request_id"] == "priv-2"][0]
+        rec = _wait_log_record(buf, "priv-2")
         assert "hello world" in rec["prompt"]
     finally:
         srv.shutdown()

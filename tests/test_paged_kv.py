@@ -1,0 +1,403 @@
+"""True paged KV + copy-on-write radix prefix cache (the PR 6 tentpole).
+
+Three properties under test. (1) Accounting: the page allocator and the
+radix tree survive a randomized op storm with the full invariant oracle
+(``PageAllocator.check``) run after EVERY operation — no leaks, no double
+frees, reservations never exceed free + evictable. (2) Bit-identity: a
+warm admission that aliases cached prompt pages (including the exact
+copy-on-write boundary case, cancelled prefills, and eviction under
+pressure) produces EXACTLY the token stream of a cold solo run — shared
+pages are read-only by construction, so the cache must be invisible.
+(3) Capacity: paged rows reserve ceil(need/page) pages, not a
+power-of-two slab, so at the same modeled HBM budget strictly more short
+rows fit than the uniform pool admits — and growth never copies a slab
+(``migrations == 0``; regrouping is a host-side table move).
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from dllama_tpu import faults
+from dllama_tpu.models import llama
+from dllama_tpu.models.config import ModelConfig
+from dllama_tpu.runtime import paged_kv
+from dllama_tpu.runtime.generate import Engine
+from dllama_tpu.runtime.sampler import SamplerConfig
+
+CFG = ModelConfig(
+    arch="llama", dim=64, hidden_dim=128, n_layers=2, n_heads=4, n_kv_heads=2,
+    vocab_size=96, seq_len=64, head_size=16, kv_dim=32, dtype="float32",
+)
+
+LONG_PROMPT = [(i * 7 + 3) % 96 for i in range(23)]
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+def _solo(params, prompt, steps, sampler=None):
+    eng = Engine(CFG, params, SamplerConfig(temperature=0.0))
+    return [t for t, _ in eng.generate(list(prompt), steps=steps,
+                                       sampler=sampler)]
+
+
+def _drain_interleaved(sess, out):
+    while any(not sess.is_done(b) for b in out):
+        sess.prefill_step()
+        for b, burst in sess.step_chunk().items():
+            if b in out:
+                out[b].extend(burst)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# allocator + radix tree: randomized fuzz against the invariant oracle
+# ---------------------------------------------------------------------------
+
+def test_page_allocator_radix_fuzz():
+    """2000 random admit/release/evict/match ops mirroring the session's
+    pin-then-reserve discipline, with ``check()`` after every one. At the
+    end every page must be back on the free list — the no-leak /
+    no-double-free bar for the whole accounting layer."""
+    rng = random.Random(0)
+    NPAGES, PAGE = 33, 4
+    alloc = paged_kv.PageAllocator(NPAGES, PAGE)
+    radix = paged_kv.RadixPrefixCache(PAGE)
+    rows = {}  # handle -> (pages refcounted by this row, outstanding resv)
+    nexth = 0
+    admits = evictions = 0
+    for _ in range(2000):
+        op = rng.random()
+        if op < 0.45:
+            # admit: small token alphabet so prefixes actually collide
+            tokens = [rng.randrange(4) for _ in range(rng.randrange(1, 20))]
+            path = radix.match(tokens)
+            nfull = min(len(path), (len(tokens) - 1) // PAGE)
+            path = path[:nfull]
+            cap = len(tokens) + rng.randrange(0, 8)
+            priv = max(0, paged_kv.pages_for(cap, PAGE) - len(path))
+            # can_admit's exactness contract: counting would-be-pinned
+            # evictable pages up front must agree with pin-then-check
+            pinned = sum(1 for n in path if alloc.refcount(n.page) == 0)
+            if not alloc.can_reserve(priv + pinned):
+                alloc.check()
+                continue
+            for n in path:
+                alloc.ref(n.page)
+            assert alloc.can_reserve(priv), "pin-then-check disagreed"
+            alloc.reserve(priv)
+            alloc.check()
+            pages, outstanding = [n.page for n in path], priv
+            for _k in range(priv):
+                p = alloc.alloc()
+                if p is None:
+                    assert radix.evict(1, alloc) == 1, \
+                        "reservation promised a page that can't be evicted"
+                    evictions += 1
+                    p = alloc.alloc()
+                assert p is not None and p != paged_kv.SCRATCH_PAGE
+                pages.append(p)
+                outstanding -= 1
+                alloc.check()
+            # publish full prompt blocks (what _finish_pages does at go-live)
+            nins = min(len(pages), (len(tokens) - 1) // PAGE)
+            for p in radix.insert(tokens, pages[:nins]):
+                alloc.hold(p)
+            rows[nexth] = (pages, outstanding)
+            nexth += 1
+            admits += 1
+        elif op < 0.80 and rows:
+            h = rng.choice(sorted(rows))
+            pages, outstanding = rows.pop(h)
+            for p in pages:
+                alloc.unref(p)
+            alloc.unreserve(outstanding)
+        elif op < 0.90:
+            evictions += radix.evict(rng.randrange(1, 4), alloc)
+        else:
+            radix.match([rng.randrange(4) for _ in range(rng.randrange(12))])
+        alloc.check()
+    assert admits > 100 and evictions > 0  # the storm exercised both paths
+    for pages, outstanding in rows.values():
+        for p in pages:
+            alloc.unref(p)
+        alloc.unreserve(outstanding)
+        alloc.check()
+    # with no live rows every cached node is refcount-0 and leaf-reachable
+    n_cached = alloc.evictable_count
+    assert radix.evict(NPAGES, alloc) == n_cached
+    alloc.check()
+    assert len(radix) == 0
+    assert alloc.free_count == NPAGES - 1, "pages leaked"
+    assert alloc.reserved_pages == 0 and alloc.evictable_count == 0
+
+
+def test_allocator_rejects_misuse():
+    alloc = paged_kv.PageAllocator(5, 8)
+    with pytest.raises(ValueError):
+        alloc.ref(paged_kv.SCRATCH_PAGE)
+    p = alloc.alloc(reserved=False)
+    with pytest.raises(ValueError):
+        alloc.drop(p)  # not cached
+    alloc.unref(p)
+    with pytest.raises(ValueError):
+        alloc.unref(p)  # already free
+    with pytest.raises(ValueError):
+        alloc.hold(p)  # free page can't hold valid KV
+    alloc.check()
+
+
+# ---------------------------------------------------------------------------
+# bit-identity: warm prefix decode == cold prefill decode
+# ---------------------------------------------------------------------------
+
+def test_warm_prefix_decode_bit_identical():
+    """Cold admit publishes the prompt's full pages; a warm re-admit
+    aliases them, prefills only the uncached tail, and must replay the
+    exact solo stream — with zero slab-migration copies."""
+    params = llama.random_params(CFG, seed=1, dtype=np.float32)
+    scfg = SamplerConfig(temperature=0.9, topp=0.95, seed=7)
+    want = _solo(params, LONG_PROMPT, 12, scfg)
+
+    eng = Engine(CFG, params, SamplerConfig(temperature=0.0))
+    sess = eng.batch_session(max_batch=3, chunk=4, prefill_chunk=5,
+                             kv_pages=8)
+    assert sess.paged and sess.page == 8
+    h1 = sess.admit_begin(LONG_PROMPT, steps=12, sampler=scfg)
+    got = _drain_interleaved(sess, {h1: []})[h1]
+    assert got == want
+    assert sess.prefix_misses == 1 and sess.prefix_hits == 0
+    sess.release(h1)
+    sess._alloc.check()
+
+    h2 = sess.admit_begin(LONG_PROMPT, steps=12, sampler=scfg)
+    got = _drain_interleaved(sess, {h2: []})[h2]
+    assert got == want, "warm (aliased-page) stream diverged from cold"
+    assert sess.prefix_hits == 1
+    # 23-token prompt at page=8: blocks 0,1 (16 tokens) come from cache
+    assert sess.prefix_tokens_matched == 16
+    assert sess.migrations == 0  # paged growth appends, never copies
+    assert sess.prefix_hit_rate == 0.5
+    sess.release(h2)
+    sess._alloc.check()
+    sess.close()
+
+
+def test_warm_admit_with_resident_row_bit_identical():
+    """The serving scenario: a resident row keeps decoding while a warm
+    admission aliases cached pages and prefills only its tail. Both
+    streams must equal solo bit for bit — aliased pages are never written
+    by the newcomer, and the newcomer never attends scratch."""
+    params = llama.random_params(CFG, seed=2, dtype=np.float32)
+    s_res = SamplerConfig(temperature=1.1, topp=0.9, seed=5)
+    s_new = SamplerConfig(temperature=0.8, topp=0.95, seed=23)
+    want_res = _solo(params, [5, 9, 3], 20, s_res)
+    want_new = _solo(params, LONG_PROMPT, 10, s_new)
+
+    eng = Engine(CFG, params, SamplerConfig(temperature=0.0))
+    sess = eng.batch_session(max_batch=3, chunk=4, prefill_chunk=5,
+                             kv_pages=8)
+    warm = sess.admit_begin(LONG_PROMPT, steps=2)  # seed the radix cache
+    _drain_interleaved(sess, {warm: []})
+    sess.release(warm)
+
+    got = {}
+    res = sess.admit([5, 9, 3], steps=20, sampler=s_res)
+    got[res] = []
+    for b, burst in sess.step_chunk().items():
+        got[b].extend(burst)
+    new = sess.admit_begin(LONG_PROMPT, steps=10, sampler=s_new)
+    got[new] = []
+    assert sess.prefix_hits >= 1
+    _drain_interleaved(sess, got)
+    sess.close()
+    assert got[res] == want_res
+    assert got[new] == want_new
+
+
+def test_cow_boundary_block_bit_identical():
+    """plen landing EXACTLY on a page boundary with the whole prompt
+    cached: the final block is copy-on-write duplicated (decode writes
+    position plen-1 into it) and the row goes live with no prefill at
+    all. The stream must still equal solo."""
+    params = llama.random_params(CFG, seed=3, dtype=np.float32)
+    prefix = [(i * 5 + 11) % 96 for i in range(16)]  # exactly 2 pages
+    longer = prefix + [(i * 3 + 2) % 96 for i in range(8)]
+    s_a = SamplerConfig(temperature=0.0, seed=1)
+    s_b = SamplerConfig(temperature=0.9, topp=0.9, seed=13)
+    want = _solo(params, prefix, 10, s_b)
+
+    eng = Engine(CFG, params, SamplerConfig(temperature=0.0))
+    sess = eng.batch_session(max_batch=2, chunk=4, prefill_chunk=6,
+                             kv_pages=8)
+    h1 = sess.admit_begin(longer, steps=6, sampler=s_a)  # publishes blocks 0,1
+    _drain_interleaved(sess, {h1: []})
+    sess.release(h1)
+
+    h2 = sess.admit_begin(prefix, steps=10, sampler=s_b)
+    assert h2 not in sess.pending_prefills, "fully-cached admit must go live"
+    assert sess.cow_copies == 1
+    got = _drain_interleaved(sess, {h2: []})[h2]
+    assert got == want, "COW-boundary stream diverged from cold solo"
+    sess.release(h2)
+    sess._alloc.check()
+    sess.close()
+
+
+def test_cancel_mid_prefill_returns_pages():
+    """Cancelling a paged admission mid-prefill must hand back every page
+    and the whole reservation; nothing half-prefilled is published, and a
+    successor reusing the pool still matches solo."""
+    params = llama.random_params(CFG, seed=4, dtype=np.float32)
+    eng = Engine(CFG, params, SamplerConfig(temperature=0.0))
+    sess = eng.batch_session(max_batch=1, chunk=4, prefill_chunk=4,
+                             kv_pages=8)
+    h = sess.admit_begin(LONG_PROMPT, steps=40)
+    sess.prefill_step()  # consume one piece, then abandon
+    assert not sess.can_admit(3, 4)
+    sess.cancel(h)
+    sess.release(h)
+    assert sess.reserved_tokens == 0
+    assert sess._alloc.reserved_pages == 0
+    sess._alloc.check()
+    assert len(sess._radix) == 0, "cancelled prefill must not publish"
+    scfg = SamplerConfig(temperature=0.8, seed=11)
+    h2 = sess.admit([7], steps=10, sampler=scfg)
+    out = _drain_interleaved(sess, {h2: []})[h2]
+    sess.close()
+    assert out == _solo(params, [7], 10, scfg)
+
+
+def test_eviction_under_pressure_keeps_identity():
+    """A pool too small to keep the cache AND a new full-length row must
+    LRU-evict cached pages to honor the reservation — and a later
+    re-admit of the evicted prompt (cache cold again) still replays the
+    solo stream."""
+    params = llama.random_params(CFG, seed=5, dtype=np.float32)
+    scfg = SamplerConfig(temperature=0.9, topp=0.95, seed=3)
+    want = _solo(params, LONG_PROMPT, 8, scfg)
+    other = [(i * 11 + 2) % 96 for i in range(23)]
+    want_other = _solo(params, other, 30, scfg)
+
+    eng = Engine(CFG, params, SamplerConfig(temperature=0.0))
+    # budget 64 tokens -> 8 usable pages of 8: one long row needs them all
+    sess = eng.batch_session(max_batch=1, chunk=4, prefill_chunk=8,
+                             kv_pages=8)
+    h1 = sess.admit_begin(LONG_PROMPT, steps=8, sampler=scfg)
+    got = _drain_interleaved(sess, {h1: []})[h1]
+    assert got == want
+    sess.release(h1)
+    assert sess._alloc.evictable_count > 0  # prompt pages now cached
+
+    h2 = sess.admit_begin(other, steps=30, sampler=scfg)
+    got = _drain_interleaved(sess, {h2: []})[h2]
+    assert got == want_other
+    assert sess.prefix_evictions > 0, "pressure must evict cached pages"
+    sess.release(h2)
+    sess._alloc.check()
+
+    h3 = sess.admit_begin(LONG_PROMPT, steps=8, sampler=scfg)
+    got = _drain_interleaved(sess, {h3: []})[h3]
+    assert got == want, "post-eviction re-admit diverged"
+    sess.close()
+
+
+# ---------------------------------------------------------------------------
+# capacity + introspection
+# ---------------------------------------------------------------------------
+
+def test_paged_pool_admits_at_least_bucketed_rows():
+    """The acceptance bar: at the same modeled budget, paged admission
+    (ceil(need/page) pages per row) packs at least as many short rows as
+    the bucketed pool and strictly more than the uniform slab."""
+    params = llama.random_params(CFG, seed=0, dtype=np.float32)
+    eng = Engine(CFG, params, SamplerConfig(temperature=0.0))
+
+    def admit_until_full(sess):
+        n = 0
+        while sess.can_admit(3, 4, [5, 9, 3]):
+            sess.admit([5, 9, 3], steps=4)
+            n += 1
+        return n
+
+    uni = eng.batch_session(max_batch=2, chunk=4)
+    bkt = eng.batch_session(max_batch=2, chunk=4, bucket_kv=True,
+                            min_bucket=8)
+    pgd = eng.batch_session(max_batch=2, chunk=4, kv_pages=8)
+    n_uni, n_bkt, n_pgd = (admit_until_full(s) for s in (uni, bkt, pgd))
+    assert uni.budget_tokens == pgd.budget_tokens
+    assert n_pgd >= n_bkt > n_uni
+    assert pgd.migrations == 0
+    stats = pgd.page_stats()
+    assert stats["pages_free"] + stats["pages_held"] == stats["pages_total"]
+    for s in (uni, bkt, pgd):
+        s.close()
+
+
+def test_page_stats_and_hit_rate_surface():
+    params = llama.random_params(CFG, seed=6, dtype=np.float32)
+    eng = Engine(CFG, params, SamplerConfig(temperature=0.0))
+    sess = eng.batch_session(max_batch=2, chunk=4, prefill_chunk=8,
+                             kv_pages=8)
+    h = sess.admit_begin(LONG_PROMPT, steps=4)
+    _drain_interleaved(sess, {h: []})
+    sess.release(h)
+    s = sess.page_stats()
+    assert s["page_tokens"] == 8
+    assert s["radix_nodes"] == 2  # two full prompt blocks published
+    assert s["pages_cached"] == 2 and s["pages_held"] == 0
+    assert s["prefix_misses"] == 1 and s["cow_copies"] == 0
+    assert sess.prefix_hit_rate == 0.0
+    sess.close()
+
+
+# ---------------------------------------------------------------------------
+# fault seams
+# ---------------------------------------------------------------------------
+
+def test_prefix_match_fault_leaves_pool_clean():
+    """A fault at the prefix_match site (fires before any reservation or
+    pin) must reject the admission and leak nothing."""
+    params = llama.random_params(CFG, seed=7, dtype=np.float32)
+    eng = Engine(CFG, params, SamplerConfig(temperature=0.0))
+    sess = eng.batch_session(max_batch=1, chunk=4, prefill_chunk=8,
+                             kv_pages=8)
+    faults.install("prefix_match:raise:times=1")
+    with pytest.raises(faults.FaultInjected):
+        sess.admit_begin(LONG_PROMPT, steps=4)
+    faults.clear()
+    assert sess.reserved_tokens == 0
+    assert sess._alloc.reserved_pages == 0
+    sess._alloc.check()
+    scfg = SamplerConfig(temperature=0.0, seed=1)
+    h = sess.admit_begin(LONG_PROMPT, steps=4, sampler=scfg)
+    out = _drain_interleaved(sess, {h: []})[h]
+    sess.close()
+    assert out == _solo(params, LONG_PROMPT, 4, scfg)
+
+
+def test_page_alloc_fault_is_resumable():
+    """A fault at the page_alloc site fires before any state mutation, so
+    the failed step can simply be retried and the stream still matches
+    solo — the chaos contract of every other seam."""
+    params = llama.random_params(CFG, seed=8, dtype=np.float32)
+    scfg = SamplerConfig(temperature=0.0, seed=2)
+    want = _solo(params, LONG_PROMPT, 6, scfg)
+    eng = Engine(CFG, params, SamplerConfig(temperature=0.0))
+    sess = eng.batch_session(max_batch=1, chunk=4, prefill_chunk=8,
+                             kv_pages=8)
+    h = sess.admit_begin(LONG_PROMPT, steps=6, sampler=scfg)
+    faults.install("page_alloc:raise:times=1")
+    with pytest.raises(faults.FaultInjected):
+        _drain_interleaved(sess, {h: []})
+    faults.clear()
+    sess._alloc.check()
+    out = _drain_interleaved(sess, {h: []})[h]
+    sess.close()
+    assert out == want
