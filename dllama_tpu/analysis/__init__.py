@@ -1,0 +1,24 @@
+"""dllama-check: dependency-free static analysis + runtime sanitizer.
+
+Static half (``python -m dllama_tpu.analysis``): AST passes proving lock
+discipline (LOCK-*), JAX trace-safety (TRACE-*), fault-site coverage
+(FAULT-*) and exception hygiene (EXC-*) over the whole package — zero
+unsuppressed findings is a CI gate. Runtime half (:mod:`.sanitize`): the
+``guarded_by`` annotation convention plus a ``DLLAMA_SANITIZE=1`` lock
+witness that catches order inversions and unguarded writes live.
+
+This ``__init__`` stays import-light: the serving/runtime modules import
+``analysis.sanitize`` on their hot import path, so the AST machinery loads
+only when the analyzer actually runs.
+"""
+
+from __future__ import annotations
+
+__all__ = ["run", "analyze_source", "Finding", "Report"]
+
+
+def __getattr__(name):
+    if name in __all__:
+        from . import core
+        return getattr(core, name)
+    raise AttributeError(name)

@@ -1,0 +1,46 @@
+"""CLI: ``python -m dllama_tpu.analysis [--json] [--root DIR]``.
+
+Exit 0 when the tree has zero unsuppressed findings, 1 otherwise — the
+``dllama-check`` CI job is exactly this command.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from . import core
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m dllama_tpu.analysis",
+        description="dllama-check: lock discipline, JAX trace-safety, "
+                    "fault-site coverage and exception hygiene.")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the full machine-readable report")
+    ap.add_argument("--root", default=None,
+                    help="repo root (default: the tree this package "
+                         "was imported from)")
+    ap.add_argument("--print-fault-sites", action="store_true",
+                    help="print the canonical README site block generated "
+                         "from faults.SITES, then exit")
+    args = ap.parse_args(argv)
+
+    if args.print_fault_sites:
+        from . import coverage
+        root = core.find_root(args.root)
+        sites, _, _, _ = coverage._faults_registry(root)
+        print(coverage.render_site_block(sites))
+        return 0
+
+    report = core.run(args.root)
+    if args.json:
+        print(report.to_json())
+    else:
+        print(report.render())
+    return 0 if report.ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,232 @@
+"""dllama-check core: findings, suppressions, file discovery, the runner.
+
+Dependency-free by construction (``ast`` + stdlib only): the analyzer must be
+runnable in the leanest CI job *before* jax is even importable, and must never
+constrain what the runtime may import.
+
+Suppression syntax (audited, reason mandatory)::
+
+    self._hot = x  # dllama: allow[LOCK-001] reason=publish-only; readers tolerate tears
+
+A suppression comment applies to findings on its own line or the line
+directly below (comment-above style). A suppression with no ``reason=`` text
+is itself a finding (SUP-001) — the gate counts unsuppressed findings only,
+so every exception to a rule stays visible in the JSON report.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import os
+import re
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*dllama:\s*allow\[([A-Z]+-\d+)\]\s*(?:reason=(.*))?$")
+
+
+@dataclasses.dataclass
+class Finding:
+    """One rule violation at a source location."""
+    rule: str
+    path: str          # repo-relative, '/'-separated
+    line: int
+    message: str
+    suppressed: bool = False
+    reason: str = ""   # the allow-comment's reason when suppressed
+
+    @property
+    def id(self) -> str:
+        """Stable finding id used in commit messages / reports."""
+        return f"{self.rule}:{self.path}:{self.line}"
+
+    def to_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        d["id"] = self.id
+        return d
+
+    def render(self) -> str:
+        tag = f" [suppressed: {self.reason}]" if self.suppressed else ""
+        return f"{self.path}:{self.line}: {self.rule}: {self.message}{tag}"
+
+
+@dataclasses.dataclass
+class Suppression:
+    rule: str
+    line: int
+    reason: str
+
+
+class SourceFile:
+    """A parsed source file plus its suppression comments."""
+
+    def __init__(self, path: str, rel: str, text: str):
+        self.path = path
+        self.rel = rel.replace(os.sep, "/")
+        self.text = text
+        self.lines = text.splitlines()
+        self.tree = ast.parse(text, filename=rel)
+        self.suppressions: list = []
+        self.bad_suppressions: list = []  # Finding (SUP-001)
+        for i, line in enumerate(self.lines, start=1):
+            m = _SUPPRESS_RE.search(line)
+            if not m:
+                continue
+            rule, reason = m.group(1), (m.group(2) or "").strip()
+            if not reason:
+                self.bad_suppressions.append(Finding(
+                    "SUP-001", self.rel, i,
+                    f"allow[{rule}] without a reason= — suppressions must "
+                    f"say why"))
+                continue
+            self.suppressions.append(Suppression(rule, i, reason))
+
+    def suppression_for(self, rule: str, line: int):
+        """A suppression on the finding's line, or the line above it."""
+        for s in self.suppressions:
+            if s.rule == rule and s.line in (line, line - 1):
+                return s
+        return None
+
+
+@dataclasses.dataclass
+class Report:
+    findings: list
+    files_scanned: int
+
+    @property
+    def unsuppressed(self) -> list:
+        return [f for f in self.findings if not f.suppressed]
+
+    @property
+    def suppressed(self) -> list:
+        return [f for f in self.findings if f.suppressed]
+
+    @property
+    def ok(self) -> bool:
+        return not self.unsuppressed
+
+    def to_json(self) -> str:
+        counts: dict = {}
+        for f in self.findings:
+            counts[f.rule] = counts.get(f.rule, 0) + 1
+        return json.dumps({
+            "ok": self.ok,
+            "files_scanned": self.files_scanned,
+            "unsuppressed": [f.to_dict() for f in self.unsuppressed],
+            "suppressed": [f.to_dict() for f in self.suppressed],
+            "counts_by_rule": counts,
+        }, indent=2, sort_keys=True)
+
+    def render(self) -> str:
+        out = []
+        for f in sorted(self.unsuppressed,
+                        key=lambda f: (f.path, f.line, f.rule)):
+            out.append(f.render())
+        n_sup = len(self.suppressed)
+        out.append(f"dllama-check: {len(self.unsuppressed)} finding(s), "
+                   f"{n_sup} suppressed, {self.files_scanned} file(s)")
+        return "\n".join(out)
+
+
+def _apply_suppressions(findings: list, src: "SourceFile") -> list:
+    for f in findings:
+        s = src.suppression_for(f.rule, f.line)
+        if s is not None:
+            f.suppressed = True
+            f.reason = s.reason
+    return findings
+
+
+def load_source(path: str, root: str) -> SourceFile:
+    rel = os.path.relpath(path, root)
+    with open(path, "r", encoding="utf-8") as fh:
+        return SourceFile(path, rel, fh.read())
+
+
+def discover(root: str) -> list:
+    """Every .py under <root>/dllama_tpu, sorted for deterministic reports."""
+    out = []
+    pkg = os.path.join(root, "dllama_tpu")
+    for dirpath, dirnames, filenames in os.walk(pkg):
+        dirnames[:] = sorted(d for d in dirnames if d != "__pycache__")
+        for fn in sorted(filenames):
+            if fn.endswith(".py"):
+                out.append(os.path.join(dirpath, fn))
+    return out
+
+
+def find_root(start: str | None = None) -> str:
+    """The repo root: the directory holding the dllama_tpu package."""
+    here = os.path.abspath(start or os.path.dirname(
+        os.path.dirname(os.path.dirname(__file__))))
+    if os.path.isdir(os.path.join(here, "dllama_tpu")):
+        return here
+    raise SystemExit(f"dllama-check: no dllama_tpu package under {here}")
+
+
+def run(root: str | None = None) -> Report:
+    """Run every pass over the tree rooted at ``root`` (default: the repo
+    this package was imported from)."""
+    from . import coverage, hygiene, locks, tracesafety
+    root = find_root(root) if root is None else os.path.abspath(root)
+    sources = []
+    findings: list = []
+    for path in discover(root):
+        try:
+            src = load_source(path, root)
+        except SyntaxError as e:
+            findings.append(Finding(
+                "AST-001", os.path.relpath(path, root).replace(os.sep, "/"),
+                e.lineno or 1, f"unparseable: {e.msg}"))
+            continue
+        sources.append(src)
+        findings.extend(src.bad_suppressions)
+
+    per_file_passes = (locks.check_guarded_writes, locks.check_guarded_globals,
+                       tracesafety.check_trace_safety,
+                       hygiene.check_exceptions)
+    for src in sources:
+        for p in per_file_passes:
+            findings.extend(_apply_suppressions(list(p(src)), src))
+
+    # cross-file passes: suppressions still resolve against the file each
+    # finding is anchored to
+    by_rel = {s.rel: s for s in sources}
+    for p in (locks.check_lock_order, locks.check_external_writes):
+        for f in p(sources):
+            src = by_rel.get(f.path)
+            if src is not None:
+                _apply_suppressions([f], src)
+            findings.append(f)
+    for f in coverage.check_fault_coverage(root, sources):
+        src = by_rel.get(f.path)
+        if src is not None:
+            _apply_suppressions([f], src)
+        findings.append(f)
+    return Report(findings=findings, files_scanned=len(sources))
+
+
+def analyze_source(text: str, filename: str = "snippet.py",
+                   passes: tuple = ()) -> list:
+    """Run per-file passes over a source string — the fixture-test entry.
+    ``passes`` defaults to all per-file passes plus the cross-file lock
+    passes applied to this single file."""
+    from . import hygiene, locks, tracesafety
+    src = SourceFile(filename, filename, text)
+    findings: list = list(src.bad_suppressions)
+    chosen = passes or (locks.check_guarded_writes,
+                        locks.check_guarded_globals,
+                        tracesafety.check_trace_safety,
+                        hygiene.check_exceptions)
+    for p in chosen:
+        findings.extend(_apply_suppressions(list(p(src)), src))
+    if not passes:
+        for f in locks.check_lock_order([src]):
+            _apply_suppressions([f], src)
+            findings.append(f)
+        for f in locks.check_external_writes([src]):
+            _apply_suppressions([f], src)
+            findings.append(f)
+    return findings

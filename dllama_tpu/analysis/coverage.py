@@ -1,0 +1,173 @@
+"""Fault-site coverage cross-checks — the PR-6 README drift, made impossible.
+
+The single source of truth is ``dllama_tpu/faults.py``: its ``SITES`` tuple
+(registration order) and ``SITE_METRICS`` map (site -> the metric family that
+proves the site's failure is *visible*). Everything else is derived:
+
+FAULT-001  a ``faults.fire("<site>")`` call names a site missing from
+           ``SITES`` (it would silently never fire — ``fire()`` does not
+           validate), or a registered site is never fired anywhere in the
+           package (dead registration).
+FAULT-002  the README's ``# sites:`` block is not byte-identical to the
+           block generated from ``SITES`` (``python -m dllama_tpu.analysis
+           --print-fault-sites`` emits the canonical block to paste).
+FAULT-003  a site has no ``SITE_METRICS`` entry, or its metric name is not
+           registered anywhere in the package — a fault you cannot see on
+           /metrics is a fault the obs drill cannot prove.
+FAULT-004  a site is not exercised by any test under tests/ (the string
+           never appears in a test file).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+
+from .core import Finding, SourceFile
+
+_WIDTH = 66
+
+
+def render_site_block(sites) -> str:
+    """The canonical README site list, generated from ``SITES``."""
+    lines: list = []
+    cur = "# sites: "
+    for i, s in enumerate(sites):
+        piece = s if i == 0 else f" | {s}"
+        if len(cur) + len(piece) > _WIDTH and cur.strip() != "# sites:":
+            lines.append(cur)
+            cur = "#        " + f"| {s}"
+        else:
+            cur += piece
+    lines.append(cur)
+    return "\n".join(lines)
+
+
+def _faults_registry(root: str):
+    """(sites, site_metrics, sites_line, metrics_line) parsed from the AST
+    of dllama_tpu/faults.py — no import, so the analyzer never executes the
+    code it checks."""
+    path = os.path.join(root, "dllama_tpu", "faults.py")
+    with open(path, "r", encoding="utf-8") as fh:
+        tree = ast.parse(fh.read(), filename=path)
+    sites: tuple = ()
+    metrics: dict = {}
+    sites_line = metrics_line = 1
+    for node in tree.body:
+        if not isinstance(node, ast.Assign):
+            continue
+        for t in node.targets:
+            if not isinstance(t, ast.Name):
+                continue
+            if t.id == "SITES" and isinstance(node.value, (ast.Tuple,
+                                                           ast.List)):
+                sites = tuple(e.value for e in node.value.elts
+                              if isinstance(e, ast.Constant))
+                sites_line = node.lineno
+            elif t.id == "SITE_METRICS" and isinstance(node.value, ast.Dict):
+                metrics_line = node.lineno
+                for k, v in zip(node.value.keys, node.value.values):
+                    if (isinstance(k, ast.Constant)
+                            and isinstance(v, ast.Constant)):
+                        metrics[k.value] = v.value
+    return sites, metrics, sites_line, metrics_line
+
+
+def _fired_sites(sources):
+    """{site: [(rel, line)]} for every faults.fire("<lit>") call."""
+    out: dict = {}
+    for src in sources:
+        if src.rel.endswith("analysis/coverage.py"):
+            continue
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call) or not node.args:
+                continue
+            fnname = (node.func.attr if isinstance(node.func, ast.Attribute)
+                      else node.func.id if isinstance(node.func, ast.Name)
+                      else "")
+            if fnname != "fire":
+                continue
+            a = node.args[0]
+            if isinstance(a, ast.Constant) and isinstance(a.value, str):
+                out.setdefault(a.value, []).append((src.rel, node.lineno))
+    return out
+
+
+def check_fault_coverage(root: str, sources):
+    findings: list = []
+    rel_faults = "dllama_tpu/faults.py"
+    try:
+        sites, site_metrics, sites_line, metrics_line = _faults_registry(root)
+    except OSError:
+        return [Finding("FAULT-001", rel_faults, 1,
+                        "dllama_tpu/faults.py unreadable")]
+    fired = _fired_sites(sources)
+
+    # FAULT-001 — both directions
+    for site, locs in sorted(fired.items()):
+        if site not in sites:
+            rel, line = locs[0]
+            findings.append(Finding(
+                "FAULT-001", rel, line,
+                f"faults.fire({site!r}) names an unregistered site — it "
+                f"will silently never fire (SITES: {', '.join(sites)})"))
+    for site in sites:
+        if site not in fired:
+            findings.append(Finding(
+                "FAULT-001", rel_faults, sites_line,
+                f"site {site!r} is registered but never fired anywhere in "
+                f"dllama_tpu/ — dead registration"))
+
+    # FAULT-002 — README block must be exactly the generated one
+    readme = os.path.join(root, "README.md")
+    block = render_site_block(sites)
+    try:
+        with open(readme, "r", encoding="utf-8") as fh:
+            readme_text = fh.read()
+    except OSError:
+        readme_text = ""
+    if block not in readme_text:
+        findings.append(Finding(
+            "FAULT-002", rel_faults, sites_line,
+            "README.md fault-site list is stale: it must contain the block "
+            "generated from faults.SITES — run `python -m "
+            "dllama_tpu.analysis --print-fault-sites` and paste it"))
+
+    # FAULT-003 — metric seam per site
+    pkg_text = "\n".join(s.text for s in sources
+                         if s.rel != rel_faults)
+    for site in sites:
+        metric = site_metrics.get(site)
+        if not metric:
+            findings.append(Finding(
+                "FAULT-003", rel_faults, metrics_line,
+                f"site {site!r} has no SITE_METRICS entry — every fault "
+                f"site needs a metric seam proving its failure is visible"))
+        elif f'"{metric}"' not in pkg_text:
+            findings.append(Finding(
+                "FAULT-003", rel_faults, metrics_line,
+                f"SITE_METRICS[{site!r}] = {metric!r} is not registered "
+                f"anywhere in dllama_tpu/"))
+    for site in site_metrics:
+        if site not in sites:
+            findings.append(Finding(
+                "FAULT-003", rel_faults, metrics_line,
+                f"SITE_METRICS names unknown site {site!r}"))
+
+    # FAULT-004 — every site exercised by at least one test
+    tests_dir = os.path.join(root, "tests")
+    test_text = []
+    if os.path.isdir(tests_dir):
+        for fn in sorted(os.listdir(tests_dir)):
+            if fn.endswith(".py"):
+                with open(os.path.join(tests_dir, fn), "r",
+                          encoding="utf-8") as fh:
+                    test_text.append(fh.read())
+    test_blob = "\n".join(test_text)
+    for site in sites:
+        if not re.search(rf"\b{re.escape(site)}\b", test_blob):
+            findings.append(Finding(
+                "FAULT-004", rel_faults, sites_line,
+                f"site {site!r} is not exercised by any test under tests/"))
+    return findings

@@ -1,0 +1,46 @@
+"""Exception-hygiene pass.
+
+EXC-001  bare ``except:`` — catches SystemExit/KeyboardInterrupt and hides
+         the injected-fault paths the chaos suite depends on; name the
+         exception (``except Exception:`` at minimum).
+EXC-002  silently swallowed exception: a handler whose entire body is
+         ``pass``/``continue`` with no comment anywhere on the handler —
+         deliberate swallows are fine, but they must say why (a comment on
+         the ``except`` or body line satisfies the rule).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import Finding, SourceFile
+
+
+def _has_comment(src: SourceFile, start: int, end: int) -> bool:
+    for ln in range(start, min(end, len(src.lines)) + 1):
+        if "#" in src.lines[ln - 1]:
+            return True
+    return False
+
+
+def check_exceptions(src: SourceFile):
+    findings: list = []
+    for node in ast.walk(src.tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        if node.type is None:
+            findings.append(Finding(
+                "EXC-001", src.rel, node.lineno,
+                "bare `except:` — catches SystemExit/KeyboardInterrupt; "
+                "name the exception"))
+            continue
+        body_is_swallow = all(
+            isinstance(s, (ast.Pass, ast.Continue)) for s in node.body)
+        if body_is_swallow:
+            end = max(getattr(s, "lineno", node.lineno) for s in node.body)
+            if not _has_comment(src, node.lineno, end):
+                findings.append(Finding(
+                    "EXC-002", src.rel, node.lineno,
+                    "exception swallowed with no explanation — add a "
+                    "comment saying why ignoring is safe"))
+    return findings

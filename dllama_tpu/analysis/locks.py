@@ -1,0 +1,490 @@
+"""Lock-discipline passes.
+
+LOCK-001  write to a ``guarded_by``-annotated field outside a lexical
+          ``with self.<lock>`` block (``__init__`` exempt — construction is
+          single-threaded by definition).
+LOCK-002  lock-order inversion: the union of every method's lexical
+          acquisition nesting forms a directed graph; any cycle means two
+          code paths can acquire the same pair of locks in opposite orders.
+LOCK-003  direct write to a field of an externally-serialized class
+          (``guarded_by(None, ...)``) through a non-``self`` receiver —
+          such classes (PageAllocator, RadixPrefixCache) own no lock, so
+          every mutation must go through their methods under the owner's
+          lock, never by reaching into their attributes.
+LOCK-004  write to a ``guard_globals``-declared module global outside a
+          ``with <module_lock>`` block.
+
+Lexical scope is the deliberate boundary: a helper that writes a guarded
+field while *its caller* holds the lock must either take the lock itself
+(both Lock->RLock or restructure) or carry an explicit allow-comment. That
+is a feature — "the lock is held somewhere up-stack" is exactly the
+convention that rots.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import Finding, SourceFile
+
+#: method names that mutate their receiver in place — a call
+#: ``self.<field>.append(x)`` counts as a write to <field>
+MUTATORS = frozenset({
+    "append", "extend", "insert", "remove", "pop", "popleft", "popitem",
+    "clear", "add", "discard", "update", "setdefault", "appendleft",
+    "sort", "reverse",
+})
+
+
+# ---------------------------------------------------------------------------
+# annotation harvesting
+# ---------------------------------------------------------------------------
+
+def _const_str_or_none(node):
+    if isinstance(node, ast.Constant) and (
+            node.value is None or isinstance(node.value, str)):
+        return True, node.value
+    return False, None
+
+
+def _decorator_guards(cls: ast.ClassDef):
+    """{field: lock_or_None} from @guarded_by(...) decorators on ``cls``."""
+    guards: dict = {}
+    for dec in cls.decorator_list:
+        if not isinstance(dec, ast.Call):
+            continue
+        name = dec.func.attr if isinstance(dec.func, ast.Attribute) else (
+            dec.func.id if isinstance(dec.func, ast.Name) else None)
+        if name != "guarded_by" or not dec.args:
+            continue
+        ok, lock = _const_str_or_none(dec.args[0])
+        if not ok:
+            continue
+        for a in dec.args[1:]:
+            ok, field = _const_str_or_none(a)
+            if ok and field is not None:
+                guards[field] = lock
+    return guards
+
+
+def harvest_classes(src: SourceFile) -> dict:
+    """{class_name: {field: lock}} with same-module base-class inheritance."""
+    classes: dict = {}
+    bases: dict = {}
+    for node in ast.walk(src.tree):
+        if isinstance(node, ast.ClassDef):
+            classes[node.name] = _decorator_guards(node)
+            bases[node.name] = [b.id for b in node.bases
+                                if isinstance(b, ast.Name)]
+    # propagate base guards down (derived declarations win)
+    for _ in range(len(classes)):
+        changed = False
+        for name, blist in bases.items():
+            for b in blist:
+                if b in classes:
+                    merged = dict(classes[b])
+                    merged.update(classes[name])
+                    if merged != classes[name]:
+                        classes[name] = merged
+                        changed = True
+        if not changed:
+            break
+    return classes
+
+
+def harvest_global_guards(src: SourceFile) -> dict:
+    """{global_name: lock_name} from module-level guard_globals(...) calls."""
+    out: dict = {}
+    for node in src.tree.body:
+        if not (isinstance(node, ast.Expr) and isinstance(node.value, ast.Call)):
+            continue
+        call = node.value
+        name = call.func.attr if isinstance(call.func, ast.Attribute) else (
+            call.func.id if isinstance(call.func, ast.Name) else None)
+        if name != "guard_globals" or len(call.args) < 2:
+            continue
+        ok, lock = _const_str_or_none(call.args[0])
+        if not ok or lock is None:
+            continue
+        for a in call.args[1:]:
+            ok, g = _const_str_or_none(a)
+            if ok and g is not None:
+                out[g] = lock
+    return out
+
+
+# ---------------------------------------------------------------------------
+# write extraction
+# ---------------------------------------------------------------------------
+
+def _self_field(node):
+    """'f' when ``node`` is ``self.f`` (possibly under subscripts)."""
+    while isinstance(node, ast.Subscript):
+        node = node.value
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+def _receiver_field(node):
+    """(receiver_src, field) for ``<expr>.f`` writes; receiver 'self' or
+    a dotted rendering of the expression."""
+    while isinstance(node, ast.Subscript):
+        node = node.value
+    if isinstance(node, ast.Attribute):
+        return _dotted(node.value), node.attr
+    return None, None
+
+
+def _dotted(node) -> str:
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        base = _dotted(node.value)
+        return f"{base}.{node.attr}" if base else node.attr
+    return ""
+
+
+def iter_writes(body):
+    """Yield (node, target) for every write expression in ``body`` —
+    Assign/AugAssign/AnnAssign targets, ``del``, and in-place mutator calls.
+    ``target`` is the written expression node (Attribute/Subscript/Name)."""
+    for node in body:
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Assign):
+                for t in sub.targets:
+                    for leaf in _unpack(t):
+                        yield sub, leaf
+            elif isinstance(sub, (ast.AugAssign, ast.AnnAssign)):
+                if getattr(sub, "value", True) is not None:
+                    yield sub, sub.target
+            elif isinstance(sub, ast.Delete):
+                for t in sub.targets:
+                    yield sub, t
+            elif (isinstance(sub, ast.Call)
+                  and isinstance(sub.func, ast.Attribute)
+                  and sub.func.attr in MUTATORS):
+                yield sub, sub.func.value
+
+
+def _unpack(t):
+    if isinstance(t, (ast.Tuple, ast.List)):
+        for e in t.elts:
+            yield from _unpack(e)
+    else:
+        yield t
+
+
+# ---------------------------------------------------------------------------
+# LOCK-001 / LOCK-004: guarded writes
+# ---------------------------------------------------------------------------
+
+class _WithTracker(ast.NodeVisitor):
+    """Walks one function body tracking the lexically-held lock set."""
+
+    def __init__(self, on_write, held0=()):
+        self.held: list = list(held0)
+        self.on_write = on_write
+
+    def visit_With(self, node: ast.With):
+        names = []
+        for item in node.items:
+            d = _dotted(item.context_expr)
+            if d:
+                names.append(d)
+                self.held.append(d)
+        for ctx_item in node.items:
+            if ctx_item.optional_vars is not None:
+                self.generic_visit(ctx_item.optional_vars)
+        for stmt in node.body:
+            self.visit(stmt)
+        for _ in names:
+            self.held.pop()
+
+    visit_AsyncWith = visit_With
+
+    def _write_nodes(self, node):
+        self.on_write(node, list(self.held))
+        self.generic_visit(node)
+
+    visit_Assign = _write_nodes
+    visit_AugAssign = _write_nodes
+    visit_AnnAssign = _write_nodes
+    visit_Delete = _write_nodes
+
+    def visit_Call(self, node: ast.Call):
+        if (isinstance(node.func, ast.Attribute)
+                and node.func.attr in MUTATORS):
+            self.on_write(node, list(self.held))
+        self.generic_visit(node)
+
+    # nested defs/lambdas run later, when the lexically-visible lock may no
+    # longer be held: analyze them with an EMPTY held set — a guarded write
+    # inside a callback needs its own lock (or an allow-comment)
+    def visit_FunctionDef(self, node):
+        inner = _WithTracker(self.on_write, held0=())
+        for stmt in node.body:
+            inner.visit(stmt)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node):
+        pass  # lambdas cannot contain statements, hence no writes
+
+
+def _writes_from_stmt(stmt, held, guards, lockname_ok, emit):
+    """Check one write-bearing statement against the class guards."""
+    targets = []
+    if isinstance(stmt, ast.Assign):
+        for t in stmt.targets:
+            targets.extend(_unpack(t))
+    elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+        if getattr(stmt, "value", True) is not None:
+            targets.append(stmt.target)
+    elif isinstance(stmt, ast.Delete):
+        targets.extend(stmt.targets)
+    elif isinstance(stmt, ast.Call):
+        targets.append(stmt.func.value)
+    for t in targets:
+        field = _self_field(t)
+        if field is None or field not in guards:
+            continue
+        lock = guards[field]
+        if lock is None:
+            continue  # externally serialized: LOCK-003's job
+        if not any(lockname_ok(h, lock) for h in held):
+            emit(stmt, field, lock)
+
+
+def check_guarded_writes(src: SourceFile):
+    """LOCK-001 over one file."""
+    findings: list = []
+    classes = harvest_classes(src)
+    for node in ast.walk(src.tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        guards = classes.get(node.name) or {}
+        if not any(v is not None for v in guards.values()):
+            continue
+        for meth in node.body:
+            if not isinstance(meth, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if meth.name == "__init__":
+                continue
+
+            def on_write(stmt, held, _meth=meth):
+                _writes_from_stmt(
+                    stmt, held, guards,
+                    lambda h, lock: h == f"self.{lock}",
+                    lambda s, field, lock: findings.append(Finding(
+                        "LOCK-001", src.rel, s.lineno,
+                        f"{node.name}.{field} written in {_meth.name}() "
+                        f"outside `with self.{lock}` (guarded_by"
+                        f"({lock!r}))")))
+
+            tracker = _WithTracker(on_write)
+            for stmt in meth.body:
+                tracker.visit(stmt)
+    return findings
+
+
+def check_guarded_globals(src: SourceFile):
+    """LOCK-004 over one file: guarded module globals written lock-free."""
+    findings: list = []
+    gguards = harvest_global_guards(src)
+    if not gguards:
+        return findings
+    for node in ast.walk(src.tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        declared = set()
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Global):
+                declared.update(sub.names)
+        hot = declared & set(gguards)
+        if not hot:
+            continue
+
+        def on_write(stmt, held, _fn=node):
+            targets = []
+            if isinstance(stmt, ast.Assign):
+                for t in stmt.targets:
+                    targets.extend(_unpack(t))
+            elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+                targets.append(stmt.target)
+            elif isinstance(stmt, ast.Delete):
+                targets.extend(stmt.targets)
+            for t in targets:
+                if not (isinstance(t, ast.Name) and t.id in hot):
+                    continue
+                lock = gguards[t.id]
+                if lock not in held:
+                    findings.append(Finding(
+                        "LOCK-004", src.rel, stmt.lineno,
+                        f"module global {t.id} written in {_fn.name}() "
+                        f"outside `with {lock}` (guard_globals)"))
+
+        tracker = _WithTracker(on_write)
+        for stmt in node.body:
+            tracker.visit(stmt)
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# LOCK-003: reaching into externally-serialized classes
+# ---------------------------------------------------------------------------
+
+def check_external_writes(sources):
+    """LOCK-003 across files: ``x.<field> = ...`` where <field> belongs to a
+    guarded_by(None, ...) class and the receiver is not ``self``."""
+    external: set = set()
+    owners: dict = {}
+    for src in sources:
+        for cname, guards in harvest_classes(src).items():
+            for field, lock in guards.items():
+                if lock is None:
+                    external.add(field)
+                    owners[field] = cname
+    findings: list = []
+    if not external:
+        return findings
+    for src in sources:
+        for stmt, target in iter_writes(src.tree.body):
+            recv, field = _receiver_field(target)
+            if field in external and recv not in ("self", "", "cls"):
+                findings.append(Finding(
+                    "LOCK-003", src.rel, stmt.lineno,
+                    f"direct write to {recv}.{field} — {owners[field]} is "
+                    f"externally serialized (guarded_by(None)); mutate via "
+                    f"its methods under the owner's lock"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# LOCK-002: acquisition-order graph
+# ---------------------------------------------------------------------------
+
+def _lock_node_name(dotted: str, cls_name: str | None, modname: str,
+                    known_lock_attrs: set, module_locks: set):
+    """Canonical graph-node name for a with-context, or None if the context
+    is not a lock (``with open(...)``, ``with mesh:``...)."""
+    if not dotted:
+        return None
+    parts = dotted.split(".")
+    leaf = parts[-1]
+    is_lockish = ("lock" in leaf.lower()
+                  or leaf in known_lock_attrs
+                  or (len(parts) == 1 and leaf in module_locks))
+    if not is_lockish:
+        return None
+    if parts[0] == "self":
+        owner = cls_name or modname
+        return ".".join([owner] + parts[1:])
+    if len(parts) == 1:
+        return f"{modname}.{leaf}"
+    return dotted
+
+
+def _module_level_locks(src: SourceFile) -> set:
+    """Names bound at module level to threading.Lock()/RLock()."""
+    out = set()
+    for node in src.tree.body:
+        if not (isinstance(node, ast.Assign)
+                and isinstance(node.value, ast.Call)):
+            continue
+        fn = node.value.func
+        leaf = fn.attr if isinstance(fn, ast.Attribute) else (
+            fn.id if isinstance(fn, ast.Name) else "")
+        if leaf in ("Lock", "RLock"):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    out.add(t.id)
+    return out
+
+
+def collect_acquisition_edges(sources):
+    """[(src_lock, dst_lock, rel, line)] from lexical with-nesting."""
+    edges: list = []
+    for src in sources:
+        modname = src.rel.rsplit("/", 1)[-1].removesuffix(".py")
+        module_locks = _module_level_locks(src)
+        lock_attrs = set()
+        for guards in harvest_classes(src).values():
+            lock_attrs.update(l for l in guards.values() if l)
+
+        def walk(body, cls_name, held):
+            for node in body:
+                if isinstance(node, ast.ClassDef):
+                    walk(node.body, node.name, held)
+                    continue
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    walk(node.body, cls_name, [])
+                    continue
+                if isinstance(node, (ast.With, ast.AsyncWith)):
+                    acquired = []
+                    for item in node.items:
+                        lname = _lock_node_name(
+                            _dotted(item.context_expr), cls_name, modname,
+                            lock_attrs, module_locks)
+                        if lname is None:
+                            continue
+                        for h in held + acquired:
+                            if h != lname:
+                                edges.append((h, lname, src.rel, node.lineno))
+                        acquired.append(lname)
+                    walk(node.body, cls_name, held + acquired)
+                    continue
+                inner = [n for n in ast.iter_child_nodes(node)
+                         if isinstance(n, ast.stmt)]
+                if inner:
+                    walk(inner, cls_name, held)
+
+        walk(src.tree.body, None, [])
+    return edges
+
+
+def check_lock_order(sources):
+    """LOCK-002: cycles in the union acquisition graph."""
+    edges = collect_acquisition_edges(sources)
+    graph: dict = {}
+    where: dict = {}
+    for a, b, rel, line in edges:
+        graph.setdefault(a, set()).add(b)
+        where.setdefault((a, b), (rel, line))
+    findings: list = []
+    reported: set = set()
+    for start in sorted(graph):
+        path: list = []
+        onpath: set = set()
+        seen: set = set()
+
+        def dfs(node):
+            if node in onpath:
+                i = path.index(node)
+                cycle = tuple(sorted(path[i:]))
+                if cycle not in reported:
+                    reported.add(cycle)
+                    hops = path[i:] + [node]
+                    locs = []
+                    for a, b in zip(hops, hops[1:]):
+                        rel, line = where[(a, b)]
+                        locs.append(f"{a} -> {b} at {rel}:{line}")
+                    rel0, line0 = where[(hops[0], hops[1])]
+                    findings.append(Finding(
+                        "LOCK-002", rel0, line0,
+                        "lock-order inversion: " + "; ".join(locs)))
+                return
+            if node in seen:
+                return
+            seen.add(node)
+            onpath.add(node)
+            path.append(node)
+            for nxt in sorted(graph.get(node, ())):
+                dfs(nxt)
+            path.pop()
+            onpath.discard(node)
+
+        dfs(start)
+    return findings

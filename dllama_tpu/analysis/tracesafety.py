@@ -1,0 +1,361 @@
+"""JAX trace-safety lints.
+
+TRACE-001  Python ``if``/``while`` (or conditional expression) branching on a
+           traced value inside a jit/shard_map region — under tracing the
+           condition is an abstract value; ``bool()`` on it either raises a
+           ConcretizationTypeError or silently bakes one branch into the
+           compiled program.
+TRACE-002  host pulls on traced values inside a jit region: ``.item()`` /
+           ``.tolist()``, ``float()/int()/bool()``, or ``np.*`` calls — each
+           forces a device sync (or a tracer leak) inside the traced
+           function.
+TRACE-003  mutation of Python state captured by a jitted closure
+           (``nonlocal``/``global`` rebinding, in-place mutator calls or
+           item-writes on free variables) — jit replays the traced function
+           zero or many times, so captured-state mutation desynchronizes
+           from execution.
+
+Region discovery: functions decorated with ``jax.jit`` / ``jit`` /
+``partial(jax.jit, ...)`` / ``functools.partial(jax.jit, ...)`` /
+``shard_map`` variants, plus defs and lambdas passed directly to
+``jax.jit(...)`` / ``shard_map(...)`` call sites in the same scope.
+
+Taint model (deliberately precision-first): non-static parameters of a jit
+region are roots; taint flows through arithmetic/comparison, subscripting,
+tuple packing/unpacking and calls on or of tainted values; it STOPS at
+attribute access (``x.shape``/``x.ndim``/``cfg.flag`` are static under
+trace), ``len()``/``isinstance()``/``type()``/``range()``, and ``is``/``is
+not`` comparisons (identity on tracers is legal Python). ``static_argnames``
+/ ``static_argnums`` remove parameters from the root set.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import Finding, SourceFile
+
+_JIT_NAMES = {"jit"}
+_SHARD_NAMES = {"shard_map"}
+_SAFE_CALLS = {"len", "isinstance", "type", "range", "enumerate", "getattr",
+               "hasattr", "zip", "print", "id", "repr", "str"}
+_HOST_CASTS = {"float", "int", "bool", "complex"}
+_HOST_METHODS = {"item", "tolist"}
+_MUTATORS = {"append", "extend", "insert", "remove", "pop", "popitem",
+             "clear", "add", "discard", "update", "setdefault", "appendleft"}
+
+
+def _leaf_name(func) -> str:
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return ""
+
+
+def _jit_call_info(call: ast.Call):
+    """(kind, statics) when ``call`` is jax.jit(...)/shard_map(...)/
+    partial(jax.jit, ...); kind None otherwise. statics = (names, nums)."""
+    leaf = _leaf_name(call.func)
+    if leaf in _JIT_NAMES or leaf in _SHARD_NAMES:
+        return ("shard" if leaf in _SHARD_NAMES else "jit",
+                _static_args(call))
+    if leaf == "partial" and call.args:
+        inner = call.args[0]
+        inner_leaf = _leaf_name(inner) if isinstance(
+            inner, (ast.Attribute, ast.Name)) else ""
+        if inner_leaf in _JIT_NAMES | _SHARD_NAMES:
+            return ("shard" if inner_leaf in _SHARD_NAMES else "jit",
+                    _static_args(call))
+    return (None, None)
+
+
+def _static_args(call: ast.Call):
+    names: set = set()
+    nums: set = set()
+    for kw in call.keywords:
+        if kw.arg == "static_argnames":
+            for n in ast.walk(kw.value):
+                if isinstance(n, ast.Constant) and isinstance(n.value, str):
+                    names.add(n.value)
+        elif kw.arg == "static_argnums":
+            for n in ast.walk(kw.value):
+                if isinstance(n, ast.Constant) and isinstance(n.value, int):
+                    nums.add(n.value)
+    return names, nums
+
+
+def find_jit_regions(src: SourceFile):
+    """[(func_node, static_names, static_nums)] for every traced region."""
+    regions: list = []
+    seen: set = set()
+
+    def add(fn, statics):
+        if id(fn) in seen:
+            return
+        seen.add(id(fn))
+        names, nums = statics if statics else (set(), set())
+        regions.append((fn, names, nums))
+
+    # defs by scope, to resolve jax.jit(fn_name) references
+    defs_by_name: dict = {}
+    for node in ast.walk(src.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs_by_name.setdefault(node.name, node)
+
+    for node in ast.walk(src.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                if isinstance(dec, ast.Call):
+                    kind, statics = _jit_call_info(dec)
+                    if kind:
+                        add(node, statics)
+                elif _leaf_name(dec) in _JIT_NAMES | _SHARD_NAMES:
+                    add(node, (set(), set()))
+        elif isinstance(node, ast.Call):
+            kind, statics = _jit_call_info(node)
+            if not kind:
+                continue
+            # jax.jit(lambda...) / jax.jit(local_fn) / shard_map(f, mesh...)
+            for arg in node.args[:1] if _leaf_name(node.func) != "partial" \
+                    else node.args[1:2]:
+                if isinstance(arg, ast.Lambda):
+                    add(arg, statics)
+                elif isinstance(arg, ast.Name) and arg.id in defs_by_name:
+                    add(defs_by_name[arg.id], statics)
+    return regions
+
+
+def _params(fn):
+    a = fn.args
+    return [p.arg for p in (a.posonlyargs + a.args + a.kwonlyargs)]
+
+
+class _Taint:
+    """Two-pass lexical taint over one traced function."""
+
+    def __init__(self, tainted0: set):
+        self.tainted = set(tainted0)
+
+    def expr(self, node) -> bool:
+        t = self.tainted
+        if isinstance(node, ast.Name):
+            return node.id in t
+        if isinstance(node, ast.Starred):
+            return self.expr(node.value)
+        if isinstance(node, (ast.BinOp,)):
+            return self.expr(node.left) or self.expr(node.right)
+        if isinstance(node, ast.UnaryOp):
+            return self.expr(node.operand)
+        if isinstance(node, ast.BoolOp):
+            return any(self.expr(v) for v in node.values)
+        if isinstance(node, ast.Compare):
+            if all(isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops):
+                return False  # identity on tracers is fine
+            return self.expr(node.left) or any(
+                self.expr(c) for c in node.comparators)
+        if isinstance(node, ast.IfExp):
+            return (self.expr(node.test) or self.expr(node.body)
+                    or self.expr(node.orelse))
+        if isinstance(node, ast.Subscript):
+            return self.expr(node.value)
+        if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+            return any(self.expr(e) for e in node.elts)
+        if isinstance(node, ast.Attribute):
+            return False  # .shape/.ndim/.dtype/cfg.flag: static under trace
+        if isinstance(node, ast.Call):
+            leaf = _leaf_name(node.func)
+            if leaf in _SAFE_CALLS:
+                return False
+            if leaf in _HOST_METHODS:
+                return False  # already a TRACE-002; result is host-side
+            recv_tainted = (isinstance(node.func, ast.Attribute)
+                            and self.expr(node.func.value))
+            args_tainted = any(self.expr(a) for a in node.args) or any(
+                self.expr(kw.value) for kw in node.keywords)
+            return recv_tainted or args_tainted
+        if isinstance(node, ast.Lambda):
+            return False
+        return False
+
+    def assign(self, stmt):
+        if isinstance(stmt, ast.Assign):
+            tainted = self.expr(stmt.value)
+            for t in stmt.targets:
+                self._mark(t, tainted)
+        elif isinstance(stmt, ast.AugAssign):
+            if self.expr(stmt.value) or self.expr(stmt.target):
+                self._mark(stmt.target, True)
+        elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            self._mark(stmt.target, self.expr(stmt.value))
+
+    def _mark(self, target, tainted: bool):
+        for leaf in _unpack(target):
+            if isinstance(leaf, ast.Name):
+                if tainted:
+                    self.tainted.add(leaf.id)
+                else:
+                    self.tainted.discard(leaf.id)
+
+
+def _unpack(t):
+    if isinstance(t, (ast.Tuple, ast.List)):
+        for e in t.elts:
+            yield from _unpack(e)
+    elif isinstance(t, ast.Starred):
+        yield from _unpack(t.value)
+    else:
+        yield t
+
+
+def _np_root(func) -> bool:
+    node = func
+    while isinstance(node, ast.Attribute):
+        node = node.value
+    return isinstance(node, ast.Name) and node.id in ("np", "numpy")
+
+
+def check_trace_safety(src: SourceFile):
+    findings: list = []
+    for fn, static_names, static_nums in find_jit_regions(src):
+        findings.extend(_check_region(src, fn, static_names, static_nums))
+    return findings
+
+
+def _check_region(src: SourceFile, fn, static_names, static_nums):
+    findings: list = []
+    params = _params(fn)
+    roots = {p for i, p in enumerate(params)
+             if p not in static_names and i not in static_nums}
+    roots.discard("self")
+
+    # locals of this region (for TRACE-003 free-variable detection)
+    local_names = set(params)
+    for node in ast.walk(fn):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            local_names.add(node.name)
+        elif isinstance(node, ast.Assign):
+            for t in node.targets:
+                for leaf in _unpack(t):
+                    if isinstance(leaf, ast.Name):
+                        local_names.add(leaf.id)
+        elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+            for leaf in _unpack(node.target):
+                if isinstance(leaf, ast.Name):
+                    local_names.add(leaf.id)
+        elif isinstance(node, (ast.For, ast.AsyncFor)):
+            for leaf in _unpack(node.target):
+                if isinstance(leaf, ast.Name):
+                    local_names.add(leaf.id)
+        elif isinstance(node, ast.comprehension):
+            for leaf in _unpack(node.target):
+                if isinstance(leaf, ast.Name):
+                    local_names.add(leaf.id)
+        elif isinstance(node, ast.withitem) and node.optional_vars:
+            for leaf in _unpack(node.optional_vars):
+                if isinstance(leaf, ast.Name):
+                    local_names.add(leaf.id)
+    declared_free = set()
+    for node in ast.walk(fn):
+        if isinstance(node, (ast.Nonlocal, ast.Global)):
+            declared_free.update(node.names)
+
+    taint = _Taint(roots)
+    # nested defs run under the same trace: their params are traced values
+    for node in ast.walk(fn):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)) and node is not fn:
+            for p in _params(node):
+                taint.tainted.add(p)
+
+    def scan_once(emit: bool):
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                taint.assign(node)
+                if emit and isinstance(node, (ast.Assign, ast.AugAssign)):
+                    _trace3_item_write(node)
+            elif isinstance(node, (ast.If, ast.While)):
+                if emit and taint.expr(node.test):
+                    findings.append(Finding(
+                        "TRACE-001", src.rel, node.lineno,
+                        f"`{'while' if isinstance(node, ast.While) else 'if'}`"
+                        f" on a traced value inside a jit region "
+                        f"({_region_name(fn)}) — use jnp.where/lax.cond"))
+            elif isinstance(node, ast.IfExp):
+                if emit and taint.expr(node.test):
+                    findings.append(Finding(
+                        "TRACE-001", src.rel, node.lineno,
+                        f"conditional expression on a traced value inside a "
+                        f"jit region ({_region_name(fn)}) — use jnp.where"))
+            elif isinstance(node, ast.Call):
+                if emit:
+                    _trace2(node)
+                    _trace3_call(node)
+
+    def _trace2(call: ast.Call):
+        leaf = _leaf_name(call.func)
+        if (leaf in _HOST_METHODS and isinstance(call.func, ast.Attribute)
+                and taint.expr(call.func.value)):
+            findings.append(Finding(
+                "TRACE-002", src.rel, call.lineno,
+                f".{leaf}() on a traced value inside a jit region "
+                f"({_region_name(fn)}) — host pull under trace"))
+        elif (isinstance(call.func, ast.Name) and leaf in _HOST_CASTS
+                and any(taint.expr(a) for a in call.args)):
+            findings.append(Finding(
+                "TRACE-002", src.rel, call.lineno,
+                f"{leaf}() on a traced value inside a jit region "
+                f"({_region_name(fn)}) — concretizes the tracer"))
+        elif (_np_root(call.func)
+                and (any(taint.expr(a) for a in call.args)
+                     or any(taint.expr(kw.value) for kw in call.keywords))):
+            findings.append(Finding(
+                "TRACE-002", src.rel, call.lineno,
+                f"np.{_leaf_name(call.func)}() on a traced value inside a "
+                f"jit region ({_region_name(fn)}) — use jnp"))
+
+    def _trace3_call(call: ast.Call):
+        if not (isinstance(call.func, ast.Attribute)
+                and call.func.attr in _MUTATORS):
+            return
+        recv = call.func.value
+        if (isinstance(recv, ast.Name)
+                and (recv.id in declared_free
+                     or recv.id not in local_names)):
+            findings.append(Finding(
+                "TRACE-003", src.rel, call.lineno,
+                f"in-place .{call.func.attr}() on captured variable "
+                f"`{recv.id}` inside a jit region ({_region_name(fn)}) — "
+                f"jit replays the trace; captured-state mutation "
+                f"desynchronizes"))
+
+    def _trace3_item_write(stmt):
+        targets = (stmt.targets if isinstance(stmt, ast.Assign)
+                   else [stmt.target])
+        for t in targets:
+            for leaf in _unpack(t):
+                if isinstance(leaf, ast.Name) and leaf.id in declared_free:
+                    findings.append(Finding(
+                        "TRACE-003", src.rel, stmt.lineno,
+                        f"rebinding captured variable `{leaf.id}` "
+                        f"(nonlocal/global) inside a jit region "
+                        f"({_region_name(fn)})"))
+                elif isinstance(leaf, ast.Subscript):
+                    base = leaf.value
+                    while isinstance(base, ast.Subscript):
+                        base = base.value
+                    if (isinstance(base, ast.Name)
+                            and base.id not in local_names):
+                        findings.append(Finding(
+                            "TRACE-003", src.rel, stmt.lineno,
+                            f"item-write into captured variable "
+                            f"`{base.id}` inside a jit region "
+                            f"({_region_name(fn)})"))
+
+    scan_once(emit=False)   # settle taint through forward references/loops
+    scan_once(emit=True)
+    return findings
+
+
+def _region_name(fn) -> str:
+    return getattr(fn, "name", None) or f"<lambda>:{fn.lineno}"

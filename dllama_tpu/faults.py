@@ -50,6 +50,26 @@ SITES = ("admit", "step_chunk", "prefill", "prefill_chunk", "prefix_match",
          "logits")
 ACTIONS = ("raise", "slow", "truncate", "bitflip", "nan")
 
+#: site -> the metric family that proves the site's failure is VISIBLE on
+#: /metrics. dllama-check (FAULT-003) statically verifies every site has an
+#: entry and every entry names a metric registered somewhere in the package;
+#: the README site list is likewise generated from SITES (FAULT-002) — the
+#: registry here is the single source of truth, so the docs/drill/site sets
+#: can never drift apart again.
+SITE_METRICS = {
+    "admit": "dllama_admission_rejections_total",
+    "step_chunk": "dllama_decode_chunk_ms",
+    "prefill": "dllama_prefill_ms",
+    "prefill_chunk": "dllama_prefill_chunk_ms",
+    "prefix_match": "dllama_prefix_cache_misses_total",
+    "page_alloc": "dllama_kv_pages",
+    "stream": "dllama_sse_disconnects_total",
+    "scheduler": "dllama_scheduler_crashes_total",
+    "weights_open": "dllama_weights_open_failures_total",
+    "weights_read": "dllama_weights_checksum_failures_total",
+    "logits": "dllama_numeric_quarantines_total",
+}
+
 
 class FaultInjected(RuntimeError):
     """Raised by a ``raise``-action fault point. Deliberately a RuntimeError

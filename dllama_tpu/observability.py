@@ -33,6 +33,8 @@ import time
 import uuid
 from typing import Callable, Dict, Iterable, List, Optional, Sequence, Tuple
 
+from .analysis.sanitize import guard_globals, guarded_by
+
 __all__ = [
     "Counter",
     "Gauge",
@@ -93,8 +95,15 @@ def _fmt_value(v: float) -> str:
     return repr(f)
 
 
+@guarded_by("_lock", "_children")
 class _Metric:
-    """Common family machinery: label keying, child storage, exposition."""
+    """Common family machinery: label keying, child storage, exposition.
+
+    ``_lock`` is the owning registry's RLock (shared across every family in
+    the registry): exposition iterates families under it, so per-family
+    locks would only add an ordering hazard. ``_children`` rebinds are
+    guarded; per-key item writes happen under the same ``with self._lock``
+    blocks (the static pass checks both)."""
 
     kind = "untyped"
 
@@ -326,6 +335,7 @@ class Histogram(_Metric):
         return out
 
 
+@guarded_by("_lock", "_metrics")
 class MetricsRegistry:
     """Get-or-create registry of metric families with Prometheus exposition."""
 
@@ -395,10 +405,12 @@ def default_registry() -> MetricsRegistry:
 # ---------------------------------------------------------------------------
 # Chrome trace-event output (DLLAMA_TRACE=<path>)
 
-_trace_lock = threading.Lock()
+_trace_lock = threading.RLock()  # re-entrant: trace_path -> configure_trace
 _trace_path: Optional[str] = None
 _trace_file = None
 _trace_env_checked = False
+guard_globals("_trace_lock", "_trace_path", "_trace_file",
+              "_trace_env_checked")
 
 # Wall-clock anchor so monotonic phase marks land on the epoch timeline.
 _T0_MONO = time.monotonic()
@@ -417,7 +429,8 @@ def configure_trace(path: Optional[str]) -> None:
             try:
                 _trace_file.close()
             except OSError:
-                pass
+                pass  # best-effort close on reconfigure; the handle is
+                # dropped either way and tracing is advisory
             _trace_file = None
         _trace_path = path or None
         _trace_env_checked = True
@@ -432,11 +445,17 @@ def configure_trace(path: Optional[str]) -> None:
 def trace_path() -> Optional[str]:
     global _trace_env_checked
     if not _trace_env_checked:
-        env = os.environ.get("DLLAMA_TRACE")
-        if env:
-            configure_trace(env)  # sets _trace_env_checked
-        else:
-            _trace_env_checked = True
+        # double-checked under the lock (an RLock so configure_trace can
+        # re-enter): two first callers racing here used to publish
+        # _trace_env_checked lock-free (dllama-check LOCK-004) — one could
+        # observe the flag set with configuration still in flight
+        with _trace_lock:
+            if not _trace_env_checked:
+                env = os.environ.get("DLLAMA_TRACE")
+                if env:
+                    configure_trace(env)  # sets _trace_env_checked
+                else:
+                    _trace_env_checked = True
     return _trace_path
 
 
@@ -452,7 +471,8 @@ def emit_trace_events(events: List[dict]) -> None:
                 f.write(json.dumps(e, separators=(",", ":")) + ",\n")
             f.flush()
         except OSError:
-            pass
+            pass  # tracing is advisory: a full disk or closed file must
+            # never fail the request being traced
 
 
 # ---------------------------------------------------------------------------
@@ -471,7 +491,7 @@ def log_json_line(record: dict, stream=None) -> None:
             out.write(line + "\n")
             out.flush()
         except (OSError, ValueError):
-            pass
+            pass  # a closed/full log stream must never take down serving
 
 
 def prompt_digest(text: str) -> str:

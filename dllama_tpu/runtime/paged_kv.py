@@ -37,6 +37,8 @@ from __future__ import annotations
 
 from typing import Callable, List, Optional, Sequence, Tuple
 
+from ..analysis.sanitize import check_invariants, guarded_by
+
 #: arena page index reserved as the garbage scratch slot: page tables are
 #: padded with it, and pinned/done rows write their discarded K/V there —
 #: it is never allocated, never cached, never read by a live query.
@@ -48,6 +50,9 @@ def pages_for(tokens: int, page: int) -> int:
     return max(0, (tokens + page - 1) // page)
 
 
+@guarded_by(None, "_free", "_ref", "_cached", "_evictable", "_reserved")
+@check_invariants("check", "reserve", "unreserve", "alloc", "ref", "unref",
+                  "hold", "drop")
 class PageAllocator:
     """Free list + per-page refcounts for a ``num_pages``-page KV arena.
 
@@ -223,6 +228,7 @@ class _Node:
         self.last_use = last_use
 
 
+@guarded_by(None, "_root", "_clock", "_count")
 class RadixPrefixCache:
     """Token-block prefix tree over arena pages.
 

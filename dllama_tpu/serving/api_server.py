@@ -38,6 +38,7 @@ import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from dllama_tpu import faults, observability
+from dllama_tpu.analysis.sanitize import guarded_by
 from dllama_tpu.observability import RequestTrace
 from dllama_tpu.runtime.generate import NumericHealthError
 from dllama_tpu.runtime.sampler import SamplerConfig
@@ -135,6 +136,7 @@ def decode_token_row(tok, prev: int, row: list, stop_ids: tuple,
     return "".join(text_parts), finish, n_gen
 
 
+@guarded_by("_lock", "_supervisor", "_window", "_active_sess", "_keep_sess")
 class Batcher:
     """CONTINUOUS batching scheduler: concurrent completions — greedy AND
     sampled, non-streaming AND streaming — share one resident slot-pool
@@ -501,8 +503,10 @@ class Batcher:
                     kv_budget=self.kv_budget,
                     kv_pages=self.kv_pages)
                 if self.kv_pages > 0:
-                    self._keep_sess = sess
-            self._active_sess = sess
+                    with self._lock:
+                        self._keep_sess = sess
+            with self._lock:
+                self._active_sess = sess
             while waiting or slot_map:
                 # lifecycle reap, BETWEEN chunks: a cancelled (client gone)
                 # or deadline-expired row is released NOW — its slab goes to
@@ -596,9 +600,11 @@ class Batcher:
             self._fail(list(slot_map.values()) + waiting, e)
             # a session that threw mid-window is suspect: never keep it
             if sess is not None and sess is self._keep_sess:
-                self._keep_sess = None
+                with self._lock:
+                    self._keep_sess = None
         finally:
-            self._active_sess = None
+            with self._lock:
+                self._active_sess = None
             if sess is not None and sess is not self._keep_sess:
                 sess.close()
 
@@ -634,7 +640,8 @@ class Batcher:
             # NO try/finally here: on an exception _window must SURVIVE the
             # unwind so the supervisor's _on_crash can fail exactly these
             # slots (a finally would clear it first and strand the waiters)
-            self._window = window
+            with self._lock:
+                self._window = window
             faults.fire("scheduler")
             window = [s for s in window if not self._reap_slot(s)]
             if window:
@@ -657,7 +664,8 @@ class Batcher:
                     observability.scheduler_trace_event(
                         "scheduler_window", t_win, time.monotonic(),
                         {"window": len(window)})])
-            self._window = []
+            with self._lock:
+                self._window = []
 
     def _on_crash(self, exc: BaseException) -> None:
         """Supervisor hook for a crashed scheduler iteration: every slot of
@@ -665,15 +673,17 @@ class Batcher:
         hang on a dead thread), and a leaked pool session's HBM is freed.
         Arrivals still queued are NOT failed — the restarted loop serves
         them; replaying the FAILED window is the client's call, not ours."""
-        window, self._window = self._window, []
+        with self._lock:
+            window, self._window = self._window, []
         err = exc if isinstance(exc, LifecycleError) else SchedulerCrashed(exc)
         for s in window:
             if not s.done.is_set():
                 self._resolve_err(s, err)
-        sess, self._active_sess = self._active_sess, None
-        if sess is None:
-            sess = self._keep_sess
-        self._keep_sess = None
+        with self._lock:
+            sess, self._active_sess = self._active_sess, None
+            if sess is None:
+                sess = self._keep_sess
+            self._keep_sess = None
         if sess is not None:
             try:
                 sess.close()
@@ -1239,7 +1249,7 @@ class OpenAIHandler(BaseHTTPRequestHandler):
             try:
                 self._lifecycle_error(e)
             except (BrokenPipeError, ConnectionResetError):
-                pass
+                pass  # client vanished while we wrote the error body
         except (BrokenPipeError, ConnectionResetError):
             pass  # client went away mid-stream (FIN -> BrokenPipe, RST ->
             # ConnectionReset); per-request isolation like the reference's
@@ -1338,7 +1348,7 @@ class OpenAIHandler(BaseHTTPRequestHandler):
                 self.wfile.write(b"data: [DONE]\n\n")
                 self.wfile.flush()
             except (BrokenPipeError, ConnectionResetError):
-                pass
+                pass  # client closed between the last chunk and [DONE]
         self.close_connection = True
 
     def _handle_completions(self, req: dict, trace: RequestTrace) -> None:
@@ -1612,7 +1622,7 @@ class OpenAIHandler(BaseHTTPRequestHandler):
                     self.wfile.write(b"data: [DONE]\n\n")
                     self.wfile.flush()
                 except (BrokenPipeError, ConnectionResetError):
-                    pass
+                    pass  # client closed between the last chunk and [DONE]
             self.close_connection = True
         else:
             self._json(200, dict(base, choices=[{
@@ -1702,7 +1712,7 @@ def serve(args) -> None:
             try:
                 os.remove(pid_path)
             except OSError:
-                pass
+                pass  # pid file already gone (drain path) or never written
 
 
 def main(argv=None) -> None:

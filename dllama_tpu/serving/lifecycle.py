@@ -32,6 +32,7 @@ import threading
 import time
 
 from .. import observability
+from ..analysis.sanitize import guarded_by
 from ..runtime import paged_kv
 
 # Module-level metric handles against the shared default registry: created at
@@ -183,6 +184,7 @@ class CancelToken:
         return RequestCancelled(self.reason or "cancelled")
 
 
+@guarded_by("_lock", "_inflight", "_draining", "_service_ewma_s")
 class AdmissionGate:
     """Bounded in-flight request counter with drain support.
 
@@ -257,6 +259,7 @@ class AdmissionGate:
             return True
 
 
+@guarded_by("_lock", "_reserved", "_rows", "pages")
 class KVBudget:
     """Serving-side KV admission accountant for a BatchSession.
 
@@ -350,6 +353,7 @@ class KVBudget:
             _M_KV_ROWS.set(self._rows[new_bucket], bucket=str(new_bucket))
 
 
+@guarded_by("_lock", "_thread", "crash_count", "_stopped")
 class Supervisor:
     """Owns a daemon thread running ``target`` and restarts it on crash.
 
@@ -387,14 +391,16 @@ class Supervisor:
                 self._target()
                 return  # clean exit: drain finished
             except BaseException as e:  # noqa: BLE001 — supervision IS the catch
-                self.crash_count += 1
+                with self._lock:
+                    self.crash_count += 1
+                    crashes = self.crash_count
                 _M_CRASHES.inc()
                 try:
                     self._on_crash(e)
                 except Exception:  # noqa: BLE001 — crash hook must not kill
                     pass  # the supervisor; liveness beats accounting here
                 if (self._max_restarts is not None
-                        and self.crash_count > self._max_restarts):
+                        and crashes > self._max_restarts):
                     return
                 time.sleep(self._restart_delay_s)
 
@@ -405,4 +411,5 @@ class Supervisor:
 
     def stop(self) -> None:
         """Stop restarting (the running iteration finishes on its own)."""
-        self._stopped = True
+        with self._lock:
+            self._stopped = True

@@ -91,7 +91,8 @@ class Tokenizer:
             try:
                 return bytes([int(piece[3:5], 16)])
             except ValueError:
-                pass
+                pass  # not a raw-byte token after all: fall through to
+                # the literal piece
         return piece
 
     def decode(self, tokens: list) -> str:

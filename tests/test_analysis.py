@@ -1,0 +1,692 @@
+"""dllama-check analyzer + sanitizer suite.
+
+Per-rule fixture snippets (positive AND negative), suppression semantics,
+the repo-level zero-findings gate, and runtime sanitizer smoke tests —
+including the acceptance-criteria seeded bugs: an unlocked annotated write,
+a traced-value ``if``, an undocumented fault site, and a lock-order
+inversion (static and runtime).
+"""
+
+import json
+import os
+import textwrap
+import threading
+
+import pytest
+
+import dllama_tpu.analysis.sanitize as sanitize
+from dllama_tpu.analysis import analyze_source
+from dllama_tpu.analysis import core as acore
+from dllama_tpu.analysis import coverage as acoverage
+
+
+def _rules(findings, unsuppressed_only=False):
+    return [f.rule for f in findings
+            if not (unsuppressed_only and f.suppressed)]
+
+
+def _snippet(s: str) -> str:
+    return textwrap.dedent(s).lstrip()
+
+
+# ---------------------------------------------------------------------------
+# LOCK-001: guarded writes
+# ---------------------------------------------------------------------------
+
+LOCK_CLASS = _snippet("""
+    import threading
+    from dllama_tpu.analysis.sanitize import guarded_by
+
+    @guarded_by("_lock", "_count", "_rows")
+    class C:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._count = 0
+            self._rows = {}
+
+        def good(self):
+            with self._lock:
+                self._count += 1
+                self._rows["a"] = 1
+
+        def reader(self):
+            return self._count  # reads are never flagged
+    """)
+
+
+def test_lock001_unlocked_write_caught():
+    # the seeded bug: an unlocked annotated write
+    src = LOCK_CLASS + "    def bad(self):\n        self._count += 1\n"
+    findings = analyze_source(src)
+    hits = [f for f in findings if f.rule == "LOCK-001"]
+    assert len(hits) == 1 and not hits[0].suppressed
+    assert "_count" in hits[0].message
+
+
+def test_lock001_negative_all_locked():
+    assert "LOCK-001" not in _rules(analyze_source(LOCK_CLASS))
+
+
+def test_lock001_item_write_into_guarded_container():
+    src = LOCK_CLASS + "    def bad(self):\n        self._rows['k'] = 2\n"
+    findings = analyze_source(src)
+    assert "LOCK-001" in _rules(findings)
+
+
+def test_lock001_mutator_call_counts_as_write():
+    src = LOCK_CLASS + "    def bad(self):\n        self._rows.update(a=1)\n"
+    assert "LOCK-001" in _rules(analyze_source(src))
+
+
+def test_lock001_init_exempt():
+    # __init__ writes without the lock and must not be flagged
+    assert "LOCK-001" not in _rules(analyze_source(LOCK_CLASS))
+
+
+# ---------------------------------------------------------------------------
+# LOCK-002: acquisition-order inversions
+# ---------------------------------------------------------------------------
+
+def test_lock002_three_lock_cycle_detected():
+    src = _snippet("""
+        class A:
+            def m1(self):
+                with self._lock_a:
+                    with self._lock_b:
+                        pass
+            def m2(self):
+                with self._lock_b:
+                    with self._lock_c:
+                        pass
+            def m3(self):
+                with self._lock_c:
+                    with self._lock_a:
+                        pass
+    """)
+    findings = analyze_source(src)
+    assert "LOCK-002" in _rules(findings)
+    msg = next(f for f in findings if f.rule == "LOCK-002").message
+    assert "_lock_a" in msg and "_lock_b" in msg and "_lock_c" in msg
+
+
+def test_lock002_consistent_order_clean():
+    src = _snippet("""
+        class A:
+            def m1(self):
+                with self._lock_a:
+                    with self._lock_b:
+                        pass
+            def m2(self):
+                with self._lock_a:
+                    with self._lock_b:
+                        pass
+    """)
+    assert "LOCK-002" not in _rules(analyze_source(src))
+
+
+def test_lock002_cross_method_two_lock_inversion():
+    # never nested in ONE method — the union graph still has the cycle
+    src = _snippet("""
+        class A:
+            def m1(self):
+                with self._lock_a:
+                    with self._lock_b:
+                        pass
+            def m2(self):
+                with self._lock_b:
+                    with self._lock_a:
+                        pass
+    """)
+    assert "LOCK-002" in _rules(analyze_source(src))
+
+
+# ---------------------------------------------------------------------------
+# LOCK-003: externally-serialized classes
+# ---------------------------------------------------------------------------
+
+def test_lock003_external_write_caught_and_methods_clean():
+    src = _snippet("""
+        from dllama_tpu.analysis.sanitize import guarded_by
+
+        @guarded_by(None, "_free")
+        class P:
+            def internal(self):
+                self._free = []  # fine: inside the owning class
+
+        def naughty(p):
+            p._free = [1]
+    """)
+    findings = analyze_source(src)
+    assert _rules(findings).count("LOCK-003") == 1
+
+
+# ---------------------------------------------------------------------------
+# LOCK-004: guarded module globals
+# ---------------------------------------------------------------------------
+
+def test_lock004_global_write_outside_lock():
+    src = _snippet("""
+        import threading
+        from dllama_tpu.analysis.sanitize import guard_globals
+
+        _glock = threading.Lock()
+        _state = None
+        guard_globals("_glock", "_state")
+
+        def good(v):
+            global _state
+            with _glock:
+                _state = v
+
+        def bad(v):
+            global _state
+            _state = v
+    """)
+    findings = analyze_source(src)
+    assert _rules(findings).count("LOCK-004") == 1
+
+
+# ---------------------------------------------------------------------------
+# TRACE-*: jit trace-safety
+# ---------------------------------------------------------------------------
+
+def test_trace001_if_on_traced_value():
+    # the seeded bug: a traced-value `if` inside jit
+    src = _snippet("""
+        import jax
+
+        @jax.jit
+        def f(x):
+            if x > 0:
+                return x
+            return -x
+    """)
+    assert "TRACE-001" in _rules(analyze_source(src))
+
+
+def test_trace001_static_argname_not_flagged():
+    src = _snippet("""
+        import jax
+        from functools import partial
+
+        @partial(jax.jit, static_argnames=("n",))
+        def f(x, n):
+            if n > 0:
+                return x
+            while n > 0:
+                n -= 1
+            return x
+    """)
+    assert "TRACE-001" not in _rules(analyze_source(src))
+
+
+def test_trace001_shape_and_identity_not_flagged():
+    src = _snippet("""
+        import jax
+
+        @jax.jit
+        def f(x, mask):
+            if mask is None:
+                return x
+            if x.ndim == 2:
+                return x + 1
+            return x
+    """)
+    assert "TRACE-001" not in _rules(analyze_source(src))
+
+
+def test_trace001_while_on_traced_value():
+    src = _snippet("""
+        import jax
+
+        @jax.jit
+        def f(x):
+            while x > 0:
+                x = x - 1
+            return x
+    """)
+    assert "TRACE-001" in _rules(analyze_source(src))
+
+
+def test_trace002_host_pulls():
+    src = _snippet("""
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def f(x):
+            a = float(x)
+            b = x.item()
+            c = np.asarray(x)
+            return a, b, c
+    """)
+    assert _rules(analyze_source(src)).count("TRACE-002") == 3
+
+
+def test_trace002_jnp_and_untraced_fine():
+    src = _snippet("""
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        SCALE = np.float32(2.0)  # np on module constants: fine
+
+        @jax.jit
+        def f(x):
+            y = jnp.asarray(x) * SCALE
+            n = float(3)  # float() on a literal: fine
+            return y * n
+    """)
+    assert "TRACE-002" not in _rules(analyze_source(src))
+
+
+def test_trace003_captured_mutation():
+    src = _snippet("""
+        import jax
+
+        acc = []
+
+        @jax.jit
+        def f(x):
+            acc.append(x)
+            return x
+    """)
+    assert "TRACE-003" in _rules(analyze_source(src))
+
+
+def test_trace003_local_append_fine():
+    src = _snippet("""
+        import jax
+
+        @jax.jit
+        def f(x):
+            parts = []
+            for i in range(4):
+                parts.append(x * i)
+            return parts
+    """)
+    assert "TRACE-003" not in _rules(analyze_source(src))
+
+
+def test_trace_regions_via_jit_call_and_lambda():
+    src = _snippet("""
+        import jax
+
+        def g(x):
+            if x > 0:
+                return x
+            return -x
+
+        gj = jax.jit(g)
+        hj = jax.jit(lambda x: float(x))
+    """)
+    rules = _rules(analyze_source(src))
+    assert "TRACE-001" in rules  # g became a jit region via jax.jit(g)
+    assert "TRACE-002" in rules  # float(x) inside the jitted lambda
+
+
+# ---------------------------------------------------------------------------
+# EXC-*: exception hygiene
+# ---------------------------------------------------------------------------
+
+def test_exc001_bare_except():
+    src = "try:\n    x = 1\nexcept:\n    pass  # whatever\n"
+    assert "EXC-001" in _rules(analyze_source(src))
+
+
+def test_exc002_uncommented_swallow():
+    src = "try:\n    x = 1\nexcept ValueError:\n    pass\n"
+    assert "EXC-002" in _rules(analyze_source(src))
+
+
+def test_exc002_commented_swallow_fine():
+    src = ("try:\n    x = 1\nexcept ValueError:\n"
+           "    pass  # value was optional\n")
+    assert "EXC-002" not in _rules(analyze_source(src))
+
+
+# ---------------------------------------------------------------------------
+# suppressions
+# ---------------------------------------------------------------------------
+
+def test_suppression_honored_same_line_and_line_above():
+    src = _snippet("""
+        from dllama_tpu.analysis.sanitize import guarded_by
+
+        @guarded_by("_lock", "_n", "_m")
+        class C:
+            def bad(self):
+                self._n = 1  # dllama: allow[LOCK-001] reason=single-writer
+                # dllama: allow[LOCK-001] reason=publish only
+                self._m = 2
+    """)
+    findings = analyze_source(src)
+    lock1 = [f for f in findings if f.rule == "LOCK-001"]
+    assert len(lock1) == 2 and all(f.suppressed for f in lock1)
+    assert all(f.reason for f in lock1)
+
+
+def test_suppression_wrong_rule_not_honored():
+    src = _snippet("""
+        from dllama_tpu.analysis.sanitize import guarded_by
+
+        @guarded_by("_lock", "_n")
+        class C:
+            def bad(self):
+                self._n = 1  # dllama: allow[TRACE-001] reason=wrong rule
+    """)
+    findings = analyze_source(src)
+    assert any(f.rule == "LOCK-001" and not f.suppressed for f in findings)
+
+
+def test_suppression_without_reason_is_a_finding():
+    src = "x = 1  # dllama: allow[LOCK-001]\n"
+    findings = analyze_source(src)
+    assert "SUP-001" in _rules(findings)
+
+
+# ---------------------------------------------------------------------------
+# FAULT-*: coverage cross-checks (tmp repo fixture)
+# ---------------------------------------------------------------------------
+
+def _mini_repo(tmp_path, *, sites, metrics, fire_calls, readme_sites=None,
+               test_text=""):
+    pkg = tmp_path / "dllama_tpu"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("")
+    (pkg / "faults.py").write_text(
+        f"SITES = {tuple(sites)!r}\nSITE_METRICS = {dict(metrics)!r}\n"
+        "def fire(site):\n    return None\n")
+    body = "from . import faults\n"
+    for m in metrics.values():
+        body += f"_M = \"{m}\"\n"
+    for s in fire_calls:
+        body += f"def seam_{s}():\n    faults.fire(\"{s}\")\n"
+    (pkg / "engine.py").write_text(body)
+    block = acoverage.render_site_block(
+        tuple(readme_sites if readme_sites is not None else sites))
+    (tmp_path / "README.md").write_text(f"usage\n```bash\n{block}\n```\n")
+    tdir = tmp_path / "tests"
+    tdir.mkdir()
+    (tdir / "test_x.py").write_text(test_text)
+    root = str(tmp_path)
+    sources = [acore.load_source(str(pkg / "engine.py"), root),
+               acore.load_source(str(pkg / "faults.py"), root)]
+    return root, sources
+
+
+def test_fault_all_green(tmp_path):
+    root, sources = _mini_repo(
+        tmp_path, sites=("a", "b"),
+        metrics={"a": "m_a_total", "b": "m_b_total"},
+        fire_calls=("a", "b"), test_text="faults a b\n")
+    assert acoverage.check_fault_coverage(root, sources) == []
+
+
+def test_fault001_unregistered_fire_and_dead_site(tmp_path):
+    root, sources = _mini_repo(
+        tmp_path, sites=("a", "b"),
+        metrics={"a": "m_a_total", "b": "m_b_total"},
+        fire_calls=("a", "ghost"), test_text="a b ghost\n")
+    rules = [f.rule for f in acoverage.check_fault_coverage(root, sources)]
+    assert rules.count("FAULT-001") == 2  # fired-unknown AND never-fired 'b'
+
+
+def test_fault002_undocumented_site(tmp_path):
+    # the seeded bug: a fault site missing from the README list
+    root, sources = _mini_repo(
+        tmp_path, sites=("a", "b"),
+        metrics={"a": "m_a_total", "b": "m_b_total"},
+        fire_calls=("a", "b"), readme_sites=("a",), test_text="a b\n")
+    rules = [f.rule for f in acoverage.check_fault_coverage(root, sources)]
+    assert "FAULT-002" in rules
+
+
+def test_fault003_missing_metric_seam(tmp_path):
+    root, sources = _mini_repo(
+        tmp_path, sites=("a", "b"), metrics={"a": "m_a_total"},
+        fire_calls=("a", "b"), test_text="a b\n")
+    rules = [f.rule for f in acoverage.check_fault_coverage(root, sources)]
+    assert "FAULT-003" in rules
+
+
+def test_fault003_unregistered_metric_name(tmp_path):
+    root, sources = _mini_repo(
+        tmp_path, sites=("a",), metrics={"a": "m_not_defined_anywhere"},
+        fire_calls=("a",), test_text="a\n")
+    # strip the metric string from engine.py so it is nowhere in the package
+    eng = tmp_path / "dllama_tpu" / "engine.py"
+    eng.write_text(eng.read_text().replace('"m_not_defined_anywhere"', '""'))
+    sources = [acore.load_source(str(eng), str(tmp_path)),
+               acore.load_source(str(tmp_path / "dllama_tpu" / "faults.py"),
+                                 str(tmp_path))]
+    rules = [f.rule for f in acoverage.check_fault_coverage(
+        str(tmp_path), sources)]
+    assert "FAULT-003" in rules
+
+
+def test_fault004_untested_site(tmp_path):
+    root, sources = _mini_repo(
+        tmp_path, sites=("a", "b"),
+        metrics={"a": "m_a_total", "b": "m_b_total"},
+        fire_calls=("a", "b"), test_text="only a here\n")
+    rules = [f.rule for f in acoverage.check_fault_coverage(root, sources)]
+    assert "FAULT-004" in rules
+
+
+def test_readme_site_block_renders_all_sites():
+    block = acoverage.render_site_block(("one", "two", "three"))
+    assert block.startswith("# sites: ")
+    for s in ("one", "two", "three"):
+        assert s in block
+
+
+# ---------------------------------------------------------------------------
+# the repo gate: zero unsuppressed findings on the real tree
+# ---------------------------------------------------------------------------
+
+def _repo_root():
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_real_tree_is_clean():
+    report = acore.run(_repo_root())
+    assert report.ok, "\n" + report.render()
+
+
+def test_json_report_shape():
+    report = acore.run(_repo_root())
+    data = json.loads(report.to_json())
+    assert data["ok"] is True
+    assert data["files_scanned"] > 40
+    assert isinstance(data["unsuppressed"], list)
+    assert isinstance(data["counts_by_rule"], dict)
+
+
+def test_cli_main_json_exit_zero(capsys):
+    from dllama_tpu.analysis.__main__ import main
+    rc = main(["--json", "--root", _repo_root()])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert json.loads(out)["ok"] is True
+
+
+# ---------------------------------------------------------------------------
+# runtime sanitizer
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def sanitizer_on():
+    old = sanitize._ENABLED
+    sanitize._ENABLED = True
+    sanitize.reset_order_graph()
+    try:
+        yield
+    finally:
+        sanitize._ENABLED = old
+        sanitize.reset_order_graph()
+
+
+@pytest.mark.skipif(os.environ.get("DLLAMA_SANITIZE", "") not in ("", "0"),
+                    reason="asserts the DISABLED fast path")
+def test_sanitizer_disabled_means_no_wrappers():
+    # acceptance criterion: zero overhead when off — no wrapper in the
+    # import path, annotated classes keep plain locks and plain __setattr__
+    from dllama_tpu.serving.lifecycle import AdmissionGate, Supervisor
+    g = AdmissionGate(2)
+    assert type(g._lock).__name__ == "lock"  # raw _thread.lock
+    assert "_dllama_sanitize_ready" not in vars(g)
+    assert AdmissionGate.__setattr__ is object.__setattr__
+    assert not hasattr(Supervisor.__init__, "__wrapped__")
+    # metadata still present for the static pass
+    assert AdmissionGate.__guarded_fields__["_inflight"] == "_lock"
+
+
+def test_sanitizer_unguarded_write_raises(sanitizer_on):
+    @sanitize.guarded_by("_lock", "_n")
+    class C:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._n = 0
+
+        def good(self):
+            with self._lock:
+                self._n += 1
+
+        def bad(self):
+            self._n += 1
+
+    c = C()
+    assert isinstance(c._lock, sanitize.LockWitness)
+    c.good()
+    assert c._n == 1
+    with pytest.raises(sanitize.UnguardedWriteError):
+        c.bad()
+
+
+def test_sanitizer_lock_order_inversion_smoke(sanitizer_on):
+    # the deliberate inversion the issue asks for: A then B on one path,
+    # B then A on another — the second path must trip the witness
+    @sanitize.guarded_by("_la", "_x")
+    class A:
+        def __init__(self):
+            self._la = threading.Lock()
+            self._x = 0
+
+    @sanitize.guarded_by("_lb", "_y")
+    class B:
+        def __init__(self):
+            self._lb = threading.Lock()
+            self._y = 0
+
+    a, b = A(), B()
+    with a._la:
+        with b._lb:
+            pass
+    with pytest.raises(sanitize.LockOrderError):
+        with b._lb:
+            with a._la:
+                pass
+    # the raw lock must NOT leak when the witness reports
+    assert a._la.raw.acquire(blocking=False)
+    a._la.raw.release()
+
+
+def test_sanitizer_invariant_autorun(sanitizer_on):
+    calls = []
+
+    @sanitize.check_invariants("check", "mutate")
+    class P:
+        def __init__(self):
+            self.v = 0
+
+        def mutate(self):
+            self.v += 1
+
+        def check(self):
+            calls.append(self.v)
+            if self.v > 1:
+                raise AssertionError("invariant broken")
+
+    p = P()
+    p.mutate()
+    assert calls == [1]
+    with pytest.raises(AssertionError):
+        p.mutate()
+
+
+@pytest.mark.skipif(os.environ.get("DLLAMA_SANITIZE", "") not in ("", "0"),
+                    reason="asserts the DISABLED fast path")
+def test_sanitizer_invariant_metadata_only_when_disabled():
+    @sanitize.check_invariants("check", "mutate")
+    class P:
+        def __init__(self):
+            self.n = 0
+
+        def mutate(self):
+            self.n += 1
+
+        def check(self):  # pragma: no cover - must NOT run when disabled
+            raise AssertionError("ran while disabled")
+
+    p = P()
+    p.mutate()
+    assert p.n == 1
+    assert P.__invariant_check__ == ("check", ("mutate",))
+
+
+def test_sanitizer_condition_still_works(sanitizer_on):
+    # AdmissionGate pairs a Condition with the guarded lock: the witness
+    # delegates to the raw lock, so wait/notify stay correct
+    @sanitize.guarded_by("_lock", "_n")
+    class G:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._cv = threading.Condition(self._lock)
+            self._n = 0
+
+        def bump(self):
+            with self._lock:
+                self._n += 1
+                self._cv.notify_all()
+
+        def wait_for_one(self, timeout):
+            with self._lock:
+                return self._cv.wait_for(lambda: self._n > 0,
+                                         timeout=timeout)
+
+    g = G()
+    t = threading.Thread(target=g.bump)
+    t.start()
+    assert g.wait_for_one(5.0)
+    t.join()
+
+
+def test_sanitized_real_classes_roundtrip(sanitizer_on):
+    # guarded_by-decorated production classes were instrumented at import
+    # (or not, if the env was off) — but fresh fixture instances built via
+    # the public decorator must behave identically to the originals
+    @sanitize.guarded_by("_lock", "_inflight")
+    class MiniGate:
+        def __init__(self, cap):
+            self.cap = cap
+            self._lock = threading.Lock()
+            self._inflight = 0
+
+        def acquire(self):
+            with self._lock:
+                if self._inflight >= self.cap:
+                    raise RuntimeError("full")
+                self._inflight += 1
+
+        def release(self):
+            with self._lock:
+                self._inflight -= 1
+
+    g = MiniGate(1)
+    g.acquire()
+    with pytest.raises(RuntimeError):
+        g.acquire()
+    g.release()
+    g.acquire()
+    g.release()
