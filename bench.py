@@ -1070,6 +1070,393 @@ def _backend_alive(timeout_s: int = 180) -> tuple:
                       "BK_OK", timeout_s)
 
 
+def run_router_bench(n: int) -> dict:
+    """BENCH_ROUTER=N: fleet front-door replay, jax-free IN THIS PROCESS
+    (the replicas are `cli serve` subprocesses pinned to CPU). Four phases
+    against a 2-replica fleet of the smoke shape:
+
+      solo      N staggered chat requests through a router over ONE replica
+      fleet     the same workload through a router over both — aggregate
+                req/s must beat solo (gate enforced only on multi-core
+                hosts: a 1-CPU runner timeshares the replicas, recorded as
+                gate_fleet_enforced=false)
+      affinity  two-turn conversations: warm-turn TTFT under prefix
+                affinity (second turn lands where the radix-cache pages
+                are hot) vs the EXPECTED VALUE of uniform-random routing
+                over 2 replicas (half the warm turns deliberately land on
+                the cold replica) — affinity p50 must win; the baseline
+                even skips the router hop, so the comparison is
+                conservative
+      failover  SIGKILL one replica mid-replay: every request must
+                resolve. Requests already in flight on the dead replica
+                may error (reported as inflight_errors; buffered responses
+                actually re-dispatch, so usually zero) but anything
+                started AFTER the kill must come back 200 via the
+                surviving replica. Zero dropped non-inflight requests.
+
+    BENCH_ROUTER_OUT writes the full report JSON for CI artifacts. The
+    final metric line is fleet req/s with vs_baseline = fleet/solo."""
+    import http.client
+    import shutil
+    import socket
+    import tempfile
+    import threading
+
+    import numpy as np
+
+    from dllama_tpu.formats.spec import ArchType, ModelSpec
+    from dllama_tpu.formats.tokenizer_file import (TokenizerData,
+                                                   write_tokenizer)
+    from dllama_tpu.formats.weights import tensor_plan, write_model
+    from dllama_tpu.quants import blocks
+    from dllama_tpu.serving import fleet as fleet_mod
+    from dllama_tpu.serving import router as router_mod
+
+    n_req = max(6, min(n, 32))
+    k_conv = 8
+    tmp = tempfile.mkdtemp(prefix="bench_router_")
+    # a deeper/longer-context cousin of the BENCH_PREFIX smoke shape: the
+    # affinity phase needs a ~700-token shared prefix whose prefill COST
+    # dominates the router hop (+~0.5 ms), or warm-vs-cold TTFT drowns in
+    # HTTP noise — yet small enough that a 2-CPU-replica fleet fits CI
+    spec = ModelSpec(arch=ArchType.LLAMA, dim=256, hidden_dim=512,
+                     n_layers=6, n_heads=8, n_kv_heads=4, vocab_size=512,
+                     seq_len=1024, weights_float_type=blocks.Q40)
+    rng = np.random.default_rng(0)
+    model, tok = os.path.join(tmp, "m.m"), os.path.join(tmp, "t.t")
+    write_model(model, spec,
+                {e.name: 0.05 * rng.standard_normal(e.d * e.n).astype(
+                    np.float32) for e in tensor_plan(spec)})
+    vocab = ([b"<unk>", b"<s>", b"</s>"] + [bytes([i]) for i in range(256)]
+             + [b"hi"] * (512 - 259))
+    write_tokenizer(tok, TokenizerData(vocab=vocab, scores=[0.0] * 512,
+                                       bos_id=1, eos_id=2))
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("JAX_PLATFORM_NAME", None)
+    # CPU children must not register the axon TPU plugin (single-session
+    # tunnel: a second registrant blocks at interpreter start)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+
+    def _free_base(span: int) -> int:
+        """A base port with `span` consecutive free ports above it."""
+        for _ in range(64):
+            with socket.socket() as s:
+                s.bind(("127.0.0.1", 0))
+                base = s.getsockname()[1]
+            if base + span > 65500:
+                continue
+            try:
+                for i in range(1, span):
+                    with socket.socket() as t:
+                        t.bind(("127.0.0.1", base + i))
+                return base
+            except OSError:
+                continue
+        raise RuntimeError("no free port span for the replica fleet")
+
+    fl = fleet_mod.Fleet(
+        model, tok, n_replicas=2, base_port=_free_base(2), host="127.0.0.1",
+        # --tp 1: CI lanes force 8 virtual CPU devices via XLA_FLAGS and
+        # the smoke shape's 4 kv heads can't shard 8 ways; --kv-pages
+        # turns on the radix prefix cache the affinity phase measures; the
+        # 40 ms window makes request+companion pairing reliable (the
+        # scheduler routes singleton windows to the solo path, which
+        # bypasses the paged radix cache)
+        # --batch-chunk 2: content bursts every 2 decode steps, so TTFT
+        # reflects PREFILL (what affinity saves) instead of a full fused
+        # chunk; --prefill-chunk 256 keeps the cold ~800-token prefill a
+        # handful of scheduler ticks and the warm aliased tail a single one
+        replica_args=["--batch-window", "40", "--batch-max", "4",
+                      "--batch-chunk", "2", "--prefill-chunk", "256",
+                      "--kv-pages", "16", "--tp", "1"],
+        log_dir=os.path.join(tmp, "logs"), env=env)
+    rep_ports = [r.port for r in fl.replicas]
+    routers = []  # (state, server) for teardown
+
+    def _mk_router(reps):
+        st = router_mod.RouterState(
+            [router_mod.Replica("127.0.0.1", p) for p in reps],
+            probe_interval_s=0.5, affinity_block=64)
+        st.probe_once()
+        srv = router_mod.create_router_server(st, "127.0.0.1", 0)
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        st.start_probes()
+        routers.append((st, srv))
+        return srv.server_address[1]
+
+    def _msgs(i, tag, turns=1):
+        # ~700-char system prompt (byte-fallback tokenizer: ~1 token/char):
+        # covers the 64-byte affinity block and ~44 replica KV pages, so a
+        # warm second turn skips a prefill the stopwatch can actually see
+        sys_p = (f"[{tag}-{i}] You are a terse operations assistant. "
+                 + "Answer in one word. Never apologize, never elaborate, "
+                   "never repeat the question back to the user. " * 6)
+        msgs = [{"role": "system", "content": sys_p},
+                {"role": "user", "content": f"first question for {tag}{i}"}]
+        if turns > 1:
+            msgs += [{"role": "assistant", "content": "ok"},
+                     {"role": "user",
+                      "content": f"second question for {tag}{i}"}]
+        return msgs
+
+    def _chat(port, messages, stream=False, timeout=120.0):
+        """-> (status, total_ms, ttft_ms-or-None). TTFT = first CONTENT
+        delta arriving at this client — the server emits its role-preamble
+        chunk at admission, BEFORE prefill, so `data:` alone lands ~2 ms
+        after connect regardless of prompt length."""
+        body = json.dumps({"model": "bench", "messages": messages,
+                           "max_tokens": 8, "temperature": 0.0,
+                           "stream": stream}).encode()
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+        t0 = time.perf_counter()
+        try:
+            conn.request("POST", "/v1/chat/completions", body,
+                         headers={"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            ttft = None
+            if stream and resp.status == 200:
+                buf = b""
+                while b'"content"' not in buf:
+                    chunk = resp.read1(65536)
+                    if not chunk:
+                        break
+                    buf += chunk
+                ttft = (time.perf_counter() - t0) * 1000.0
+            resp.read()
+            return resp.status, (time.perf_counter() - t0) * 1000.0, ttft
+        finally:
+            conn.close()
+
+    def _replay(port, tag, count, stagger_s=0.05):
+        """Staggered-arrival replay -> (req/s, n_ok)."""
+        results = [None] * count
+
+        def _one(i):
+            try:
+                status, ms, _ = _chat(port, _msgs(i, tag))
+                results[i] = status
+            except Exception:  # noqa: BLE001 — a reset mid-response counts as a drop
+                results[i] = -1
+        threads = []
+        t0 = time.perf_counter()
+        for i in range(count):
+            th = threading.Thread(target=_one, args=(i,), daemon=True)
+            th.start()
+            threads.append(th)
+            time.sleep(stagger_s)
+        for th in threads:
+            th.join(timeout=240.0)
+        wall = time.perf_counter() - t0
+        return count / wall, sum(1 for r in results if r == 200)
+
+    gates = []
+    try:
+        log(f"router bench: booting 2-replica CPU fleet "
+            f"(ports {rep_ports})...")
+        t0 = time.perf_counter()
+        fl.start()
+        if not fl.wait_ready(timeout_s=300.0):
+            raise RuntimeError("fleet replicas never became ready")
+        log(f"fleet ready in {time.perf_counter() - t0:.1f}s")
+        solo_port = _mk_router(rep_ports[:1])
+        fleet_port = _mk_router(rep_ports)
+
+        # -- throughput: solo vs fleet under the SAME staggered arrivals
+        rps_solo, ok_solo = _replay(solo_port, "solo", n_req)
+        log(f"solo: {rps_solo:.2f} req/s ({ok_solo}/{n_req} ok)")
+        rps_fleet, ok_fleet = _replay(fleet_port, "fleet", n_req)
+        log(f"fleet-of-2: {rps_fleet:.2f} req/s ({ok_fleet}/{n_req} ok)")
+        gate_fleet = (os.cpu_count() or 1) >= 2
+        if ok_solo != n_req or ok_fleet != n_req:
+            gates.append(f"throughput replay dropped requests: "
+                         f"solo {ok_solo}/{n_req}, fleet {ok_fleet}/{n_req}")
+        if gate_fleet and rps_fleet <= rps_solo:
+            gates.append(f"fleet {rps_fleet:.2f} req/s did not beat solo "
+                         f"{rps_solo:.2f} on a {os.cpu_count()}-core host")
+
+        # -- affinity: warm-turn TTFT, routed vs expected-uniform-random.
+        # Every measured request ships with a concurrent cheap companion:
+        # a singleton admission window takes the solo path, which bypasses
+        # the paged radix cache entirely — only a window of >=2 rows runs
+        # the continuous (paged) path where the seed's prompt pages get
+        # published and the warm turn aliases them. Seed and warm run back
+        # to back per conversation so LRU pressure can't evict the pages
+        # in between.
+        co_seq = [0]
+
+        def _with_companion(port, msgs, stream=False, co_ports=None):
+            # co_ports: where the companions go. A routed request's landing
+            # replica is the router's choice, so router-phase callers pass
+            # BOTH replica ports — the one the request hits gets a window
+            # partner, the other digests a lone ping on the solo path
+            dones = []
+            for cp in (co_ports or [port]):
+                co_seq[0] += 1
+                done = threading.Event()
+                dones.append(done)
+
+                def _co(seq, cport, ev):
+                    try:
+                        _chat(cport, [{"role": "user",
+                                       "content": f"companion ping {seq}"}])
+                    except Exception:  # noqa: BLE001 — best-effort
+                        pass
+                    finally:
+                        ev.set()
+                threading.Thread(target=_co, args=(co_seq[0], cp, done),
+                                 daemon=True).start()
+            out = _chat(port, msgs, stream=stream)
+            for done in dones:
+                done.wait(timeout=120.0)
+            return out
+
+        # compile warm-up: the first long-prompt prefill piece and the
+        # batch>=2 decode groups each compile once per replica — pay that
+        # on a throwaway conversation so neither measured phase eats it
+        for p in rep_ports:
+            _with_companion(p, _msgs(99, "wup"))
+            _with_companion(p, _msgs(99, "wup", turns=2), stream=True)
+
+        aff_ttfts, uni_ttfts = [], []
+        for i in range(k_conv):
+            st, _, _ = _with_companion(fleet_port, _msgs(i, "aff"),
+                                       co_ports=rep_ports)
+            if st != 200:
+                raise RuntimeError(f"affinity seed {i} got {st}")
+            st, _, ttft = _with_companion(
+                fleet_port, _msgs(i, "aff", turns=2), stream=True,
+                co_ports=rep_ports)
+            if st != 200 or ttft is None:
+                raise RuntimeError(f"affinity warm turn {i} got {st}")
+            aff_ttfts.append(ttft)
+        for i in range(k_conv):
+            # co_ports=rep_ports here too: BOTH phases pay the same lone
+            # companion on the other replica, so the 1-CPU host's
+            # timesharing penalty cancels out of the comparison
+            st, _, _ = _with_companion(rep_ports[i % 2], _msgs(i, "uni"),
+                                       co_ports=rep_ports)
+            if st != 200:
+                raise RuntimeError(f"uniform seed {i} got {st}")
+            # half hit the seeded replica, half the other one: the
+            # deterministic expected value of coin-flip routing
+            hit = i < k_conv // 2
+            port_i = rep_ports[i % 2 if hit else (i + 1) % 2]
+            st, _, ttft = _with_companion(
+                port_i, _msgs(i, "uni", turns=2), stream=True,
+                co_ports=rep_ports)
+            if st != 200 or ttft is None:
+                raise RuntimeError(f"uniform warm turn {i} got {st}")
+            uni_ttfts.append(ttft)
+        # diagnostic, not a gate: a nonzero replica hit rate proves the
+        # radix cache (not scheduling noise) produced the TTFT split
+        hit_rates = []
+        for p in rep_ports:
+            try:
+                c = http.client.HTTPConnection("127.0.0.1", p, timeout=5.0)
+                c.request("GET", "/ready")
+                rd = json.loads(c.getresponse().read())
+                c.close()
+                hit_rates.append(round(
+                    float(rd.get("prefix_hit_rate", 0.0)), 4))
+            except (OSError, ValueError):
+                hit_rates.append(None)
+        aff_p50, uni_p50 = _pct(aff_ttfts, 50), _pct(uni_ttfts, 50)
+        log(f"warm-turn TTFT p50: affinity {aff_p50:.1f} ms vs "
+            f"uniform-random {uni_p50:.1f} ms "
+            f"(replica prefix hit rates {hit_rates})")
+        if aff_p50 >= uni_p50:
+            gates.append(f"affinity warm TTFT p50 {aff_p50:.1f} ms is not "
+                         f"below uniform-random {uni_p50:.1f} ms")
+
+        # -- failover: SIGKILL replica 0 mid-replay
+        m = 10
+        results, started = [None] * m, [0.0] * m
+        kill_marker = [None]
+        t0 = time.perf_counter()
+
+        def _one(i):
+            started[i] = time.perf_counter() - t0
+            try:
+                st, _, _ = _chat(fleet_port, _msgs(i, "kill"), timeout=90.0)
+                results[i] = st
+            except Exception:  # noqa: BLE001 — a reset mid-response counts as an error
+                results[i] = -1
+
+        def _kill():
+            time.sleep(0.45)
+            kill_marker[0] = time.perf_counter() - t0
+            fl.replicas[0].proc.kill()
+            log(f"killed replica 0 at t+{kill_marker[0]:.2f}s")
+        threading.Thread(target=_kill, daemon=True).start()
+        threads = []
+        for i in range(m):
+            th = threading.Thread(target=_one, args=(i,), daemon=True)
+            th.start()
+            threads.append(th)
+            time.sleep(0.15)
+        for th in threads:
+            th.join(timeout=180.0)
+        hung = sum(1 for r in results if r is None)
+        kill_t = kill_marker[0] if kill_marker[0] is not None else 0.0
+        post_kill_errors = sum(
+            1 for i, r in enumerate(results)
+            if r != 200 and r is not None and started[i] >= kill_t)
+        inflight_errors = sum(
+            1 for i, r in enumerate(results)
+            if r != 200 and r is not None and started[i] < kill_t)
+        n_ok = sum(1 for r in results if r == 200)
+        log(f"failover: {n_ok}/{m} ok, {inflight_errors} in-flight errors, "
+            f"{post_kill_errors} post-kill errors, {hung} hung")
+        if hung:
+            gates.append(f"{hung} requests never resolved after the kill")
+        if post_kill_errors:
+            gates.append(f"{post_kill_errors} requests started after the "
+                         "kill failed — failover dropped non-inflight work")
+    finally:
+        for st, srv in routers:
+            st.stop_probes()
+            srv.shutdown()
+            srv.server_close()
+        fl.drain(timeout_s=10.0)
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    report = {
+        "requests": n_req, "replicas": 2, "cpu_count": os.cpu_count(),
+        "solo_req_per_s": round(rps_solo, 3),
+        "fleet_req_per_s": round(rps_fleet, 3),
+        "fleet_vs_solo": round(rps_fleet / rps_solo, 3),
+        "gate_fleet_enforced": gate_fleet,
+        "affinity_warm_ttft_p50_ms": round(aff_p50, 3),
+        "uniform_warm_ttft_p50_ms": round(uni_p50, 3),
+        "affinity_warm_ttft_ms": [round(t, 1) for t in aff_ttfts],
+        "uniform_warm_ttft_ms": [round(t, 1) for t in uni_ttfts],
+        "replica_prefix_hit_rates": hit_rates,
+        "failover": {"total": m, "ok": n_ok,
+                     "inflight_errors": inflight_errors,
+                     "post_kill_errors": post_kill_errors, "hung": hung},
+        "gates_failed": gates,
+    }
+    out_path = os.environ.get("BENCH_ROUTER_OUT")
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump(report, f, indent=2)
+        log(f"report written to {out_path}")
+    result = {
+        "metric": "smoke_router_req_per_s",
+        "value": round(rps_fleet, 3),
+        "unit": "req/s",
+        "vs_baseline": round(rps_fleet / rps_solo, 2),
+        "baseline": "same workload through a router over ONE replica",
+        "weights": "q40-router-fleet2",
+        "platform": "cpu-subprocess-fleet",
+        "n_devices": 2,
+    }
+    if gates:
+        result["error"] = "; ".join(gates)
+    return result
+
+
 def main() -> None:
     # metric name for the error path, resolvable without touching jax
     choice = os.environ.get("BENCH_MODEL", "")
@@ -1079,6 +1466,7 @@ def main() -> None:
                  else "faults" if _env_count("BENCH_FAULTS")
                  else "integrity" if _env_count("BENCH_INTEGRITY")
                  else "obs" if _env_count("BENCH_OBS")
+                 else "router" if _env_count("BENCH_ROUTER")
                  else "decode")
     err_metric = {"tiny": "tinyllama_1.1b", "llama3": "llama3_8b",
                   "moe": "mixtral_lite", "grok": "grok1_lite",
@@ -1109,6 +1497,22 @@ def main() -> None:
         timer = threading.Timer(deadline_s, _deadline)
         timer.daemon = True
         timer.start()
+
+    nrouter = _env_count("BENCH_ROUTER")
+    if nrouter:
+        # the router replay is jax-free IN THIS PROCESS (replicas are CPU
+        # subprocesses), so branch before the backend probes: a dead TPU
+        # tunnel must not block a pure-CPU fleet replay
+        try:
+            result = run_router_bench(nrouter)
+        except Exception as e:  # noqa: BLE001 — emit the machine-readable record
+            result = {"metric": err_metric, "value": None, "unit": "req/s",
+                      "vs_baseline": None,
+                      "error": f"{type(e).__name__}: {e}"}
+        if deadline_s > 0:
+            timer.cancel()
+        print(json.dumps(result), flush=True)
+        raise SystemExit(1 if result.get("error") else 0)
 
     if os.environ.get("DLLAMA_PLATFORM"):
         # same escape hatch as the CLI: force the backend via jax.config

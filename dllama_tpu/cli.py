@@ -37,6 +37,73 @@ def build_parser() -> argparse.ArgumentParser:
                     "row-band section the check reads ~1/N of the file's "
                     "bytes; replicated 1-D tensors are always fully "
                     "checked. Run once per host, e.g. --shard 0/4 ... 3/4")
+    def add_router_flags(rp, default_port: int) -> None:
+        # shared by `router` (standalone front door) and `fleet` (router +
+        # local replicas): the routing policy knobs
+        rp.add_argument("--host", default="0.0.0.0")
+        rp.add_argument("--port", type=int, default=default_port,
+                        help="the front-door listen port")
+        rp.add_argument(
+            "--probe-interval", type=float, default=1.0, metavar="S",
+            help="seconds between /ready probe rounds: drain/crash takes a "
+            "replica out of rotation within one interval")
+        rp.add_argument(
+            "--connect-timeout", type=float, default=2.0, metavar="S",
+            help="upstream connect + status-line timeout per hop")
+        rp.add_argument(
+            "--upstream-timeout", type=float, default=0.0, metavar="S",
+            help="upstream response/stream read timeout after the status "
+            "line; 0 = unlimited (long decodes stream for minutes)")
+        rp.add_argument(
+            "--retry-budget", type=int, default=2, metavar="N",
+            help="extra replicas tried after a retriable upstream failure "
+            "(connect error or 503); 429/504 always pass through untouched")
+        rp.add_argument(
+            "--affinity-block", type=int, default=256, metavar="BYTES",
+            help="prompt-prefix affinity hash block size: repeat "
+            "conversations route to the replica whose radix cache holds "
+            "their warm KV pages; 0 disables affinity (pure least-load)")
+
+    # the fleet front door: stdlib-only, no model artifacts, no jax — it
+    # proxies the OpenAI surface across N running `serve` replicas
+    rp = sub.add_parser(
+        "router", help="stateless HTTP front door over N running replicas")
+    rp.add_argument(
+        "--replica", action="append", required=True, metavar="HOST:PORT",
+        help="one upstream dllama-api replica (repeatable)")
+    add_router_flags(rp, default_port=9900)
+
+    # router + N locally spawned/supervised replicas in one command — the
+    # test/bench topology (production runs `serve` per machine + `router`)
+    fp = sub.add_parser(
+        "fleet", help="spawn, supervise and front N local replicas")
+    fp.add_argument("--model", required=True)
+    fp.add_argument("--tokenizer", required=True)
+    fp.add_argument("--replicas", type=int, default=2, metavar="N",
+                    help="replica subprocesses to spawn and supervise")
+    fp.add_argument("--base-port", type=int, default=9990, metavar="P",
+                    help="replica i listens on P+i")
+    fp.add_argument("--replica-host", default="127.0.0.1",
+                    help="interface the replicas bind (loopback: only the "
+                    "router is meant to face traffic)")
+    fp.add_argument(
+        "--replica-arg", action="append", default=[], metavar="'--flag v'",
+        help="extra `serve` flag(s) passed to every replica (repeatable), "
+        "e.g. --replica-arg '--kv-pages 16' --replica-arg '--batch-max 4'")
+    fp.add_argument("--max-restarts", type=int, default=3, metavar="N",
+                    help="per-replica crash-restart budget; a replica past "
+                    "it stays down (the router routes around the hole)")
+    fp.add_argument("--ready-timeout", type=float, default=180.0,
+                    metavar="S", help="max wait for every replica's first "
+                    "/ready 200 (weights load time)")
+    fp.add_argument("--drain-timeout", type=float, default=30.0,
+                    metavar="S", help="SIGTERM grace per drain: replicas "
+                    "finish in-flight work, then the router stops")
+    fp.add_argument("--log-dir", default=None, metavar="DIR",
+                    help="per-replica stdout/stderr logs (replica-N.log); "
+                    "default: inherit this terminal")
+    add_router_flags(fp, default_port=9900)
+
     for mode in ("inference", "generate", "chat", "serve", "worker"):
         sp = sub.add_parser(mode)
         if mode == "serve":  # the dllama-api surface (`src/apps/dllama-api`)
@@ -665,6 +732,19 @@ def main(argv=None) -> None:
     if args.mode == "verify":
         # pure host-side file check: no device, no distributed init
         raise SystemExit(run_verify(args))
+    if args.mode == "router":
+        # stdlib networking only: no device, no distributed init, no jax
+        from dllama_tpu.serving.router import run_router
+
+        run_router(args)
+        return
+    if args.mode == "fleet":
+        # the supervisor itself is jax-free; replicas import jax in their
+        # own subprocesses
+        from dllama_tpu.serving.fleet import run_fleet
+
+        run_fleet(args)
+        return
     maybe_init_distributed(args)
     if args.mode == "chat":
         run_chat(args)

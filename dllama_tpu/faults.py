@@ -19,8 +19,12 @@ Spec grammar (sites separated by ``;``)::
   ``prefill`` / ``prefill_chunk`` (Engine), ``stream`` (the SSE writer),
   ``scheduler`` (top of every server scheduler window — the
   supervisor-restart drill), ``weights_open`` / ``weights_read``
-  (WeightFileReader — the artifact-integrity drills), and ``logits``
-  (every decode dispatch — the numeric-health drill).
+  (WeightFileReader — the artifact-integrity drills), ``logits``
+  (every decode dispatch — the numeric-health drill), and the fleet
+  router's seams ``route_pick`` (every replica-selection decision),
+  ``proxy_upstream`` (every upstream hop — injected failures take the
+  same retry path as real connect errors) and ``probe`` (every /ready
+  health probe — injected failures open the circuit like real ones).
 * ``action`` — ``raise`` (throw :class:`FaultInjected`), ``slow`` (sleep
   ``delay_ms``, default 50), or a *data* action the seam itself interprets:
   ``truncate`` (weights_open: pretend the file is ``drop`` bytes short,
@@ -47,7 +51,7 @@ import time
 
 SITES = ("admit", "step_chunk", "prefill", "prefill_chunk", "prefix_match",
          "page_alloc", "stream", "scheduler", "weights_open", "weights_read",
-         "logits")
+         "logits", "route_pick", "proxy_upstream", "probe")
 ACTIONS = ("raise", "slow", "truncate", "bitflip", "nan")
 
 #: site -> the metric family that proves the site's failure is VISIBLE on
@@ -68,6 +72,12 @@ SITE_METRICS = {
     "weights_open": "dllama_weights_open_failures_total",
     "weights_read": "dllama_weights_checksum_failures_total",
     "logits": "dllama_numeric_quarantines_total",
+    # router seams (serving/router.py): a faulted pick is a 5xx the ingress
+    # counter sees, a faulted upstream hop is an upstream error (and a
+    # retry), a faulted probe is a probe failure that opens the circuit
+    "route_pick": "dllama_router_http_requests_total",
+    "proxy_upstream": "dllama_router_upstream_errors_total",
+    "probe": "dllama_router_probe_failures_total",
 }
 
 

@@ -321,18 +321,26 @@ class Batcher:
         """KV occupancy for /ready and /stats: token reservations, resident
         rows per bucket (slab modes) and — in paged mode — page-pool state
         plus the prefix-cache hit rate. The multi-replica router weighs
-        replicas by exactly this payload."""
+        replicas by exactly this payload, so the load-picture fields it
+        scores on (``kv_pages_free``/``kv_pages_total``/``prefix_hit_rate``)
+        are ALWAYS present — zero in slab modes — and one cheap /ready
+        probe carries the whole picture (/stats stays a superset)."""
         info = {
             "kv_tokens_reserved": self.kv_budget.reserved,
             "kv_tokens_budget": self.kv_budget.total_tokens,
             "kv_rows": {str(k): v for k, v in sorted(
                 self.kv_budget.rows_by_bucket().items()) if v},
+            "kv_pages_free": 0,
+            "kv_pages_total": 0,
+            "prefix_hit_rate": 0.0,
         }
         if self.kv_pages > 0:
             sess = self._active_sess or self._keep_sess
             pages = (sess.page_stats() if sess is not None
                      else self.kv_budget.page_stats())
             info["kv_pages"] = pages
+            info["kv_pages_free"] = pages.get("pages_free", 0)
+            info["kv_pages_total"] = pages.get("pages_total", 0)
             info["prefix_hit_rate"] = pages.get("prefix_hit_rate", 0.0)
         return info
 
@@ -1013,7 +1021,8 @@ class ServerState:
         ready = not self.gate.draining and scheduler_alive
         kv = (batcher.kv_info() if batcher is not None
               else {"kv_tokens_reserved": 0, "kv_tokens_budget": 0,
-                    "kv_rows": {}})
+                    "kv_rows": {}, "kv_pages_free": 0, "kv_pages_total": 0,
+                    "prefix_hit_rate": 0.0})
         return ready, {
             "status": "ready" if ready else "not_ready",
             "draining": self.gate.draining,

@@ -1,0 +1,250 @@
+"""Local fleet supervisor: N ``dllama-api`` replicas + the router, one
+command.
+
+``cli fleet`` spawns N ``cli serve`` subprocesses sharing one model
+artifact on consecutive ports, supervises them (a crashed replica restarts
+under a per-replica budget; the router's probe loop routes around it in
+the meantime), fronts them with the in-process router, and on SIGTERM
+drains the whole topology in order: stop restarting, SIGTERM every replica
+(each drains itself — finishes in-flight work while its /ready flips 503
+and the router stops sending traffic), then stop the router.
+
+This is the test/bench topology — real deployments run ``cli serve`` per
+machine under an orchestrator and ``cli router`` in front — but it is the
+SAME code path: the router cannot tell fleet-spawned replicas from remote
+ones, which is exactly what makes the fleet e2e tests meaningful.
+
+Stdlib-only and jax-free: the replicas import jax in their own processes;
+the supervisor is pure process + socket plumbing.
+"""
+
+from __future__ import annotations
+
+import http.client
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+from dllama_tpu.analysis.sanitize import guarded_by
+from dllama_tpu.serving import router as router_mod
+
+
+class ReplicaProc:
+    """Bookkeeping for one replica subprocess (mutated only by Fleet under
+    Fleet's lock)."""
+
+    def __init__(self, index: int, host: str, port: int, argv: list):
+        self.index = index
+        self.host = host
+        self.port = port
+        self.argv = argv
+        self.proc: subprocess.Popen = None
+        self.restarts = 0
+
+    @property
+    def name(self) -> str:
+        return f"{self.host}:{self.port}"
+
+
+@guarded_by("_lock", "_draining", "_stopped")
+class Fleet:
+    """Spawn + supervise + drain N replica subprocesses.
+
+    The replica list itself is immutable after construction; each
+    ReplicaProc's ``proc``/``restarts`` fields are only touched by
+    :meth:`_spawn`/:meth:`poll_restart`/:meth:`drain`, all serialized by
+    ``_lock`` — the supervision thread and the signal-initiated drain
+    thread race on exactly those."""
+
+    def __init__(self, model: str, tokenizer: str, n_replicas: int = 2,
+                 base_port: int = 9990, host: str = "127.0.0.1",
+                 replica_args: list = (), max_restarts: int = 3,
+                 log_dir: str = None, env: dict = None):
+        self.host = host
+        self.max_restarts = max_restarts
+        self.log_dir = log_dir
+        self.env = dict(env if env is not None else os.environ)
+        self._lock = threading.Lock()
+        self._draining = False
+        self._stopped = threading.Event()
+        self._supervision: threading.Thread = None
+        self.replicas = tuple(
+            ReplicaProc(i, host, base_port + i, [
+                sys.executable, "-m", "dllama_tpu.cli", "serve",
+                "--model", model, "--tokenizer", tokenizer,
+                "--host", host, "--port", str(base_port + i),
+            ] + list(replica_args))
+            for i in range(n_replicas))
+
+    def addresses(self) -> list:
+        return [r.name for r in self.replicas]
+
+    def _open_log(self, r: ReplicaProc):
+        if not self.log_dir:
+            return None  # inherit the supervisor's stderr
+        os.makedirs(self.log_dir, exist_ok=True)
+        return open(os.path.join(self.log_dir,
+                                 f"replica-{r.index}.log"), "ab")
+
+    def _spawn(self, r: ReplicaProc) -> None:
+        """Start (or restart) one replica. Caller holds ``_lock``."""
+        log = self._open_log(r)
+        r.proc = subprocess.Popen(
+            r.argv, env=self.env,
+            stdout=log, stderr=subprocess.STDOUT if log else None,
+            start_new_session=True)  # own process group: a ^C at the
+        #   supervisor's terminal must not SIGINT replicas mid-drain
+        if log is not None:
+            log.close()  # Popen holds its own fd
+
+    def start(self) -> None:
+        with self._lock:
+            for r in self.replicas:
+                self._spawn(r)
+
+    @staticmethod
+    def _probe_ready(host: str, port: int, timeout_s: float = 1.0) -> bool:
+        try:
+            conn = http.client.HTTPConnection(host, port, timeout=timeout_s)
+            try:
+                conn.request("GET", "/ready")
+                return conn.getresponse().status == 200
+            finally:
+                conn.close()
+        except OSError:
+            return False  # not up yet — the caller polls
+
+    def wait_ready(self, timeout_s: float = 180.0) -> bool:
+        """Block until EVERY replica answers /ready 200 (model loaded,
+        scheduler up). A replica process that already exited fails fast —
+        waiting out the full timeout on a crashed replica helps nobody."""
+        deadline = time.monotonic() + timeout_s
+        pending = list(self.replicas)
+        while pending and time.monotonic() < deadline:
+            still = []
+            for r in pending:
+                if r.proc is not None and r.proc.poll() is not None:
+                    raise RuntimeError(
+                        f"replica {r.name} exited with "
+                        f"{r.proc.returncode} before becoming ready")
+                if not self._probe_ready(r.host, r.port):
+                    still.append(r)
+            pending = still
+            if pending:
+                time.sleep(0.25)
+        return not pending
+
+    def poll_restart(self) -> int:
+        """One supervision pass: restart every exited replica still under
+        its restart budget. Returns the number restarted. The router
+        keeps routing around the hole while the restart loads weights."""
+        n = 0
+        with self._lock:
+            if self._draining:
+                return 0  # exits during drain are the POINT, not crashes
+            for r in self.replicas:
+                if r.proc is None or r.proc.poll() is None:
+                    continue
+                if r.restarts >= self.max_restarts:
+                    continue  # crash-looping: leave it down, the probe
+                    #            loop keeps it out of rotation
+                r.restarts += 1
+                print(f"🔁 replica {r.name} exited "
+                      f"({r.proc.returncode}); restart "
+                      f"{r.restarts}/{self.max_restarts}", file=sys.stderr)
+                self._spawn(r)
+                n += 1
+        return n
+
+    def _supervision_loop(self, interval_s: float) -> None:
+        while not self._stopped.is_set():
+            self.poll_restart()
+            self._stopped.wait(interval_s)
+
+    def start_supervision(self, interval_s: float = 1.0) -> None:
+        if self._supervision is not None:
+            return
+        self._supervision = threading.Thread(
+            target=self._supervision_loop, args=(interval_s,),
+            daemon=True, name="dllama-fleet-supervise")
+        self._supervision.start()
+
+    def drain(self, timeout_s: float = 30.0) -> bool:
+        """SIGTERM every replica (each runs its own graceful drain: /ready
+        flips 503, in-flight requests finish) and wait; SIGKILL stragglers
+        at the deadline. Returns True when every replica exited in time."""
+        with self._lock:
+            self._draining = True
+        self._stopped.set()
+        procs = [r.proc for r in self.replicas if r.proc is not None]
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+        deadline = time.monotonic() + timeout_s
+        clean = True
+        for p in procs:
+            left = deadline - time.monotonic()
+            try:
+                p.wait(timeout=max(0.1, left))
+            except subprocess.TimeoutExpired:
+                clean = False
+                p.kill()
+                p.wait()
+        return clean
+
+
+def run_fleet(args) -> None:
+    """``cli fleet``: the whole local topology — N replicas + router —
+    supervised until SIGTERM/SIGINT, then drained in order."""
+    replica_args = []
+    for extra in getattr(args, "replica_arg", None) or []:
+        replica_args.extend(extra.split())
+    fleet = Fleet(
+        args.model, args.tokenizer,
+        n_replicas=args.replicas, base_port=args.base_port,
+        host=args.replica_host, replica_args=replica_args,
+        max_restarts=args.max_restarts, log_dir=args.log_dir)
+    print(f"🚀 spawning {args.replicas} replicas on "
+          f"{args.replica_host}:{args.base_port}..."
+          f"{args.base_port + args.replicas - 1}")
+    fleet.start()
+    try:
+        if not fleet.wait_ready(args.ready_timeout):
+            raise RuntimeError(
+                f"fleet not ready within {args.ready_timeout:.0f}s")
+        fleet.start_supervision()
+        state = router_mod.state_from_args(args, fleet.addresses())
+        state.probe_once()
+        state.start_probes()
+        srv = router_mod.create_router_server(
+            state, host=args.host, port=args.port)
+
+        def _drain(_signum=None, _frame=None):
+            # off the signal frame: drain blocks up to --drain-timeout and
+            # srv.shutdown blocks until serve_forever returns
+            print(f"⛔ draining fleet (up to {args.drain_timeout:.0f}s) ...",
+                  file=sys.stderr)
+
+            def _run():
+                fleet.drain(args.drain_timeout)
+                state.stop_probes()
+                srv.shutdown()
+
+            threading.Thread(target=_run, daemon=True,
+                             name="dllama-fleet-drain").start()
+
+        try:
+            signal.signal(signal.SIGTERM, _drain)
+            signal.signal(signal.SIGINT, _drain)
+        except ValueError:
+            pass  # not the main thread (embedded/test use): no signal hook
+        print(f"🛰️  fleet front door on {args.host}:{args.port} -> "
+              f"{', '.join(fleet.addresses())}")
+        srv.serve_forever()
+    finally:
+        # belt over braces: serve_forever exits via drain in the normal
+        # path, but a startup failure must never orphan replica processes
+        fleet.drain(timeout_s=min(5.0, args.drain_timeout))

@@ -1,0 +1,794 @@
+"""Fleet front door: a stateless HTTP router over N ``dllama-api`` replicas.
+
+Every lifecycle/serving PR so far (429/503/504 semantics, /health vs /ready,
+SIGTERM drain, X-Request-Id, the radix prefix cache) was designed so a fleet
+of identical replicas could sit behind a load balancer; this module IS that
+balancer, stdlib-only like the rest of serving/. It proxies the OpenAI
+surface (``/v1/chat/completions`` incl. SSE streaming passthrough,
+``/v1/models``) and keeps no request state of its own — kill the router,
+restart it, and the fleet picture rebuilds from one probe round.
+
+Routing policy, in order:
+
+* **prefix affinity** — multi-turn traffic should land where its KV pages
+  are warm. The router has no tokenizer, so affinity keys on the canonical
+  *byte* stream of the messages array, hashed in cumulative block-aligned
+  prefixes (``--affinity-block`` bytes per block): turn N+1 carries turn N's
+  rendered conversation as a byte prefix, so its longest matching block
+  hash points at the replica whose radix cache already holds those pages.
+  A saturated affinity target (slots full AND queue backed up) falls back
+  to least-load — a warm cache never justifies queueing behind it.
+* **weighted least-load** — scored from the occupancy/queue-depth/kv-page
+  fields each replica publishes on ``/ready`` (one cheap probe carries the
+  whole picture), plus the router's own live in-flight count per replica
+  (the probe snapshot is up to a probe interval stale; in-flight is not).
+* **failover** — connect-phase failures and 503s (a draining or
+  mid-restart replica) retry on the next-best replica under
+  ``--retry-budget``; 429 (fleet at capacity) and 504 (deadline) pass
+  through untouched — retrying those would amplify overload or burn a
+  client's remaining deadline. Once bytes have streamed to the client,
+  nothing retries.
+
+Replica health is judged twice: an active ``/ready`` probe loop (drain
+flips a replica out of rotation within one probe interval) and passive
+circuit-breaking on data-path connect errors (exponential backoff, closed
+again by the next successful probe). Either alone has a blind spot — the
+probe is periodic, the data path only sees replicas it already picked.
+
+The router serves its own ``/health``, ``/ready``, ``/metrics`` and
+``/stats`` (aggregating per-replica state) and generates/propagates
+``X-Request-Id`` across the hop so a trace correlates end-to-end. Fault
+seams ``route_pick``, ``proxy_upstream`` and ``probe`` are wired through
+``faults.SITES``; injected failures take the same retry/circuit paths as
+real ones.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import http.client
+import json
+import threading
+import time
+from collections import OrderedDict
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from dllama_tpu import faults, observability
+from dllama_tpu.analysis.sanitize import guarded_by
+from dllama_tpu.serving.lifecycle import LifecycleError, Supervisor
+
+#: longest prompt prefix the affinity index keys on, in blocks — bounds the
+#: per-request hashing work and the index growth per conversation
+MAX_AFFINITY_BLOCKS = 64
+
+#: least-load score weights: queue depth outranks occupancy (queued work is
+#: guaranteed wait; occupied slots may finish any chunk), kv-page pressure
+#: is a tiebreaker between equally-busy replicas, and the router's own
+#: in-flight count breaks ties between idle replicas *within* one probe
+#: interval (it is the only live signal between probes)
+W_OCCUPANCY = 1.0
+W_QUEUE = 2.0
+W_KV = 0.5
+W_INFLIGHT = 0.25
+
+
+class NoReplicaAvailable(LifecycleError):
+    """No routable replica (all draining, dead, or circuit-open): HTTP 503.
+
+    Carries Retry-After like the in-replica lifecycle rejections — the
+    client should back off for roughly one probe interval, after which a
+    restarted/undrained replica would be back in rotation."""
+
+    http_status = 503
+
+    def __init__(self, n_replicas: int, n_excluded: int,
+                 retry_after_s: float = 1.0):
+        super().__init__(
+            f"no replica available ({n_replicas} configured, "
+            f"{n_excluded} already tried this request)")
+        self.retry_after_s = retry_after_s
+
+
+def canonical_prompt_bytes(messages: list) -> bytes:
+    """The affinity hash input: role/content pairs framed with separator
+    bytes that cannot appear in JSON string content. Deliberately NOT the
+    rendered chat template: the router is template-agnostic, and any stable
+    injective encoding works — turn N+1's encoding extends turn N's."""
+    parts = []
+    for m in messages:
+        if not isinstance(m, dict):
+            continue
+        content = m.get("content", "")
+        if not isinstance(content, str):
+            # multi-part content arrays hash as their canonical JSON
+            content = json.dumps(content, sort_keys=True)
+        parts.append(str(m.get("role", "")).encode("utf-8", "replace")
+                     + b"\x1f" + content.encode("utf-8", "replace") + b"\x1e")
+    return b"".join(parts)
+
+
+def prefix_hashes(messages: list, block: int) -> list:
+    """Cumulative sha256 of each block-aligned prefix of the canonical
+    prompt bytes, shortest first. Hash i covers bytes [0, (i+1)*block) —
+    cumulative, so two conversations sharing hash i share the whole
+    prefix, exactly the property the replica-side radix cache exploits."""
+    if block <= 0:
+        return []
+    data = canonical_prompt_bytes(messages)
+    n_blocks = min(len(data) // block, MAX_AFFINITY_BLOCKS)
+    h = hashlib.sha256()
+    out = []
+    for i in range(n_blocks):
+        h.update(data[i * block:(i + 1) * block])
+        out.append(h.hexdigest())
+    return out
+
+
+def load_score(snap: dict) -> float:
+    """Weighted least-load score for one replica snapshot (lower = better).
+    Every term is normalized by the replica's slot count so heterogeneous
+    fleets (different --batch-max) compare fairly."""
+    load = snap.get("load") or {}
+    total = load.get("slots_total", 0) or 1
+    occ = load.get("slots_occupied", 0) / total
+    queue = load.get("queue_depth", 0) / total
+    kv_total = load.get("kv_pages_total", 0)
+    kv = (1.0 - load.get("kv_pages_free", 0) / kv_total) if kv_total else 0.0
+    inflight = snap.get("inflight", 0) / total
+    return (W_OCCUPANCY * occ + W_QUEUE * queue + W_KV * kv
+            + W_INFLIGHT * inflight)
+
+
+def saturated(snap: dict) -> bool:
+    """Is this replica's warm cache worth queueing for? No: a full slot
+    pool WITH a backlog means affinity would trade TTFT-queue-time for
+    prefill-time — strictly worse once the queue is nonempty."""
+    load = snap.get("load") or {}
+    total = load.get("slots_total", 0)
+    return (total > 0 and load.get("slots_occupied", 0) >= total
+            and load.get("queue_depth", 0) > 0)
+
+
+@guarded_by("_lock", "_ready", "_info", "_failures", "_circuit_until",
+            "_inflight", "_probed_at")
+class Replica:
+    """One upstream ``dllama-api`` process as the router sees it: the last
+    probe verdict + load snapshot, the passive circuit breaker, and the
+    router-side in-flight count. All mutable state lives behind ``_lock``;
+    readers take :meth:`snapshot` — no caller ever holds two replica locks,
+    so the lock graph stays acyclic by construction."""
+
+    def __init__(self, host: str, port: int, circuit_base_s: float = 0.25,
+                 circuit_max_s: float = 5.0):
+        self.host = host
+        self.port = port
+        self.name = f"{host}:{port}"
+        self.circuit_base_s = circuit_base_s
+        self.circuit_max_s = circuit_max_s
+        self._lock = threading.Lock()
+        # optimistic until the first probe: a just-configured replica takes
+        # traffic immediately, and a dead one trips the passive breaker on
+        # its first connect error anyway
+        self._ready = True
+        self._info: dict = {}
+        self._failures = 0
+        self._circuit_until = 0.0
+        self._inflight = 0
+        self._probed_at = 0.0
+
+    def mark_probe(self, ready: bool, info: dict | None) -> None:
+        """Record one active-probe verdict. A ready probe also closes the
+        passive circuit: the replica answered /ready, so connect errors
+        that opened the breaker are behind us."""
+        with self._lock:
+            self._ready = ready
+            self._probed_at = time.monotonic()
+            if info is not None:
+                self._info = info
+            if ready:
+                self._failures = 0
+                self._circuit_until = 0.0
+
+    def mark_conn_failure(self) -> None:
+        """Passive circuit breaker: a data-path connect failure opens the
+        circuit with exponential backoff so one dead replica costs each
+        request at most one connect attempt per backoff window."""
+        with self._lock:
+            self._failures += 1
+            backoff = min(self.circuit_max_s,
+                          self.circuit_base_s * (2 ** (self._failures - 1)))
+            self._circuit_until = time.monotonic() + backoff
+
+    def mark_conn_success(self) -> None:
+        with self._lock:
+            self._failures = 0
+            self._circuit_until = 0.0
+
+    def mark_unready(self) -> None:
+        """Passive drain detection: the data path got a 503, so stop
+        routing here now instead of waiting out the probe interval."""
+        with self._lock:
+            self._ready = False
+
+    def begin(self) -> None:
+        with self._lock:
+            self._inflight += 1
+
+    def end(self) -> None:
+        with self._lock:
+            self._inflight = max(0, self._inflight - 1)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "name": self.name,
+                "ready": self._ready,
+                "circuit_open": time.monotonic() < self._circuit_until,
+                "consecutive_failures": self._failures,
+                "inflight": self._inflight,
+                "probed_age_s": (round(time.monotonic() - self._probed_at, 3)
+                                 if self._probed_at else None),
+                "load": dict(self._info),
+            }
+
+
+@guarded_by("_lock", "_map")
+class AffinityIndex:
+    """Bounded LRU map from cumulative prefix hash -> replica name. One
+    entry per block of every routed conversation, evicted least-recently
+    -used; capacity bounds router memory regardless of traffic shape."""
+
+    def __init__(self, capacity: int = 4096):
+        self.capacity = capacity
+        self._lock = threading.Lock()
+        self._map: OrderedDict = OrderedDict()
+
+    def lookup(self, hashes: list):
+        """The replica that served the LONGEST matching block prefix (the
+        most warm pages), or None. Touches the hit for LRU recency."""
+        with self._lock:
+            for h in reversed(hashes):
+                name = self._map.get(h)
+                if name is not None:
+                    self._map.move_to_end(h)
+                    return name
+        return None
+
+    def record(self, hashes: list, name: str) -> None:
+        """Point every block prefix of a successfully routed conversation
+        at the replica that now holds its pages (last writer wins: after a
+        failover the NEW replica is the warm one)."""
+        with self._lock:
+            for h in hashes:
+                self._map[h] = name
+                self._map.move_to_end(h)
+            while len(self._map) > self.capacity:
+                self._map.popitem(last=False)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._map)
+
+
+class RouterState:
+    """Config + fleet picture + metrics for one router process. The
+    replica list is immutable after construction (drain/death is a probe
+    verdict on a Replica, never a list edit), so readers iterate it
+    without a lock; all mutable state lives inside Replica/AffinityIndex/
+    MetricsRegistry, each behind its own lock."""
+
+    def __init__(self, replicas: list, retry_budget: int = 2,
+                 probe_interval_s: float = 1.0,
+                 connect_timeout_s: float = 2.0,
+                 upstream_timeout_s: float = 0.0,
+                 affinity_block: int = 256,
+                 affinity_capacity: int = 4096,
+                 metrics=None):
+        self.replicas = tuple(replicas)
+        self.retry_budget = retry_budget
+        self.probe_interval_s = probe_interval_s
+        self.connect_timeout_s = connect_timeout_s
+        self.upstream_timeout_s = upstream_timeout_s
+        self.affinity_block = affinity_block
+        self.affinity = AffinityIndex(affinity_capacity)
+        self.started_at = time.time()
+        # a fresh registry per router (not the process default): in-process
+        # tests run several routers side by side, and the router's series
+        # must never mix with an in-process replica's engine series
+        self.metrics = (metrics if metrics is not None
+                        else observability.MetricsRegistry())
+        reg = self.metrics
+        self._m_http = reg.counter(
+            "dllama_router_http_requests_total",
+            "Router HTTP responses written, by route and status code",
+            ("route", "code"))
+        self._m_picks = reg.counter(
+            "dllama_router_picks_total",
+            "Replica-selection decisions, by policy that made the call",
+            ("reason",))
+        self._m_retries = reg.counter(
+            "dllama_router_retries_total",
+            "Requests re-dispatched to another replica after a retriable "
+            "upstream failure (connect error or 503)")
+        self._m_upstream_errors = reg.counter(
+            "dllama_router_upstream_errors_total",
+            "Upstream hops that failed before a usable response",
+            ("replica",))
+        self._m_probe_failures = reg.counter(
+            "dllama_router_probe_failures_total",
+            "Active /ready probes that errored (connect/parse/injected)",
+            ("replica",))
+        self._m_client_disconnects = reg.counter(
+            "dllama_router_client_disconnects_total",
+            "Streaming clients that vanished mid-SSE (the upstream replica "
+            "connection is closed immediately so its cancel-on-disconnect "
+            "fires within one chunk)")
+        self._m_replicas_ready = reg.gauge(
+            "dllama_router_replicas_ready",
+            "Replicas currently in rotation (ready and circuit closed)")
+        self._m_ttfb = reg.histogram(
+            "dllama_router_upstream_ttfb_ms",
+            "Upstream time-to-first-byte (connect + status line) per hop")
+        self._probe_supervisor = None
+        self._probe_stop = threading.Event()
+
+    # -- routing ----------------------------------------------------------
+
+    def pick(self, hashes: list, exclude=frozenset()):
+        """Choose the replica for one dispatch attempt: (replica, reason).
+
+        Fires the ``route_pick`` seam (an injected fault here surfaces as
+        a 5xx the ingress counter sees). Affinity wins when its target is
+        routable and unsaturated; otherwise weighted least-load over every
+        routable replica not already tried this request."""
+        faults.fire("route_pick")
+        candidates = []
+        for r in self.replicas:
+            if r.name in exclude:
+                continue
+            s = r.snapshot()
+            if s["ready"] and not s["circuit_open"]:
+                candidates.append((r, s))
+        if not candidates:
+            raise NoReplicaAvailable(len(self.replicas), len(exclude),
+                                     retry_after_s=max(
+                                         1.0, self.probe_interval_s))
+        reason = "least_load"
+        if hashes:
+            target = self.affinity.lookup(hashes)
+            if target is not None:
+                for r, s in candidates:
+                    if r.name != target:
+                        continue
+                    if not saturated(s):
+                        self._m_picks.inc(reason="affinity")
+                        return r, "affinity"
+                    reason = "affinity_fallback"
+                    break
+        r, _ = min(candidates, key=lambda rs: load_score(rs[1]))
+        self._m_picks.inc(reason=reason)
+        return r, reason
+
+    # -- probing ----------------------------------------------------------
+
+    def probe_replica(self, r: Replica) -> bool:
+        """One active /ready probe. Fires the ``probe`` seam; any failure
+        (connect, timeout, unparseable body, injected) is a DOWN verdict
+        that takes the replica out of rotation until a probe succeeds."""
+        try:
+            faults.fire("probe")
+            conn = http.client.HTTPConnection(
+                r.host, r.port, timeout=self.connect_timeout_s)
+            try:
+                conn.request("GET", "/ready",
+                             headers={"X-Request-Id":
+                                      observability.new_request_id()})
+                resp = conn.getresponse()
+                body = resp.read()
+            finally:
+                conn.close()
+            info = json.loads(body) if body else {}
+            if not isinstance(info, dict):
+                raise ValueError("non-object /ready body")
+            ready = resp.status == 200
+            r.mark_probe(ready, info)
+            return ready
+        except (OSError, ValueError, faults.FaultInjected):
+            # an unreachable/garbled probe IS the health signal, not an
+            # error to propagate: record DOWN and keep the loop alive
+            r.mark_probe(False, None)
+            self._m_probe_failures.inc(replica=r.name)
+            return False
+
+    def probe_once(self) -> int:
+        """Probe the whole fleet; returns (and gauges) the in-rotation
+        count."""
+        n_ready = 0
+        for r in self.replicas:
+            if self.probe_replica(r):
+                n_ready += 1
+        self._m_replicas_ready.set(float(n_ready))
+        return n_ready
+
+    def _probe_loop(self) -> None:
+        while not self._probe_stop.is_set():
+            self.probe_once()
+            self._probe_stop.wait(self.probe_interval_s)
+
+    def start_probes(self) -> None:
+        """Start the background probe loop (idempotent), supervised the
+        same way the replica scheduler is: a crashed loop restarts rather
+        than silently freezing the fleet picture at its last snapshot."""
+        if self._probe_supervisor is not None:
+            return
+        self._probe_supervisor = Supervisor(
+            self._probe_loop,
+            on_crash=lambda exc: None,  # state is probe-local; next round
+            name="dllama-router-probe")  # rebuilds it from scratch
+        self._probe_supervisor.start()
+
+    def stop_probes(self) -> None:
+        self._probe_stop.set()
+        if self._probe_supervisor is not None:
+            self._probe_supervisor.stop()
+
+    # -- views ------------------------------------------------------------
+
+    def readiness(self) -> tuple:
+        """(ready, info) for the router's own /ready: ready iff at least
+        one replica is in rotation. The info aggregates the fleet load
+        picture so one curl answers 'can you take traffic, and how much'."""
+        snaps = [r.snapshot() for r in self.replicas]
+        routable = [s for s in snaps
+                    if s["ready"] and not s["circuit_open"]]
+        agg = {
+            "slots_occupied": 0, "slots_total": 0, "queue_depth": 0,
+            "kv_pages_free": 0, "kv_pages_total": 0,
+        }
+        for s in routable:
+            load = s.get("load") or {}
+            for k in agg:
+                agg[k] += load.get(k, 0)
+        return len(routable) > 0, {
+            "status": "ready" if routable else "not_ready",
+            "replicas_total": len(snaps),
+            "replicas_ready": len(routable),
+            "fleet": agg,
+            "replicas": snaps,
+        }
+
+    def stats(self) -> dict:
+        ready, info = self.readiness()
+        return {
+            "role": "router",
+            "uptime_s": round(time.time() - self.started_at, 1),
+            "ready": ready,
+            "affinity_entries": len(self.affinity),
+            "load": info,
+            "metrics": self.metrics.snapshot(),
+        }
+
+
+class RouterHandler(BaseHTTPRequestHandler):
+    """The front-door HTTP surface. Local routes (/health /ready /metrics
+    /stats) answer from RouterState; everything else on the OpenAI surface
+    proxies to a picked replica with failover. Every response — local,
+    proxied, or error — echoes X-Request-Id, and the same id travels on
+    the upstream hop so one grep correlates router and replica traces."""
+
+    protocol_version = "HTTP/1.1"
+    state: RouterState = None  # set by create_router_server
+
+    def log_message(self, fmt, *args):  # quiet; the CLI prints its own lines
+        pass
+
+    _KNOWN_ROUTES = ("/v1/chat/completions", "/chat/completions",
+                     "/v1/models", "/health", "/healthz", "/ready",
+                     "/metrics", "/stats")
+
+    def _route(self) -> str:
+        p = self.path.split("?", 1)[0]
+        return p if p in self._KNOWN_ROUTES else "other"
+
+    def _begin_request(self) -> None:
+        self._rid = observability.sanitize_request_id(
+            self.headers.get("X-Request-Id"))
+
+    def _count(self, code: int) -> None:
+        self.state._m_http.inc(route=self._route(), code=str(code))
+
+    def _json(self, code: int, obj: dict, headers: dict = None) -> None:
+        body = json.dumps(obj).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.send_header("X-Request-Id", self._rid)
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
+        self.end_headers()
+        self._count(code)
+        self.wfile.write(body)
+
+    def _error(self, code: int, message: str) -> None:
+        self._json(code, {"error": {"message": message,
+                                    "type": "router_error",
+                                    "request_id": self._rid}})
+
+    def _lifecycle_error(self, e: LifecycleError) -> None:
+        headers = {}
+        if e.retry_after_s is not None:
+            headers["Retry-After"] = str(max(1, int(round(e.retry_after_s))))
+        self._json(e.http_status,
+                   {"error": {"message": str(e), "type": "server_error",
+                              "request_id": self._rid}},
+                   headers=headers)
+
+    # -- local routes -----------------------------------------------------
+
+    def do_GET(self):
+        self._begin_request()
+        st = self.state
+        if self.path in ("/health", "/healthz"):
+            # LIVENESS of the router process itself: 200 whenever it can
+            # answer, even with zero routable replicas (readiness's job)
+            _, info = st.readiness()
+            self._json(200, {"status": "ok", "role": "router",
+                             "replicas_total": info["replicas_total"],
+                             "replicas_ready": info["replicas_ready"]})
+        elif self.path == "/ready":
+            ready, info = st.readiness()
+            self._json(200 if ready else 503, info)
+        elif self.path == "/metrics":
+            body = st.metrics.render().encode()
+            self.send_response(200)
+            self.send_header("Content-Type",
+                             "text/plain; version=0.0.4; charset=utf-8")
+            self.send_header("Content-Length", str(len(body)))
+            self.send_header("X-Request-Id", self._rid)
+            self.end_headers()
+            self._count(200)
+            self.wfile.write(body)
+        elif self.path == "/stats":
+            self._json(200, st.stats())
+        elif self.path == "/v1/models":
+            # model identity is fleet-wide (one model per fleet): proxy to
+            # any routable replica
+            self._proxy("GET", b"", affinity_hashes=[])
+        else:
+            self._error(404, f"unknown path {self.path}")
+
+    def do_POST(self):
+        self._begin_request()
+        if self.path not in ("/v1/chat/completions", "/chat/completions"):
+            self._error(404, f"unknown path {self.path}")
+            return
+        try:
+            length = int(self.headers.get("Content-Length", "0"))
+            body = self.rfile.read(length) if length else b"{}"
+        except (ValueError, OSError) as e:
+            self._error(400, f"bad request body: {e}")
+            return
+        hashes = []
+        if self.state.affinity_block > 0:
+            try:
+                req = json.loads(body or b"{}")
+                hashes = prefix_hashes(req.get("messages") or [],
+                                       self.state.affinity_block)
+            except (ValueError, AttributeError):
+                pass  # unparseable body: let the replica speak the 400;
+                #       affinity simply doesn't apply
+        self._proxy("POST", body, affinity_hashes=hashes)
+
+    # -- the proxy core ---------------------------------------------------
+
+    def _upstream_headers(self) -> dict:
+        h = {"X-Request-Id": self._rid,
+             "Content-Type": self.headers.get("Content-Type",
+                                              "application/json"),
+             "Accept": self.headers.get("Accept", "*/*")}
+        return h
+
+    def _proxy(self, method: str, body: bytes, affinity_hashes: list) -> None:
+        """Dispatch one request with failover.
+
+        Retriable = the hop died before the client received anything — a
+        connect error, an injected proxy_upstream fault, a replica killed
+        mid-BUFFERED-body (nothing was forwarded yet, so re-dispatch is
+        safe) — or a 503 (draining / scheduler mid-restart, no decode work
+        done). 429/504 and every other status pass through untouched: a
+        429 means the fleet is at capacity (retrying amplifies the
+        overload — the client owns the backoff) and a 504 already burned
+        the request's deadline. Nothing retries once response bytes have
+        been forwarded, which for SSE means once the stream began."""
+        st = self.state
+        tried: set = set()
+        last_503 = None  # pass the FINAL 503 through on budget exhaustion
+        attempts = 0
+        while True:
+            try:
+                replica, _reason = st.pick(affinity_hashes, exclude=tried)
+            except NoReplicaAvailable as e:
+                if last_503 is not None:
+                    self._relay_buffered(*last_503)
+                    return
+                self._lifecycle_error(e)
+                return
+            except faults.FaultInjected as e:
+                # an injected route_pick fault is a router bug stand-in:
+                # surfaces as a 500 the ingress counter sees
+                self._error(500, str(e))
+                return
+            tried.add(replica.name)
+            replica.begin()
+            conn = None
+            t0 = time.monotonic()
+            try:
+                try:
+                    faults.fire("proxy_upstream")
+                    conn = http.client.HTTPConnection(
+                        replica.host, replica.port,
+                        timeout=st.connect_timeout_s)
+                    conn.request(method, self.path, body or None,
+                                 headers=self._upstream_headers())
+                    # two-phase timeout: strict on connect/status-line,
+                    # then unlimited (or --upstream-timeout) for the body —
+                    # a long decode must not trip the connect timeout
+                    if conn.sock is not None:
+                        conn.sock.settimeout(st.upstream_timeout_s or None)
+                    resp = conn.getresponse()
+                    st._m_ttfb.observe((time.monotonic() - t0) * 1000.0)
+                    streaming = (resp.status == 200 and "text/event-stream"
+                                 in (resp.getheader("Content-Type") or ""))
+                    if not streaming:
+                        payload = (resp.status, resp.read(),
+                                   self._relay_headers(resp))
+                except (OSError, http.client.HTTPException,
+                        faults.FaultInjected) as e:
+                    replica.mark_conn_failure()
+                    st._m_upstream_errors.inc(replica=replica.name)
+                    if attempts < st.retry_budget:
+                        attempts += 1
+                        st._m_retries.inc()
+                        continue
+                    self._error(502, f"upstream {replica.name} failed: {e}")
+                    return
+                if resp.status == 503:
+                    # draining or scheduler-crashed: out of rotation NOW
+                    # (don't wait for the probe) and retry elsewhere
+                    replica.mark_unready()
+                    st._m_upstream_errors.inc(replica=replica.name)
+                    if attempts < st.retry_budget:
+                        attempts += 1
+                        st._m_retries.inc()
+                        last_503 = payload
+                        continue
+                    self._relay_buffered(*payload)
+                    return
+                # a usable response (200/429/504/4xx/...): this hop is
+                # done retrying — forward it verbatim
+                replica.mark_conn_success()
+                if streaming:
+                    self._relay_sse(resp, conn, replica)
+                else:
+                    self._relay_buffered(*payload)
+                if resp.status == 200 and affinity_hashes:
+                    st.affinity.record(affinity_hashes, replica.name)
+                return
+            finally:
+                # runs on every exit AND every retry `continue`: the
+                # in-flight count and the upstream socket never leak
+                replica.end()
+                if conn is not None:
+                    conn.close()
+
+    @staticmethod
+    def _relay_headers(resp) -> dict:
+        """Upstream headers worth forwarding verbatim. Retry-After carries
+        the replica's backoff hint on 429/503; X-Request-Id is OURS (the
+        replica echoes the same id we sent, so no conflict)."""
+        out = {}
+        for k in ("Content-Type", "Retry-After"):
+            v = resp.getheader(k)
+            if v is not None:
+                out[k] = v
+        return out
+
+    def _relay_buffered(self, status: int, body: bytes,
+                        headers: dict) -> None:
+        self.send_response(status)
+        for k, v in headers.items():
+            self.send_header(k, v)
+        self.send_header("Content-Length", str(len(body)))
+        self.send_header("X-Request-Id", self._rid)
+        self.send_header("Connection", "close")
+        self.end_headers()
+        self._count(status)
+        try:
+            self.wfile.write(body)
+        except OSError:
+            # client vanished before the (already complete) body landed:
+            # nothing upstream to cancel, nothing to retry
+            self.state._m_client_disconnects.inc()
+
+    def _relay_sse(self, resp, conn, replica) -> None:
+        """SSE passthrough: relay upstream bytes to the client as they
+        arrive (read1 returns per-recv, not per-buffer-fill, so chunk
+        latency adds no buffering delay) — byte-identical bodies.
+
+        The one stateful obligation: when the CLIENT disconnects
+        mid-stream, close the UPSTREAM connection immediately — the
+        replica's cancel-on-disconnect frees the decode slot within one
+        chunk. Closing at generator/handler GC instead would keep the
+        dead stream decoding for its full completion."""
+        self.send_response(200)
+        self.send_header("Content-Type",
+                         resp.getheader("Content-Type", "text/event-stream"))
+        self.send_header("Cache-Control", "no-cache")
+        self.send_header("Connection", "close")
+        self.send_header("X-Request-Id", self._rid)
+        self.end_headers()
+        self._count(200)
+        try:
+            while True:
+                try:
+                    chunk = resp.read1(65536)
+                except (OSError, http.client.HTTPException):
+                    break  # upstream died mid-stream: the partial body and
+                    #        missing [DONE] are the client's truncation signal
+                if not chunk:
+                    break
+                try:
+                    self.wfile.write(chunk)
+                    self.wfile.flush()
+                except OSError:
+                    self.state._m_client_disconnects.inc()
+                    break
+        finally:
+            # the immediacy guarantee: upstream socket down NOW, on every
+            # exit path (client gone, upstream EOF, relay error)
+            conn.close()
+
+
+def create_router_server(state: RouterState, host: str = "0.0.0.0",
+                         port: int = 9900):
+    handler = type("Handler", (RouterHandler,), {"state": state})
+    return ThreadingHTTPServer((host, port), handler)
+
+
+def state_from_args(args, replica_addrs: list) -> RouterState:
+    """RouterState from parsed `cli router`/`cli fleet` flags + a list of
+    "host:port" strings."""
+    replicas = []
+    for addr in replica_addrs:
+        host, _, port = addr.rpartition(":")
+        if not host or not port.isdigit():
+            raise SystemExit(f"bad --replica {addr!r}: want HOST:PORT")
+        replicas.append(Replica(host, int(port)))
+    if not replicas:
+        raise SystemExit("router needs at least one --replica HOST:PORT")
+    return RouterState(
+        replicas,
+        retry_budget=getattr(args, "retry_budget", 2),
+        probe_interval_s=getattr(args, "probe_interval", 1.0),
+        connect_timeout_s=getattr(args, "connect_timeout", 2.0),
+        upstream_timeout_s=getattr(args, "upstream_timeout", 0.0),
+        affinity_block=getattr(args, "affinity_block", 256),
+    )
+
+
+def run_router(args) -> None:
+    """``cli router``: front a fleet of already-running replicas. No jax,
+    no model artifacts — the router is pure stdlib networking and starts
+    in milliseconds."""
+    state = state_from_args(args, args.replica)
+    state.probe_once()  # synchronous first round: start with a real picture
+    state.start_probes()
+    srv = create_router_server(state, host=args.host, port=args.port)
+    print(f"🛰️  router on {args.host}:{args.port} -> "
+          f"{', '.join(r.name for r in state.replicas)} "
+          f"(affinity block {state.affinity_block}B, "
+          f"retry budget {state.retry_budget})")
+    try:
+        srv.serve_forever()
+    finally:
+        state.stop_probes()
