@@ -974,6 +974,124 @@ def run_decode_bench(cfg_dict: dict, bench_steps: int = None, quant_ok: bool = F
                   for c in reg.snapshot()
                   .get("dllama_requests_path_total", {}).get("values", [])}
         log(f"paths routed: {routed}")
+
+        # (3) Fleet front-door A/B: the same proxy hot path through a REAL
+        #     RouterState twice — fleet observability on (flight recorder +
+        #     a federation scrape loop hitting /metrics/fleet while traffic
+        #     flows) vs off — against in-process stub replicas, so the
+        #     delta isolates the router-side cost of parent-span headers,
+        #     Server-Timing hop attribution, the flight ring, and
+        #     concurrent federation. Same < 1% hard-fail budget; stubs are
+        #     stdlib HTTP, no jax: CPU-smokeable.
+        import http.client as _hc
+        import json as _jsn
+        from http.server import BaseHTTPRequestHandler as _BH
+        from http.server import ThreadingHTTPServer as _TS
+
+        from dllama_tpu.serving import router as _rt
+
+        class _StubReplica(_BH):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *a):
+                pass
+
+            def _send(self, body, ctype="application/json", extra=()):
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                for k, v in extra:
+                    self.send_header(k, v)
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/ready":
+                    self._send(_jsn.dumps({
+                        "status": "ok", "replica_id": "bench-stub",
+                        "time_us": _obs.mono_to_us(),
+                        "load": {"slots_occupied": 0, "slots_total": 8,
+                                 "queue_depth": 0, "kv_pages_free": 64,
+                                 "kv_pages_total": 64,
+                                 "prefix_hit_rate": 0.0}}).encode())
+                else:  # /metrics for the federation scrape loop
+                    self._send(
+                        b"# TYPE dllama_http_requests_total counter\n"
+                        b'dllama_http_requests_total{route="/x"} 1\n',
+                        ctype="text/plain; version=0.0.4")
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length") or 0)
+                self.rfile.read(n)
+                self._send(
+                    _jsn.dumps({"choices": [{"message": {
+                        "role": "assistant", "content": "ok"}}]}).encode(),
+                    extra=(("Server-Timing",
+                            "queue;dur=0.1, prefill;dur=0.2, "
+                            "decode;dur=0.3"),))
+
+        def _fleet_leg(obs_on):
+            ups = [_TS(("127.0.0.1", 0), _StubReplica) for _ in range(2)]
+            for u in ups:
+                _threading.Thread(target=u.serve_forever,
+                                  daemon=True).start()
+            state = _rt.RouterState(
+                [_rt.Replica("127.0.0.1", u.server_address[1])
+                 for u in ups],
+                probe_interval_s=3600.0, metrics=_obs.MetricsRegistry(),
+                enable_flight=obs_on)
+            state.probe_once()
+            srv = _rt.create_router_server(state, host="127.0.0.1", port=0)
+            port = srv.server_address[1]
+            _threading.Thread(target=srv.serve_forever, daemon=True).start()
+            stop = _threading.Event()
+            if obs_on:
+                def _scrape_loop():
+                    while not stop.is_set():
+                        state.federate()
+                        stop.wait(0.05)  # 300x denser than a real
+                        #   Prometheus scrape: a deliberately hostile cadence
+                _threading.Thread(target=_scrape_loop, daemon=True).start()
+            body = _jsn.dumps({
+                "model": "bench", "max_tokens": 1,
+                "messages": [{"role": "user", "content": "x"}]}).encode()
+            NREQ = 50
+
+            def _round():
+                conn = _hc.HTTPConnection("127.0.0.1", port)
+                t1 = time.perf_counter()
+                for _ in range(NREQ):
+                    conn.request("POST", "/v1/chat/completions", body=body,
+                                 headers={"Content-Type":
+                                          "application/json"})
+                    r = conn.getresponse()
+                    r.read()
+                dt = (time.perf_counter() - t1) * 1000.0 / NREQ
+                conn.close()
+                return dt
+
+            try:
+                _round()  # warm sockets, code paths, and the scrape loop
+                return min(_round() for _ in range(7))
+            finally:
+                stop.set()
+                srv.shutdown()
+                srv.server_close()
+                for u in ups:
+                    u.shutdown()
+                    u.server_close()
+
+        log("obs: fleet front-door A/B (proxy hot path, fleet obs on/off)")
+        fl_on = _fleet_leg(True)
+        fl_off = _fleet_leg(False)
+        fl_over = (fl_on - fl_off) / fl_off * 100.0
+        log(f"fleet front-door overhead: on {fl_on:.3f} vs off "
+            f"{fl_off:.3f} ms/request = {fl_over:+.2f}% (budget < 1%)")
+        if fl_over >= 1.0:
+            raise RuntimeError(
+                f"fleet observability overhead {fl_over:+.2f}% exceeds "
+                "the 1% budget (flight+federation on vs off through the "
+                "router front door)")
         return (on_ms,
                 f"{weights}-obs-b{B}-overhead{overhead:.2f}pct{cfg_tag}")
 

@@ -104,6 +104,17 @@ def build_parser() -> argparse.ArgumentParser:
                     "default: inherit this terminal")
     add_router_flags(fp, default_port=9900)
 
+    # live fleet terminal view: polls the router's /stats + /metrics/fleet
+    # — stdlib only, runs anywhere a curl would
+    tp = sub.add_parser(
+        "top", help="live terminal view of a running router/fleet")
+    tp.add_argument("--router", default="127.0.0.1:9900",
+                    metavar="HOST:PORT", help="the router front door")
+    tp.add_argument("--interval", type=float, default=1.0, metavar="S",
+                    help="seconds between refreshes")
+    tp.add_argument("--iterations", type=int, default=0, metavar="N",
+                    help="stop after N refreshes (0 = run until ^C)")
+
     for mode in ("inference", "generate", "chat", "serve", "worker"):
         sp = sub.add_parser(mode)
         if mode == "serve":  # the dllama-api surface (`src/apps/dllama-api`)
@@ -719,6 +730,117 @@ def run_verify(args) -> int:
     return 1
 
 
+def _top_get(host: str, port: int, path: str, timeout_s: float = 2.0):
+    import http.client
+
+    conn = http.client.HTTPConnection(host, port, timeout=timeout_s)
+    try:
+        conn.request("GET", path)
+        resp = conn.getresponse()
+        return resp.status, resp.read()
+    finally:
+        conn.close()
+
+
+def _top_fleet_families(text: str) -> dict:
+    """Fold a /metrics/fleet exposition into
+    {(family, replica): value}, summing counter series and histogram
+    ``_sum``/``_count`` lines across their remaining labels."""
+    out: dict = {}
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        head, _, value = line.rpartition(" ")
+        if not head:
+            continue
+        name, _, labels = head.partition("{")
+        replica = None
+        for part in labels.rstrip("}").split(","):
+            if part.startswith('replica="'):
+                replica = part[len('replica="'):].rstrip('"')
+        if name.endswith("_bucket"):
+            continue
+        try:
+            v = float(value)
+        except ValueError:
+            continue  # a non-numeric sample (foreign exposition noise)
+            #           must not kill a read-only dashboard loop
+        key = (name, replica)
+        out[key] = out.get(key, 0.0) + v
+    return out
+
+
+def run_top(args) -> int:
+    """``cli top``: a refreshing terminal view of the fleet — per-replica
+    rotation/load from the router's /stats, per-replica request counters
+    and latency means from /metrics/fleet. Read-only; safe against a
+    half-up fleet (unreachable router prints a retry line)."""
+    import json as json_mod
+
+    host, _, port_s = args.router.rpartition(":")
+    if not host or not port_s.isdigit():
+        raise SystemExit(f"bad --router {args.router!r}: want HOST:PORT")
+    port = int(port_s)
+    n = 0
+    try:
+        while True:
+            n += 1
+            lines = []
+            try:
+                _, stats_body = _top_get(host, port, "/stats")
+                stats = json_mod.loads(stats_body)
+                code, fleet_body = _top_get(host, port, "/metrics/fleet")
+                fams = (_top_fleet_families(
+                    fleet_body.decode("utf-8", "replace"))
+                    if code == 200 else {})
+                load = stats.get("load") or {}
+                lines.append(
+                    f"dllama top — router {args.router}  "
+                    f"up {stats.get('uptime_s', 0):.0f}s  "
+                    f"replicas {load.get('replicas_ready', '?')}/"
+                    f"{load.get('replicas_total', '?')} ready  "
+                    f"affinity {stats.get('affinity_entries', 0)}")
+                lines.append("")
+                lines.append(
+                    f"{'replica':<22}{'state':<10}{'infl':>5}{'occ':>8}"
+                    f"{'queue':>7}{'kv_free':>9}{'probe_age':>11}"
+                    f"{'reqs':>8}{'ttft_ms':>9}{'tpot_ms':>9}")
+                for snap in load.get("replicas") or []:
+                    name = snap.get("name", "?")
+                    state = ("circuit" if snap.get("circuit_open")
+                             else "ready" if snap.get("ready") else "down")
+                    rload = snap.get("load") or {}
+                    age = snap.get("probed_age_s")
+
+                    def mean(fam):
+                        s = fams.get((f"{fam}_sum", name))
+                        c = fams.get((f"{fam}_count", name))
+                        return f"{s / c:.1f}" if s is not None and c else "-"
+
+                    reqs = fams.get(("dllama_http_requests_total", name))
+                    lines.append(
+                        f"{name:<22}{state:<10}"
+                        f"{snap.get('inflight', 0):>5}"
+                        f"{rload.get('slots_occupied', 0):>4}/"
+                        f"{rload.get('slots_total', 0):<3}"
+                        f"{rload.get('queue_depth', 0):>7}"
+                        f"{rload.get('kv_pages_free', '-'):>9}"
+                        f"{(f'{age:.1f}s' if age is not None else '-'):>11}"
+                        f"{(f'{reqs:.0f}' if reqs is not None else '-'):>8}"
+                        f"{mean('dllama_ttft_ms'):>9}"
+                        f"{mean('dllama_tpot_ms'):>9}")
+            except (OSError, ValueError) as e:
+                lines = [f"dllama top — router {args.router} "
+                         f"unreachable ({e}); retrying..."]
+            sys.stdout.write("\x1b[2J\x1b[H" + "\n".join(lines) + "\n")
+            sys.stdout.flush()
+            if args.iterations and n >= args.iterations:
+                return 0
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        return 0  # ^C is how an interactive top session ends: clean exit
+
+
 def main(argv=None) -> None:
     # DLLAMA_PLATFORM=cpu|tpu forces the JAX backend via jax.config — unlike
     # the JAX_PLATFORMS env var this works even when a sitecustomize has
@@ -745,6 +867,9 @@ def main(argv=None) -> None:
 
         run_fleet(args)
         return
+    if args.mode == "top":
+        # read-only observer: stdlib HTTP polling, no device, no jax
+        raise SystemExit(run_top(args))
     maybe_init_distributed(args)
     if args.mode == "chat":
         run_chat(args)

@@ -20,11 +20,16 @@ Spec grammar (sites separated by ``;``)::
   ``scheduler`` (top of every server scheduler window — the
   supervisor-restart drill), ``weights_open`` / ``weights_read``
   (WeightFileReader — the artifact-integrity drills), ``logits``
-  (every decode dispatch — the numeric-health drill), and the fleet
+  (every decode dispatch — the numeric-health drill), the fleet
   router's seams ``route_pick`` (every replica-selection decision),
   ``proxy_upstream`` (every upstream hop — injected failures take the
-  same retry path as real connect errors) and ``probe`` (every /ready
-  health probe — injected failures open the circuit like real ones).
+  same retry path as real connect errors), ``probe`` (every /ready
+  health probe — injected failures open the circuit like real ones)
+  and ``federate_scrape`` (every per-replica /metrics scrape behind the
+  router's /metrics/fleet — a faulted scrape drops that replica from
+  the merged exposition, never the endpoint), plus ``flight_dump``
+  (every flight-recorder ring dump — a faulted dump is swallowed and
+  counted, proving the black box cannot crash the process).
 * ``action`` — ``raise`` (throw :class:`FaultInjected`), ``slow`` (sleep
   ``delay_ms``, default 50), or a *data* action the seam itself interprets:
   ``truncate`` (weights_open: pretend the file is ``drop`` bytes short,
@@ -51,7 +56,8 @@ import time
 
 SITES = ("admit", "step_chunk", "prefill", "prefill_chunk", "prefix_match",
          "page_alloc", "stream", "scheduler", "weights_open", "weights_read",
-         "logits", "route_pick", "proxy_upstream", "probe")
+         "logits", "route_pick", "proxy_upstream", "probe",
+         "federate_scrape", "flight_dump")
 ACTIONS = ("raise", "slow", "truncate", "bitflip", "nan")
 
 #: site -> the metric family that proves the site's failure is VISIBLE on
@@ -78,6 +84,11 @@ SITE_METRICS = {
     "route_pick": "dllama_router_http_requests_total",
     "proxy_upstream": "dllama_router_upstream_errors_total",
     "probe": "dllama_router_probe_failures_total",
+    # fleet observability seams: a faulted per-replica scrape shows up as a
+    # federation error; a faulted ring dump is swallowed and counted under
+    # reason="error" — the black box itself is fault-drilled
+    "federate_scrape": "dllama_router_federate_errors_total",
+    "flight_dump": "dllama_flight_dumps_total",
 }
 
 

@@ -23,6 +23,7 @@ Three cooperating pieces, all stdlib-only (matching the repo's no-deps style):
 
 from __future__ import annotations
 
+import collections
 import hashlib
 import itertools
 import json
@@ -37,6 +38,7 @@ from .analysis.sanitize import guard_globals, guarded_by
 
 __all__ = [
     "Counter",
+    "FlightRecorder",
     "Gauge",
     "Histogram",
     "MetricsRegistry",
@@ -45,10 +47,18 @@ __all__ = [
     "configure_trace",
     "trace_path",
     "emit_trace_events",
+    "emit_process_name",
+    "merge_trace_parts",
+    "flight_recorder",
     "log_json_line",
     "prompt_digest",
     "new_request_id",
     "next_span_id",
+    "mono_to_us",
+    "parent_span_value",
+    "sanitize_parent_span",
+    "server_timing_header",
+    "parse_server_timing",
     "scheduler_trace_event",
     "SCHEDULER_TID",
     "LATENCY_BUCKETS_MS",
@@ -421,6 +431,15 @@ def _mono_to_us(t_mono: float) -> int:
     return _T0_EPOCH_US + int((t_mono - _T0_MONO) * 1e6)
 
 
+def mono_to_us(t_mono: Optional[float] = None) -> int:
+    """This process's trace-timeline clock (µs since epoch, monotonic-anchored).
+
+    Replicas report it on ``/ready`` (``time_us``) so the router can estimate
+    the per-replica clock offset from its probe round trip (skew + RTT/2) and
+    merge fleet trace parts onto one skew-corrected timeline."""
+    return _mono_to_us(time.monotonic() if t_mono is None else t_mono)
+
+
 def configure_trace(path: Optional[str]) -> None:
     """Point span output at ``path`` (truncates), or disable with None."""
     global _trace_path, _trace_file, _trace_env_checked
@@ -473,6 +492,61 @@ def emit_trace_events(events: List[dict]) -> None:
         except OSError:
             pass  # tracing is advisory: a full disk or closed file must
             # never fail the request being traced
+
+
+def emit_process_name(name: str) -> None:
+    """Label this pid's track group in Perfetto (``process_name`` metadata).
+
+    In a merged fleet trace the router and each replica keep distinct pids;
+    this is what makes the merged file read "router" / "replica:9990"
+    instead of bare numbers."""
+    emit_trace_events([{
+        "name": "process_name", "ph": "M", "pid": os.getpid(), "tid": 0,
+        "args": {"name": name},
+    }])
+
+
+def merge_trace_parts(base_path: str,
+                      parts: Sequence[Tuple[str, float]]) -> int:
+    """Append per-process trace part files onto ``base_path``'s timeline.
+
+    ``parts`` is ``(path, delta_us)`` pairs; ``delta_us`` is ADDED to every
+    event's ``ts`` — pass the NEGATED estimated clock offset of the part's
+    process relative to the base process, so its spans land skew-corrected
+    on the base timeline. The line-per-event Chrome JSON Array format (no
+    closing bracket) makes this a line rewrite, not a JSON-document merge.
+    Returns the number of events merged; unreadable parts and unparsable
+    lines are skipped (merging is advisory, like tracing itself)."""
+    n = 0
+    try:
+        out = open(base_path, "a", encoding="utf-8")
+    except OSError:
+        return 0
+    with out:
+        for path, delta_us in parts:
+            try:
+                fh = open(path, "r", encoding="utf-8")
+            except OSError:
+                continue  # a missing/unreadable part (replica never wrote
+                #            a trace) skips, the rest still merge
+            with fh:
+                for line in fh:
+                    line = line.strip().rstrip(",")
+                    if not line or line in ("[", "]"):
+                        continue
+                    try:
+                        e = json.loads(line)
+                    except ValueError:
+                        continue  # a torn line from a killed writer is
+                        #            expected in a crash-path merge
+                    if "ts" in e:
+                        e["ts"] = int(e["ts"] + delta_us)
+                    try:
+                        out.write(json.dumps(e, separators=(",", ":")) + ",\n")
+                    except OSError:
+                        return n
+                    n += 1
+    return n
 
 
 # ---------------------------------------------------------------------------
@@ -540,6 +614,172 @@ def sanitize_request_id(raw: Optional[str]) -> str:
 
 
 # ---------------------------------------------------------------------------
+# Cross-process trace stitching (X-Dllama-Parent-Span hop header)
+
+def parent_span_value(span_id: int) -> str:
+    """The ``X-Dllama-Parent-Span`` value the router sends upstream:
+    ``<router_pid>:<router_span_id>`` — globally unique across the fleet's
+    processes, and used verbatim as the Chrome flow-event id binding the
+    router's proxy span to the replica's request span in the merged file."""
+    return f"{os.getpid()}:{int(span_id)}"
+
+
+def sanitize_parent_span(raw: Optional[str]) -> Optional[str]:
+    """Accept a hop header only in the exact shape the router mints (two
+    decimal fields); anything else is ignored — a malformed value must not
+    leak into the trace file or flow-event ids."""
+    if not raw:
+        return None
+    raw = raw.strip()
+    pid, sep, span = raw.partition(":")
+    if sep and pid.isdigit() and span.isdigit() and len(raw) <= 64:
+        return raw
+    return None
+
+
+def flow_start_event(flow_id: str, tid: int, t_mono: float) -> dict:
+    """Flow-arrow start ('ph':'s') on the ROUTER's proxy track; the replica
+    emits the matching finish so Perfetto draws router→replica arrows."""
+    return {"name": "hop", "ph": "s", "cat": "flow", "id": flow_id,
+            "pid": os.getpid(), "tid": tid, "ts": _mono_to_us(t_mono)}
+
+
+# ---------------------------------------------------------------------------
+# Server-Timing (per-hop latency attribution)
+
+def server_timing_header(trace: "RequestTrace") -> str:
+    """Render the replica's phase durations as a ``Server-Timing`` response
+    header (``queue;dur=…, prefill;dur=…, decode;dur=…``). Phases not yet
+    known at header time (e.g. decode on an SSE response whose headers go
+    out before tokens) are simply omitted — the header is additive."""
+    parts = []
+    q = trace.queue_wait_ms
+    if q is not None:
+        parts.append(f"queue;dur={q:.3f}")
+    if trace.prefill_ms is not None:
+        parts.append(f"prefill;dur={trace.prefill_ms:.3f}")
+    if trace.t_first is not None and trace.t_last is not None:
+        parts.append(f"decode;dur={(trace.t_last - trace.t_first) * 1e3:.3f}")
+    return ", ".join(parts)
+
+
+def parse_server_timing(header: Optional[str]) -> Dict[str, float]:
+    """Parse ``Server-Timing`` into {metric_name: dur_ms}; entries without a
+    ``dur`` param (legal per the spec) are skipped, garbage is ignored."""
+    out: Dict[str, float] = {}
+    if not header:
+        return out
+    for item in header.split(","):
+        name, _, params = item.strip().partition(";")
+        name = name.strip()
+        if not name:
+            continue
+        for p in params.split(";"):
+            k, _, v = p.strip().partition("=")
+            if k.strip().lower() == "dur":
+                try:
+                    out[name] = float(v.strip().strip('"'))
+                except ValueError:
+                    pass  # a garbled dur from a foreign server: skip the
+                    #       entry, keep parsing the rest of the header
+                break
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Flight recorder: the process's black box
+
+@guarded_by("_lock", "_events", "_seq")
+class FlightRecorder:
+    """Bounded ring of recent structured events — the process's black box.
+
+    Request admits/rejections, chunk ticks, fired faults and 5xx responses
+    land here as tiny dicts; on crash, deadline (504), quarantine or SIGTERM
+    the ring is dumped to ``$DLLAMA_FLIGHT/flight-<process>-<pid>-<reason>.json``
+    so the incident ships its own evidence instead of requiring a repro.
+    ``record`` is O(1) and allocation-bounded (deque maxlen); ``dump`` never
+    raises — a black box that can crash the plane is worse than none.
+    """
+
+    def __init__(self, capacity: int = 256, process: str = "server"):
+        self.capacity = max(8, int(capacity))
+        self.process = process  # display name; rebound once by create_server
+        self._lock = threading.Lock()
+        self._events: collections.deque = collections.deque(
+            maxlen=self.capacity)
+        self._seq = 0
+
+    def record(self, kind: str, **fields: object) -> None:
+        e = dict(fields)
+        e["kind"] = kind
+        e["t_us"] = _mono_to_us(time.monotonic())
+        with self._lock:
+            self._seq += 1
+            e["seq"] = self._seq
+            self._events.append(e)
+
+    def snapshot(self) -> dict:
+        """The ring as JSON-ready dict (``seq`` tells how much history the
+        bounded ring has already shed)."""
+        with self._lock:
+            events = list(self._events)
+            seq = self._seq
+        return {"process": self.process, "pid": os.getpid(),
+                "capacity": self.capacity, "seq": seq, "events": events}
+
+    def dump(self, reason: str, path: Optional[str] = None) -> Optional[str]:
+        """Write the ring to ``path`` (or under ``$DLLAMA_FLIGHT``); returns
+        the file written, or None (env unset, or the write failed — either
+        way the caller's crash/drain path proceeds untouched)."""
+        snap = self.snapshot()
+        snap["reason"] = reason
+        snap["dumped_at_us"] = _mono_to_us(time.monotonic())
+        try:
+            from . import faults
+            faults.fire("flight_dump")
+            target = path
+            if target is None:
+                d = os.environ.get("DLLAMA_FLIGHT")
+                if not d:
+                    return None
+                os.makedirs(d, exist_ok=True)
+                target = os.path.join(
+                    d, f"flight-{self.process}-{os.getpid()}-{reason}.json")
+            with open(target, "w", encoding="utf-8") as f:
+                json.dump(snap, f, separators=(",", ":"))
+            _M_FLIGHT_DUMPS.inc(reason=reason)
+            return target
+        except Exception:  # noqa: BLE001 — incl. injected FaultInjected:
+            # the black box must never take down the process it observes
+            _M_FLIGHT_DUMPS.inc(reason="error")
+            return None
+
+
+# Dump accounting on the shared default registry so every process exposes
+# it from first scrape; the reason label distinguishes crash/504/sigterm
+# dumps from failed ones ("error").
+_M_FLIGHT_DUMPS = _DEFAULT.counter(
+    "dllama_flight_dumps_total",
+    "Flight-recorder ring dumps, by trigger reason (error = dump failed)",
+    ("reason",))
+
+# Process-global recorder for code with no handle to a server/router state
+# (lifecycle's module-level error paths); states that want isolation (the
+# router; in-process multi-replica tests) construct their own.
+_flight_lock = threading.Lock()
+_flight: Optional[FlightRecorder] = None
+guard_globals("_flight_lock", "_flight")
+
+
+def flight_recorder() -> FlightRecorder:
+    global _flight
+    with _flight_lock:
+        if _flight is None:
+            _flight = FlightRecorder()
+        return _flight
+
+
+# ---------------------------------------------------------------------------
 # Per-request trace
 
 class RequestTrace:
@@ -547,14 +787,18 @@ class RequestTrace:
     thread (handler or scheduler) and read after completion, so no lock."""
 
     __slots__ = (
-        "request_id", "span_id", "t0", "path", "t_start", "prefill_ms",
-        "t_first", "t_last", "admission_depth", "queue_depth",
+        "request_id", "span_id", "parent_span", "t0", "path", "t_start",
+        "prefill_ms", "t_first", "t_last", "admission_depth", "queue_depth",
         "tokens_in", "tokens_out", "finish_reason", "status",
         "prompt_sha", "prompt_text", "model", "prefill_chunks",
     )
 
-    def __init__(self, request_id: str):
+    def __init__(self, request_id: str, parent_span: Optional[str] = None):
         self.request_id = request_id
+        #: the router hop's span ("<pid>:<span_id>", from
+        #: X-Dllama-Parent-Span via sanitize_parent_span) — None on a solo
+        #: server, where trace output is byte-for-byte what it always was
+        self.parent_span = parent_span
         #: this request's trace track: a real allocated span id (see
         #: next_span_id), never a hash of the request id
         self.span_id = next_span_id()
@@ -659,6 +903,8 @@ class RequestTrace:
         args = {"request_id": self.request_id, "path": self.path,
                 "tokens_in": self.tokens_in, "tokens_out": self.tokens_out,
                 "finish_reason": self.finish_reason}
+        if self.parent_span:
+            args["parent_span"] = self.parent_span
 
         def ev(name: str, t_a: float, t_b: float, extra: Optional[dict] = None) -> dict:
             return {
@@ -673,6 +919,15 @@ class RequestTrace:
              "args": {"name": f"req {self.request_id}"}},
             ev("request", self.t0, end, args),
         ]
+        if self.parent_span:
+            # Flow-arrow finish: binds this replica-side request span to the
+            # router's proxy span (which emitted the matching 'ph':'s' with
+            # the same id) so the merged fleet trace draws the hop.
+            events.append({
+                "name": "hop", "ph": "f", "bp": "e", "cat": "flow",
+                "id": self.parent_span, "pid": pid, "tid": tid,
+                "ts": _mono_to_us(self.t0),
+            })
         if self.t_start is not None:
             events.append(ev("queue_wait", self.t0, self.t_start))
             if self.prefill_ms is not None and not self.prefill_chunks:

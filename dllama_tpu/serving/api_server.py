@@ -575,6 +575,14 @@ class Batcher:
                                 s.mark_prefill(sess.prefill_ms_of(b))
                 if slot_map:
                     self._m_occupancy.observe(float(len(slot_map)))
+                    # the black box keeps the in-flight request ids per
+                    # tick: a replica killed mid-decode dumps a ring whose
+                    # last events say exactly whose work died with it
+                    st.flight.record(
+                        "chunk_tick", rows=len(slot_map),
+                        requests=[s.trace.request_id
+                                  for s in slot_map.values()
+                                  if s.trace is not None][:8])
                 for b, burst in sess.step_chunk().items():
                     s = slot_map[b]
                     s.tokens.extend(burst)
@@ -683,6 +691,11 @@ class Batcher:
         them; replaying the FAILED window is the client's call, not ours."""
         with self._lock:
             window, self._window = self._window, []
+        self.state.flight.record(
+            "scheduler_crash", error=repr(exc)[:200],
+            requests=[s.trace.request_id for s in window
+                      if s.trace is not None][:8])
+        self.state.flight.dump("scheduler_crash")
         err = exc if isinstance(exc, LifecycleError) else SchedulerCrashed(exc)
         for s in window:
             if not s.done.is_set():
@@ -779,7 +792,7 @@ class ServerState:
                  kv_bucket_min: int = 0, kv_pages: int = 0,
                  request_timeout: float = 0.0, queue_depth: int = 64,
                  metrics=None, log_json: bool = False,
-                 log_prompts: bool = False, log_stream=None):
+                 log_prompts: bool = False, log_stream=None, flight=None):
         """``default_seed``: seed for requests that send none — None means a
         fresh time-based seed per request (the launch-flag --seed plumbs in
         here so an operator can make the whole server reproducible).
@@ -835,10 +848,22 @@ class ServerState:
         # the same registry instance.
         self.metrics = (metrics if metrics is not None
                         else observability.default_registry())
+        #: the process's flight-recorder ring (GET /debug/flight; dumped on
+        #: crash/504/SIGTERM). The process-global instance by default so the
+        #: lifecycle layer's module-level hooks land in the same ring;
+        #: in-process multi-replica tests pass their own for isolation.
+        self.flight = (flight if flight is not None
+                       else observability.flight_recorder())
         self.log_json = bool(log_json)
         self.log_prompts = bool(log_prompts)
         self.log_stream = log_stream
         self.started_at = time.time()
+        #: replica identity: start nonce + (once bound) the listen port.
+        #: Survives nothing — that is the point: a crash-restart mints a NEW
+        #: generation, so federated series and router logs can tell "the
+        #: same replica came back" from "a stale snapshot of the old one".
+        self.start_nonce = uuid.uuid4().hex[:8]
+        self.replica_id = f"0-{self.start_nonce}"  # port set by create_server
         reg = self.metrics
         self._m_http = reg.counter(
             "dllama_http_requests_total",
@@ -1025,6 +1050,13 @@ class ServerState:
                     "prefix_hit_rate": 0.0})
         return ready, {
             "status": "ready" if ready else "not_ready",
+            # identity + clock: the router keys federated series and its
+            # generation-change log on replica_id, and estimates this
+            # replica's trace-clock offset (skew + RTT/2) from time_us
+            # against its own probe send/recv timestamps
+            "replica_id": self.replica_id,
+            "started_at": round(self.started_at, 3),
+            "time_us": observability.mono_to_us(),
             "draining": self.gate.draining,
             "scheduler_alive": scheduler_alive,
             "scheduler_crashes": (batcher.crash_count
@@ -1059,6 +1091,13 @@ class ServerState:
             self._m_tokens_out.inc(trace.tokens_out)
             self._m_completion_hist.observe(float(trace.tokens_out))
         observability.emit_trace_events(trace.trace_events())
+        self.flight.record(
+            "request_end", request_id=trace.request_id, status=trace.status,
+            finish_reason=trace.finish_reason, tokens_out=trace.tokens_out)
+        if trace.status == 504 or trace.finish_reason == "timeout":
+            # a blown deadline is an incident worth its black box: the dump
+            # shows what the gate/scheduler were doing while budget burned
+            self.flight.dump("deadline")
         if self.log_json:
             rec = trace.record()
             if self.log_prompts and trace.prompt_text is not None:
@@ -1073,6 +1112,8 @@ class ServerState:
         snap = self.metrics.snapshot()
         return {
             "model": self.model_name,
+            "replica_id": self.replica_id,
+            "started_at": round(self.started_at, 3),
             "uptime_s": round(time.time() - self.started_at, 1),
             "load": info,
             "metrics": snap,
@@ -1114,7 +1155,7 @@ class OpenAIHandler(BaseHTTPRequestHandler):
     #: SSE streams, and every 4xx/5xx alike
     _KNOWN_ROUTES = ("/v1/chat/completions", "/chat/completions",
                      "/v1/models", "/health", "/healthz", "/ready",
-                     "/metrics", "/stats")
+                     "/metrics", "/stats", "/debug/flight")
 
     def _route(self) -> str:
         """Route label for the HTTP counter: known paths verbatim, anything
@@ -1125,15 +1166,33 @@ class OpenAIHandler(BaseHTTPRequestHandler):
     def _begin_request(self) -> None:
         """Per-request handler state: the request id (client-supplied
         X-Request-Id when sane, freshly minted otherwise) echoed on EVERY
-        response, and the not-yet-emitted trace for POSTs."""
+        response, the router's hop span (X-Dllama-Parent-Span) for trace
+        stitching, and the not-yet-emitted trace for POSTs."""
         self._rid = observability.sanitize_request_id(
             self.headers.get("X-Request-Id"))
+        self._parent_span = observability.sanitize_parent_span(
+            self.headers.get("X-Dllama-Parent-Span"))
         self._trace = None
+        self._t_begin = time.monotonic()
 
     def _count(self, code: int) -> None:
         self.state._m_http.inc(route=self._route(), code=str(code))
         if self._trace is not None and self._trace.status == 0:
             self._trace.status = code
+        if code >= 500:
+            self.state.flight.record("http_5xx", code=code,
+                                     route=self._route(),
+                                     request_id=self._rid)
+
+    def _server_timing(self) -> str:
+        """Server-Timing value for THIS response: the request trace's phase
+        durations when one exists (the router's hop attribution reads
+        queue/prefill/decode), handler wall time otherwise — every endpoint
+        emits the header (CONTRIBUTING rule), even plain GETs."""
+        st = (observability.server_timing_header(self._trace)
+              if self._trace is not None else "")
+        total = f"total;dur={(time.monotonic() - self._t_begin) * 1e3:.3f}"
+        return f"{st}, {total}" if st else total
 
     def _json(self, code: int, obj: dict, headers: dict = None) -> None:
         body = json.dumps(obj).encode()
@@ -1141,6 +1200,7 @@ class OpenAIHandler(BaseHTTPRequestHandler):
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
         self.send_header("X-Request-Id", self._rid)
+        self.send_header("Server-Timing", self._server_timing())
         for k, v in (headers or {}).items():
             self.send_header(k, v)
         self.end_headers()
@@ -1153,6 +1213,9 @@ class OpenAIHandler(BaseHTTPRequestHandler):
         self.send_header("Cache-Control", "no-cache")
         self.send_header("Connection", "close")
         self.send_header("X-Request-Id", self._rid)
+        # headers leave before decode runs: only the phases known NOW (queue
+        # wait at best) appear; the router attributes the rest to stream time
+        self.send_header("Server-Timing", self._server_timing())
         self.end_headers()
         self._count(200)
 
@@ -1219,6 +1282,12 @@ class OpenAIHandler(BaseHTTPRequestHandler):
             self.wfile.write(body)
         elif self.path == "/stats":
             self._json(200, st.stats())
+        elif self.path == "/debug/flight":
+            # the live flight-recorder ring, no dump required: what this
+            # process saw happen recently, for incident triage and for the
+            # router's aggregated fleet view
+            self._json(200, dict(st.flight.snapshot(),
+                                 replica_id=st.replica_id))
         else:
             self._error(404, f"unknown path {self.path}")
 
@@ -1235,8 +1304,11 @@ class OpenAIHandler(BaseHTTPRequestHandler):
             return
         # one trace per completion attempt — ALSO for typed rejections
         # (429/503/504), so rejected request ids still appear in the
-        # structured log and the latency histograms stay success-only
-        trace = self._trace = RequestTrace(self._rid)
+        # structured log and the latency histograms stay success-only.
+        # A router-minted parent span stitches this trace under the
+        # router's proxy span in the merged fleet timeline.
+        trace = self._trace = RequestTrace(self._rid,
+                                           parent_span=self._parent_span)
         trace.model = self.state.model_name
         # bounded admission at the door: gate capacity covers EVERY in-
         # flight completion (solo and batched alike), so overflow is an
@@ -1250,6 +1322,8 @@ class OpenAIHandler(BaseHTTPRequestHandler):
             self.state.finish_request(trace)
             return
         trace.admission_depth = self.state.gate.depth
+        self.state.flight.record("request_start", request_id=self._rid,
+                                 depth=trace.admission_depth)
         try:
             self._handle_completions(req, trace)
         except LifecycleError as e:
@@ -1647,7 +1721,14 @@ class OpenAIHandler(BaseHTTPRequestHandler):
 
 def create_server(state: ServerState, host: str = "0.0.0.0", port: int = 9990):
     handler = type("Handler", (OpenAIHandler,), {"state": state})
-    return ThreadingHTTPServer((host, port), handler)
+    srv = ThreadingHTTPServer((host, port), handler)
+    # identity binds to the ACTUAL port (port=0 tests get the kernel's
+    # pick): port names the replica across restarts, the nonce names this
+    # generation of it
+    bound = srv.server_address[1]
+    state.replica_id = f"{bound}-{state.start_nonce}"
+    state.flight.process = f"replica-{bound}"
+    return srv
 
 
 def drain_and_shutdown(state: ServerState, srv, drain_timeout_s: float) -> bool:
@@ -1656,6 +1737,8 @@ def drain_and_shutdown(state: ServerState, srv, drain_timeout_s: float) -> bool:
     ``drain_timeout_s`` for in-flight requests, then stop the listener.
     Returns True when the drain completed with nothing in flight (a False
     means live requests were cut off at the timeout)."""
+    state.flight.dump("sigterm")  # the shutdown's black box, written FIRST:
+    # if the drain itself wedges, the ring already shows what was in flight
     state.begin_drain()
     idle = state.gate.wait_idle(drain_timeout_s)
     srv.shutdown()
@@ -1694,6 +1777,9 @@ def serve(args) -> None:
         log_prompts=getattr(args, "log_prompts", False),
     )
     srv = create_server(state, host=args.host, port=args.port)
+    # label this pid's track group in a merged fleet trace (no-op when
+    # DLLAMA_TRACE is unset)
+    observability.emit_process_name(f"replica:{args.port}")
     pid_path = getattr(args, "pid_file", None)
     if pid_path:
         write_pid_file(pid_path)

@@ -28,6 +28,7 @@ import sys
 import threading
 import time
 
+from dllama_tpu import observability
 from dllama_tpu.analysis.sanitize import guarded_by
 from dllama_tpu.serving import router as router_mod
 
@@ -43,6 +44,7 @@ class ReplicaProc:
         self.argv = argv
         self.proc: subprocess.Popen = None
         self.restarts = 0
+        self.env: dict = None  # per-replica overrides (trace part file)
 
     @property
     def name(self) -> str:
@@ -78,6 +80,17 @@ class Fleet:
                 "--host", host, "--port", str(base_port + i),
             ] + list(replica_args))
             for i in range(n_replicas))
+        # each replica writes its own trace PART file next to the
+        # supervisor's: N processes appending to one file would interleave
+        # mid-line; run_fleet stitches the parts (skew-corrected) at drain
+        if self.env.get("DLLAMA_TRACE"):
+            for r in self.replicas:
+                r.env = dict(self.env, DLLAMA_TRACE=self.trace_part(r))
+
+    def trace_part(self, r: ReplicaProc):
+        """The per-replica trace part file path (None: tracing off)."""
+        base = self.env.get("DLLAMA_TRACE")
+        return f"{base}.replica-{r.port}" if base else None
 
     def addresses(self) -> list:
         return [r.name for r in self.replicas]
@@ -93,7 +106,7 @@ class Fleet:
         """Start (or restart) one replica. Caller holds ``_lock``."""
         log = self._open_log(r)
         r.proc = subprocess.Popen(
-            r.argv, env=self.env,
+            r.argv, env=r.env if r.env is not None else self.env,
             stdout=log, stderr=subprocess.STDOUT if log else None,
             start_new_session=True)  # own process group: a ^C at the
         #   supervisor's terminal must not SIGINT replicas mid-drain
@@ -196,6 +209,41 @@ class Fleet:
         return clean
 
 
+def merge_fleet_trace(fleet: Fleet, state) -> int:
+    """Stitch the per-replica trace part files into the supervisor's own
+    (router) trace file, each shifted by the negated clock offset the
+    probe loop estimated for that replica — this is what makes a replica's
+    queue/prefill/decode spans nest under the router's proxy spans on one
+    timeline despite monotonic-clock skew. Consumes the part files and
+    returns the number of events merged; no-op when tracing is off."""
+    base = observability.trace_path()
+    if base is None:
+        return 0
+    offsets = {}
+    if state is not None:
+        offsets = {rep.name: rep.clock_offset_us()
+                   for rep in state.replicas}
+    parts = []
+    for r in fleet.replicas:
+        part = fleet.trace_part(r)
+        if part and os.path.exists(part):
+            # merge_trace_parts ADDS its delta to each ts: subtracting the
+            # replica's offset moves its stamps onto the router's clock
+            parts.append((part, -offsets.get(r.name, 0)))
+    if not parts:
+        return 0
+    n = observability.merge_trace_parts(base, parts)
+    for part, _ in parts:
+        try:
+            os.remove(part)
+        except OSError:
+            pass  # the events are already merged; a leftover part file
+            #       is clutter, not a failure
+    print(f"🧵 merged {n} replica trace event(s) from {len(parts)} part "
+          f"file(s) into {base}", file=sys.stderr)
+    return n
+
+
 def run_fleet(args) -> None:
     """``cli fleet``: the whole local topology — N replicas + router —
     supervised until SIGTERM/SIGINT, then drained in order."""
@@ -211,12 +259,14 @@ def run_fleet(args) -> None:
           f"{args.replica_host}:{args.base_port}..."
           f"{args.base_port + args.replicas - 1}")
     fleet.start()
+    state = None
     try:
         if not fleet.wait_ready(args.ready_timeout):
             raise RuntimeError(
                 f"fleet not ready within {args.ready_timeout:.0f}s")
         fleet.start_supervision()
         state = router_mod.state_from_args(args, fleet.addresses())
+        observability.emit_process_name("router")
         state.probe_once()
         state.start_probes()
         srv = router_mod.create_router_server(
@@ -248,3 +298,6 @@ def run_fleet(args) -> None:
         # belt over braces: serve_forever exits via drain in the normal
         # path, but a startup failure must never orphan replica processes
         fleet.drain(timeout_s=min(5.0, args.drain_timeout))
+        # replicas are down (their trace files are final): stitch the
+        # parts into the one merged fleet trace
+        merge_fleet_trace(fleet, state)

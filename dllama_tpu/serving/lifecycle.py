@@ -129,6 +129,8 @@ class DeadlineExceeded(LifecycleError):
             "timeout); partial output discarded, slot released")
         self.budget_s = budget_s
         _M_DEADLINES.inc()
+        observability.flight_recorder().record(
+            "deadline", budget_s=round(budget_s, 3))
 
 
 class RequestCancelled(LifecycleError):
@@ -195,13 +197,18 @@ class AdmissionGate:
     the gate is, seeded by an EWMA of recent request service times.
     """
 
-    def __init__(self, capacity: int):
+    def __init__(self, capacity: int, flight=None):
         self.capacity = max(1, capacity)
         self._lock = threading.Lock()
         self._idle = threading.Condition(self._lock)
         self._inflight = 0
         self._draining = False
         self._service_ewma_s = 1.0  # optimistic prior; updated per release
+        # set-once black box (observability.FlightRecorder); every admission
+        # decision lands in its ring so a crash dump shows what the gate was
+        # doing in the final seconds
+        self._flight = flight if flight is not None \
+            else observability.flight_recorder()
 
     @property
     def depth(self) -> int:
@@ -222,13 +229,17 @@ class AdmissionGate:
         with self._lock:
             if self._draining:
                 _M_REJECTIONS.inc(reason="draining")
+                self._flight.record("reject", reason="draining")
                 raise ServerDraining()
             if self._inflight >= self.capacity:
                 _M_REJECTIONS.inc(reason="queue_full")
+                self._flight.record("reject", reason="queue_full",
+                                    depth=self._inflight)
                 raise QueueFull(self._inflight, self.capacity,
                                 self.retry_after_s())
             self._inflight += 1
             _M_INFLIGHT.set(self._inflight)
+            self._flight.record("admit", depth=self._inflight)
             return time.monotonic()
 
     def release(self, admitted_at: float = None) -> None:
@@ -395,6 +406,9 @@ class Supervisor:
                     self.crash_count += 1
                     crashes = self.crash_count
                 _M_CRASHES.inc()
+                observability.flight_recorder().record(
+                    "crash", target=self._name, error=repr(e)[:200],
+                    crash_count=crashes)
                 try:
                     self._on_crash(e)
                 except Exception:  # noqa: BLE001 — crash hook must not kill

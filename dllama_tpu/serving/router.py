@@ -38,9 +38,33 @@ probe is periodic, the data path only sees replicas it already picked.
 The router serves its own ``/health``, ``/ready``, ``/metrics`` and
 ``/stats`` (aggregating per-replica state) and generates/propagates
 ``X-Request-Id`` across the hop so a trace correlates end-to-end. Fault
-seams ``route_pick``, ``proxy_upstream`` and ``probe`` are wired through
-``faults.SITES``; injected failures take the same retry/circuit paths as
-real ones.
+seams ``route_pick``, ``proxy_upstream``, ``probe`` and
+``federate_scrape`` are wired through ``faults.SITES``; injected failures
+take the same retry/circuit paths as real ones.
+
+Fleet observability (this is the stitching half of observability.py):
+
+* every proxied request carries ``X-Dllama-Parent-Span: <pid>:<span>``
+  upstream; the replica parents its RequestTrace under it and the router
+  emits the matching flow arrow, so one merged Perfetto file shows the
+  router's proxy/connect/stream spans and the replica's queue/prefill/
+  decode spans on a common timeline. The probe loop doubles as a clock
+  sync: the replica stamps ``/ready`` with its monotonic-epoch time, the
+  router subtracts half the probe RTT, and the per-replica offset feeds
+  ``merge_trace_parts`` at fleet shutdown so spans nest despite skew.
+* ``GET /metrics/fleet`` scrapes every in-rotation replica's /metrics and
+  merges the expositions under a ``replica`` label (counters sum, gauges
+  stay per-replica, histogram buckets merge); a crashed replica's series
+  drop out with its circuit, and a failed scrape drops that replica from
+  the merge — never the endpoint.
+* each replica's ``Server-Timing`` response header splits the router's
+  wall time into ``dllama_router_hop_ms{phase=connect|upstream_queue|
+  upstream_compute|stream}`` — where a slow request spent its time, per
+  hop, without parsing any trace.
+* the router keeps its own flight-recorder ring (admits at the replicas,
+  upstream errors, replica generation changes) and ``GET /debug/flight``
+  returns it together with every replica's ring — the one call a
+  postmortem starts from.
 """
 
 from __future__ import annotations
@@ -48,6 +72,8 @@ from __future__ import annotations
 import hashlib
 import http.client
 import json
+import os
+import sys
 import threading
 import time
 from collections import OrderedDict
@@ -124,17 +150,25 @@ def prefix_hashes(messages: list, block: int) -> list:
     return out
 
 
-def load_score(snap: dict) -> float:
+def load_score(snap: dict, stale: bool = False) -> float:
     """Weighted least-load score for one replica snapshot (lower = better).
     Every term is normalized by the replica's slot count so heterogeneous
-    fleets (different --batch-max) compare fairly."""
+    fleets (different --batch-max) compare fairly.
+
+    ``stale`` means the probe snapshot is too old to trust (older than
+    twice the probe interval — the probe loop is wedged or the replica is
+    slow-walking /ready): score on the router's own live in-flight count
+    alone rather than on occupancy/queue/kv numbers frozen at their last
+    good values."""
     load = snap.get("load") or {}
     total = load.get("slots_total", 0) or 1
+    inflight = snap.get("inflight", 0) / total
+    if stale:
+        return W_INFLIGHT * inflight
     occ = load.get("slots_occupied", 0) / total
     queue = load.get("queue_depth", 0) / total
     kv_total = load.get("kv_pages_total", 0)
     kv = (1.0 - load.get("kv_pages_free", 0) / kv_total) if kv_total else 0.0
-    inflight = snap.get("inflight", 0) / total
     return (W_OCCUPANCY * occ + W_QUEUE * queue + W_KV * kv
             + W_INFLIGHT * inflight)
 
@@ -150,7 +184,7 @@ def saturated(snap: dict) -> bool:
 
 
 @guarded_by("_lock", "_ready", "_info", "_failures", "_circuit_until",
-            "_inflight", "_probed_at")
+            "_inflight", "_probed_at", "_clock_offset_us", "_replica_id")
 class Replica:
     """One upstream ``dllama-api`` process as the router sees it: the last
     probe verdict + load snapshot, the passive circuit breaker, and the
@@ -175,19 +209,54 @@ class Replica:
         self._circuit_until = 0.0
         self._inflight = 0
         self._probed_at = 0.0
+        # monotonic-clock skew estimate vs this replica (trace stitching)
+        # and the replica's self-reported identity (restart detection)
+        self._clock_offset_us = 0
+        self._replica_id = None
 
-    def mark_probe(self, ready: bool, info: dict | None) -> None:
+    def mark_probe(self, ready: bool, info: dict | None,
+                   offset_us: int | None = None):
         """Record one active-probe verdict. A ready probe also closes the
         passive circuit: the replica answered /ready, so connect errors
-        that opened the breaker are behind us."""
+        that opened the breaker are behind us.
+
+        Returns the PREVIOUS replica identity when this probe observed a
+        generation change (a different process now answers on host:port —
+        a crash-restart the caller should log), else None."""
+        prev_gen = None
         with self._lock:
             self._ready = ready
             self._probed_at = time.monotonic()
             if info is not None:
                 self._info = info
+                rid = info.get("replica_id")
+                if rid is not None:
+                    if self._replica_id is not None and rid != self._replica_id:
+                        prev_gen = self._replica_id
+                    self._replica_id = rid
+            if offset_us is not None:
+                self._clock_offset_us = int(offset_us)
             if ready:
                 self._failures = 0
                 self._circuit_until = 0.0
+        return prev_gen
+
+    def probe_age_s(self) -> float:
+        """Seconds since the last completed probe (nan = never probed):
+        the value behind ``dllama_router_probe_age_seconds`` and the
+        staleness test that demotes this replica's load snapshot."""
+        with self._lock:
+            if not self._probed_at:
+                return float("nan")
+            return time.monotonic() - self._probed_at
+
+    def clock_offset_us(self) -> int:
+        """Estimated ``replica_monotonic_us - router_monotonic_us`` from
+        the last probe round trip (skew + RTT/2). Subtracting it from a
+        replica's trace timestamps moves them onto the router's timeline —
+        exactly what ``merge_trace_parts`` does at fleet shutdown."""
+        with self._lock:
+            return self._clock_offset_us
 
     def mark_conn_failure(self) -> None:
         """Passive circuit breaker: a data-path connect failure opens the
@@ -228,6 +297,8 @@ class Replica:
                 "inflight": self._inflight,
                 "probed_age_s": (round(time.monotonic() - self._probed_at, 3)
                                  if self._probed_at else None),
+                "replica_id": self._replica_id,
+                "clock_offset_us": self._clock_offset_us,
                 "load": dict(self._info),
             }
 
@@ -270,6 +341,57 @@ class AffinityIndex:
             return len(self._map)
 
 
+def merge_expositions(parts: list) -> str:
+    """Merge per-replica Prometheus text expositions into one fleet view.
+
+    ``parts`` is ``[(replica_name, exposition_text), ...]``. Every sample
+    line gains a ``replica`` label, which IS the merge semantics the text
+    format can express: the per-replica series stay disjoint, so counters
+    sum, gauges stay attributable, and histogram buckets merge under any
+    downstream ``sum by (le)`` — while each family's HELP/TYPE pair
+    dedupes to one occurrence (first replica wins) so the output is still
+    a valid exposition with every family's samples contiguous."""
+    helps: dict = {}
+    types: dict = {}
+    samples: OrderedDict = OrderedDict()  # family -> relabeled sample lines
+    for replica, text in parts:
+        lab = 'replica="%s"' % str(replica).replace("\\", "\\\\").replace(
+            '"', '\\"')
+        family = None
+        for line in text.splitlines():
+            if line.startswith("# HELP ") or line.startswith("# TYPE "):
+                fields = line.split(" ", 3)
+                if len(fields) < 3:
+                    continue
+                family = fields[2]
+                target = helps if fields[1] == "HELP" else types
+                target.setdefault(family, line)
+                samples.setdefault(family, [])
+            elif not line or line.startswith("#"):
+                continue
+            else:
+                # sample line: name[{labels}] value — _bucket/_sum/_count
+                # suffixes group under the family that declared them
+                name = line.split("{", 1)[0].split(" ", 1)[0]
+                key = (family if family is not None
+                       and name.startswith(family) else name)
+                if "{" in line:
+                    head, rest = line.split("{", 1)
+                    relabeled = f"{head}{{{lab},{rest}"
+                else:
+                    head, _, value = line.partition(" ")
+                    relabeled = f"{head}{{{lab}}} {value}"
+                samples.setdefault(key, []).append(relabeled)
+    out = []
+    for family, lines in samples.items():
+        if family in helps:
+            out.append(helps[family])
+        if family in types:
+            out.append(types[family])
+        out.extend(lines)
+    return "\n".join(out) + "\n" if out else ""
+
+
 class RouterState:
     """Config + fleet picture + metrics for one router process. The
     replica list is immutable after construction (drain/death is a probe
@@ -283,7 +405,7 @@ class RouterState:
                  upstream_timeout_s: float = 0.0,
                  affinity_block: int = 256,
                  affinity_capacity: int = 4096,
-                 metrics=None):
+                 metrics=None, enable_flight: bool = True):
         self.replicas = tuple(replicas)
         self.retry_budget = retry_budget
         self.probe_interval_s = probe_interval_s
@@ -329,6 +451,31 @@ class RouterState:
         self._m_ttfb = reg.histogram(
             "dllama_router_upstream_ttfb_ms",
             "Upstream time-to-first-byte (connect + status line) per hop")
+        self._m_hop = reg.histogram(
+            "dllama_router_hop_ms",
+            "Per-hop latency attribution: the router's wall time split into "
+            "connect (to upstream first byte), the replica's own "
+            "Server-Timing queue/compute phases, and the relay stream",
+            ("phase",))
+        self._m_federate_errors = reg.counter(
+            "dllama_router_federate_errors_total",
+            "Per-replica /metrics scrapes behind /metrics/fleet that failed "
+            "(connect/parse/injected); the replica drops out of that merged "
+            "exposition, never the endpoint",
+            ("replica",))
+        self._m_probe_age = reg.gauge(
+            "dllama_router_probe_age_seconds",
+            "Seconds since each replica's last completed /ready probe "
+            "(absent until one completes); pick() stops trusting a load "
+            "snapshot older than twice the probe interval",
+            ("replica",))
+        for r in self.replicas:
+            self._m_probe_age.set_function(r.probe_age_s, replica=r.name)
+        # the router's own flight recorder — like its registry, never the
+        # process default: in-process fleet tests run replicas beside it
+        # and the rings must not mix
+        self.flight = (observability.FlightRecorder(process="router")
+                       if enable_flight else None)
         self._probe_supervisor = None
         self._probe_stop = threading.Event()
 
@@ -365,7 +512,16 @@ class RouterState:
                         return r, "affinity"
                     reason = "affinity_fallback"
                     break
-        r, _ = min(candidates, key=lambda rs: load_score(rs[1]))
+        # probe-staleness fallback: a snapshot older than 2x the probe
+        # interval no longer describes the replica (wedged probe loop,
+        # slow-walking /ready) — weight those candidates by the router's
+        # own live in-flight count only
+        stale_after_s = 2.0 * self.probe_interval_s
+        r, _ = min(candidates,
+                   key=lambda rs: load_score(
+                       rs[1],
+                       stale=(rs[1]["probed_age_s"] is not None
+                              and rs[1]["probed_age_s"] > stale_after_s)))
         self._m_picks.inc(reason=reason)
         return r, reason
 
@@ -380,18 +536,39 @@ class RouterState:
             conn = http.client.HTTPConnection(
                 r.host, r.port, timeout=self.connect_timeout_s)
             try:
+                t_send = time.monotonic()
                 conn.request("GET", "/ready",
                              headers={"X-Request-Id":
                                       observability.new_request_id()})
                 resp = conn.getresponse()
                 body = resp.read()
+                t_recv = time.monotonic()
             finally:
                 conn.close()
             info = json.loads(body) if body else {}
             if not isinstance(info, dict):
                 raise ValueError("non-object /ready body")
             ready = resp.status == 200
-            r.mark_probe(ready, info)
+            # clock-offset estimate for trace stitching: the replica stamps
+            # /ready with its monotonic-epoch time_us; assuming the reply
+            # was stamped mid-round-trip, the difference to our own
+            # mid-point is skew (error bounded by RTT/2 — microseconds on
+            # loopback, where fleets under one router live)
+            offset_us = None
+            t_us = info.get("time_us")
+            if isinstance(t_us, (int, float)):
+                mid_us = observability.mono_to_us((t_send + t_recv) / 2.0)
+                offset_us = int(t_us) - mid_us
+            prev_gen = r.mark_probe(ready, info, offset_us=offset_us)
+            if prev_gen is not None:
+                new_gen = info.get("replica_id")
+                print(f"🔁 router: replica {r.name} restarted "
+                      f"(generation {prev_gen} -> {new_gen})",
+                      file=sys.stderr)
+                if self.flight is not None:
+                    self.flight.record("replica_generation",
+                                       replica=r.name, prev=prev_gen,
+                                       new=new_gen)
             return ready
         except (OSError, ValueError, faults.FaultInjected):
             # an unreachable/garbled probe IS the health signal, not an
@@ -468,6 +645,67 @@ class RouterState:
             "metrics": self.metrics.snapshot(),
         }
 
+    # -- fleet observability ----------------------------------------------
+
+    def _scrape(self, r: Replica, path: str) -> bytes:
+        """One GET against a replica's local surface (metrics/flight)."""
+        conn = http.client.HTTPConnection(
+            r.host, r.port, timeout=self.connect_timeout_s)
+        try:
+            conn.request("GET", path, headers={
+                "X-Request-Id": observability.new_request_id()})
+            resp = conn.getresponse()
+            body = resp.read()
+            if resp.status != 200:
+                raise ValueError(f"{path} -> {resp.status}")
+            return body
+        finally:
+            conn.close()
+
+    def federate(self) -> str:
+        """The /metrics/fleet body: every in-rotation replica's /metrics,
+        merged under a ``replica`` label. Crashed/draining replicas fall
+        out of the merge with their circuit/ready verdict, so a restarted
+        replica never leaves stale series behind; a failed scrape (fires
+        the ``federate_scrape`` seam) is counted and skipped — the
+        endpoint itself always answers."""
+        parts = []
+        for r in self.replicas:
+            s = r.snapshot()
+            if not s["ready"] or s["circuit_open"]:
+                continue
+            try:
+                faults.fire("federate_scrape")
+                body = self._scrape(r, "/metrics")
+                parts.append((r.name, body.decode("utf-8", "replace")))
+            except (OSError, ValueError, faults.FaultInjected):
+                self._m_federate_errors.inc(replica=r.name)
+        return merge_expositions(parts)
+
+    def flight_report(self) -> dict:
+        """The router's own flight ring plus every replica's /debug/flight
+        — the aggregate a postmortem starts from after an upstream
+        failure. Unreachable replicas (usually exactly the interesting
+        ones) report their routing verdict in place of a ring; their
+        on-crash dump lives in $DLLAMA_FLIGHT on disk."""
+        out = {
+            "router": (self.flight.snapshot()
+                       if self.flight is not None else None),
+            "replicas": {},
+        }
+        for r in self.replicas:
+            s = r.snapshot()
+            try:
+                out["replicas"][r.name] = json.loads(
+                    self._scrape(r, "/debug/flight"))
+            except (OSError, ValueError):
+                out["replicas"][r.name] = {
+                    "error": "unreachable",
+                    "ready": s["ready"],
+                    "circuit_open": s["circuit_open"],
+                }
+        return out
+
 
 class RouterHandler(BaseHTTPRequestHandler):
     """The front-door HTTP surface. Local routes (/health /ready /metrics
@@ -484,7 +722,8 @@ class RouterHandler(BaseHTTPRequestHandler):
 
     _KNOWN_ROUTES = ("/v1/chat/completions", "/chat/completions",
                      "/v1/models", "/health", "/healthz", "/ready",
-                     "/metrics", "/stats")
+                     "/metrics", "/metrics/fleet", "/stats",
+                     "/debug/flight")
 
     def _route(self) -> str:
         p = self.path.split("?", 1)[0]
@@ -493,9 +732,18 @@ class RouterHandler(BaseHTTPRequestHandler):
     def _begin_request(self) -> None:
         self._rid = observability.sanitize_request_id(
             self.headers.get("X-Request-Id"))
+        self._t_begin = time.monotonic()
+        # one router span per request: its pid:span value is BOTH the
+        # X-Dllama-Parent-Span the replica parents its trace under and the
+        # flow-arrow id tying the two process tracks together
+        self._span_id = observability.next_span_id()
+        self._parent_value = observability.parent_span_value(self._span_id)
 
     def _count(self, code: int) -> None:
         self.state._m_http.inc(route=self._route(), code=str(code))
+
+    def _server_timing(self) -> str:
+        return f"total;dur={(time.monotonic() - self._t_begin) * 1e3:.3f}"
 
     def _json(self, code: int, obj: dict, headers: dict = None) -> None:
         body = json.dumps(obj).encode()
@@ -503,8 +751,20 @@ class RouterHandler(BaseHTTPRequestHandler):
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
         self.send_header("X-Request-Id", self._rid)
+        self.send_header("Server-Timing", self._server_timing())
         for k, v in (headers or {}).items():
             self.send_header(k, v)
+        self.end_headers()
+        self._count(code)
+        self.wfile.write(body)
+
+    def _text(self, code: int, body: bytes) -> None:
+        self.send_response(code)
+        self.send_header("Content-Type",
+                         "text/plain; version=0.0.4; charset=utf-8")
+        self.send_header("Content-Length", str(len(body)))
+        self.send_header("X-Request-Id", self._rid)
+        self.send_header("Server-Timing", self._server_timing())
         self.end_headers()
         self._count(code)
         self.wfile.write(body)
@@ -539,17 +799,13 @@ class RouterHandler(BaseHTTPRequestHandler):
             ready, info = st.readiness()
             self._json(200 if ready else 503, info)
         elif self.path == "/metrics":
-            body = st.metrics.render().encode()
-            self.send_response(200)
-            self.send_header("Content-Type",
-                             "text/plain; version=0.0.4; charset=utf-8")
-            self.send_header("Content-Length", str(len(body)))
-            self.send_header("X-Request-Id", self._rid)
-            self.end_headers()
-            self._count(200)
-            self.wfile.write(body)
+            self._text(200, st.metrics.render().encode())
+        elif self.path == "/metrics/fleet":
+            self._text(200, st.federate().encode())
         elif self.path == "/stats":
             self._json(200, st.stats())
+        elif self.path == "/debug/flight":
+            self._json(200, st.flight_report())
         elif self.path == "/v1/models":
             # model identity is fleet-wide (one model per fleet): proxy to
             # any routable replica
@@ -583,6 +839,7 @@ class RouterHandler(BaseHTTPRequestHandler):
 
     def _upstream_headers(self) -> dict:
         h = {"X-Request-Id": self._rid,
+             "X-Dllama-Parent-Span": self._parent_value,
              "Content-Type": self.headers.get("Content-Type",
                                               "application/json"),
              "Accept": self.headers.get("Accept", "*/*")}
@@ -604,90 +861,183 @@ class RouterHandler(BaseHTTPRequestHandler):
         tried: set = set()
         last_503 = None  # pass the FINAL 503 through on budget exhaustion
         attempts = 0
-        while True:
-            try:
-                replica, _reason = st.pick(affinity_hashes, exclude=tried)
-            except NoReplicaAvailable as e:
-                if last_503 is not None:
-                    self._relay_buffered(*last_503)
-                    return
-                self._lifecycle_error(e)
-                return
-            except faults.FaultInjected as e:
-                # an injected route_pick fault is a router bug stand-in:
-                # surfaces as a 500 the ingress counter sees
-                self._error(500, str(e))
-                return
-            tried.add(replica.name)
-            replica.begin()
-            conn = None
-            t0 = time.monotonic()
-            try:
+        # the hop record _finish_proxy turns into attribution histograms,
+        # router trace spans and (on failure) the error verdict — filled
+        # in as the dispatch progresses, reflecting the LAST attempt
+        hop = {"replica": None, "status": None, "error": None,
+               "t_conn": None, "t_ttfb": None, "timing": {}}
+        try:
+            while True:
                 try:
-                    faults.fire("proxy_upstream")
-                    conn = http.client.HTTPConnection(
-                        replica.host, replica.port,
-                        timeout=st.connect_timeout_s)
-                    conn.request(method, self.path, body or None,
-                                 headers=self._upstream_headers())
-                    # two-phase timeout: strict on connect/status-line,
-                    # then unlimited (or --upstream-timeout) for the body —
-                    # a long decode must not trip the connect timeout
-                    if conn.sock is not None:
-                        conn.sock.settimeout(st.upstream_timeout_s or None)
-                    resp = conn.getresponse()
-                    st._m_ttfb.observe((time.monotonic() - t0) * 1000.0)
-                    streaming = (resp.status == 200 and "text/event-stream"
-                                 in (resp.getheader("Content-Type") or ""))
-                    if not streaming:
-                        payload = (resp.status, resp.read(),
-                                   self._relay_headers(resp))
-                except (OSError, http.client.HTTPException,
-                        faults.FaultInjected) as e:
-                    replica.mark_conn_failure()
-                    st._m_upstream_errors.inc(replica=replica.name)
-                    if attempts < st.retry_budget:
-                        attempts += 1
-                        st._m_retries.inc()
-                        continue
-                    self._error(502, f"upstream {replica.name} failed: {e}")
+                    replica, _reason = st.pick(affinity_hashes,
+                                               exclude=tried)
+                except NoReplicaAvailable as e:
+                    if last_503 is not None:
+                        hop["status"] = last_503[0]
+                        self._relay_buffered(*last_503)
+                        return
+                    hop["error"] = "no_replica"
+                    hop["status"] = e.http_status
+                    self._lifecycle_error(e)
                     return
-                if resp.status == 503:
-                    # draining or scheduler-crashed: out of rotation NOW
-                    # (don't wait for the probe) and retry elsewhere
-                    replica.mark_unready()
-                    st._m_upstream_errors.inc(replica=replica.name)
-                    if attempts < st.retry_budget:
-                        attempts += 1
-                        st._m_retries.inc()
-                        last_503 = payload
-                        continue
-                    self._relay_buffered(*payload)
+                except faults.FaultInjected as e:
+                    # an injected route_pick fault is a router bug
+                    # stand-in: surfaces as a 500 the ingress counter sees
+                    hop["error"] = "route_pick"
+                    hop["status"] = 500
+                    self._error(500, str(e))
                     return
-                # a usable response (200/429/504/4xx/...): this hop is
-                # done retrying — forward it verbatim
-                replica.mark_conn_success()
-                if streaming:
-                    self._relay_sse(resp, conn, replica)
-                else:
-                    self._relay_buffered(*payload)
-                if resp.status == 200 and affinity_hashes:
-                    st.affinity.record(affinity_hashes, replica.name)
-                return
-            finally:
-                # runs on every exit AND every retry `continue`: the
-                # in-flight count and the upstream socket never leak
-                replica.end()
-                if conn is not None:
-                    conn.close()
+                tried.add(replica.name)
+                replica.begin()
+                conn = None
+                t0 = time.monotonic()
+                hop["replica"] = replica.name
+                hop["t_conn"], hop["t_ttfb"] = t0, None
+                try:
+                    try:
+                        faults.fire("proxy_upstream")
+                        conn = http.client.HTTPConnection(
+                            replica.host, replica.port,
+                            timeout=st.connect_timeout_s)
+                        conn.request(method, self.path, body or None,
+                                     headers=self._upstream_headers())
+                        # two-phase timeout: strict on connect/status-line,
+                        # then unlimited (or --upstream-timeout) for the
+                        # body — a long decode must not trip the connect
+                        # timeout
+                        if conn.sock is not None:
+                            conn.sock.settimeout(
+                                st.upstream_timeout_s or None)
+                        resp = conn.getresponse()
+                        st._m_ttfb.observe((time.monotonic() - t0) * 1000.0)
+                        hop["t_ttfb"] = time.monotonic()
+                        hop["status"] = resp.status
+                        hop["timing"] = observability.parse_server_timing(
+                            resp.getheader("Server-Timing") or "")
+                        streaming = (resp.status == 200
+                                     and "text/event-stream"
+                                     in (resp.getheader("Content-Type")
+                                         or ""))
+                        if not streaming:
+                            payload = (resp.status, resp.read(),
+                                       self._relay_headers(resp))
+                    except (OSError, http.client.HTTPException,
+                            faults.FaultInjected) as e:
+                        replica.mark_conn_failure()
+                        st._m_upstream_errors.inc(replica=replica.name)
+                        if st.flight is not None:
+                            st.flight.record("upstream_error",
+                                             replica=replica.name,
+                                             request_id=self._rid,
+                                             error=repr(e)[:200])
+                        if attempts < st.retry_budget:
+                            attempts += 1
+                            st._m_retries.inc()
+                            continue
+                        hop["error"] = "upstream"
+                        hop["status"] = 502
+                        self._error(502,
+                                    f"upstream {replica.name} failed: {e}")
+                        return
+                    if resp.status == 503:
+                        # draining or scheduler-crashed: out of rotation
+                        # NOW (don't wait for the probe), retry elsewhere
+                        replica.mark_unready()
+                        st._m_upstream_errors.inc(replica=replica.name)
+                        if st.flight is not None:
+                            st.flight.record("upstream_503",
+                                             replica=replica.name,
+                                             request_id=self._rid)
+                        if attempts < st.retry_budget:
+                            attempts += 1
+                            st._m_retries.inc()
+                            last_503 = payload
+                            continue
+                        self._relay_buffered(*payload)
+                        return
+                    # a usable response (200/429/504/4xx/...): this hop is
+                    # done retrying — forward it verbatim
+                    replica.mark_conn_success()
+                    if streaming:
+                        self._relay_sse(resp, conn, replica)
+                    else:
+                        self._relay_buffered(*payload)
+                    if resp.status == 200 and affinity_hashes:
+                        st.affinity.record(affinity_hashes, replica.name)
+                    return
+                finally:
+                    # runs on every exit AND every retry `continue`: the
+                    # in-flight count and the upstream socket never leak
+                    replica.end()
+                    if conn is not None:
+                        conn.close()
+        finally:
+            self._finish_proxy(hop)
+
+    def _finish_proxy(self, hop: dict) -> None:
+        """Close out one proxied request: per-hop attribution histograms
+        (the router's wall time minus the phases the replica claimed via
+        Server-Timing) and the router-side trace spans, flow-arrowed to
+        the replica's track. A hop that never produced a usable response
+        — including a replica killed mid-request — closes its span with
+        ``error=true`` so the orphan is visible, not silently absent."""
+        st = self.state
+        t_end = time.monotonic()
+        timing = hop["timing"]
+        if hop["t_conn"] is not None and hop["t_ttfb"] is not None:
+            st._m_hop.observe((hop["t_ttfb"] - hop["t_conn"]) * 1e3,
+                              phase="connect")
+            if "queue" in timing:
+                st._m_hop.observe(timing["queue"], phase="upstream_queue")
+            if "prefill" in timing or "decode" in timing:
+                st._m_hop.observe(timing.get("prefill", 0.0)
+                                  + timing.get("decode", 0.0),
+                                  phase="upstream_compute")
+            st._m_hop.observe((t_end - hop["t_ttfb"]) * 1e3, phase="stream")
+        if observability.trace_path() is None:
+            return
+        pid = os.getpid()
+        tid = self._span_id
+        us = observability.mono_to_us
+        span_args = {"request_id": self._rid, "replica": hop["replica"],
+                     "status": hop["status"]}
+        if hop["error"] is not None:
+            span_args["error"] = True
+            span_args["error_kind"] = hop["error"]
+        events = [
+            {"name": "thread_name", "ph": "M", "pid": pid, "tid": tid,
+             "args": {"name": f"router {self._rid}"}},
+            {"name": "router_proxy", "ph": "X", "pid": pid, "tid": tid,
+             "ts": us(self._t_begin),
+             "dur": max(1, us(t_end) - us(self._t_begin)),
+             "cat": "router", "args": span_args},
+        ]
+        if hop["t_conn"] is not None:
+            t_fb = hop["t_ttfb"] if hop["t_ttfb"] is not None else t_end
+            events.append(
+                {"name": "connect", "ph": "X", "pid": pid, "tid": tid,
+                 "ts": us(hop["t_conn"]),
+                 "dur": max(1, us(t_fb) - us(hop["t_conn"])),
+                 "cat": "router"})
+            events.append(observability.flow_start_event(
+                self._parent_value, tid, hop["t_conn"]))
+            if hop["t_ttfb"] is not None:
+                events.append(
+                    {"name": "stream", "ph": "X", "pid": pid, "tid": tid,
+                     "ts": us(hop["t_ttfb"]),
+                     "dur": max(1, us(t_end) - us(hop["t_ttfb"])),
+                     "cat": "router"})
+        observability.emit_trace_events(events)
 
     @staticmethod
     def _relay_headers(resp) -> dict:
         """Upstream headers worth forwarding verbatim. Retry-After carries
-        the replica's backoff hint on 429/503; X-Request-Id is OURS (the
+        the replica's backoff hint on 429/503; Server-Timing carries the
+        replica's phase split (the router appends its own total as a
+        second header — HTTP merges repeats); X-Request-Id is OURS (the
         replica echoes the same id we sent, so no conflict)."""
         out = {}
-        for k in ("Content-Type", "Retry-After"):
+        for k in ("Content-Type", "Retry-After", "Server-Timing"):
             v = resp.getheader(k)
             if v is not None:
                 out[k] = v
@@ -700,6 +1050,7 @@ class RouterHandler(BaseHTTPRequestHandler):
             self.send_header(k, v)
         self.send_header("Content-Length", str(len(body)))
         self.send_header("X-Request-Id", self._rid)
+        self.send_header("Server-Timing", self._server_timing())
         self.send_header("Connection", "close")
         self.end_headers()
         self._count(status)
@@ -726,6 +1077,10 @@ class RouterHandler(BaseHTTPRequestHandler):
         self.send_header("Cache-Control", "no-cache")
         self.send_header("Connection", "close")
         self.send_header("X-Request-Id", self._rid)
+        upstream_timing = resp.getheader("Server-Timing")
+        if upstream_timing:
+            self.send_header("Server-Timing", upstream_timing)
+        self.send_header("Server-Timing", self._server_timing())
         self.end_headers()
         self._count(200)
         try:
@@ -781,6 +1136,7 @@ def run_router(args) -> None:
     no model artifacts — the router is pure stdlib networking and starts
     in milliseconds."""
     state = state_from_args(args, args.replica)
+    observability.emit_process_name("router")
     state.probe_once()  # synchronous first round: start with a real picture
     state.start_probes()
     srv = create_router_server(state, host=args.host, port=args.port)

@@ -8,14 +8,26 @@ produced a nonzero counter delta: an outage class with no metric movement
 is an outage an operator cannot alert on, and that is the regression this
 lane exists to catch.
 
+``--fleet`` runs the FLEET leg instead: a real ``cli fleet`` subprocess
+topology (router + 2 replicas, tiny synthetic weights, CPU) with tracing
+and the flight recorder on. It passes only if (a) the merged Perfetto
+file contains at least one STITCHED request — a router proxy span and a
+replica request span sharing the request id, tied by a flow arrow — with
+the router and each replica on distinct named process tracks, (b) the
+router's /metrics/fleet chat-route counter sums equal the per-replica
+/metrics sums, and (c) the SIGTERM drain left one flight-recorder dump
+per process whose ring holds the drilled request ids.
+
 Artifacts written to --out-dir (uploaded by CI):
     metrics_before.txt / metrics_after.txt   raw Prometheus expositions
     deltas.json                              per-counter deltas + verdict
     trace.jsonl                              Chrome/Perfetto request spans
     requests.jsonl                           structured JSON request logs
+    fleet-trace.json / fleet_verdict.json / flight/   (--fleet leg)
 
 Usage:  JAX_PLATFORMS=cpu python scripts/obs_drill.py [--out-dir obs-drill]
-Exit 0 only if every fault class moved its counter.
+                                                      [--fleet]
+Exit 0 only if every assertion of the selected leg holds.
 """
 
 from __future__ import annotations
@@ -79,11 +91,269 @@ def chat(**kw):
     return body
 
 
+def series_sum(text: str, family: str, must_contain: str = "") -> float:
+    """Sum one family's sample values across all its series, optionally
+    restricted to series whose label block contains ``must_contain``."""
+    total = 0.0
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        sample, _, value = line.rpartition(" ")
+        if sample.partition("{")[0] != family or must_contain not in sample:
+            continue
+        try:
+            total += float(value)
+        except ValueError:
+            pass
+    return total
+
+
+def fleet_main(args) -> int:
+    """The --fleet leg: real router + 2 replica subprocesses, then assert
+    stitching, federation arithmetic, and the SIGTERM flight dumps."""
+    import glob
+    import signal
+    import socket
+    import subprocess
+    import time
+
+    import numpy as np
+
+    from dllama_tpu.formats.spec import ArchType, ModelSpec
+    from dllama_tpu.formats.tokenizer_file import TokenizerData, write_tokenizer
+    from dllama_tpu.formats.weights import tensor_plan, write_model
+    from dllama_tpu.quants import blocks
+
+    out = os.path.abspath(args.out_dir)
+    art = os.path.join(out, "artifacts")
+    os.makedirs(art, exist_ok=True)
+    model, tokp = os.path.join(art, "m.m"), os.path.join(art, "t.t")
+    spec = ModelSpec(arch=ArchType.LLAMA, dim=64, hidden_dim=96, n_layers=2,
+                     n_heads=4, n_kv_heads=2, vocab_size=300, seq_len=96,
+                     weights_float_type=blocks.Q40)
+    rng = np.random.default_rng(0)
+    write_model(model, spec,
+                {e.name: 0.05 * rng.standard_normal(e.d * e.n).astype(
+                    np.float32) for e in tensor_plan(spec)})
+    vocab = ([b"<unk>", b"<s>", b"</s>"] + [bytes([i]) for i in range(256)]
+             + [b"hi"] * 41)
+    write_tokenizer(tokp, TokenizerData(
+        vocab=vocab, scores=[0.0] * 300, bos_id=1, eos_id=2))
+
+    trace = os.path.join(out, "fleet-trace.json")
+    flight_dir = os.path.join(out, "flight")
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO,
+               DLLAMA_TRACE=trace, DLLAMA_FLIGHT=flight_dir)
+    env.pop("JAX_PLATFORM_NAME", None)
+    env.pop("PALLAS_AXON_POOL_IPS", None)  # CPU children must not register
+    #   the axon TPU plugin (single-session tunnel blocks a 2nd registrant)
+
+    def free_port():
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        p = s.getsockname()[1]
+        s.close()
+        return p
+
+    router_port, base_port = free_port(), free_port() + 1000
+    fleet_log = open(os.path.join(out, "fleet.log"), "w")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "dllama_tpu.cli", "fleet",
+         "--model", model, "--tokenizer", tokp,
+         "--replicas", "2", "--base-port", str(base_port),
+         "--host", "127.0.0.1", "--port", str(router_port),
+         "--probe-interval", "0.3", "--ready-timeout", "240",
+         "--log-dir", os.path.join(out, "logs"),
+         "--replica-arg", "--batch-window 5 --batch-max 2 --tp 1"],
+        env=env, cwd=REPO, stdout=fleet_log, stderr=subprocess.STDOUT)
+
+    failures = []
+    drilled_ids = []
+    try:
+        deadline = time.monotonic() + 300
+        up = False
+        while time.monotonic() < deadline:
+            if proc.poll() is not None:
+                raise RuntimeError(
+                    f"fleet exited early ({proc.returncode}); see fleet.log")
+            try:
+                status, _ = request(router_port, "GET", "/ready", timeout=2)
+                if status == 200:
+                    up = True
+                    break
+            except OSError:
+                pass  # front door not listening yet — keep polling
+            time.sleep(0.5)
+        if not up:
+            raise RuntimeError("fleet front door never became ready")
+        print(f"fleet up: router :{router_port} -> replicas "
+              f":{base_port},:{base_port + 1}")
+
+        for i in range(3):
+            conn = http.client.HTTPConnection("127.0.0.1", router_port,
+                                              timeout=120)
+            conn.request("POST", "/v1/chat/completions",
+                         body=json.dumps(chat(model="m", max_tokens=4)),
+                         headers={"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            resp.read()
+            rid = resp.getheader("X-Request-Id")
+            timing = resp.getheader("Server-Timing") or ""
+            conn.close()
+            if resp.status != 200:
+                failures.append(f"chat #{i} returned {resp.status}")
+            if rid:
+                drilled_ids.append(rid)
+            if i == 0 and "total;dur=" not in timing:
+                failures.append(
+                    f"router response lacks Server-Timing: {timing!r}")
+        print(f"drilled {len(drilled_ids)} chat request(s) through the "
+              f"front door")
+
+        # -- federation arithmetic: /metrics/fleet sums == per-replica sums
+        status, data = request(router_port, "GET", "/metrics/fleet",
+                               timeout=30)
+        fed = data.decode()
+        with open(os.path.join(out, "metrics_fleet.txt"), "w") as f:
+            f.write(fed)
+        if status != 200:
+            failures.append(f"/metrics/fleet returned {status}")
+        rep_texts = []
+        for p in (base_port, base_port + 1):
+            status, data = request(p, "GET", "/metrics", timeout=30)
+            if status != 200:
+                failures.append(f"replica :{p} /metrics returned {status}")
+            rep_texts.append(data.decode())
+            with open(os.path.join(out, f"metrics_replica_{p}.txt"),
+                      "w") as f:
+                f.write(rep_texts[-1])
+        # chat-route counters are quiescent between the two scrapes (probe
+        # traffic only touches /ready and /metrics series), so the sums
+        # must agree EXACTLY
+        for family, restrict in (
+                ("dllama_http_requests_total", 'route="/v1/chat/completions"'),
+                ("dllama_completion_tokens_total", "")):
+            want = sum(series_sum(t, family, restrict) for t in rep_texts)
+            got = series_sum(fed, family, restrict)
+            label = f"{family}{{{restrict}}}" if restrict else family
+            print(f"  federation {label}: fleet={got:g} replicas={want:g}")
+            if got != want or want <= 0:
+                failures.append(
+                    f"federation mismatch for {label}: "
+                    f"fleet={got:g} != sum(replicas)={want:g}")
+        if 'replica="127.0.0.1:' not in fed:
+            failures.append("/metrics/fleet series lack the replica label")
+
+        # -- flight visibility while alive: router aggregates /debug/flight
+        status, data = request(router_port, "GET", "/debug/flight",
+                               timeout=30)
+        if status != 200:
+            failures.append(f"/debug/flight returned {status}")
+        else:
+            report = json.loads(data)
+            if len(report.get("replicas", {})) != 2:
+                failures.append(
+                    f"/debug/flight aggregated {report.get('replicas')!r}, "
+                    f"wanted 2 replicas")
+    except Exception as e:
+        failures.append(f"fleet drill aborted: {e!r}")
+    finally:
+        # SIGTERM: replicas dump their flight rings, drain, and the
+        # supervisor stitches the trace parts into fleet-trace.json
+        if proc.poll() is None:
+            proc.send_signal(signal.SIGTERM)
+            try:
+                rc = proc.wait(timeout=120)
+                if rc != 0:
+                    failures.append(f"fleet drain exited {rc}")
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait()
+                failures.append("fleet did not drain within 120s")
+        fleet_log.close()
+
+    # -- stitched merged trace: router + replica spans of one request on
+    #    one timeline, tied by a flow arrow, on distinct process tracks
+    n_stitched = 0
+    try:
+        raw = open(trace).read()
+        if not raw.startswith("[\n"):
+            failures.append("fleet-trace.json is not a Perfetto JSON array")
+        events = [json.loads(l.rstrip(","))
+                  for l in raw.splitlines()[1:] if l.strip()]
+        proxy = {e["args"].get("request_id"): e for e in events
+                 if e.get("name") == "router_proxy" and "args" in e}
+        reqs = {e["args"].get("request_id"): e for e in events
+                if e.get("name") == "request" and "args" in e}
+        flow_s = {e.get("id") for e in events if e.get("ph") == "s"}
+        flow_f = {e.get("id") for e in events if e.get("ph") == "f"}
+        for rid in drilled_ids:
+            if (rid in proxy and rid in reqs
+                    and proxy[rid].get("pid") != reqs[rid].get("pid")
+                    and reqs[rid]["args"].get("parent_span") in
+                    (flow_s & flow_f)):
+                n_stitched += 1
+        if n_stitched < 1:
+            failures.append(
+                f"no stitched request in merged trace "
+                f"(proxy spans for {sorted(proxy)}, replica spans for "
+                f"{sorted(reqs)}, flows s={sorted(flow_s)} "
+                f"f={sorted(flow_f)})")
+        names = {e["args"].get("name") for e in events
+                 if e.get("name") == "process_name"}
+        if "router" not in names or not any(
+                str(n).startswith("replica:") for n in names):
+            failures.append(f"merged trace process tracks wrong: {names}")
+    except OSError as e:
+        failures.append(f"merged trace unreadable: {e!r}")
+
+    # -- SIGTERM flight dumps: one black box per replica, holding the
+    #    drilled request ids in its recent events
+    dumps = sorted(glob.glob(os.path.join(flight_dir, "flight-*.json")))
+    if len(dumps) < 2:
+        failures.append(
+            f"expected >=2 flight dumps under {flight_dir}, got {dumps}")
+    seen_ids = set()
+    for path in dumps:
+        try:
+            d = json.load(open(path))
+        except (OSError, ValueError) as e:
+            failures.append(f"flight dump {path} unreadable: {e!r}")
+            continue
+        seen_ids.update(ev.get("request_id") for ev in d.get("events", []))
+    if drilled_ids and not (seen_ids & set(drilled_ids)):
+        failures.append(
+            f"no drilled request id in any flight dump "
+            f"(drilled {drilled_ids}, dumps held {sorted(seen_ids)})")
+
+    verdict = {"ok": not failures, "failures": failures,
+               "stitched_requests": n_stitched,
+               "drilled_request_ids": drilled_ids,
+               "flight_dumps": [os.path.basename(p) for p in dumps]}
+    with open(os.path.join(out, "fleet_verdict.json"), "w") as f:
+        json.dump(verdict, f, indent=2, sort_keys=True)
+
+    print(f"\nstitched requests in merged trace: {n_stitched}")
+    print(f"flight dumps: {len(dumps)} -> {flight_dir}")
+    if failures:
+        for msg in failures:
+            print(f"FAIL: {msg}", file=sys.stderr)
+        return 1
+    print("fleet observability drill: stitched trace + exact federation + "
+          "flight dumps all verified")
+    return 0
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--out-dir", default="obs-drill")
+    ap.add_argument("--fleet", action="store_true",
+                    help="run the fleet leg (subprocess router + replicas) "
+                         "instead of the single-process fault drill")
     args = ap.parse_args()
     os.makedirs(args.out_dir, exist_ok=True)
+    if args.fleet:
+        return fleet_main(args)
 
     from dllama_tpu import faults, observability
     from dllama_tpu.models import llama

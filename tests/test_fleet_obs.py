@@ -1,0 +1,613 @@
+"""Fleet-wide observability: cross-process trace stitching (parent spans,
+clock-offset merge), metric federation behind /metrics/fleet, per-hop
+Server-Timing attribution, the flight-recorder black box, and their fault
+seams (federate_scrape / flight_dump).
+
+Router-level tests run the real RouterState/RouterHandler against
+in-process ObsReplica HTTP servers (a FakeReplica that also speaks
+/metrics, /debug/flight, Server-Timing and the /ready identity fields);
+trace-level tests drive observability.py directly.
+"""
+
+import http.client
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from dllama_tpu import faults, observability
+from dllama_tpu.serving import router as rt
+
+
+# ---------------------------------------------------------------------------
+# fakes + helpers
+# ---------------------------------------------------------------------------
+
+class ObsReplica:
+    """An in-process replica fake with the fleet-observability surface:
+    /ready carries replica_id + time_us (optionally skewed), /metrics
+    serves a canned exposition, /debug/flight a canned ring, and POST
+    answers with a Server-Timing phase header."""
+
+    def __init__(self, name="obs", replica_id="gen-1", skew_us=0,
+                 metrics_text="", server_timing=None):
+        self.name = name
+        self.ready = True
+        self.replica_id = replica_id
+        self.skew_us = skew_us
+        self.metrics_text = metrics_text
+        self.server_timing = server_timing
+        self.load = {"slots_occupied": 0, "slots_total": 8,
+                     "queue_depth": 0, "kv_pages_free": 64,
+                     "kv_pages_total": 64}
+        self.flight_snapshot = {"process": name, "events": []}
+        self.requests = []
+        owner = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):
+                pass
+
+            def _json(self, code, obj, headers=None):
+                body = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/ready":
+                    info = {"status": "ready" if owner.ready
+                            else "not_ready",
+                            "replica_id": owner.replica_id,
+                            "time_us": observability.mono_to_us()
+                            + owner.skew_us,
+                            **owner.load}
+                    self._json(200 if owner.ready else 503, info)
+                elif self.path == "/metrics":
+                    body = owner.metrics_text.encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "text/plain")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                elif self.path == "/debug/flight":
+                    self._json(200, owner.flight_snapshot)
+                else:
+                    self._json(404, {"error": "nope"})
+
+            def do_POST(self):
+                length = int(self.headers.get("Content-Length", "0"))
+                body = self.rfile.read(length)
+                owner.requests.append((self.path, body, dict(self.headers)))
+                headers = {}
+                if owner.server_timing:
+                    headers["Server-Timing"] = owner.server_timing
+                self._json(200, {"object": "chat.completion",
+                                 "served_by": owner.name}, headers=headers)
+
+        self.srv = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.port = self.srv.server_address[1]
+        self.addr = f"127.0.0.1:{self.port}"
+        self.thread = threading.Thread(target=self.srv.serve_forever,
+                                       daemon=True)
+        self.thread.start()
+
+    def close(self):
+        self.srv.shutdown()
+        self.srv.server_close()
+
+
+def make_state(replica_addrs, **kw):
+    reps = []
+    for a in replica_addrs:
+        host, port = a.rsplit(":", 1)
+        reps.append(rt.Replica(host, int(port)))
+    kw.setdefault("probe_interval_s", 0.1)
+    return rt.RouterState(reps, **kw)
+
+
+class RouterUnderTest:
+    def __init__(self, replica_addrs, **kw):
+        self.state = make_state(replica_addrs, **kw)
+        self.srv = rt.create_router_server(self.state, "127.0.0.1", 0)
+        self.port = self.srv.server_address[1]
+        self.thread = threading.Thread(target=self.srv.serve_forever,
+                                       daemon=True)
+        self.thread.start()
+
+    def close(self):
+        self.state.stop_probes()
+        self.srv.shutdown()
+        self.srv.server_close()
+
+
+def request(port, method, path, body=None, headers=None, timeout=30):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    try:
+        conn.request(method, path,
+                     json.dumps(body).encode() if body is not None else None,
+                     headers={"Content-Type": "application/json",
+                              **(headers or {})})
+        resp = conn.getresponse()
+        return resp.status, resp.read(), dict(resp.getheaders())
+    finally:
+        conn.close()
+
+
+CHAT = {"model": "m", "messages": [{"role": "user", "content": "hello"}]}
+
+EXPO_A = """# HELP dllama_http_requests_total HTTP responses
+# TYPE dllama_http_requests_total counter
+dllama_http_requests_total{route="/v1/chat/completions",code="200"} 7
+# HELP dllama_ttft_ms Time to first token
+# TYPE dllama_ttft_ms histogram
+dllama_ttft_ms_bucket{le="10"} 3
+dllama_ttft_ms_bucket{le="+Inf"} 7
+dllama_ttft_ms_sum 55.0
+dllama_ttft_ms_count 7
+"""
+
+EXPO_B = """# HELP dllama_http_requests_total HTTP responses
+# TYPE dllama_http_requests_total counter
+dllama_http_requests_total{route="/v1/chat/completions",code="200"} 5
+# HELP dllama_ttft_ms Time to first token
+# TYPE dllama_ttft_ms histogram
+dllama_ttft_ms_bucket{le="10"} 2
+dllama_ttft_ms_bucket{le="+Inf"} 5
+dllama_ttft_ms_sum 40.0
+dllama_ttft_ms_count 5
+"""
+
+
+def read_trace_events(path):
+    events = []
+    with open(path, "r", encoding="utf-8") as f:
+        for line in f:
+            line = line.strip().rstrip(",")
+            if not line or line in ("[", "]"):
+                continue
+            events.append(json.loads(line))
+    return events
+
+
+# ---------------------------------------------------------------------------
+# flight recorder: the black box
+# ---------------------------------------------------------------------------
+
+def test_flight_recorder_ring_is_bounded():
+    fr = observability.FlightRecorder(capacity=16, process="t")
+    for i in range(100):
+        fr.record("tick", i=i)
+    snap = fr.snapshot()
+    assert len(snap["events"]) == 16
+    assert snap["seq"] == 100
+    # the ring keeps the MOST RECENT events
+    assert snap["events"][-1]["i"] == 99
+    assert snap["events"][0]["i"] == 84
+
+
+def test_flight_dump_writes_json_and_counts(tmp_path, monkeypatch):
+    monkeypatch.setenv("DLLAMA_FLIGHT", str(tmp_path))
+    fr = observability.FlightRecorder(capacity=8, process="t2")
+    fr.record("request_start", request_id="req-abc")
+    target = fr.dump("test_reason")
+    assert target is not None
+    data = json.loads(open(target).read())
+    assert data["reason"] == "test_reason"
+    assert data["events"][-1]["request_id"] == "req-abc"
+
+
+@pytest.mark.faults
+def test_flight_dump_fault_is_swallowed(tmp_path, monkeypatch):
+    # an injected flight_dump fault must never escape: the dump returns
+    # None, the reason="error" counter moves, and the NEXT dump works
+    monkeypatch.setenv("DLLAMA_FLIGHT", str(tmp_path))
+    fr = observability.FlightRecorder(capacity=8, process="t3")
+    fr.record("tick")
+    faults.install("flight_dump:raise:times=1")
+    try:
+        assert fr.dump("crash") is None
+        target = fr.dump("crash")
+        assert target is not None
+    finally:
+        faults.clear()
+
+
+# ---------------------------------------------------------------------------
+# Server-Timing round trip + parent-span plumbing
+# ---------------------------------------------------------------------------
+
+def test_server_timing_header_round_trip():
+    tr = observability.RequestTrace("req-1")
+    tr.mark_start("solo")
+    tr.mark_prefill(2.5)
+    tr.mark_token()
+    tr.mark_token()
+    header = observability.server_timing_header(tr)
+    parsed = observability.parse_server_timing(header)
+    assert "queue" in parsed and "prefill" in parsed and "decode" in parsed
+    assert parsed["prefill"] == 2.5
+    assert all(v >= 0.0 for v in parsed.values())
+
+
+def test_parse_server_timing_tolerates_garbage():
+    parsed = observability.parse_server_timing(
+        'queue;dur=1.5, nonsense, bad;dur=xyz, total;dur="9.25";desc=x')
+    assert parsed == {"queue": 1.5, "total": 9.25}
+    assert observability.parse_server_timing("") == {}
+
+
+def test_sanitize_parent_span():
+    v = observability.parent_span_value(42)
+    assert observability.sanitize_parent_span(v) == v
+    assert observability.sanitize_parent_span(None) is None
+    assert observability.sanitize_parent_span("abc:def") is None
+    assert observability.sanitize_parent_span("12:34:56") is None
+    assert observability.sanitize_parent_span("1" * 80 + ":2") is None
+
+
+def test_request_trace_emits_flow_finish_under_parent():
+    tr = observability.RequestTrace("req-2", parent_span="123:456")
+    tr.mark_start("solo")
+    tr.mark_token()
+    tr.status, tr.finish_reason = 200, "stop"
+    events = tr.trace_events()
+    flows = [e for e in events if e.get("ph") == "f"]
+    assert len(flows) == 1 and flows[0]["id"] == "123:456"
+    assert flows[0]["bp"] == "e"
+    req = next(e for e in events if e["name"] == "request")
+    assert req["args"]["parent_span"] == "123:456"
+
+
+def test_request_trace_without_parent_is_valid_solo():
+    # a solo server (no router in front) must produce a well-formed trace
+    # with no flow events at all
+    tr = observability.RequestTrace("req-3", parent_span=None)
+    tr.mark_start("solo")
+    tr.mark_token()
+    tr.status, tr.finish_reason = 200, "stop"
+    events = tr.trace_events()
+    assert events and not [e for e in events if e.get("ph") in ("s", "f")]
+    req = next(e for e in events if e["name"] == "request")
+    assert "parent_span" not in req["args"]
+    for e in events:
+        json.dumps(e)  # every event serializes
+
+
+# ---------------------------------------------------------------------------
+# trace merge: clock-offset correction
+# ---------------------------------------------------------------------------
+
+def test_merge_trace_parts_shifts_timestamps(tmp_path):
+    base = tmp_path / "trace.json"
+    part = tmp_path / "trace.json.replica-9990"
+    base.write_text('[\n{"name":"router_proxy","ph":"X","ts":1000,'
+                    '"dur":50,"pid":1,"tid":1},\n')
+    # the replica's clock runs 10_000_000us AHEAD — an offset far larger
+    # than any span duration (the stitching edge case: naive merging
+    # would place the replica spans 10s away from their parent)
+    part.write_text('[\n{"name":"prefill","ph":"X","ts":10001000,'
+                    '"dur":20,"pid":2,"tid":1},\n'
+                    'garbage not json\n'
+                    '{"name":"process_name","ph":"M","pid":2,"tid":0,'
+                    '"args":{"name":"replica:9990"}},\n')
+    n = observability.merge_trace_parts(str(base), [(str(part), -10_000_000)])
+    assert n == 2  # the garbage line is skipped, not fatal
+    events = read_trace_events(str(base))
+    by_name = {e["name"]: e for e in events}
+    # after correction the replica span nests inside the router span
+    assert by_name["prefill"]["ts"] == 1000
+    assert "ts" not in by_name["process_name"] or \
+        by_name["process_name"].get("ts") is not None
+
+
+def test_merge_trace_parts_missing_part_is_noop(tmp_path):
+    base = tmp_path / "t.json"
+    base.write_text("[\n")
+    n = observability.merge_trace_parts(
+        str(base), [(str(tmp_path / "nope.json"), 0)])
+    assert n == 0
+
+
+# ---------------------------------------------------------------------------
+# metric federation
+# ---------------------------------------------------------------------------
+
+def test_metrics_fleet_sums_match_per_replica():
+    a = ObsReplica("a", metrics_text=EXPO_A)
+    b = ObsReplica("b", metrics_text=EXPO_B)
+    router = RouterUnderTest([a.addr, b.addr])
+    try:
+        router.state.probe_once()
+        code, body, headers = request(router.port, "GET", "/metrics/fleet")
+        assert code == 200
+        text = body.decode()
+        # every sample line carries a replica label, series stay disjoint
+        assert f'replica="{a.addr}"' in text
+        assert f'replica="{b.addr}"' in text
+        total = 0.0
+        for line in text.splitlines():
+            if line.startswith("dllama_http_requests_total{"):
+                total += float(line.rsplit(" ", 1)[1])
+        assert total == 12.0  # 7 (a) + 5 (b): counters sum across the fleet
+        # HELP/TYPE dedupe: one declaration per family, not per replica
+        assert text.count("# TYPE dllama_http_requests_total") == 1
+        # histogram buckets merge: both replicas' le="+Inf" series present
+        inf = [ln for ln in text.splitlines()
+               if ln.startswith("dllama_ttft_ms_bucket") and '+Inf' in ln]
+        assert len(inf) == 2
+        # the endpoint echoes request id + Server-Timing like every route
+        assert "Server-Timing" in headers
+    finally:
+        router.close(), a.close(), b.close()
+
+
+def test_metrics_fleet_drops_circuit_open_replica():
+    # a crashed replica's series must drop out with its circuit — no
+    # stale counters lingering in the merge after a crash-restart
+    a = ObsReplica("a", metrics_text=EXPO_A)
+    b = ObsReplica("b", metrics_text=EXPO_B)
+    router = RouterUnderTest([a.addr, b.addr])
+    try:
+        router.state.probe_once()
+        dead = next(r for r in router.state.replicas if r.name == b.addr)
+        dead.mark_conn_failure()  # opens the circuit
+        text = router.state.federate()
+        assert f'replica="{a.addr}"' in text
+        assert f'replica="{b.addr}"' not in text
+    finally:
+        router.close(), a.close(), b.close()
+
+
+@pytest.mark.faults
+def test_federate_scrape_fault_drops_replica_not_endpoint():
+    a = ObsReplica("a", metrics_text=EXPO_A)
+    b = ObsReplica("b", metrics_text=EXPO_B)
+    router = RouterUnderTest([a.addr, b.addr])
+    try:
+        router.state.probe_once()
+        faults.install("federate_scrape:raise:times=1")
+        try:
+            code, body, _ = request(router.port, "GET", "/metrics/fleet")
+        finally:
+            faults.clear()
+        assert code == 200  # the endpoint always answers
+        text = body.decode()
+        # the first scrape (replica a) was faulted and dropped; b survived
+        assert f'replica="{a.addr}"' not in text
+        assert f'replica="{b.addr}"' in text
+        err = router.state._m_federate_errors.value(replica=a.addr)
+        assert err == 1.0
+    finally:
+        router.close(), a.close(), b.close()
+
+
+# ---------------------------------------------------------------------------
+# probe staleness + replica identity
+# ---------------------------------------------------------------------------
+
+def test_probe_age_gauge_and_stale_fallback():
+    a = ObsReplica("a")
+    b = ObsReplica("b")
+    try:
+        st = make_state([a.addr, b.addr], probe_interval_s=0.05)
+        st.probe_once()
+        # gauge renders with a replica label after the first probe round
+        text = st.metrics.render()
+        assert "dllama_router_probe_age_seconds" in text
+        assert f'replica="{a.addr}"' in text
+        ra = next(r for r in st.replicas if r.name == a.addr)
+        rb = next(r for r in st.replicas if r.name == b.addr)
+        # replica a's snapshot claims terrible load, but goes STALE (no
+        # probe for > 2x interval); replica b stays fresh but carries a
+        # live in-flight request. Trusting the stale snapshot would route
+        # everything to b; the inflight-only fallback must pick a.
+        a.load.update(slots_occupied=8, queue_depth=8, kv_pages_free=0)
+        st.probe_replica(ra)
+        with ra._lock:
+            ra._probed_at = time.monotonic() - 10.0
+        rb.begin()
+        try:
+            picked, _ = st.pick([], frozenset())
+            assert picked.name == a.addr
+        finally:
+            rb.end()
+    finally:
+        a.close(), b.close()
+
+
+def test_probe_records_identity_and_clock_offset():
+    # the fake's clock runs 5s ahead; the probe's RTT/2 estimate must
+    # recover the offset to well under the skew magnitude
+    a = ObsReplica("a", replica_id="gen-A", skew_us=5_000_000)
+    try:
+        st = make_state([a.addr])
+        st.probe_once()
+        snap = st.replicas[0].snapshot()
+        assert snap["replica_id"] == "gen-A"
+        assert abs(snap["clock_offset_us"] - 5_000_000) < 500_000
+    finally:
+        a.close()
+
+
+def test_generation_change_is_logged_and_recorded():
+    a = ObsReplica("a", replica_id="gen-1")
+    try:
+        st = make_state([a.addr])
+        st.probe_once()
+        a.replica_id = "gen-2"  # the process behind host:port "restarted"
+        st.probe_once()
+        events = st.flight.snapshot()["events"]
+        gen = [e for e in events if e["kind"] == "replica_generation"]
+        assert len(gen) == 1
+        assert gen[0]["prev"] == "gen-1" and gen[0]["new"] == "gen-2"
+        # identity tracked forward: no repeat event on the next probe
+        st.probe_once()
+        events = st.flight.snapshot()["events"]
+        assert len([e for e in events
+                    if e["kind"] == "replica_generation"]) == 1
+    finally:
+        a.close()
+
+
+# ---------------------------------------------------------------------------
+# per-hop attribution + stitched router spans
+# ---------------------------------------------------------------------------
+
+def test_hop_attribution_from_server_timing():
+    a = ObsReplica("a", server_timing="queue;dur=1.5, prefill;dur=2.0, "
+                                      "decode;dur=3.5")
+    router = RouterUnderTest([a.addr])
+    try:
+        router.state.probe_once()
+        conn = http.client.HTTPConnection("127.0.0.1", router.port,
+                                          timeout=30)
+        try:
+            conn.request("POST", "/v1/chat/completions",
+                         json.dumps(CHAT).encode(),
+                         headers={"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            assert resp.status == 200
+            # the replica's phase split reaches the CLIENT too: getheader
+            # joins the forwarded replica header and the router's total
+            client_timing = resp.getheader("Server-Timing") or ""
+            assert "queue;dur=1.5" in client_timing
+            assert "total;dur=" in client_timing
+            resp.read()
+        finally:
+            conn.close()
+        # _finish_proxy runs AFTER the response bytes reach the client:
+        # wait for the handler thread to publish the histograms
+        hop = router.state._m_hop
+        deadline = time.monotonic() + 5.0
+        while (hop.percentile(50.0, phase="stream") !=
+               hop.percentile(50.0, phase="stream")  # nan: not yet
+               and time.monotonic() < deadline):
+            time.sleep(0.01)
+        assert hop.percentile(50.0, phase="connect") >= 0.0
+        assert hop.percentile(50.0, phase="stream") >= 0.0
+        assert hop.percentile(50.0, phase="upstream_queue") == 1.5
+        assert hop.percentile(50.0, phase="upstream_compute") == 5.5
+    finally:
+        router.close(), a.close()
+
+
+def test_proxy_emits_stitched_spans_and_parent_header(tmp_path):
+    a = ObsReplica("a")
+    trace = tmp_path / "router-trace.json"
+    observability.configure_trace(str(trace))
+    router = RouterUnderTest([a.addr])
+    try:
+        router.state.probe_once()
+        code, _, _ = request(router.port, "POST", "/v1/chat/completions",
+                             body=CHAT)
+        assert code == 200
+    finally:
+        # close the router FIRST: server_close joins handler threads, so
+        # _finish_proxy has emitted before the trace file closes
+        router.close(), a.close()
+        observability.configure_trace(None)
+    # the replica received a well-formed parent span header
+    _, _, headers = a.requests[-1]
+    parent = headers.get("X-Dllama-Parent-Span")
+    assert observability.sanitize_parent_span(parent) == parent
+    events = read_trace_events(str(trace))
+    proxy = [e for e in events if e["name"] == "router_proxy"]
+    assert len(proxy) == 1
+    assert proxy[0]["args"]["replica"] == a.addr
+    assert proxy[0]["args"]["status"] == 200
+    assert "error" not in proxy[0]["args"]
+    # the flow-arrow start carries the SAME id the replica was handed
+    flows = [e for e in events if e.get("ph") == "s"]
+    assert len(flows) == 1 and flows[0]["id"] == parent
+    assert [e for e in events if e["name"] == "connect"]
+    assert [e for e in events if e["name"] == "stream"]
+
+
+def test_dead_replica_closes_router_span_with_error(tmp_path):
+    # replica killed mid-request (here: never listening): the router span
+    # must still close, marked error=true — an orphan you can SEE in the
+    # merged trace, not a silently missing request
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        dead_port = s.getsockname()[1]
+    trace = tmp_path / "orphan-trace.json"
+    observability.configure_trace(str(trace))
+    router = RouterUnderTest([f"127.0.0.1:{dead_port}"],
+                             retry_budget=0, connect_timeout_s=0.5)
+    try:
+        code, _, _ = request(router.port, "POST", "/v1/chat/completions",
+                             body=CHAT)
+        assert code == 502
+    finally:
+        router.close()  # joins handler threads before the trace closes
+        observability.configure_trace(None)
+    events = read_trace_events(str(trace))
+    proxy = [e for e in events if e["name"] == "router_proxy"]
+    assert len(proxy) == 1
+    assert proxy[0]["args"]["error"] is True
+    assert proxy[0]["args"]["status"] == 502
+    assert proxy[0]["dur"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# /debug/flight aggregation + router flight events
+# ---------------------------------------------------------------------------
+
+def test_router_debug_flight_aggregates_fleet():
+    a = ObsReplica("a")
+    a.flight_snapshot = {"process": "replica-x", "events":
+                         [{"kind": "admit", "seq": 1}]}
+    router = RouterUnderTest([a.addr])
+    try:
+        router.state.probe_once()
+        code, body, _ = request(router.port, "GET", "/debug/flight")
+        assert code == 200
+        report = json.loads(body)
+        assert report["router"]["process"] == "router"
+        assert report["replicas"][a.addr]["events"][0]["kind"] == "admit"
+    finally:
+        router.close(), a.close()
+
+
+def test_upstream_failure_lands_in_router_flight_ring():
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        dead_port = s.getsockname()[1]
+    router = RouterUnderTest([f"127.0.0.1:{dead_port}"],
+                             retry_budget=0, connect_timeout_s=0.5)
+    try:
+        code, _, _ = request(router.port, "POST", "/v1/chat/completions",
+                             body=CHAT)
+        assert code == 502
+        events = router.state.flight.snapshot()["events"]
+        errs = [e for e in events if e["kind"] == "upstream_error"]
+        assert errs and errs[-1]["replica"] == f"127.0.0.1:{dead_port}"
+        # /debug/flight still answers, reporting the replica unreachable
+        code, body, _ = request(router.port, "GET", "/debug/flight")
+        assert code == 200
+        report = json.loads(body)
+        assert report["replicas"][f"127.0.0.1:{dead_port}"]["error"] \
+            == "unreachable"
+    finally:
+        router.close()
+
+
+def test_merge_expositions_unit():
+    merged = rt.merge_expositions([("r1", EXPO_A), ("r2", EXPO_B)])
+    assert 'dllama_http_requests_total{replica="r1",route=' in merged
+    assert 'dllama_ttft_ms_sum{replica="r2"} 40.0' in merged
+    assert merged.count("# HELP dllama_ttft_ms ") == 1
+    assert rt.merge_expositions([]) == ""
