@@ -631,6 +631,139 @@ def run_decode_bench(cfg_dict: dict, bench_steps: int = None, quant_ok: bool = F
                 f"migration copies — growth must append pages: {report}")
         return warm_p50, f"{weights}-prefix{n_req}-pg{page}{cfg_tag}"
 
+    # BENCH_OVERLAP=N replays ONE N-request mix through a real pooled
+    # BatchSession TWICE on the same TP mesh + quant weights — monolithic
+    # shard_map programs vs the microbatch compute/communication-overlap
+    # programs (--tp-overlap) — and reports the A/B wall-clock delta. The
+    # mode is EXACT by construction, so the replay FAILS unless the two
+    # runs stream bit-identical tokens AND the overlap engine actually
+    # engaged (dllama_tp_overlap_chunks_total moved; >= 2 resident rows).
+    # CPU-runnable (BENCH_MODEL=smoke + the CI lanes' 8 virtual devices):
+    # off-TPU the delta is plumbing-only — the ring-vs-fused gather win is
+    # an ICI property, so TPU numbers are owed for any perf claim.
+    # BENCH_OVERLAP_OUT writes the full report JSON for CI artifacts.
+    ovn = _env_count("BENCH_OVERLAP")
+    if ovn:
+        import numpy as np
+
+        from dllama_tpu import observability
+        from dllama_tpu.parallel.mesh import tp_mesh
+
+        # the serving smoke shape has n_kv_heads=4: pick the largest TP
+        # degree the head count supports instead of requiring n_dev | kv
+        tp = n_dev
+        while tp > 1 and cfg.n_kv_heads % tp:
+            tp -= 1
+        if tp < 2:
+            raise RuntimeError(
+                "BENCH_OVERLAP needs a TP mesh (run on >1 device, or CPU "
+                "with XLA_FLAGS=--xla_force_host_platform_device_count=8)")
+        ov_mesh = tp_mesh(tp)
+        qkind = weights if weights in ("q40", "q80") else "q40"
+        log(f"overlap A/B: tp={tp}, {qkind} weights, building engines...")
+        qparams = llama.device_random_quant_params(cfg, kind=qkind, seed=0)
+        reg = observability.MetricsRegistry()
+        greedy = SamplerConfig(temperature=0.0, seed=0)
+        e_mono = Engine(cfg, qparams, greedy, cache_dtype=cache_dtype,
+                        mesh=ov_mesh, metrics=None)
+        e_ov = Engine(cfg, qparams, greedy, cache_dtype=cache_dtype,
+                      mesh=ov_mesh, tp_overlap=True, metrics=reg)
+        if not e_ov.tp_overlap_active:
+            raise RuntimeError(
+                f"overlap engine did not come up overlapped: "
+                f"{e_ov.tp_overlap_reason}")
+
+        n_req = max(4, min(ovn, 64))
+        B = max(2, min(batch or 4, 8))
+        chunk = 8
+        rng = np.random.default_rng(0)
+        reqs = []
+        for i in range(n_req):
+            plen = int(rng.integers(4, max(8, cfg.seq_len // 8)))
+            steps = chunk * int(rng.integers(1, 4))
+            prompt = [int(t) for t in rng.integers(1, cfg.vocab_size, plen)]
+            reqs.append((prompt, steps))
+
+        def _overlap_replay(eng):
+            """Admit-all pooled drain -> (wall_s, tokens, [streams])."""
+            sess = eng.batch_session(B, chunk=chunk)
+            got = {}
+            pending = list(range(n_req))
+            handle_req = {}
+            t0 = time.perf_counter()
+            while pending or handle_req:
+                while pending and sess.free_slots:
+                    j = pending.pop(0)
+                    h = sess.admit(list(reqs[j][0]), steps=reqs[j][1],
+                                   sampler=greedy)
+                    handle_req[h] = j
+                for h, burst in sess.step_chunk().items():
+                    got.setdefault(handle_req[h], []).extend(burst)
+                    if sess.is_done(h):
+                        sess.release(h)
+                        del handle_req[h]
+            wall = time.perf_counter() - t0
+            sess.close()
+            streams = [got[j] for j in range(n_req)]
+            return wall, sum(len(s) for s in streams), streams
+
+        def _chunks(registry):
+            for line in registry.render().splitlines():
+                if line.startswith("dllama_tp_overlap_chunks_total"):
+                    return float(line.split()[-1])
+            return 0.0
+
+        _overlap_replay(e_mono)  # compile both ways before timing
+        _overlap_replay(e_ov)
+        engaged_at = _chunks(reg)
+        mono_wall, mono_tok, mono_streams = _overlap_replay(e_mono)
+        ov_wall, ov_tok, ov_streams = _overlap_replay(e_ov)
+        engaged = _chunks(reg) - engaged_at
+        if ov_streams != mono_streams:
+            diff = [j for j in range(n_req)
+                    if ov_streams[j] != mono_streams[j]]
+            raise RuntimeError(
+                f"overlap replay diverged from monolithic on request(s) "
+                f"{diff} — the mode must be bit-identical")
+        if engaged <= 0:
+            raise RuntimeError(
+                "overlap programs never engaged during the timed replay "
+                "(dllama_tp_overlap_chunks_total did not move)")
+        delta_pct = (mono_wall - ov_wall) / mono_wall * 100.0
+        log(f"monolithic {mono_tok / mono_wall:.1f} tok/s "
+            f"({mono_wall:.2f}s) vs overlap {ov_tok / ov_wall:.1f} tok/s "
+            f"({ov_wall:.2f}s): {delta_pct:+.1f}% wall "
+            f"({engaged:.0f} overlapped dispatches)")
+        on_tpu = jax.default_backend() == "tpu"
+        if not on_tpu:
+            log("CPU smoke: delta is plumbing-only — ring-vs-fused gather "
+                "wins need ICI; TPU numbers owed")
+        report = {
+            "requests": n_req, "pool": B, "tp": tp, "weights": qkind,
+            "wire": e_ov.tp_wire, "tokens": mono_tok,
+            "mono_wall_s": round(mono_wall, 3),
+            "overlap_wall_s": round(ov_wall, 3),
+            "mono_tok_s": round(mono_tok / mono_wall, 2),
+            "overlap_tok_s": round(ov_tok / ov_wall, 2),
+            "delta_pct": round(delta_pct, 2),
+            "overlap_chunks": engaged,
+            "bit_identical": True,
+            "backend": jax.default_backend(),
+            "tpu_deltas_owed": not on_tpu,
+        }
+        if not on_tpu:
+            report["note"] = ("CPU smoke: structural gates only (bit-"
+                              "identity + engagement); throughput deltas "
+                              "owed to the TPU battery — the ring-vs-fused "
+                              "gather win is an ICI property")
+        out_path = os.environ.get("BENCH_OVERLAP_OUT")
+        if out_path:
+            with open(out_path, "w") as f:
+                json.dump(report, f, indent=2)
+            log(f"report written to {out_path}")
+        return (ov_wall / max(ov_tok, 1)) * 1000.0, \
+            f"{qkind}-overlap{n_req}-tp{tp}{cfg_tag}"
+
     # BENCH_CONTINUOUS=N replays a staggered-arrival serving workload of N
     # requests through BOTH schedulers — the continuous slot pool
     # (Engine.batch_session: rows admitted mid-flight between fused chunks)
@@ -1580,6 +1713,7 @@ def main() -> None:
     choice = os.environ.get("BENCH_MODEL", "")
     err_phase = ("prefill" if _prefill_count()
                  else "prefix" if _env_count("BENCH_PREFIX")
+                 else "overlap" if _env_count("BENCH_OVERLAP")
                  else "serve" if _env_count("BENCH_CONTINUOUS")
                  else "faults" if _env_count("BENCH_FAULTS")
                  else "integrity" if _env_count("BENCH_INTEGRITY")
@@ -1686,6 +1820,7 @@ def main() -> None:
                                   or _env_count("BENCH_INTEGRITY")
                                   or _env_count("BENCH_OBS")
                                   or _env_count("BENCH_PREFIX")
+                                  or _env_count("BENCH_OVERLAP")
                                   or _prefill_count())):
         # the scheduling replays (continuous-vs-static, fault boundedness,
         # prefill stall) measure SCHEDULING, so the CPU default is a shape
@@ -1725,6 +1860,7 @@ def main() -> None:
 
     phase = ("prefill" if _prefill_count()
              else "prefix" if _env_count("BENCH_PREFIX")
+             else "overlap" if _env_count("BENCH_OVERLAP")
              else "serve" if _env_count("BENCH_CONTINUOUS")
              else "faults" if _env_count("BENCH_FAULTS")
              else "integrity" if _env_count("BENCH_INTEGRITY")

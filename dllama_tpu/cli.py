@@ -303,6 +303,16 @@ def build_parser() -> argparse.ArgumentParser:
             "(default on TPU: q40 when the model file is q40, else the --dtype); "
             "bf16/f16/f32 dequantize at load",
         )
+        sp.add_argument(
+            "--tp-overlap",
+            action="store_true",
+            help="microbatch compute/communication overlap for the batched "
+            "TP decode/verify programs: the batch splits into two "
+            "half-batches whose ring-scheduled activation gathers hide "
+            "under the other half's compute (bit-identical; engages only "
+            "when >=2 rows are resident; needs the quantized shard_map TP "
+            "path — dense or MoE runs warn and drop to monolithic)",
+        )
         sp.add_argument("--nthreads", type=int, default=None, help=argparse.SUPPRESS)
         if mode in ("inference", "generate"):
             sp.add_argument(
@@ -484,17 +494,25 @@ def load_engine(args):
     if tp_compress and not compress_active:
         print("⚠️  --buffer-float-type q80 only applies to quantized weights "
               "(q40/q80) under --tp; running plain gathers")
+    tp_overlap = bool(getattr(args, "tp_overlap", False))
+    if tp_overlap and (mesh is None or wft not in ("q40", "q80")):
+        # the Engine would warn-and-drop too; saying it here names the CLI
+        # knobs that would turn it on (the Engine only knows its inputs)
+        print("⚠️  --tp-overlap needs --tp > 1 with quantized weights "
+              "(q40/q80); running monolithic TP programs")
     from dllama_tpu.runtime.generate import DECODE_CHUNK
 
     # explicit None check: an invalid explicit value (e.g. 0) must reach
     # Engine's own validation and error, not silently become the default
     chunk = getattr(args, "decode_chunk", None)
     engine = Engine(cfg, params, sampler_cfg, cache_dtype=cache_dtype, mesh=mesh,
-                    tp_compress=compress_active,
+                    tp_compress=compress_active, tp_overlap=tp_overlap,
                     decode_chunk=DECODE_CHUNK if chunk is None else chunk)
     if mesh is not None:
         wire = "q80-compressed" if compress_active else "plain"
-        print(f"🔗 tensor-parallel over {n_tp} devices (ICI mesh, {wire} gathers)")
+        overlap = (", microbatch overlap" if engine.tp_overlap_active else "")
+        print(f"🔗 tensor-parallel over {n_tp} devices (ICI mesh, {wire} "
+              f"gathers{overlap})")
     return engine, tok, cfg
 
 

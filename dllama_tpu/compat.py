@@ -1,0 +1,65 @@
+"""jax version-compatibility shims.
+
+The codebase targets the current jax API (``jax.shard_map`` with
+``check_vma``, ``pltpu.CompilerParams``); older pins expose the same
+features under pre-rename names (``jax.experimental.shard_map`` with
+``check_rep``, ``pltpu.TPUCompilerParams``). Every call site routes
+through these helpers so the rename lives in exactly one place and the
+rest of the tree reads as if only the modern API existed.
+"""
+
+from __future__ import annotations
+
+import inspect
+
+try:  # modern jax: public export
+    from jax import shard_map as _shard_map  # type: ignore[attr-defined]
+except ImportError:  # older jax
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+# the replication-checking kwarg was renamed check_rep -> check_vma, and
+# manual axes moved from the inverted ``auto`` set to ``axis_names``
+_PARAMS = inspect.signature(_shard_map).parameters
+_CHECK_KW = "check_vma" if "check_vma" in _PARAMS else "check_rep"
+_HAS_AXIS_NAMES = "axis_names" in _PARAMS
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True,
+              axis_names=None):
+    """``jax.shard_map`` under either name of its replication-check kwarg.
+
+    ``axis_names`` (modern API: the mesh axes the body handles manually)
+    maps onto the older API's complement kwarg ``auto`` (the axes XLA still
+    partitions automatically)."""
+    kw = {_CHECK_KW: check_vma}
+    if axis_names is not None:
+        if _HAS_AXIS_NAMES:
+            kw["axis_names"] = set(axis_names)
+        else:
+            auto = frozenset(mesh.axis_names) - frozenset(axis_names)
+            if auto:
+                kw["auto"] = auto
+    return _shard_map(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kw,
+    )
+
+
+def axis_size(axis_name) -> int:
+    """``jax.lax.axis_size`` on jax versions that predate it. Inside
+    shard_map the fallback ``psum(1, axis)`` folds to a static python int,
+    so both branches are compile-time constants."""
+    import jax
+
+    if hasattr(jax.lax, "axis_size"):
+        return jax.lax.axis_size(axis_name)
+    return jax.lax.psum(1, axis_name)
+
+
+def tpu_compiler_params(**kwargs):
+    """``pltpu.CompilerParams(...)`` under its old (TPUCompilerParams) or
+    new name. Deferred pallas import: callers already import pallas lazily
+    so CPU-only processes never pay for (or require) the TPU backend."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    cls = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
+    return cls(**kwargs)

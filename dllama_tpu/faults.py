@@ -29,7 +29,10 @@ Spec grammar (sites separated by ``;``)::
   router's /metrics/fleet — a faulted scrape drops that replica from
   the merged exposition, never the endpoint), plus ``flight_dump``
   (every flight-recorder ring dump — a faulted dump is swallowed and
-  counted, proving the black box cannot crash the process).
+  counted, proving the black box cannot crash the process) and
+  ``overlap_split`` (every dispatch the Engine routes through a
+  microbatch-overlap TP program — an injected failure there flows
+  through the same chunk error handling as a real one).
 * ``action`` — ``raise`` (throw :class:`FaultInjected`), ``slow`` (sleep
   ``delay_ms``, default 50), or a *data* action the seam itself interprets:
   ``truncate`` (weights_open: pretend the file is ``drop`` bytes short,
@@ -57,7 +60,7 @@ import time
 SITES = ("admit", "step_chunk", "prefill", "prefill_chunk", "prefix_match",
          "page_alloc", "stream", "scheduler", "weights_open", "weights_read",
          "logits", "route_pick", "proxy_upstream", "probe",
-         "federate_scrape", "flight_dump")
+         "federate_scrape", "flight_dump", "overlap_split")
 ACTIONS = ("raise", "slow", "truncate", "bitflip", "nan")
 
 #: site -> the metric family that proves the site's failure is VISIBLE on
@@ -89,6 +92,10 @@ SITE_METRICS = {
     # reason="error" — the black box itself is fault-drilled
     "federate_scrape": "dllama_router_federate_errors_total",
     "flight_dump": "dllama_flight_dumps_total",
+    # every dispatch the Engine routes through a microbatch-overlap TP
+    # program (Engine._overlap_engaged) — a faulted split takes the same
+    # error path as a real chunk failure
+    "overlap_split": "dllama_tp_overlap_chunks_total",
 }
 
 

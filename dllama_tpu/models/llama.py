@@ -910,6 +910,161 @@ def forward_batched(
     return logits, {"k": new_k, "v": new_v}
 
 
+def _overlap_axis(tp_axis, ring: bool):
+    from dllama_tpu.parallel.collectives import RingAxis
+
+    return RingAxis(tp_axis) if (ring and tp_axis is not None) else tp_axis
+
+
+def _check_overlap_split(cfg: ModelConfig, batch: int) -> int:
+    """Static validation of the two-microbatch split; returns the cut row.
+
+    MoE is rejected at trace time: ``_moe_decode_selected`` computes the
+    selected-experts union over ALL rows (cap ``min(E, T*k)`` from the
+    column maxima), so a row-split changes which experts run and the
+    result would not be bit-identical to the monolithic step."""
+    if cfg.is_moe:
+        raise ValueError(
+            "tp_overlap requires a dense FFN: the MoE selected-experts "
+            "union spans all rows, so a microbatch split changes the "
+            "expert schedule (not bit-identical)")
+    if batch < 2:
+        raise ValueError(f"tp_overlap needs batch >= 2 rows, got {batch}")
+    return batch // 2
+
+
+def forward_batched_overlap(
+    cfg: ModelConfig,
+    params: dict,
+    rope: dict,
+    tokens: jnp.ndarray,  # [B] int32 — one pending token per sequence
+    cache: dict,  # {"k","v": [L, B, S, n_kv, hd]}
+    pos: jnp.ndarray,  # [B] int32 — each sequence's own position
+    tp_axis: str | None = None,
+    gather_logits: bool = True,
+    tp_compress: bool = False,
+    allow_flash: bool = True,
+    ring: bool = True,
+) -> tuple:
+    """``forward_batched`` with the rows split into two microbatches whose
+    per-layer schedules interleave — the TokenWeave-style compute/comm
+    overlap for TP decode, EXACT by construction.
+
+    Per layer, microbatch A's attention (ending in its head + wo gathers)
+    is issued before microbatch B's in program order; the two chains share
+    only the layer's weights (read-only), so XLA's latency-hiding
+    scheduler is free to run B's matmuls while A's gather is on the wire.
+    With ``ring=True`` each gather is the ``lax.ppermute`` chunk rotation
+    (`parallel.collectives.RingAxis`): tp-1 small async hops instead of
+    one fused blocking all-gather, giving the scheduler hop-granular
+    boundaries to hide. ``ring=False`` keeps fused all-gathers and relies
+    on XLA alone over the interleaved two-microbatch HLO.
+
+    Bit-identity with the monolithic step (tested across tp degrees with
+    and without ``tp_compress``): every op in the layer body is per-row
+    (rmsnorm, rope, cache write, attention, sampling upstream), the
+    matmuls compute each output row from the full K independent of the
+    other rows, and the gathered chunk concatenation order is fixed —
+    so splitting [B] into [B//2] + [B - B//2] permutes nothing. Both
+    halves advance inside ONE layer scan, so weights still stream from
+    HBM once per layer for all B rows. MoE is rejected (see
+    ``_check_overlap_split``)."""
+    B = tokens.shape[0]
+    h = _check_overlap_split(cfg, B)
+    ga = _overlap_axis(tp_axis, ring)
+    x = embed(cfg, params, tokens)
+    xa, xb = x[:h], x[h:]
+    pa, pb = pos[:h], pos[h:]
+    ka, kb = cache["k"][:, :h], cache["k"][:, h:]
+    va, vb = cache["v"][:, :h], cache["v"][:, h:]
+    layers = params["layers"]
+
+    def layer_step(carry, idx):
+        xa, xb, ka, kb, va, vb = carry
+        lp = {
+            name: (leaf if isinstance(leaf, QuantTensor)
+                   else jax.lax.dynamic_index_in_dim(leaf, idx, 0, keepdims=False))
+            for name, leaf in layers.items()
+        }
+        att_a, ka, va = _attn_block_batched(
+            cfg, lp, rope, xa, ka, va, pa, layer=idx,
+            tp_axis=ga, tp_compress=tp_compress)
+        att_b, kb, vb = _attn_block_batched(
+            cfg, lp, rope, xb, kb, vb, pb, layer=idx,
+            tp_axis=ga, tp_compress=tp_compress)
+        xa = _ffn_residual(cfg, lp, xa, att_a, ga, tp_compress, layer=idx)
+        xb = _ffn_residual(cfg, lp, xb, att_b, ga, tp_compress, layer=idx)
+        return (xa, xb, ka, kb, va, vb), None
+
+    (xa, xb, ka, kb, va, vb), _ = jax.lax.scan(
+        layer_step, (xa, xb, ka, kb, va, vb),
+        jnp.arange(cfg.n_layers, dtype=jnp.int32),
+    )
+    # rejoin, then a tail IDENTICAL to forward_batched's: the final rmsnorm,
+    # logits matmul and (plain fused) logits gather see the same [B, dim]
+    x = jnp.concatenate([xa, xb], axis=0)
+    new_k = jnp.concatenate([ka, kb], axis=1)
+    new_v = jnp.concatenate([va, vb], axis=1)
+    x = rmsnorm(x, params["rms_final"], cfg.norm_eps)
+    logits = matmul_any(x, params["wcls"]).astype(jnp.float32)
+    if tp_axis is not None and gather_logits:
+        logits = _gather(logits, tp_axis)[..., : cfg.vocab_size]
+    if cfg.logit_scale != 1.0:
+        logits = logits * cfg.logit_scale
+    return logits, {"k": new_k, "v": new_v}
+
+
+def _verify_layer(cfg: ModelConfig, lp: dict, rope: dict, x, k_cache,
+                  v_cache, pos, idx, tp_axis=None, tp_compress: bool = False):
+    """One layer of the batched spec-verify step: x [B, T, dim], stacked
+    [L, B, S, kv, hd] caches, per-row base positions ``pos``. The shared
+    body of ``forward_batched_verify`` and its microbatch-overlap twin."""
+    B, T = x.shape[:2]
+    xb = rmsnorm(x, lp["rms_att"], cfg.norm_eps)
+    xf = xb.reshape(B * T, cfg.dim)
+    if "wqkv" in lp:
+        qkv = matmul_any(xf, lp["wqkv"], idx)
+        d, kv = cfg.dim, cfg.kv_dim
+        q, k, v = qkv[:, :d], qkv[:, d : d + kv], qkv[:, d + kv :]
+    else:
+        q = matmul_any(xf, lp["wq"], idx)
+        k = matmul_any(xf, lp["wk"], idx)
+        v = matmul_any(xf, lp["wv"], idx)
+    # head counts derive from the ARRAY shapes: under tp they are the
+    # local slices (the reference's MultiHeadAttSlice head split)
+    q = q.reshape(B, T, -1, cfg.head_size)
+    k = k.reshape(B, T, -1, cfg.head_size)
+    v = v.reshape(B, T, -1, cfg.head_size)
+
+    # per-row angles for positions pos[b]..pos[b]+T-1 (the table gather
+    # clamps at seq_len-1; rows that close are emission-capped by the
+    # caller's budgets before any clamped position could be emitted)
+    ppos = pos[:, None] + jnp.arange(T, dtype=jnp.int32)[None, :]
+    cos = rope["cos"][ppos][:, :, None, :]  # [B, T, 1, hs/2]
+    sin = rope["sin"][ppos][:, :, None, :]
+    q = apply_rope(q, cos, sin, cfg.rope_style)
+    k = apply_rope(k, cos, sin, cfg.rope_style)
+
+    slab_k = jax.lax.dynamic_index_in_dim(k_cache, idx, 0, keepdims=False)
+    slab_v = jax.lax.dynamic_index_in_dim(v_cache, idx, 0, keepdims=False)
+    write = jax.vmap(
+        lambda c, kk, p: jax.lax.dynamic_update_slice_in_dim(
+            c, kk.astype(c.dtype), p, axis=0))
+    slab_k = write(slab_k, k, pos)
+    slab_v = write(slab_v, v, pos)
+    zero = (0, 0, 0, 0)
+    k_cache = jax.lax.dynamic_update_slice(k_cache, slab_k[None], (idx, *zero))
+    v_cache = jax.lax.dynamic_update_slice(v_cache, slab_v[None], (idx, *zero))
+
+    out = jax.vmap(gqa_attention)(q, slab_k, slab_v, pos)  # [B, T, H, hd]
+    heads = _gather(out.reshape(B * T, -1), tp_axis, tp_compress)
+    att = _gather(matmul_any(heads, lp["wo"], idx), tp_axis, tp_compress)
+    x = _ffn_residual(cfg, lp, x.reshape(B * T, cfg.dim),
+                      att, tp_axis, tp_compress,
+                      layer=idx).reshape(B, T, cfg.dim)
+    return x, k_cache, v_cache
+
+
 def forward_batched_verify(
     cfg: ModelConfig,
     params: dict,
@@ -948,54 +1103,75 @@ def forward_batched_verify(
                    else jax.lax.dynamic_index_in_dim(leaf, idx, 0, keepdims=False))
             for name, leaf in layers.items()
         }
-        xb = rmsnorm(x, lp["rms_att"], cfg.norm_eps)
-        xf = xb.reshape(B * T, cfg.dim)
-        if "wqkv" in lp:
-            qkv = matmul_any(xf, lp["wqkv"], idx)
-            d, kv = cfg.dim, cfg.kv_dim
-            q, k, v = qkv[:, :d], qkv[:, d : d + kv], qkv[:, d + kv :]
-        else:
-            q = matmul_any(xf, lp["wq"], idx)
-            k = matmul_any(xf, lp["wk"], idx)
-            v = matmul_any(xf, lp["wv"], idx)
-        # head counts derive from the ARRAY shapes: under tp they are the
-        # local slices (the reference's MultiHeadAttSlice head split)
-        q = q.reshape(B, T, -1, cfg.head_size)
-        k = k.reshape(B, T, -1, cfg.head_size)
-        v = v.reshape(B, T, -1, cfg.head_size)
-
-        # per-row angles for positions pos[b]..pos[b]+T-1 (the table gather
-        # clamps at seq_len-1; rows that close are emission-capped by the
-        # caller's budgets before any clamped position could be emitted)
-        ppos = pos[:, None] + jnp.arange(T, dtype=jnp.int32)[None, :]
-        cos = rope["cos"][ppos][:, :, None, :]  # [B, T, 1, hs/2]
-        sin = rope["sin"][ppos][:, :, None, :]
-        q = apply_rope(q, cos, sin, cfg.rope_style)
-        k = apply_rope(k, cos, sin, cfg.rope_style)
-
-        slab_k = jax.lax.dynamic_index_in_dim(k_cache, idx, 0, keepdims=False)
-        slab_v = jax.lax.dynamic_index_in_dim(v_cache, idx, 0, keepdims=False)
-        write = jax.vmap(
-            lambda c, kk, p: jax.lax.dynamic_update_slice_in_dim(
-                c, kk.astype(c.dtype), p, axis=0))
-        slab_k = write(slab_k, k, pos)
-        slab_v = write(slab_v, v, pos)
-        zero = (0, 0, 0, 0)
-        k_cache = jax.lax.dynamic_update_slice(k_cache, slab_k[None], (idx, *zero))
-        v_cache = jax.lax.dynamic_update_slice(v_cache, slab_v[None], (idx, *zero))
-
-        out = jax.vmap(gqa_attention)(q, slab_k, slab_v, pos)  # [B, T, H, hd]
-        heads = _gather(out.reshape(B * T, -1), tp_axis, tp_compress)
-        att = _gather(matmul_any(heads, lp["wo"], idx), tp_axis, tp_compress)
-        x = _ffn_residual(cfg, lp, x.reshape(B * T, cfg.dim),
-                          att, tp_axis, tp_compress,
-                          layer=idx).reshape(B, T, cfg.dim)
+        x, k_cache, v_cache = _verify_layer(
+            cfg, lp, rope, x, k_cache, v_cache, pos, idx,
+            tp_axis=tp_axis, tp_compress=tp_compress)
         return (x, k_cache, v_cache), None
 
     (x, new_k, new_v), _ = jax.lax.scan(
         layer_step, (x, cache["k"], cache["v"]),
         jnp.arange(cfg.n_layers, dtype=jnp.int32),
     )
+    x = rmsnorm(x, params["rms_final"], cfg.norm_eps)
+    logits = matmul_any(x.reshape(B * T, cfg.dim),
+                        params["wcls"]).astype(jnp.float32)
+    if tp_axis is not None and gather_logits:
+        # slice off lane-alignment vocab padding, exactly like `forward`
+        logits = _gather(logits, tp_axis)[..., : cfg.vocab_size]
+    logits = logits.reshape(B, T, -1)
+    if cfg.logit_scale != 1.0:
+        logits = logits * cfg.logit_scale
+    return logits, {"k": new_k, "v": new_v}
+
+
+def forward_batched_verify_overlap(
+    cfg: ModelConfig,
+    params: dict,
+    rope: dict,
+    tokens: jnp.ndarray,  # [B, T] int32 — pending + draft rows per sequence
+    cache: dict,  # {"k","v": [L, B, S, n_kv, hd]}
+    pos: jnp.ndarray,  # [B] int32 — position of tokens[b, 0]
+    tp_axis: str | None = None,
+    gather_logits: bool = True,
+    tp_compress: bool = False,
+    ring: bool = True,
+) -> tuple:
+    """``forward_batched_verify`` with the rows split into two interleaved
+    microbatches — the spec-verify twin of ``forward_batched_overlap``
+    (same exactness argument: ``_verify_layer`` is per-row throughout, the
+    flattened [h*T, dim] matmuls compute each row from the full K, and
+    ring-gather chunk order is fixed). Both halves share one layer scan so
+    weights stream once per layer."""
+    B, T = tokens.shape
+    h = _check_overlap_split(cfg, B)
+    ga = _overlap_axis(tp_axis, ring)
+    x = embed(cfg, params, tokens)  # [B, T, dim]
+    xa, xb = x[:h], x[h:]
+    pa, pb = pos[:h], pos[h:]
+    ka, kb = cache["k"][:, :h], cache["k"][:, h:]
+    va, vb = cache["v"][:, :h], cache["v"][:, h:]
+    layers = params["layers"]
+
+    def layer_step(carry, idx):
+        xa, xb, ka, kb, va, vb = carry
+        lp = {
+            name: (leaf if isinstance(leaf, QuantTensor)
+                   else jax.lax.dynamic_index_in_dim(leaf, idx, 0, keepdims=False))
+            for name, leaf in layers.items()
+        }
+        xa, ka, va = _verify_layer(cfg, lp, rope, xa, ka, va, pa, idx,
+                                   tp_axis=ga, tp_compress=tp_compress)
+        xb, kb, vb = _verify_layer(cfg, lp, rope, xb, kb, vb, pb, idx,
+                                   tp_axis=ga, tp_compress=tp_compress)
+        return (xa, xb, ka, kb, va, vb), None
+
+    (xa, xb, ka, kb, va, vb), _ = jax.lax.scan(
+        layer_step, (xa, xb, ka, kb, va, vb),
+        jnp.arange(cfg.n_layers, dtype=jnp.int32),
+    )
+    x = jnp.concatenate([xa, xb], axis=0)
+    new_k = jnp.concatenate([ka, kb], axis=1)
+    new_v = jnp.concatenate([va, vb], axis=1)
     x = rmsnorm(x, params["rms_final"], cfg.norm_eps)
     logits = matmul_any(x.reshape(B * T, cfg.dim),
                         params["wcls"]).astype(jnp.float32)

@@ -37,6 +37,8 @@ import sys
 import jax
 import jax.numpy as jnp
 
+from dllama_tpu import compat
+
 #: cache-block length (sequence positions per DMA). 256 divides every model
 #: seq_len the bench/CLI loads (512/1024/2048/4096/...); callers must fall
 #: back to the dense path when S % block is nonzero.
@@ -197,7 +199,7 @@ def _launch(qr, qpos, k5, v5, n_blk, layer, interpret):
         functools.partial(_kernel, block_s=BLOCK_S),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((B, n_kv, Tgp, hd), qr.dtype),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=compat.tpu_compiler_params(
             dimension_semantics=("arbitrary", "arbitrary")),
         interpret=interpret,
     )(idx, qr, qpos, k5, v5)

@@ -39,6 +39,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from dllama_tpu import compat
+
 from dllama_tpu.quants import blocks
 
 QK = blocks.QK  # 32 values per quantization block
@@ -196,7 +198,7 @@ def q80_matmul(x: jnp.ndarray, w: jnp.ndarray, scales: jnp.ndarray,
         ],
         out_specs=pl.BlockSpec((bt, bo), lambda t_, o, k: (t_, o)),
         out_shape=jax.ShapeDtypeStruct((T, O), jnp.float32),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=compat.tpu_compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary")
         ),
         interpret=interpret,
@@ -244,7 +246,7 @@ def q80_matmul_stacked(x: jnp.ndarray, w: jnp.ndarray, scales: jnp.ndarray,
         functools.partial(_q80_kernel, acc_dtype=jnp.float32, stacked=True),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((T, O), jnp.float32),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=compat.tpu_compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary")
         ),
         interpret=interpret,
@@ -332,7 +334,7 @@ def _q40_correction(xp, s_lo, s_hi, layer=None, interpret=False):
     O = s_lo.shape[-1]
     bo = O if O < 128 else min(1024, _pad_up(O, 128))
     bt = min(T, T_BLOCK)
-    params = pltpu.CompilerParams(
+    params = compat.tpu_compiler_params(
         dimension_semantics=("parallel", "parallel"))
     if layer is None:
         return pl.pallas_call(
@@ -403,7 +405,7 @@ def q40_matmul(x: jnp.ndarray, packed: jnp.ndarray, s_lo: jnp.ndarray,
         ],
         out_specs=pl.BlockSpec((bt, bo), lambda t_, o, k: (t_, o)),
         out_shape=jax.ShapeDtypeStruct((T, O), jnp.float32),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=compat.tpu_compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary")
         ),
         interpret=interpret,
@@ -454,7 +456,7 @@ def q40_matmul_stacked(x: jnp.ndarray, packed: jnp.ndarray, s_lo: jnp.ndarray,
                           nosub=nosub),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((T, O), jnp.float32),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=compat.tpu_compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary")
         ),
         interpret=interpret,

@@ -13,6 +13,51 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from dllama_tpu import compat
+
+
+class RingAxis(str):
+    """Marker for a tp axis whose gathers take the ppermute ring schedule.
+
+    Subclassing ``str`` keeps the axis usable everywhere a plain axis name
+    is (``is None`` checks, ``jax.lax`` axis-name arguments), so the
+    microbatch-overlap drivers can opt whole call chains into ring gathers
+    without threading an extra flag through every helper signature. The
+    ring is bit-identical to the fused all-gather (pure data movement,
+    same chunk order) — it exists because tp-1 small async permutes give
+    XLA's latency-hiding scheduler boundaries to overlap with the other
+    microbatch's compute, where one fused all-gather is a single blocking
+    wait."""
+
+    __slots__ = ()
+
+
+def _all_gather_last(x: jnp.ndarray, tp_axis) -> jnp.ndarray:
+    """All-gather on the feature (last) axis with chunks concatenated in
+    axis order — one fused collective, or the ``lax.ppermute`` chunk
+    rotation when ``tp_axis`` is a :class:`RingAxis` (the same primitive
+    ``parallel/pipeline.py`` rotates microbatches with). Identical results
+    either way; the assembly writes the chunk received on hop ``h`` at
+    slot ``(idx - h) mod tp``, which is exactly the tiled all-gather's
+    concatenation order."""
+    if not isinstance(tp_axis, RingAxis):
+        return jax.lax.all_gather(x, tp_axis, axis=-1, tiled=True)
+    axis = str(tp_axis)
+    tp = compat.axis_size(axis)  # static under shard_map
+    if tp == 1:
+        return x
+    idx = jax.lax.axis_index(axis)
+    lead, f = x.shape[:-1], x.shape[-1]
+    perm = [(i, (i + 1) % tp) for i in range(tp)]
+    out = jnp.zeros((*lead, tp, f), x.dtype)
+    out = jax.lax.dynamic_update_index_in_dim(out, x, idx, len(lead))
+    buf = x
+    for hop in range(1, tp):
+        buf = jax.lax.ppermute(buf, axis, perm)
+        out = jax.lax.dynamic_update_index_in_dim(
+            out, buf, (idx - hop) % tp, len(lead))
+    return out.reshape(*lead, tp * f)
+
 
 def gather_columns(x: jnp.ndarray, tp_axis, compress: bool = False) -> jnp.ndarray:
     """Concatenate the feature (last) axis across the tp axis (identity when
@@ -30,7 +75,7 @@ def gather_columns(x: jnp.ndarray, tp_axis, compress: bool = False) -> jnp.ndarr
     if tp_axis is None:
         return x
     if not compress:
-        return jax.lax.all_gather(x, tp_axis, axis=-1, tiled=True)
+        return _all_gather_last(x, tp_axis)
     lead = x.shape[:-1]
     f = x.shape[-1]
     xf = x.astype(jnp.float32).reshape(*lead, f // 32, 32)
@@ -45,7 +90,7 @@ def gather_columns(x: jnp.ndarray, tp_axis, compress: bool = False) -> jnp.ndarr
         scale[..., 0], jnp.int8
     ).reshape(*lead, f // 8)
     payload = jnp.concatenate([q.reshape(*lead, f), scale_bytes], axis=-1)
-    pg = jax.lax.all_gather(payload, tp_axis, axis=-1, tiled=True)
+    pg = _all_gather_last(payload, tp_axis)
     tp = pg.shape[-1] // (f + f // 8)
     pg = pg.reshape(*lead, tp, f + f // 8)
     qg = pg[..., :f].astype(jnp.float32).reshape(*lead, tp, f // 32, 32)

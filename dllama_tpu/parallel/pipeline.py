@@ -27,6 +27,8 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+
+from dllama_tpu import compat
 from jax.sharding import PartitionSpec as P
 
 from dllama_tpu.models import llama
@@ -99,7 +101,7 @@ def pipeline_forward_train(
         mask = (idx == S - 1).astype(finished.dtype)
         return jax.lax.psum(finished * mask, pp_axis)
 
-    mapped = jax.shard_map(
+    mapped = compat.shard_map(
         pipelined,
         mesh=mesh,
         in_specs=(P(pp_axis), P(), P(), P()),

@@ -44,10 +44,7 @@ from dllama_tpu.ops.qmatmul import K_MULTIPLE, QuantTensor, _pad_up
 from dllama_tpu.parallel.mesh import TP
 from dllama_tpu.parallel.sharding import cache_spec, check_tp_compatible
 
-try:  # jax >= 0.6 moved shard_map out of experimental
-    from jax import shard_map
-except ImportError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map
+from dllama_tpu.compat import shard_map
 
 
 def has_quant_leaves(params) -> bool:
@@ -245,30 +242,42 @@ def _make_tp_program(cfg: ModelConfig, mesh, params: dict, compress: bool,
 
 
 def make_tp_forward_batched(cfg: ModelConfig, mesh, params: dict,
-                            compress: bool = False):
+                            compress: bool = False, overlap: bool = False,
+                            overlap_ring: bool = True):
     """``fwd(params, rope, cache, tokens, pos) -> (logits, cache)`` for the
     BATCHED decode step (``llama.forward_batched``: tokens/pos are [B]) as a
     shard_map program over the same output-sharded quant planes as
     ``make_tp_forward`` — multi-chip batched serving, B sequences sharing
-    every local weight stream AND every ICI gather."""
+    every local weight stream AND every ICI gather.
+
+    ``overlap=True`` builds the two-microbatch compute/communication
+    overlap variant (``llama.forward_batched_overlap`` — bit-identical,
+    needs B >= 2 and a dense FFN); ``overlap_ring`` picks ppermute ring
+    gathers vs fused all-gathers + XLA latency hiding."""
     from dllama_tpu.models import llama
 
+    inner = (partial(llama.forward_batched_overlap, ring=overlap_ring)
+             if overlap else llama.forward_batched)
     return _make_tp_program(cfg, mesh, params, compress,
-                            llama.forward_batched, batch_cache_spec)
+                            inner, batch_cache_spec)
 
 
 def make_tp_verify_batched(cfg: ModelConfig, mesh, params: dict,
-                           compress: bool = False):
+                           compress: bool = False, overlap: bool = False,
+                           overlap_ring: bool = True):
     """``fwd(params, rope, cache, tokens, pos) -> (logits, cache)`` for the
     BATCHED speculative-verify step (``llama.forward_batched_verify``:
     tokens [B, T], pos [B]) as a shard_map program over the same
     output-sharded quant planes — batched speculation under tensor
     parallelism: draft_len+1 positions x B rows share every local weight
-    stream AND every ICI gather per launch."""
+    stream AND every ICI gather per launch. ``overlap``/``overlap_ring``
+    as in ``make_tp_forward_batched``."""
     from dllama_tpu.models import llama
 
+    inner = (partial(llama.forward_batched_verify_overlap, ring=overlap_ring)
+             if overlap else llama.forward_batched_verify)
     return _make_tp_program(cfg, mesh, params, compress,
-                            llama.forward_batched_verify, batch_cache_spec)
+                            inner, batch_cache_spec)
 
 
 def make_tp_forward(cfg: ModelConfig, mesh, params: dict, compress: bool = False):

@@ -112,6 +112,7 @@ class Engine:
         mesh=None,
         fuse_quant: bool = True,
         tp_compress: bool = False,
+        tp_overlap: bool = False,
         decode_chunk: int = DECODE_CHUNK,
         numeric_checks: bool = True,
         metrics=DEFAULT_METRICS,
@@ -120,6 +121,19 @@ class Engine:
         tensor-parallel — params are placed with the reference's row/col
         slicing as NamedShardings and XLA emits the AllReduces the reference
         hand-rolls as broadcast+gather+root-sum.
+
+        ``tp_overlap``: compile microbatch-overlap variants of the batched
+        decode / spec-verify TP programs alongside the monolithic ones
+        (llama.forward_batched_overlap): the batch splits into two
+        half-batches whose per-layer gathers are ring-scheduled
+        (collectives.RingAxis) so one microbatch's wire time hides under
+        the other's compute. Bit-identical to the monolithic programs; a
+        dispatch engages the overlap program only when >= 2 rows are
+        resident (see batch_loop/paged_loop/verify_program). Requested but
+        unavailable combinations (no mesh, dense-pjit TP, MoE) warn and
+        drop to monolithic — ``tp_overlap_active``/``tp_overlap_reason``
+        record the resolution machine-visibly (the server surfaces them
+        on /stats).
 
         ``numeric_checks``: fuse the numeric-health watchdog — an
         ``isfinite(logits)`` per-row flag — into every decode step (plus the
@@ -182,6 +196,10 @@ class Engine:
                 "dllama_prefix_evictions_total",
                 "Refcount-zero prefix-cache pages evicted (LRU) to satisfy "
                 "an allocation")
+            self._m_overlap = metrics.counter(
+                "dllama_tp_overlap_chunks_total",
+                "Decode/verify dispatches routed through the microbatch "
+                "compute/communication-overlap TP programs")
         else:
             self._m_prefill = self._m_step = self._m_chunk = None
             self._m_prefill_chunk = self._m_migrations = None
@@ -190,12 +208,21 @@ class Engine:
             self._m_spec_emitted = None
             self._m_prefix_hits = self._m_prefix_misses = None
             self._m_prefix_tokens = self._m_cow = None
-            self._m_prefix_evictions = None
+            self._m_prefix_evictions = self._m_overlap = None
         self.cfg = cfg
         self.sampler_cfg = sampler_cfg
         self.mesh = mesh
         self.numeric_checks = numeric_checks
         self._tp_compress = tp_compress
+        #: machine-visible wire/overlap resolution (served on /stats):
+        #: ``tp_wire`` is what actually crosses the interconnect per gather,
+        #: ``tp_overlap_active``/``tp_overlap_reason`` say whether the
+        #: microbatch-overlap programs were built and, if not, why the
+        #: request was dropped (warn-and-drop, never an error).
+        self.tp_wire = "plain"
+        self.tp_overlap_active = False
+        self.tp_overlap_reason = ("not requested" if not tp_overlap
+                                  else "no mesh (single device)")
         # fused-loop chunk: one host round trip per chunk of tokens. Bigger
         # chunks amortize dispatch/sync latency (dominant on tunneled or
         # remote-PJRT setups) at the cost of coarser streaming granularity.
@@ -213,6 +240,9 @@ class Engine:
         #: shard_map (the dense-pjit mesh path has no verify wrapper)
         self.supports_batch_spec = True
         self._batch_cache_sharding = None
+        # microbatch-overlap forward variants (quant-TP shard_map only);
+        # stay None when the overlap programs are unavailable or unwanted
+        fwd_b_ov = fwd_v_ov = None
         if mesh is not None:
             from dllama_tpu.parallel import quant_tp, sharding as _sh
             from jax.sharding import NamedSharding
@@ -231,6 +261,44 @@ class Engine:
                 tp_fwd_v = quant_tp.make_tp_verify_batched(
                     cfg, mesh, self.params, compress=tp_compress
                 )
+                if tp_compress:
+                    self.tp_wire = "q80"
+                if tp_overlap:
+                    if cfg.is_moe:
+                        # the MoE decode's selected-experts union spans all
+                        # rows (llama._check_overlap_split) — a half-batch
+                        # would change which experts load
+                        self.tp_overlap_reason = (
+                            "moe: selected-experts union spans rows")
+                        import sys as _sys
+
+                        print("dllama: tp_overlap requested but the model "
+                              "is MoE — the selected-experts union spans "
+                              "all rows, so the microbatch split is not "
+                              "exact; monolithic TP programs used",
+                              file=_sys.stderr, flush=True)
+                    else:
+                        tp_fwd_b_ov = quant_tp.make_tp_forward_batched(
+                            cfg, mesh, self.params, compress=tp_compress,
+                            overlap=True,
+                        )
+                        tp_fwd_v_ov = quant_tp.make_tp_verify_batched(
+                            cfg, mesh, self.params, compress=tp_compress,
+                            overlap=True,
+                        )
+
+                        def fwd_b_ov(cfg_, params_, rope_, tokens_, cache_,
+                                     pos_):
+                            return tp_fwd_b_ov(params_, rope_, cache_,
+                                               tokens_, pos_)
+
+                        def fwd_v_ov(cfg_, params_, rope_, tokens_, cache_,
+                                     pos_):
+                            return tp_fwd_v_ov(params_, rope_, cache_,
+                                               tokens_, pos_)
+
+                        self.tp_overlap_active = True
+                        self.tp_overlap_reason = "on"
 
                 fwd_last = None
 
@@ -245,6 +313,16 @@ class Engine:
 
             else:
                 self.supports_batch_spec = False
+                if tp_overlap:
+                    self.tp_overlap_reason = (
+                        "dense-pjit TP path (overlap needs the shard_map "
+                        "quant path)")
+                    import sys as _sys
+
+                    print("dllama: tp_overlap requested but the params are "
+                          "dense — the microbatch-overlap programs ride the "
+                          "shard_map quant-TP path; monolithic pjit used",
+                          file=_sys.stderr, flush=True)
                 # dense pjit: forward_batched partitions like forward (the
                 # per-row vmap'd attention shards by kv head unchanged).
                 # allow_flash=False — GSPMD cannot partition a Pallas custom
@@ -355,111 +433,131 @@ class Engine:
             )
             return toks, cache, ok
 
-        @partial(jax.jit, donate_argnums=(2,), static_argnames=("n_steps",))
-        def _decode_loop_batch(params, rope, cache, tokens, pos, keys, temps,
-                               topps, poison, n_steps):
-            """N batched decode steps fused into one program: every step
-            streams the weights ONCE for all B sequences (llama.forward_batched)
-            and samples each row on device. A row whose own context fills
-            before the batch's step budget pins at slot seq_len-1 (its later
-            tokens are garbage the caller discards); other rows are
-            unaffected — no cross-row truncation.
+        def _make_decode_loop_batch(fwd_b):
+            """Build the fused batched-decode chunk program around one
+            batched forward — called twice under tp_overlap (monolithic
+            fwd_b and the microbatch-overlap variant) so both programs run
+            the byte-identical scan/sampler/watchdog body."""
 
-            ``keys`` [B, 2] / ``temps`` [B] / ``topps`` [B]: every row runs
-            its OWN sampler chain and settings, split once per step exactly
-            like the solo paths' ``key, sub = split(key)`` — a sampled row
-            seeded like a solo request emits the solo request's exact stream
-            (the server batches mixed-sampler requests on this invariant).
+            @partial(jax.jit, donate_argnums=(2,),
+                     static_argnames=("n_steps",))
+            def _decode_loop_batch(params, rope, cache, tokens, pos, keys,
+                                   temps, topps, poison, n_steps):
+                """N batched decode steps fused into one program: every step
+                streams the weights ONCE for all B sequences
+                (llama.forward_batched) and samples each row on device. A row
+                whose own context fills before the batch's step budget pins
+                at slot seq_len-1 (its later tokens are garbage the caller
+                discards); other rows are unaffected — no cross-row
+                truncation.
 
-            ``ok`` [B] accumulates each row's watchdog flag over the chunk;
-            a poisoned row's garbage stays confined to its own row (per-row
-            sampling, per-row cache slab) — siblings are bit-identical."""
+                ``keys`` [B, 2] / ``temps`` [B] / ``topps`` [B]: every row
+                runs its OWN sampler chain and settings, split once per step
+                exactly like the solo paths' ``key, sub = split(key)`` — a
+                sampled row seeded like a solo request emits the solo
+                request's exact stream (the server batches mixed-sampler
+                requests on this invariant).
 
-            def body(carry, _):
-                cache, toks, pos_, keys_, ok = carry
-                logits, cache = fwd_b(cfg, params, rope, toks, cache, pos_)
-                logits, ok = _health(logits, poison, ok)
-                split = jax.vmap(jax.random.split)(keys_)  # [B, 2, 2]
-                keys_, subs = split[:, 0], split[:, 1]
-                nxt = jax.vmap(sample_dynamic)(logits, subs, temps, topps
-                                               ).astype(jnp.int32)
-                pos_ = jnp.minimum(pos_ + 1, jnp.int32(cfg.seq_len - 1))
-                return (cache, nxt, pos_, keys_, ok), nxt
+                ``ok`` [B] accumulates each row's watchdog flag over the
+                chunk; a poisoned row's garbage stays confined to its own row
+                (per-row sampling, per-row cache slab) — siblings are
+                bit-identical."""
 
-            (cache, toks, pos, keys, ok), out = jax.lax.scan(
-                body,
-                (cache, tokens, pos, keys,
-                 jnp.ones(tokens.shape, jnp.bool_)),
-                length=n_steps,
-            )
-            return out, cache, keys, ok  # out [n_steps, B], ok [B]
+                def body(carry, _):
+                    cache, toks, pos_, keys_, ok = carry
+                    logits, cache = fwd_b(cfg, params, rope, toks, cache,
+                                          pos_)
+                    logits, ok = _health(logits, poison, ok)
+                    split = jax.vmap(jax.random.split)(keys_)  # [B, 2, 2]
+                    keys_, subs = split[:, 0], split[:, 1]
+                    nxt = jax.vmap(sample_dynamic)(logits, subs, temps, topps
+                                                   ).astype(jnp.int32)
+                    pos_ = jnp.minimum(pos_ + 1, jnp.int32(cfg.seq_len - 1))
+                    return (cache, nxt, pos_, keys_, ok), nxt
 
-        @partial(jax.jit, donate_argnums=(2,), static_argnames=("n_steps",))
-        def _decode_loop_paged(params, rope, arena, tables, tokens, pos,
-                               keys, temps, topps, poison, n_steps):
-            """N batched decode steps over PAGED KV: the resident cache is
-            one arena of fixed-size token pages ``{k,v: [L, P, page, kv,
-            hd]}`` and ``tables`` [B, nb] maps each row's logical block b
-            to a physical page (scratch page 0 pads unallocated tails).
+                (cache, toks, pos, keys, ok), out = jax.lax.scan(
+                    body,
+                    (cache, tokens, pos, keys,
+                     jnp.ones(tokens.shape, jnp.bool_)),
+                    length=n_steps,
+                )
+                return out, cache, keys, ok  # out [n_steps, B], ok [B]
 
-            Each step gathers every row's pages into a contiguous
-            [L, B, nb*page, kv, hd] window — logical position i of the row
-            IS window index i, so ``forward_batched`` (rope by pos,
-            mask by pos, write-before-attend) runs on it unchanged and the
-            math is bit-identical to a bucketed slab of ctx=nb*page — then
-            scatters back ONLY the page containing the position this step
-            wrote. Aliased (prefix-cache) pages are never the written page:
-            a live row writes at pos >= prompt_len-1, strictly past every
-            fully-shared block, and pinned/done rows resolve to the scratch
-            page. Duplicate scatter indices (several pinned rows on
-            scratch) are harmless garbage-on-garbage.
+            return _decode_loop_batch
 
-            Sampling/health semantics are _decode_loop_batch's exactly:
-            per-row key chains split once per step, per-row watchdog ``ok``
-            accumulation, pos clamped at the window's last slot."""
-            page = arena["k"].shape[2]
-            B, nb = tables.shape
-            W = nb * page
+        def _make_decode_loop_paged(fwd_b):
+            """The paged twin of _make_decode_loop_batch — same
+            two-instantiation contract for the overlap variant."""
 
-            def gather(a):
-                w = jnp.take(a, tables, axis=1)  # [L, B, nb, page, kv, hd]
-                return w.reshape(a.shape[0], B, W, a.shape[3], a.shape[4])
+            @partial(jax.jit, donate_argnums=(2,),
+                     static_argnames=("n_steps",))
+            def _decode_loop_paged(params, rope, arena, tables, tokens, pos,
+                                   keys, temps, topps, poison, n_steps):
+                """N batched decode steps over PAGED KV: the resident cache is
+                one arena of fixed-size token pages ``{k,v: [L, P, page, kv,
+                hd]}`` and ``tables`` [B, nb] maps each row's logical block b
+                to a physical page (scratch page 0 pads unallocated tails).
 
-            def body(carry, _):
-                arena, toks, pos_, keys_, ok = carry
-                window = jax.tree.map(gather, arena)
-                logits, window = fwd_b(cfg, params, rope, toks, window, pos_)
-                logits, ok = _health(logits, poison, ok)
-                split = jax.vmap(jax.random.split)(keys_)
-                keys_, subs = split[:, 0], split[:, 1]
-                nxt = jax.vmap(sample_dynamic)(logits, subs, temps, topps
-                                               ).astype(jnp.int32)
-                wpos = jnp.clip(pos_, 0, W - 1)  # [B] position written
-                blk = wpos // page
-                phys = jnp.take_along_axis(tables, blk[:, None],
-                                           axis=1)[:, 0]  # [B]
-                off = blk * page
+                Each step gathers every row's pages into a contiguous
+                [L, B, nb*page, kv, hd] window — logical position i of the row
+                IS window index i, so ``forward_batched`` (rope by pos,
+                mask by pos, write-before-attend) runs on it unchanged and the
+                math is bit-identical to a bucketed slab of ctx=nb*page — then
+                scatters back ONLY the page containing the position this step
+                wrote. Aliased (prefix-cache) pages are never the written page:
+                a live row writes at pos >= prompt_len-1, strictly past every
+                fully-shared block, and pinned/done rows resolve to the scratch
+                page. Duplicate scatter indices (several pinned rows on
+                scratch) are harmless garbage-on-garbage.
 
-                def scat(a, w):
-                    # per row: the page-sized slice of the updated window
-                    # holding this step's K/V write, back to its arena page
-                    pg = jax.vmap(
-                        lambda wb, o: jax.lax.dynamic_slice_in_dim(
-                            wb, o, page, axis=1),
-                        in_axes=(1, 0), out_axes=1)(w, off)
-                    return a.at[:, phys].set(pg)  # [L, B, page, kv, hd]
+                Sampling/health semantics are _decode_loop_batch's exactly:
+                per-row key chains split once per step, per-row watchdog ``ok``
+                accumulation, pos clamped at the window's last slot."""
+                page = arena["k"].shape[2]
+                B, nb = tables.shape
+                W = nb * page
 
-                arena = jax.tree.map(scat, arena, window)
-                pos_ = jnp.minimum(pos_ + 1, jnp.int32(W - 1))
-                return (arena, nxt, pos_, keys_, ok), nxt
+                def gather(a):
+                    w = jnp.take(a, tables, axis=1)  # [L, B, nb, page, kv, hd]
+                    return w.reshape(a.shape[0], B, W, a.shape[3], a.shape[4])
 
-            (arena, toks, pos, keys, ok), out = jax.lax.scan(
-                body,
-                (arena, tokens, pos, keys,
-                 jnp.ones(tokens.shape, jnp.bool_)),
-                length=n_steps,
-            )
-            return out, arena, keys, ok  # out [n_steps, B], ok [B]
+                def body(carry, _):
+                    arena, toks, pos_, keys_, ok = carry
+                    window = jax.tree.map(gather, arena)
+                    logits, window = fwd_b(cfg, params, rope, toks, window, pos_)
+                    logits, ok = _health(logits, poison, ok)
+                    split = jax.vmap(jax.random.split)(keys_)
+                    keys_, subs = split[:, 0], split[:, 1]
+                    nxt = jax.vmap(sample_dynamic)(logits, subs, temps, topps
+                                                   ).astype(jnp.int32)
+                    wpos = jnp.clip(pos_, 0, W - 1)  # [B] position written
+                    blk = wpos // page
+                    phys = jnp.take_along_axis(tables, blk[:, None],
+                                               axis=1)[:, 0]  # [B]
+                    off = blk * page
+
+                    def scat(a, w):
+                        # per row: the page-sized slice of the updated window
+                        # holding this step's K/V write, back to its arena page
+                        pg = jax.vmap(
+                            lambda wb, o: jax.lax.dynamic_slice_in_dim(
+                                wb, o, page, axis=1),
+                            in_axes=(1, 0), out_axes=1)(w, off)
+                        return a.at[:, phys].set(pg)  # [L, B, page, kv, hd]
+
+                    arena = jax.tree.map(scat, arena, window)
+                    pos_ = jnp.minimum(pos_ + 1, jnp.int32(W - 1))
+                    return (arena, nxt, pos_, keys_, ok), nxt
+
+                (arena, toks, pos, keys, ok), out = jax.lax.scan(
+                    body,
+                    (arena, tokens, pos, keys,
+                     jnp.ones(tokens.shape, jnp.bool_)),
+                    length=n_steps,
+                )
+                return out, arena, keys, ok  # out [n_steps, B], ok [B]
+
+            return _decode_loop_paged
 
         bsh = (None if self._batch_cache_sharding is None else
                {"k": self._batch_cache_sharding, "v": self._batch_cache_sharding})
@@ -504,29 +602,49 @@ class Engine:
                     d, s, (0, 0, 0, 0, 0)), dst, src),
             donate_argnums=0,
         )
-        self._page_to_single = jax.jit(
-            # Arena page ``p`` into a single-sequence staging cache at token
-            # offset ``off`` — how a paged admission preloads its aliased
-            # prefix before tail prefill. p/off are traced: ONE compile
-            # serves every (page, offset), dispatched once per aliased page.
-            lambda single, arena, p, off: jax.tree.map(
-                lambda s, a: jax.lax.dynamic_update_slice(
-                    s, jax.lax.dynamic_index_in_dim(a, p, axis=1,
-                                                    keepdims=False),
-                    (0, off, 0, 0)), single, arena),
-            donate_argnums=0,
-        )
-        self._single_to_page = jax.jit(
-            # Token block [off, off+page) of a filled staging cache into
-            # arena page ``p`` — a completed prefill's fresh tail blocks
-            # scattered into the pool (the staging cache is then dropped).
-            lambda arena, single, p, off: jax.tree.map(
-                lambda a, s: a.at[:, p].set(jax.lax.dynamic_slice(
-                    s, (0, off, 0, 0),
-                    (s.shape[0], a.shape[2], s.shape[2], s.shape[3]))),
-                arena, single),
-            donate_argnums=0,
-        )
+        def _pages_to_single(single, arena, pages, ntok):
+            """Arena pages ``pages`` [NB] into token positions [0, ntok) of a
+            single-sequence staging cache — how a paged admission preloads
+            its whole aliased prefix in ONE gather dispatch (it used to loop
+            one dispatch per page). ``pages`` may be scratch-padded past the
+            prefix (callers pad to a power-of-two count so compiles stay
+            O(log max_nb), like the window ladder); the traced ``ntok`` mask
+            keeps the padding out of the staging cache."""
+
+            def go(s, a):
+                nb, page = pages.shape[0], a.shape[2]
+                w = jnp.take(a, pages, axis=1).reshape(
+                    a.shape[0], nb * page, a.shape[3], a.shape[4])
+                n = min(nb * page, s.shape[1])
+                w = jax.lax.slice_in_dim(w, 0, n, axis=1)
+                keep = (jnp.arange(n) < ntok)[None, :, None, None]
+                head = jax.lax.slice_in_dim(s, 0, n, axis=1)
+                return jax.lax.dynamic_update_slice(
+                    s, jnp.where(keep, w, head), (0, 0, 0, 0))
+
+            return jax.tree.map(go, single, arena)
+
+        self._pages_to_single = jax.jit(_pages_to_single, donate_argnums=0)
+
+        def _single_to_pages(arena, single, pages, offs):
+            """Token blocks [offs[i], offs[i]+page) of a filled staging
+            cache into arena pages ``pages[i]`` — a completed prefill's
+            fresh tail blocks scattered into the pool in ONE dispatch (the
+            staging cache is then dropped). Scratch-padded (page, off=0)
+            pairs land harmless garbage on the scratch page, the paged
+            decode loop's own duplicate-scatter convention."""
+
+            def go(a, s):
+                pg = jax.vmap(
+                    lambda o: jax.lax.dynamic_slice(
+                        s, (0, o, 0, 0),
+                        (s.shape[0], a.shape[2], s.shape[2], s.shape[3]))
+                )(offs)  # [M, L, page, kv, hd]
+                return a.at[:, pages].set(jnp.moveaxis(pg, 0, 1))
+
+            return jax.tree.map(go, arena, single)
+
+        self._single_to_pages = jax.jit(_single_to_pages, donate_argnums=0)
         self._page_copy = jax.jit(
             # Arena page ``src`` duplicated into page ``dst``: the
             # copy-on-write boundary — an admission whose prompt ends flush
@@ -540,15 +658,23 @@ class Engine:
             donate_argnums=0,
         )
 
-        @partial(jax.jit, donate_argnums=(2,))
-        def _verify_batch(params, rope, cache, tokens, pos):
-            """Batched greedy speculative verify: [B, T] candidate rows ->
-            every (row, position)'s argmax next token in ONE program — the
-            batching and speculation bandwidth wins composed (weights stream
-            once for B sequences x T positions). Single mesh or quant-TP
-            shard_map (fwd_v resolves to make_tp_verify_batched there)."""
-            logits, cache = fwd_v(cfg, params, rope, tokens, cache, pos)
-            return jnp.argmax(logits, axis=-1).astype(jnp.int32), cache
+        def _make_verify_batch(fwd_v):
+            """Build the batched verify program around one verify forward —
+            instantiated for the monolithic and (under tp_overlap) the
+            microbatch-overlap variants."""
+
+            @partial(jax.jit, donate_argnums=(2,))
+            def _verify_batch(params, rope, cache, tokens, pos):
+                """Batched greedy speculative verify: [B, T] candidate rows
+                -> every (row, position)'s argmax next token in ONE program —
+                the batching and speculation bandwidth wins composed (weights
+                stream once for B sequences x T positions). Single mesh or
+                quant-TP shard_map (fwd_v resolves to make_tp_verify_batched
+                there)."""
+                logits, cache = fwd_v(cfg, params, rope, tokens, cache, pos)
+                return jnp.argmax(logits, axis=-1).astype(jnp.int32), cache
+
+            return _verify_batch
 
         @partial(jax.jit, donate_argnums=(2,))
         def _verify_step(params, rope, cache, tokens, pos):
@@ -581,11 +707,26 @@ class Engine:
         self._flag_true = jnp.ones((), jnp.bool_)
         self._no_poison: dict = {}  # B -> cached all-False [B] flags
         self._decode_loop = partial(_decode_loop, self.params, self.rope)
-        self._decode_loop_batch = partial(_decode_loop_batch, self.params, self.rope)
-        self._decode_loop_paged = partial(_decode_loop_paged, self.params, self.rope)
+        self._decode_loop_batch = partial(
+            _make_decode_loop_batch(fwd_b), self.params, self.rope)
+        self._decode_loop_paged = partial(
+            _make_decode_loop_paged(fwd_b), self.params, self.rope)
         self._verify_step = partial(_verify_step, self.params, self.rope)
-        self._verify_batch = partial(_verify_batch, self.params, self.rope)
+        self._verify_batch = partial(
+            _make_verify_batch(fwd_v), self.params, self.rope)
         self._verify_sampled = partial(_verify_sampled, self.params, self.rope)
+        # overlap twins of the batched programs: same loop bodies around the
+        # microbatch-overlap forwards; None when overlap is inactive. A
+        # dispatch picks per call via batch_loop/paged_loop/verify_program.
+        self._decode_loop_batch_ov = (
+            partial(_make_decode_loop_batch(fwd_b_ov), self.params, self.rope)
+            if fwd_b_ov is not None else None)
+        self._decode_loop_paged_ov = (
+            partial(_make_decode_loop_paged(fwd_b_ov), self.params, self.rope)
+            if fwd_b_ov is not None else None)
+        self._verify_batch_ov = (
+            partial(_make_verify_batch(fwd_v_ov), self.params, self.rope)
+            if fwd_v_ov is not None else None)
 
         # compiled once; materializes the cache already-sharded (allocate-then-
         # reshard would transiently put the FULL cache in one device's HBM,
@@ -695,6 +836,46 @@ class Engine:
 
     def new_cache(self) -> dict:
         return self._init_cache()
+
+    def _overlap_engaged(self, rows: int) -> bool:
+        """One overlap dispatch decision: True routes this call through the
+        microbatch-overlap program. Engages only when >= 2 rows are live —
+        a lone resident row has no second microbatch to hide wire time
+        behind, so it takes the monolithic program (same math either way;
+        the overlap twin's static batch split is pool-sized regardless).
+        Fires the ``overlap_split`` fault seam and counts the engagement
+        (dllama_tp_overlap_chunks_total) so A/B replays and the obs drill
+        can prove which program served each chunk."""
+        if rows < 2:
+            return False
+        faults.fire("overlap_split")
+        if self._m_overlap is not None:
+            self._m_overlap.inc()
+        return True
+
+    def batch_loop(self, rows: int):
+        """The fused batched-decode chunk program for a dispatch with
+        ``rows`` live rows — the overlap twin when built and engaged,
+        else the monolithic program."""
+        if self._decode_loop_batch_ov is not None \
+                and self._overlap_engaged(rows):
+            return self._decode_loop_batch_ov
+        return self._decode_loop_batch
+
+    def paged_loop(self, rows: int):
+        """Paged twin of :meth:`batch_loop` (same engagement rule)."""
+        if self._decode_loop_paged_ov is not None \
+                and self._overlap_engaged(rows):
+            return self._decode_loop_paged_ov
+        return self._decode_loop_paged
+
+    def verify_program(self, rows: int):
+        """The batched spec-verify program for ``rows`` live rows (see
+        :meth:`batch_loop`)."""
+        if self._verify_batch_ov is not None \
+                and self._overlap_engaged(rows):
+            return self._verify_batch_ov
+        return self._verify_batch
 
     def next_key(self) -> jax.Array:
         self._key, sub = jax.random.split(self._key)
@@ -1043,7 +1224,7 @@ class Engine:
         while remaining > 0:
             tc = time.perf_counter()
             n = min(self.decode_chunk, prefill_bucket(remaining))
-            chunk, cache, keys, ok = self._decode_loop_batch(
+            chunk, cache, keys, ok = self.batch_loop(B)(
                 cache, tokens, pos, keys, temps, topps,
                 self._poison_rows(B), n_steps=n
             )
@@ -1253,7 +1434,7 @@ class Engine:
                 d = indexes[b].draft(pend[b], k) if k > 0 else []
                 drafts.append(d)
                 feeds.append([pend[b]] + d + [0] * (T - 1 - len(d)))
-            g, cache = self._verify_batch(
+            g, cache = self.verify_program(B)(
                 cache, jnp.asarray(feeds, jnp.int32),
                 jnp.asarray([min(poss[b], S - T) if done[b] else poss[b]
                              for b in range(B)], jnp.int32))
@@ -1938,6 +2119,23 @@ class BatchSession:
                 return nb
         return self._nb_ladder[-1]
 
+    def _pad_pages(self, pages: list, offs: Optional[list] = None):
+        """Scratch-pad a page (and optional offset) list to the next power
+        of two so the batched admit copies (Engine._pages_to_single /
+        _single_to_pages) compile one program per size bucket instead of
+        one per distinct prefix length. Padded entries resolve to the
+        scratch page — garbage writes/reads the copy helpers mask or the
+        arena convention already tolerates."""
+        n = max(1, len(pages))
+        m = 1
+        while m < n:
+            m *= 2
+        pad = m - len(pages)
+        out = jnp.asarray(pages + [paged_kv.SCRATCH_PAGE] * pad, jnp.int32)
+        if offs is None:
+            return out
+        return out, jnp.asarray(offs + [0] * pad, jnp.int32)
+
     def _alloc_prow(self, nb: int) -> tuple:
         """A free row in the ``nb``-block group, materializing/growing it
         on demand (mirrors _alloc_row)."""
@@ -1991,13 +2189,20 @@ class BatchSession:
         rp = self._rowpages[handle]
         plen = len(prompt_tokens)
         total = (plen - 1) // self.page + 1
+        # allocation stays a host loop (per-page fault seam + allocator
+        # bookkeeping); the device scatters coalesce into ONE dispatch below
+        scat_pages: list = []
+        scat_offs: list = []
         for b in range(len(rp.blocks), total):
             p = self._page_alloc(rp)
             if staging is not None and b * self.page < plen - 1:
-                self._arena = self.eng._single_to_page(
-                    self._arena, staging, jnp.int32(p),
-                    jnp.int32(b * self.page))
+                scat_pages.append(p)
+                scat_offs.append(b * self.page)
             rp.blocks.append(p)
+        if scat_pages:
+            pages, offs = self._pad_pages(scat_pages, scat_offs)
+            self._arena = self.eng._single_to_pages(
+                self._arena, staging, pages, offs)
         # blocks with (b+1)*page <= plen-1 hold immutable prompt KV (this
         # row only writes at pos >= plen-1): cacheable for future admits
         nins = (plen - 1) // self.page
@@ -2198,13 +2403,16 @@ class BatchSession:
         faults.fire("prefill")
         st.prefilling = True
         staging = self.eng.new_cache()
-        for b, n in enumerate(full):
-            # preload the aliased blocks so the chunked prefill continues
-            # at ``cached`` over the exact KV a cold prefill would have
-            # written (the chunked==monolithic invariant then carries)
-            staging = self.eng._page_to_single(
-                staging, self._arena, jnp.int32(n.page),
-                jnp.int32(b * self.page))
+        if full:
+            # preload ALL aliased blocks in one gather dispatch so the
+            # chunked prefill continues at ``cached`` over the exact KV a
+            # cold prefill would have written (the chunked==monolithic
+            # invariant then carries) — a W-block warm prefix costs O(1)
+            # dispatches, not O(W)
+            staging = self.eng._pages_to_single(
+                staging, self._arena,
+                self._pad_pages([n.page for n in full]),
+                jnp.int32(len(full) * self.page))
         pf = _PendingPrefill(prompt_tokens, scfg, staging)
         pf.cursor = cached
         self._prefills[handle] = pf
@@ -2374,7 +2582,7 @@ class BatchSession:
             if not live:
                 continue
             t1 = time.perf_counter()
-            chunk, pool.cache, keys, ok = self.eng._decode_loop_batch(
+            chunk, pool.cache, keys, ok = self.eng.batch_loop(len(live))(
                 pool.cache, jnp.asarray(pool.tokens),
                 jnp.asarray(pool.pos), jnp.asarray(pool.keys),
                 jnp.asarray(pool.temps), jnp.asarray(pool.topps),
@@ -2461,7 +2669,7 @@ class BatchSession:
                 continue
             W = nb * self.page
             t1 = time.perf_counter()
-            chunk, self._arena, keys, ok = self.eng._decode_loop_paged(
+            chunk, self._arena, keys, ok = self.eng.paged_loop(len(live))(
                 self._arena, jnp.asarray(g.tables),
                 jnp.asarray(g.tokens), jnp.asarray(g.pos),
                 jnp.asarray(g.keys), jnp.asarray(g.temps),

@@ -899,6 +899,18 @@ class ServerState:
             "dllama_sse_disconnects_total",
             "Streaming responses whose client vanished mid-stream (the "
             "decode row is cancelled at its next chunk boundary)")
+        # info-style gauge (value 1, identity in the labels): the resolved
+        # TP wire format and overlap mode ride /metrics — and therefore the
+        # router's federated /metrics/fleet — so a q80 request that was
+        # warned-and-dropped to plain gathers is machine-visible fleet-wide
+        reg.gauge("dllama_tp_wire_info",
+                  "Resolved TP wire/overlap configuration (labels carry "
+                  "the values; constant 1)",
+                  labelnames=("tp_wire", "tp_overlap")).set(
+            1.0,
+            tp_wire=getattr(engine, "tp_wire", "plain"),
+            tp_overlap=("on" if getattr(engine, "tp_overlap_active", False)
+                        else "off"))
         reg.gauge("dllama_batch_queue_depth",
                   "Arrivals waiting for the batch scheduler").set_function(
             lambda: float(self.batcher.queue_depth())
@@ -1067,6 +1079,15 @@ class ServerState:
                             if batcher is not None else 0),
             "slots_occupied": occupied,
             "slots_total": total,
+            # TP wire resolution, machine-visible: a q80 request the CLI
+            # warned-and-dropped reads back "plain" here, and tp_overlap
+            # says whether the microbatch-overlap programs were actually
+            # built (with the drop reason when not)
+            "tp_wire": getattr(self.engine, "tp_wire", "plain"),
+            "tp_overlap": ("on" if getattr(self.engine, "tp_overlap_active",
+                                           False) else "off"),
+            "tp_overlap_reason": getattr(self.engine, "tp_overlap_reason",
+                                         "not requested"),
             **kv,
         }
 

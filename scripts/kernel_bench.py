@@ -10,6 +10,15 @@ device time per call. Effective GB/s is against the bytes the kernel must
 stream (weights + scales; activations are noise at T=1).
 
 Usage: python scripts/kernel_bench.py [q40|q80|bf16|all] [K] [O] [iters]
+
+``gather`` mode microbenches the TP activation wire instead of the matmul
+kernels: the plain fused all-gather vs the Q80-compressed payload vs the
+``lax.ppermute`` ring schedule (collectives.RingAxis — what ``--tp-overlap``
+pipelines against the other microbatch's compute), at decode activation
+sizes (T rows x F features, gathered across all visible devices). Same
+difference-timing idiom, so the tunnel round trip cancels.
+
+Usage: python scripts/kernel_bench.py gather [F] [T] [iters]
 """
 
 import functools
@@ -76,8 +85,75 @@ def bench(kind, K, O, iters=256, T=1):
     return ms, gbs
 
 
+def bench_gather(F=4096, T=1, iters=256):
+    """Time one TP activation gather three ways at a decode shape: plain
+    fused all-gather, Q80-compressed payload (1.125 bytes/feature in ONE
+    collective), and the ppermute ring schedule the overlap mode uses.
+    Wire bytes are the (tp-1)/tp fraction each chip must receive."""
+    from dllama_tpu.parallel import collectives
+    from dllama_tpu.parallel.mesh import tp_mesh
+
+    from dllama_tpu import compat
+
+    tp = len(jax.devices())
+    if tp < 2:
+        raise SystemExit(
+            "gather mode needs >1 device (TPU slice, or CPU with "
+            "XLA_FLAGS=--xla_force_host_platform_device_count=8)")
+    mesh = tp_mesh(tp)
+    f_local = F // tp // 32 * 32  # local shard, q80-block aligned
+    F_eff = f_local * tp
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((T, F_eff)).astype(np.float32)
+                    ).astype(jnp.bfloat16)
+
+    results = {}
+    for name, axis, compress in (
+        ("plain", "tp", False),
+        ("q80", "tp", True),
+        ("ring", collectives.RingAxis("tp"), False),
+        ("ring+q80", collectives.RingAxis("tp"), True),
+    ):
+        def tp_gather(xs, _axis=axis, _c=compress):
+            g = collectives.gather_columns(xs, _axis, compress=_c)
+            # feed the local shard back in so scan iterations chain (no CSE)
+            idx = jax.lax.axis_index("tp")
+            lo = idx * f_local
+            return (jax.lax.dynamic_slice_in_dim(g, lo, f_local, axis=-1)
+                    * jnp.bfloat16(1.0))
+
+        sharded = compat.shard_map(
+            tp_gather, mesh=mesh,
+            in_specs=jax.sharding.PartitionSpec(None, "tp"),
+            out_specs=jax.sharding.PartitionSpec(None, "tp"))
+
+        @functools.partial(jax.jit, static_argnames=("n",))
+        def run(xs, n):
+            def step(xs, _):
+                return sharded(xs), ()
+            xs, _ = jax.lax.scan(step, xs, None, length=n)
+            return jnp.sum(xs.astype(jnp.float32))
+
+        t1 = _timed_host_sync(functools.partial(run, n=iters), x)
+        t2 = _timed_host_sync(functools.partial(run, n=2 * iters), x)
+        ms = max(t2 - t1, 1e-9) * 1e3 / iters
+        wire = (T * F_eff * (1.125 if compress else 2.0)) * (tp - 1) / tp
+        results[name] = ms
+        print(f"gather {name:8s} F={F_eff} T={T} tp={tp}: {ms:7.4f} ms/call"
+              f"  {wire/1e3:7.1f} KB wire/chip"
+              f"   [t({iters})={t1*1e3:.0f}ms t({2*iters})={t2*1e3:.0f}ms]",
+              flush=True)
+    return results
+
+
 if __name__ == "__main__":
     kind = sys.argv[1] if len(sys.argv) > 1 else "all"
+    if kind == "gather":
+        F = int(sys.argv[2]) if len(sys.argv) > 2 else 4096
+        T = int(sys.argv[3]) if len(sys.argv) > 3 else 1
+        iters = int(sys.argv[4]) if len(sys.argv) > 4 else 256
+        bench_gather(F, T, iters)
+        sys.exit(0)
     K = int(sys.argv[2]) if len(sys.argv) > 2 else 4096
     O = int(sys.argv[3]) if len(sys.argv) > 3 else 11008
     iters = int(sys.argv[4]) if len(sys.argv) > 4 else 256

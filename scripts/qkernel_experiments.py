@@ -41,6 +41,8 @@ apply_platform_override(jax)
 import jax.numpy as jnp
 import numpy as np
 
+from dllama_tpu import compat
+
 sys.path.insert(0, __import__("os").path.dirname(__import__("os").path.dirname(
     __import__("os").path.abspath(__file__))))
 
@@ -106,7 +108,7 @@ def variant_b(x, qt):
         ],
         out_specs=pl.BlockSpec((bt, bo), lambda t_, o, k: (t_, o)),
         out_shape=jax.ShapeDtypeStruct((T, O), jnp.float32),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=compat.tpu_compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=jax.default_backend() != "tpu",
     )(x_lo, x_hi, packed, s_lo, s_hi)
@@ -208,7 +210,7 @@ def variant_e(x, qt):
         ],
         out_specs=pl.BlockSpec((bt, bo), lambda t_, o, k: (t_, o)),
         out_shape=jax.ShapeDtypeStruct((T, O), jnp.float32),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=compat.tpu_compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=jax.default_backend() != "tpu",
     )(x_lo, x_hi, sx_lo, sx_hi, packed, s_lo, s_hi)
@@ -252,7 +254,7 @@ def variant_f(x, qt):
         ],
         out_specs=pl.BlockSpec((bt, bo), lambda t_, o, k: (t_, o)),
         out_shape=jax.ShapeDtypeStruct((T, O), jnp.float32),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=compat.tpu_compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=jax.default_backend() != "tpu",
     )(x_lo, x_hi, packed, s_lo, s_hi)
@@ -311,7 +313,7 @@ def _variant_g_impl(x, qt, s_lo_bf16, s_hi_bf16):
         ],
         out_specs=pl.BlockSpec((bt, bo), lambda t_, o, k: (t_, o)),
         out_shape=jax.ShapeDtypeStruct((T, O), jnp.float32),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=compat.tpu_compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=jax.default_backend() != "tpu",
     )(x_lo, x_hi, packed, s_lo, s_hi)

@@ -308,6 +308,47 @@ def test_eviction_under_pressure_keeps_identity():
     sess.close()
 
 
+def test_admit_copy_dispatch_counts():
+    """The paged-admit batching bar: a W-block warm prefix preloads its
+    staging cache in ONE gather dispatch and a completed prefill's tail
+    blocks scatter into the arena in ONE dispatch — O(1) device calls in
+    the number of blocks, where the per-page loop was O(W). Streams stay
+    bit-identical (the batched copies move the exact same KV)."""
+    params = llama.random_params(CFG, seed=9, dtype=np.float32)
+    scfg = SamplerConfig(temperature=0.0, seed=4)
+    prompt = [(i * 13 + 5) % 96 for i in range(60)]  # 8 pages: 7 full + tail
+    eng = Engine(CFG, params, SamplerConfig(temperature=0.0))
+    calls = {"gather": 0, "scatter": 0}
+    g0, s0 = eng._pages_to_single, eng._single_to_pages
+
+    def gather(*a, **k):
+        calls["gather"] += 1
+        return g0(*a, **k)
+
+    def scatter(*a, **k):
+        calls["scatter"] += 1
+        return s0(*a, **k)
+
+    eng._pages_to_single, eng._single_to_pages = gather, scatter
+    sess = eng.batch_session(max_batch=2, chunk=4, prefill_chunk=16,
+                             kv_pages=8)
+    h1 = sess.admit_begin(prompt, steps=4, sampler=scfg)
+    cold = _drain_interleaved(sess, {h1: []})[h1]
+    assert calls["gather"] == 0  # nothing cached yet — no preload at all
+    assert calls["scatter"] == 1, "cold tail must scatter in ONE dispatch"
+    sess.release(h1)
+
+    calls["gather"] = calls["scatter"] = 0
+    h2 = sess.admit_begin(prompt, steps=4, sampler=scfg)
+    assert sess.prefix_tokens_matched == 7 * 8  # 7 aliased full blocks
+    warm = _drain_interleaved(sess, {h2: []})[h2]
+    assert warm == cold, "batched admit copies diverged from cold stream"
+    assert calls["gather"] == 1, \
+        "a 7-block warm prefix must preload in ONE gather dispatch"
+    assert calls["scatter"] == 1
+    sess.close()
+
+
 # ---------------------------------------------------------------------------
 # capacity + introspection
 # ---------------------------------------------------------------------------

@@ -1,0 +1,239 @@
+"""Microbatched compute/communication overlap for TP decode (--tp-overlap).
+
+The overlap programs split the batched decode / spec-verify shard_map
+programs into two half-batch microbatches pipelined per layer, with the
+activation all-gathers rescheduled as explicit `lax.ppermute` chunk
+rotations (`collectives.RingAxis`) so one microbatch's wire time hides
+under the other's compute. The mode is only worth having if it is EXACT:
+every test here asserts bit-identity against the monolithic programs —
+same mesh, same params, same sampler chain — across tp degree, the Q80
+compressed wire, both batched entry points (decode and speculative
+verify), odd batch sizes (uneven split), and both KV layouts of the
+pooled session (slab and paged).
+
+Also covered: the >= 2-resident-rows engagement gate (single-row
+dispatches fall back to the monolithic program and the
+`dllama_tp_overlap_chunks_total` counter must not move), the
+machine-visible warn-and-drop resolution (`tp_overlap_active` /
+`tp_overlap_reason` / `tp_wire` — what /stats and the
+`dllama_tp_wire_info` gauge report), and the `overlap_split` fault seam.
+
+Engines compile a full layer-scan program pair per (tp, wire) point, so
+the module caches them — tests share engines, never mutate them, and the
+shape is kept small (the matrix is about EXACTNESS, not model scale).
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dllama_tpu import faults, observability
+from dllama_tpu.models import llama
+from dllama_tpu.models.config import ModelConfig
+from dllama_tpu.parallel.mesh import tp_mesh
+from dllama_tpu.runtime.generate import Engine
+from dllama_tpu.runtime.sampler import SamplerConfig
+
+CFG = ModelConfig(
+    arch="llama", dim=128, hidden_dim=256, n_layers=2, n_heads=4,
+    n_kv_heads=4, vocab_size=256, seq_len=64, head_size=32, kv_dim=128,
+    dtype="float32",
+)
+
+MIXTRAL = ModelConfig(
+    arch="mixtral", dim=128, hidden_dim=256, n_layers=2, n_heads=4,
+    n_kv_heads=4, vocab_size=256, seq_len=64, head_size=32, kv_dim=128,
+    n_experts=4, n_active_experts=2, rope_style="half", dtype="float32",
+)
+
+GREEDY = SamplerConfig(temperature=0.0, seed=7)
+
+# odd batch: the split is uneven (2 + 1), exercising both half-batch shapes
+PROMPTS = [[1, 2, 3], [4, 5], [6, 7, 8, 9]]
+
+_PAIRS = {}
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+@pytest.fixture(scope="module")
+def qp():
+    dense = llama.random_params(CFG, seed=0, dtype=np.float32)
+    return llama.quantize_params(dense, "q40")
+
+
+def _pair(qp, tp, compress=False):
+    """Cached (monolithic engine, overlap engine, overlap registry) on the
+    same mesh + params. Tests share these and must not mutate them; the
+    overlap-chunks counter only ever counts up, so counter assertions are
+    written against deltas."""
+    key = (tp, compress)
+    if key not in _PAIRS:
+        mesh = tp_mesh(tp)
+        reg = observability.MetricsRegistry()
+        e0 = Engine(CFG, qp, GREEDY, mesh=mesh, tp_compress=compress,
+                    metrics=None)
+        e1 = Engine(CFG, qp, GREEDY, mesh=mesh, tp_compress=compress,
+                    tp_overlap=True, metrics=reg)
+        _PAIRS[key] = (e0, e1, reg)
+    return _PAIRS[key]
+
+
+def _session_stream(eng, prompts, steps, **kw):
+    sess = eng.batch_session(4, chunk=4, **kw)
+    hs = [sess.admit_begin(p, steps=steps) for p in prompts]
+    while sess.prefill_step() is not None:
+        pass
+    got = {h: [] for h in hs}
+    while any(not sess.is_done(h) for h in hs):
+        for h, toks in sess.step_chunk().items():
+            got[h].extend(toks)
+    sess.close()
+    return [got[h] for h in hs]
+
+
+def _counter(reg):
+    for line in reg.render().splitlines():
+        if line.startswith("dllama_tp_overlap_chunks_total"):
+            return float(line.split()[-1])
+    return 0.0
+
+
+# ---------------------------------------------------------------------------
+# bit-identity matrix: tp x wire x entry point, odd batch (uneven split)
+# ---------------------------------------------------------------------------
+
+
+_TP_POINTS = [pytest.param(1, marks=pytest.mark.slow), 2, 4]
+
+
+@pytest.mark.parametrize("compress", [False, True], ids=["plain", "q80"])
+@pytest.mark.parametrize("tp", _TP_POINTS)
+def test_overlap_decode_bit_identical(qp, tp, compress):
+    """Batched decode through the overlap programs emits exactly the
+    monolithic streams at every tp degree, both wires, odd B=3.
+
+    tp=1 (degenerate ring, overlap still splits) is `slow`-marked: the
+    tier-1 lane pins the acceptance matrix tp in {2, 4}, the full matrix
+    runs without the marker filter."""
+    e0, e1, _ = _pair(qp, tp, compress=compress)
+    assert e1.tp_overlap_active and e1.tp_overlap_reason == "on"
+    assert e1.tp_wire == ("q80" if compress else "plain")
+    assert e1.generate_batch(PROMPTS, steps=8) == \
+        e0.generate_batch(PROMPTS, steps=8)
+
+
+@pytest.mark.parametrize("compress", [False, True], ids=["plain", "q80"])
+@pytest.mark.parametrize("tp", _TP_POINTS)
+def test_overlap_verify_bit_identical(qp, tp, compress):
+    """Speculative verify (the second batched shard_map entry point) is
+    exact through the overlap split too — same matrix as decode."""
+    e0, e1, _ = _pair(qp, tp, compress=compress)
+    got, stats1 = e1.generate_batch_spec(PROMPTS, steps=8, draft_len=3)
+    want, stats0 = e0.generate_batch_spec(PROMPTS, steps=8, draft_len=3)
+    assert got == want
+    assert stats1["emitted"] == stats0["emitted"]
+
+
+@pytest.mark.parametrize("paged", [False, True], ids=["slab", "paged"])
+def test_overlap_session_bit_identical(qp, paged):
+    """The pooled BatchSession (the serving path) routes its chunk
+    dispatches through the overlap programs — slab and paged KV layouts
+    must both stream bit-identically to the monolithic engine."""
+    e0, e1, _ = _pair(qp, 4)
+    kw = dict(kv_pages=16) if paged else {}
+    assert _session_stream(e1, PROMPTS, 8, **kw) == \
+        _session_stream(e0, PROMPTS, 8, **kw)
+
+
+# ---------------------------------------------------------------------------
+# engagement gate + counter + fault seam
+# ---------------------------------------------------------------------------
+
+
+def test_overlap_counter_and_single_row_fallback(qp):
+    """>= 2 resident rows engage overlap (counter moves); a single-row
+    dispatch silently uses the monolithic program (counter must NOT move,
+    stream still exact)."""
+    e0, e1, reg = _pair(qp, 2)
+
+    before = _counter(reg)
+    assert e1.generate_batch(PROMPTS, steps=4) == \
+        e0.generate_batch(PROMPTS, steps=4)
+    engaged = _counter(reg)
+    assert engaged > before
+
+    assert e1.generate_batch([[1, 2, 3]], steps=4) == \
+        e0.generate_batch([[1, 2, 3]], steps=4)
+    assert _counter(reg) == engaged
+
+
+def test_overlap_split_fault_seam(qp):
+    """`overlap_split` fires on every overlap engagement: an injected
+    raise surfaces as FaultInjected from the dispatching call."""
+    _, e1, _ = _pair(qp, 2)
+    faults.install("overlap_split:raise:times=1")
+    with pytest.raises(faults.FaultInjected) as exc:
+        e1.generate_batch(PROMPTS, steps=4)
+    assert exc.value.site == "overlap_split"
+    faults.clear()
+    # the seam is per-dispatch, not per-engine: the engine still works
+    assert e1.generate_batch(PROMPTS, steps=4)
+
+
+def test_overlap_rejects_bad_splits_at_trace_time():
+    """The static split check refuses what cannot be exact."""
+    with pytest.raises(ValueError, match="batch >= 2"):
+        llama._check_overlap_split(CFG, 1)
+    with pytest.raises(ValueError, match="selected-experts union"):
+        llama._check_overlap_split(MIXTRAL, 4)
+    assert llama._check_overlap_split(CFG, 3) == 1
+
+
+# ---------------------------------------------------------------------------
+# machine-visible warn-and-drop resolution (what /stats reports)
+# ---------------------------------------------------------------------------
+
+
+def test_overlap_resolution_no_mesh(qp):
+    eng = Engine(CFG, qp, GREEDY, tp_overlap=True, metrics=None)
+    assert not eng.tp_overlap_active
+    assert eng.tp_overlap_reason == "no mesh (single device)"
+    assert eng.tp_wire == "plain"
+
+
+def test_overlap_resolution_not_requested(qp):
+    eng = Engine(CFG, qp, GREEDY, mesh=tp_mesh(2), metrics=None)
+    assert not eng.tp_overlap_active
+    assert eng.tp_overlap_reason == "not requested"
+
+
+def test_overlap_resolution_moe_drops_to_monolithic():
+    """MoE + tp_overlap must warn-and-drop, never error: the engine comes
+    up with monolithic programs and a machine-readable reason."""
+    dense = llama.random_params(MIXTRAL, seed=0, dtype=np.float32)
+    qmoe = llama.quantize_params(dense, "q40")
+    eng = Engine(MIXTRAL, qmoe, GREEDY, mesh=tp_mesh(2), tp_overlap=True,
+                 metrics=None)
+    assert not eng.tp_overlap_active
+    assert "moe" in eng.tp_overlap_reason
+    # monolithic programs were still built (the drop is a downgrade, not
+    # a failure) — presence of the batched loop is enough, decoding the
+    # MoE engine here would only re-pay a compile tier-1 doesn't need
+    assert eng._decode_loop_batch is not None
+    assert eng._decode_loop_batch_ov is None
+
+
+def test_overlap_resolution_dense_drops_to_monolithic():
+    """Float (dense-pjit) TP has no shard_map microbatch programs: the
+    request is dropped with the reason clients see on /stats."""
+    dense = llama.random_params(CFG, seed=0, dtype=np.float32)
+    eng = Engine(CFG, dense, GREEDY, mesh=tp_mesh(2), tp_overlap=True,
+                 metrics=None)
+    assert not eng.tp_overlap_active
+    assert "dense-pjit" in eng.tp_overlap_reason
