@@ -1708,6 +1708,257 @@ def run_router_bench(n: int) -> dict:
     return result
 
 
+def run_disagg_bench(n: int) -> dict:
+    """BENCH_DISAGG=N: disaggregated-serving replay, jax-free IN THIS
+    PROCESS (replicas are `cli serve` subprocesses pinned to CPU). The
+    SAME staggered streamed workload runs through two 2-replica fleets of
+    the router-bench shape, booted back to back:
+
+      colocated   two "both" replicas — every request prefills and
+                  decodes on one replica, no migration (the baseline)
+      disagg      one dedicated-prefill + one dedicated-decode replica —
+                  every request prefills on the prefill replica and
+                  migrates its KV pages to the decode replica at first
+                  token
+
+    Gates (the bench itself FAILS on any):
+      * zero dropped requests in either leg
+      * the disagg leg actually migrated EVERY request (the router's
+        outcome="ok" counter delta equals the request count — a leg that
+        silently fell back to normal routing would "win" the latency
+        comparison by not doing the work)
+      * migrated TTFB p50 <= colocated TTFB p50 x DISAGG_SLACK + 250 ms
+        (slack 1.5 by default: the handoff adds one HTTP hop plus a page
+        encode/decode, which must stay a bounded tax on first-token
+        latency, not a multiple; the additive grace absorbs CPU-runner
+        scheduling noise on what is a sub-second quantity)
+
+    BENCH_DISAGG_OUT writes the full report JSON for CI artifacts. The
+    final metric line is migrated TTFB p50 with vs_baseline =
+    colocated/migrated (below 1.0 = migration costs latency)."""
+    import http.client
+    import shutil
+    import socket
+    import tempfile
+    import threading
+
+    import numpy as np
+
+    from dllama_tpu.formats.spec import ArchType, ModelSpec
+    from dllama_tpu.formats.tokenizer_file import (TokenizerData,
+                                                   write_tokenizer)
+    from dllama_tpu.formats.weights import tensor_plan, write_model
+    from dllama_tpu.quants import blocks
+    from dllama_tpu.serving import fleet as fleet_mod
+    from dllama_tpu.serving import router as router_mod
+
+    n_req = max(4, min(n, 24))
+    slack = float(os.environ.get("DISAGG_SLACK", "1.5"))
+    tmp = tempfile.mkdtemp(prefix="bench_disagg_")
+    # the router-bench shape: a ~700-token prompt whose prefill cost
+    # dominates the HTTP hop, so TTFB measures work, not socket latency
+    spec = ModelSpec(arch=ArchType.LLAMA, dim=256, hidden_dim=512,
+                     n_layers=6, n_heads=8, n_kv_heads=4, vocab_size=512,
+                     seq_len=1024, weights_float_type=blocks.Q40)
+    rng = np.random.default_rng(0)
+    model, tok = os.path.join(tmp, "m.m"), os.path.join(tmp, "t.t")
+    write_model(model, spec,
+                {e.name: 0.05 * rng.standard_normal(e.d * e.n).astype(
+                    np.float32) for e in tensor_plan(spec)})
+    vocab = ([b"<unk>", b"<s>", b"</s>"] + [bytes([i]) for i in range(256)]
+             + [b"hi"] * (512 - 259))
+    write_tokenizer(tok, TokenizerData(vocab=vocab, scores=[0.0] * 512,
+                                       bos_id=1, eos_id=2))
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("JAX_PLATFORM_NAME", None)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env.pop("DLLAMA_FAULTS", None)
+
+    def _free_base(span: int) -> int:
+        for _ in range(64):
+            with socket.socket() as s:
+                s.bind(("127.0.0.1", 0))
+                base = s.getsockname()[1]
+            if base + span > 65500:
+                continue
+            try:
+                for i in range(1, span):
+                    with socket.socket() as t:
+                        t.bind(("127.0.0.1", base + i))
+                return base
+            except OSError:
+                continue
+        raise RuntimeError("no free port span for the replica fleet")
+
+    def _msgs(i, tag):
+        sys_p = (f"[{tag}-{i}] You are a terse operations assistant. "
+                 + "Answer in one word. Never apologize, never elaborate, "
+                   "never repeat the question back to the user. " * 6)
+        return [{"role": "system", "content": sys_p},
+                {"role": "user", "content": f"question for {tag}{i}"}]
+
+    def _chat_ttfb(port, messages, timeout=180.0):
+        """-> (status, ttfb_ms-or-None): streamed request, clocking the
+        first CONTENT delta (the role preamble lands pre-prefill)."""
+        body = json.dumps({"model": "bench", "messages": messages,
+                           "max_tokens": 8, "temperature": 0.0,
+                           "stream": True}).encode()
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+        t0 = time.perf_counter()
+        try:
+            conn.request("POST", "/v1/chat/completions", body,
+                         headers={"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            ttfb = None
+            if resp.status == 200:
+                buf = b""
+                while b'"content"' not in buf:
+                    chunk = resp.read1(65536)
+                    if not chunk:
+                        break
+                    buf += chunk
+                else:
+                    ttfb = (time.perf_counter() - t0) * 1000.0
+            resp.read()
+            return resp.status, ttfb
+        finally:
+            conn.close()
+
+    def _leg(tag, roles):
+        """Boot a 2-replica fleet with the given roles behind a fresh
+        router, replay the workload, tear it all down. Returns
+        (ttfbs, n_ok, migrations_by_outcome)."""
+        fl = fleet_mod.Fleet(
+            model, tok, n_replicas=2, base_port=_free_base(2),
+            host="127.0.0.1",
+            replica_args=["--batch-window", "40", "--batch-max", "4",
+                          "--batch-chunk", "2", "--prefill-chunk", "256",
+                          "--kv-pages", "16", "--tp", "1"],
+            log_dir=os.path.join(tmp, f"logs-{tag}"), env=env, roles=roles)
+        st = None
+        srv = None
+        try:
+            log(f"disagg bench [{tag}]: booting {'+'.join(roles)} fleet "
+                f"(ports {[r.port for r in fl.replicas]})...")
+            t0 = time.perf_counter()
+            fl.start()
+            if not fl.wait_ready(timeout_s=300.0):
+                raise RuntimeError(f"[{tag}] replicas never became ready")
+            log(f"[{tag}] fleet ready in {time.perf_counter() - t0:.1f}s")
+            st = router_mod.RouterState(
+                [router_mod.Replica("127.0.0.1", r.port)
+                 for r in fl.replicas], probe_interval_s=0.5)
+            st.probe_once()
+            srv = router_mod.create_router_server(st, "127.0.0.1", 0)
+            threading.Thread(target=srv.serve_forever, daemon=True).start()
+            st.start_probes()
+            port = srv.server_address[1]
+
+            def _migrations():
+                fam = st.metrics.snapshot().get(
+                    "dllama_kv_transfer_migrations_total", {})
+                return {v["labels"]["outcome"]: v["value"]
+                        for v in fam.get("values", [])}
+
+            # warm-up through the front door: compiles each replica's
+            # prefill/decode programs — and, in the disagg leg, the whole
+            # export/import path — outside the stopwatch. Two requests so
+            # BOTH colocated replicas compile (least-load alternates).
+            for w in range(2):
+                stt, _ = _chat_ttfb(port, _msgs(w, f"wup-{tag}"))
+                if stt != 200:
+                    raise RuntimeError(f"[{tag}] warm-up {w} got {stt}")
+            base_ok = _migrations().get("ok", 0)
+
+            ttfbs, statuses = [None] * n_req, [None] * n_req
+
+            def _one(i):
+                try:
+                    statuses[i], ttfbs[i] = _chat_ttfb(
+                        port, _msgs(i, tag))
+                except Exception:  # noqa: BLE001 — a reset counts as a drop
+                    statuses[i] = -1
+            threads = []
+            for i in range(n_req):
+                th = threading.Thread(target=_one, args=(i,), daemon=True)
+                th.start()
+                threads.append(th)
+                time.sleep(0.2)
+            for th in threads:
+                th.join(timeout=240.0)
+            n_ok = sum(1 for s_ in statuses if s_ == 200)
+            mig = _migrations()
+            mig["ok_delta"] = mig.get("ok", 0) - base_ok
+            return [t for t in ttfbs if t is not None], n_ok, mig
+        finally:
+            if st is not None:
+                st.stop_probes()
+            if srv is not None:
+                srv.shutdown()
+                srv.server_close()
+            fl.drain(timeout_s=10.0)
+
+    gates = []
+    try:
+        colo_ttfbs, colo_ok, _ = _leg("colo", ["both", "both"])
+        colo_p50 = _pct(colo_ttfbs, 50)
+        log(f"colocated: {colo_ok}/{n_req} ok, TTFB p50 {colo_p50:.1f} ms")
+        mig_ttfbs, mig_ok, mig = _leg("disagg", ["prefill", "decode"])
+        mig_p50 = _pct(mig_ttfbs, 50)
+        log(f"disaggregated: {mig_ok}/{n_req} ok, TTFB p50 "
+            f"{mig_p50:.1f} ms, migrations {mig}")
+        if colo_ok != n_req or mig_ok != n_req:
+            gates.append(f"dropped requests: colocated {colo_ok}/{n_req}, "
+                         f"disaggregated {mig_ok}/{n_req}")
+        if mig["ok_delta"] < n_req:
+            gates.append(
+                f"only {mig['ok_delta']:.0f}/{n_req} requests migrated "
+                f"(outcomes {mig}) — the latency comparison would credit "
+                "normal routing, not the handoff")
+        bound = colo_p50 * slack + 250.0
+        if mig_p50 > bound:
+            gates.append(f"migrated TTFB p50 {mig_p50:.1f} ms exceeds "
+                         f"colocated {colo_p50:.1f} ms x {slack} + 250 ms "
+                         f"= {bound:.1f} ms")
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    report = {
+        "requests": n_req, "slack": slack, "cpu_count": os.cpu_count(),
+        # CPU smoke: scheduling + handoff correctness only. The latency
+        # case for disaggregation (prefill interference on decode TPOT,
+        # inter-chip page transfer) is a hardware property — numbers owed
+        # once the TPU tunnel resolves (ROADMAP carried follow-up).
+        "tpu_deltas_owed": True,
+        "colocated_ttfb_p50_ms": round(colo_p50, 3),
+        "migrated_ttfb_p50_ms": round(mig_p50, 3),
+        "colocated_ttfb_ms": [round(t, 1) for t in colo_ttfbs],
+        "migrated_ttfb_ms": [round(t, 1) for t in mig_ttfbs],
+        "migrations": {k: round(v, 0) for k, v in mig.items()},
+        "gates_failed": gates,
+    }
+    out_path = os.environ.get("BENCH_DISAGG_OUT")
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump(report, f, indent=2)
+        log(f"report written to {out_path}")
+    result = {
+        "metric": "smoke_disagg_ttfb_ms",
+        "value": round(mig_p50, 3),
+        "unit": "ms",
+        "vs_baseline": round(colo_p50 / mig_p50, 2) if mig_p50 else None,
+        "baseline": "same streamed workload on a colocated 2-replica fleet "
+                    "(no migration)",
+        "weights": "q40-disagg-fleet2",
+        "platform": "cpu-subprocess-fleet",
+        "n_devices": 2,
+    }
+    if gates:
+        result["error"] = "; ".join(gates)
+    return result
+
+
 def main() -> None:
     # metric name for the error path, resolvable without touching jax
     choice = os.environ.get("BENCH_MODEL", "")
@@ -1719,6 +1970,7 @@ def main() -> None:
                  else "integrity" if _env_count("BENCH_INTEGRITY")
                  else "obs" if _env_count("BENCH_OBS")
                  else "router" if _env_count("BENCH_ROUTER")
+                 else "disagg" if _env_count("BENCH_DISAGG")
                  else "decode")
     err_metric = {"tiny": "tinyllama_1.1b", "llama3": "llama3_8b",
                   "moe": "mixtral_lite", "grok": "grok1_lite",
@@ -1751,14 +2003,18 @@ def main() -> None:
         timer.start()
 
     nrouter = _env_count("BENCH_ROUTER")
-    if nrouter:
-        # the router replay is jax-free IN THIS PROCESS (replicas are CPU
-        # subprocesses), so branch before the backend probes: a dead TPU
-        # tunnel must not block a pure-CPU fleet replay
+    ndisagg = _env_count("BENCH_DISAGG")
+    if nrouter or ndisagg:
+        # the router and disaggregation replays are jax-free IN THIS
+        # PROCESS (replicas are CPU subprocesses), so branch before the
+        # backend probes: a dead TPU tunnel must not block a pure-CPU
+        # fleet replay
         try:
-            result = run_router_bench(nrouter)
+            result = (run_router_bench(nrouter) if nrouter
+                      else run_disagg_bench(ndisagg))
         except Exception as e:  # noqa: BLE001 — emit the machine-readable record
-            result = {"metric": err_metric, "value": None, "unit": "req/s",
+            result = {"metric": err_metric, "value": None,
+                      "unit": "req/s" if nrouter else "ms",
                       "vs_baseline": None,
                       "error": f"{type(e).__name__}: {e}"}
         if deadline_s > 0:

@@ -63,6 +63,12 @@ def build_parser() -> argparse.ArgumentParser:
             help="prompt-prefix affinity hash block size: repeat "
             "conversations route to the replica whose radix cache holds "
             "their warm KV pages; 0 disables affinity (pure least-load)")
+        rp.add_argument(
+            "--kv-wire", default="f32", choices=["f32", "q80"],
+            help="wire mode for the prefill->decode KV page handoff (only "
+            "used when the fleet declares both roles): f32 is bit-exact — "
+            "a migrated stream is token-for-token the solo stream; q80 "
+            "ships ~3.76x fewer bytes, block-quantized and error-bounded")
 
     # the fleet front door: stdlib-only, no model artifacts, no jax — it
     # proxies the OpenAI surface across N running `serve` replicas
@@ -86,6 +92,17 @@ def build_parser() -> argparse.ArgumentParser:
     fp.add_argument("--replica-host", default="127.0.0.1",
                     help="interface the replicas bind (loopback: only the "
                     "router is meant to face traffic)")
+    fp.add_argument(
+        "--prefill", type=int, default=0, metavar="N",
+        help="dedicated prefill replicas (the first N of --replicas, via "
+        "a per-replica --role): they run new prompts plus the first decode "
+        "chunk, then hand the row's KV pages to a decode replica; goes "
+        "with --decode")
+    fp.add_argument(
+        "--decode", type=int, default=0, metavar="M",
+        help="dedicated decode replicas (the next M): they import migrated "
+        "KV page streams warm and stream the rest of each completion; "
+        "goes with --prefill")
     fp.add_argument(
         "--replica-arg", action="append", default=[], metavar="'--flag v'",
         help="extra `serve` flag(s) passed to every replica (repeatable), "
@@ -257,6 +274,19 @@ def build_parser() -> argparse.ArgumentParser:
                 help="include raw prompt text in --log-json records "
                 "(privacy default is OFF: logs carry counts and hashes "
                 "only)",
+            )
+            sp.add_argument(
+                "--role",
+                default="both",
+                choices=["prefill", "decode", "both"],
+                help="disaggregation role this replica declares on /ready: "
+                "'prefill' replicas serve POST /v1/prefill (prompt + first "
+                "decode chunk, then export the row's KV pages on the "
+                "wire), 'decode' replicas serve POST /v1/kv/import (admit "
+                "the migrated row warm and stream the rest); 'both' (the "
+                "default) serves end-to-end. Needs --kv-pages for the "
+                "migration endpoints; the role is advisory — the router "
+                "enforces placement",
             )
         sp.add_argument("--model", required=True)
         sp.add_argument("--tokenizer", required=True)
@@ -800,9 +830,13 @@ def run_top(args) -> int:
         raise SystemExit(f"bad --router {args.router!r}: want HOST:PORT")
     port = int(port_s)
     n = 0
+    # last-seen dllama_kv_transfer_bytes_total per replica (value, t): the
+    # KV-handoff column is a RATE, so it needs the previous refresh
+    kv_prev: dict = {}
     try:
         while True:
             n += 1
+            now = time.monotonic()
             lines = []
             try:
                 _, stats_body = _top_get(host, port, "/stats")
@@ -820,9 +854,10 @@ def run_top(args) -> int:
                     f"affinity {stats.get('affinity_entries', 0)}")
                 lines.append("")
                 lines.append(
-                    f"{'replica':<22}{'state':<10}{'infl':>5}{'occ':>8}"
-                    f"{'queue':>7}{'kv_free':>9}{'probe_age':>11}"
-                    f"{'reqs':>8}{'ttft_ms':>9}{'tpot_ms':>9}")
+                    f"{'replica':<22}{'role':<9}{'state':<10}{'infl':>5}"
+                    f"{'occ':>8}{'queue':>7}{'kv_free':>9}{'probe_age':>11}"
+                    f"{'reqs':>8}{'ttft_ms':>9}{'tpot_ms':>9}"
+                    f"{'kv_kB/s':>9}")
                 for snap in load.get("replicas") or []:
                     name = snap.get("name", "?")
                     state = ("circuit" if snap.get("circuit_open")
@@ -836,8 +871,21 @@ def run_top(args) -> int:
                         return f"{s / c:.1f}" if s is not None and c else "-"
 
                     reqs = fams.get(("dllama_http_requests_total", name))
+                    # KV handoff wire rate (in+out summed — the families
+                    # fold summed their direction label): delta since the
+                    # previous refresh of this replica's bytes counter
+                    kv_bytes = fams.get(
+                        ("dllama_kv_transfer_bytes_total", name))
+                    kv_rate = "-"
+                    if kv_bytes is not None:
+                        last = kv_prev.get(name)
+                        kv_prev[name] = (kv_bytes, now)
+                        if last is not None and now > last[1]:
+                            kv_rate = "{:.1f}".format(
+                                (kv_bytes - last[0]) / 1024.0
+                                / (now - last[1]))
                     lines.append(
-                        f"{name:<22}{state:<10}"
+                        f"{name:<22}{snap.get('role', 'both'):<9}{state:<10}"
                         f"{snap.get('inflight', 0):>5}"
                         f"{rload.get('slots_occupied', 0):>4}/"
                         f"{rload.get('slots_total', 0):<3}"
@@ -846,7 +894,8 @@ def run_top(args) -> int:
                         f"{(f'{age:.1f}s' if age is not None else '-'):>11}"
                         f"{(f'{reqs:.0f}' if reqs is not None else '-'):>8}"
                         f"{mean('dllama_ttft_ms'):>9}"
-                        f"{mean('dllama_tpot_ms'):>9}")
+                        f"{mean('dllama_tpot_ms'):>9}"
+                        f"{kv_rate:>9}")
             except (OSError, ValueError) as e:
                 lines = [f"dllama top — router {args.router} "
                          f"unreachable ({e}); retrying..."]

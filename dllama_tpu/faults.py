@@ -32,7 +32,13 @@ Spec grammar (sites separated by ``;``)::
   counted, proving the black box cannot crash the process) and
   ``overlap_split`` (every dispatch the Engine routes through a
   microbatch-overlap TP program — an injected failure there flows
-  through the same chunk error handling as a real one).
+  through the same chunk error handling as a real one). The
+  disaggregation seams are ``kv_export`` (every KV page-stream export on
+  a prefill replica), ``kv_import`` (every page-stream import/admit on a
+  decode replica — a faulted import is a failed transfer the router's
+  fallback matrix handles) and ``migrate`` (every router-orchestrated
+  prefill→decode migration — a faulted migration degrades to
+  re-prefilling on the decode replica, never a client-visible error).
 * ``action`` — ``raise`` (throw :class:`FaultInjected`), ``slow`` (sleep
   ``delay_ms``, default 50), or a *data* action the seam itself interprets:
   ``truncate`` (weights_open: pretend the file is ``drop`` bytes short,
@@ -60,7 +66,8 @@ import time
 SITES = ("admit", "step_chunk", "prefill", "prefill_chunk", "prefix_match",
          "page_alloc", "stream", "scheduler", "weights_open", "weights_read",
          "logits", "route_pick", "proxy_upstream", "probe",
-         "federate_scrape", "flight_dump", "overlap_split")
+         "federate_scrape", "flight_dump", "overlap_split",
+         "kv_export", "kv_import", "migrate")
 ACTIONS = ("raise", "slow", "truncate", "bitflip", "nan")
 
 #: site -> the metric family that proves the site's failure is VISIBLE on
@@ -96,6 +103,12 @@ SITE_METRICS = {
     # program (Engine._overlap_engaged) — a faulted split takes the same
     # error path as a real chunk failure
     "overlap_split": "dllama_tp_overlap_chunks_total",
+    # disaggregation seams: a faulted export/import is a failed transfer
+    # the exporting/importing replica counts; a faulted migration is a
+    # router-side fallback to re-prefill on the decode replica
+    "kv_export": "dllama_kv_transfer_exports_total",
+    "kv_import": "dllama_kv_transfer_imports_total",
+    "migrate": "dllama_kv_transfer_migrations_total",
 }
 
 

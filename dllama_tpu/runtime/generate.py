@@ -645,6 +645,18 @@ class Engine:
             return jax.tree.map(go, arena, single)
 
         self._single_to_pages = jax.jit(_single_to_pages, donate_argnums=0)
+
+        def _pages_import(arena, pages, blob):
+            """Imported page payloads ``blob`` (leaves [L, M, page, kv, hd]
+            — the decoded wire frames of a migrating row) scattered into
+            arena pages ``pages`` [M] in ONE dispatch. Scratch-padded
+            entries land harmless garbage on the scratch page, like
+            _single_to_pages' padding convention."""
+            return jax.tree.map(
+                lambda a, x: a.at[:, pages].set(x.astype(a.dtype)),
+                arena, blob)
+
+        self._pages_import = jax.jit(_pages_import, donate_argnums=0)
         self._page_copy = jax.jit(
             # Arena page ``src`` duplicated into page ``dst``: the
             # copy-on-write boundary — an admission whose prompt ends flush
@@ -1686,13 +1698,20 @@ class _SlotState:
 class _PendingPrefill:
     """A chunked admission's in-flight prompt state (admit_begin)."""
 
-    __slots__ = ("prompt", "scfg", "cache", "cursor")
+    __slots__ = ("prompt", "scfg", "cache", "cursor", "pub_nodes",
+                 "scattered")
 
     def __init__(self, prompt: list, scfg: SamplerConfig, cache: dict):
         self.prompt = prompt
         self.scfg = scfg
         self.cache = cache  # single-sequence [L, S, kv, hd] being filled
         self.cursor = 0  # prompt-prefix tokens already prefilled
+        # paged publish-at-admit state: the radix nodes this admission
+        # created ready=False (index-aligned with the row's blocks; None
+        # where another row's node already existed), and the token count
+        # already scattered from the staging cache into arena pages
+        self.pub_nodes: list = []
+        self.scattered = 0
 
 
 class _BucketPool:
@@ -2415,6 +2434,20 @@ class BatchSession:
                 jnp.int32(len(full) * self.page))
         pf = _PendingPrefill(prompt_tokens, scfg, staging)
         pf.cursor = cached
+        pf.scattered = cached
+        # publish-at-admit: allocate the row's fully-prompt-covered tail
+        # blocks NOW and hang them in the radix tree ready=False, so a
+        # concurrent admit of the same prefix aliases each block the
+        # moment the chunk that fills it lands (COW sharing while BOTH
+        # rows are live, not only after this row's go-live)
+        nins = (plen - 1) // self.page
+        for _ in range(len(rp.blocks), nins):
+            rp.blocks.append(self._page_alloc(rp))
+        pf.pub_nodes = self._radix.publish_pending(
+            prompt_tokens, rp.blocks[:nins])
+        for n in pf.pub_nodes:
+            if n is not None:
+                self._alloc.hold(n.page)
         self._prefills[handle] = pf
         return handle
 
@@ -2456,6 +2489,8 @@ class BatchSession:
         if self.eng._m_prefill_chunk is not None:
             self.eng._m_prefill_chunk.observe(dt)
         pf.cursor += len(piece)
+        if self.paged:
+            self._scatter_published(handle, pf)
         if pf.cursor < len(prefix):
             return handle, False
         # prefix complete: land the filled single cache in the row's KV
@@ -2474,6 +2509,185 @@ class BatchSession:
         if self.eng._m_prefill is not None:
             self.eng._m_prefill.observe(st.prefill_ms)
         return handle, True
+
+    def _scatter_published(self, handle: int, pf: _PendingPrefill) -> None:
+        """Land the staging cache's newly completed full blocks in their
+        (already published, ready=False) arena pages and flip the nodes
+        ready — the other half of publish-at-admit: a concurrent admit
+        aliases each block as soon as the prefill chunk that filled it
+        returns. One batched scatter per chunk; blocks another row's
+        pending node shadowed (pub_nodes None) still get their private
+        scatter, they just never become cache."""
+        rp = self._rowpages[handle]
+        plen = len(pf.prompt)
+        nins = (plen - 1) // self.page
+        done = min(pf.cursor // self.page, nins)
+        start = pf.scattered // self.page
+        if done <= start:
+            return
+        pages, offs = self._pad_pages(
+            [rp.blocks[b] for b in range(start, done)],
+            [b * self.page for b in range(start, done)])
+        self._arena = self.eng._single_to_pages(
+            self._arena, pf.cache, pages, offs)
+        for b in range(start, done):
+            n = pf.pub_nodes[b] if b < len(pf.pub_nodes) else None
+            if n is not None:
+                n.ready = True
+        pf.scattered = done * self.page
+
+    # -- migration (disaggregated serving) --------------------------------
+    def export_row(self, handle: int) -> dict:
+        """Snapshot a live paged row for migration to a sibling replica:
+        its page payloads (host numpy, arena leaf order), page-table
+        geometry, and the decode state a solo run would carry across the
+        next chunk boundary — pending token, position, the row's ADVANCED
+        per-row sampler chain, and the budget/stop accounting. Importing
+        the snapshot with :meth:`admit_from_export` on a session with the
+        same model and chunk size continues the stream bit-identically to
+        the row never having moved. The row itself is untouched — the
+        caller releases it once the transfer is acknowledged (a failed
+        transfer loses nothing)."""
+        if not self.paged:
+            raise RuntimeError(
+                "export_row needs a paged session (--kv-pages)")
+        st = self._state(handle)
+        if st.prefilling:
+            raise RuntimeError(f"slot {handle} is still prefilling")
+        if st.done:
+            raise RuntimeError(
+                f"slot {handle} already finished — nothing to migrate")
+        faults.fire("kv_export")
+        g, r = self._where[handle]
+        rp = self._rowpages[handle]
+        idx = jnp.asarray(rp.blocks, jnp.int32)
+        leaves = [np.asarray(jnp.take(leaf, idx, axis=1))
+                  for leaf in jax.tree.leaves(self._arena)]
+        return {
+            "page_tokens": self.page,
+            "n_blocks": len(rp.blocks),
+            "plen": rp.plen,
+            "pos": int(g.pos[r]),
+            "token": int(g.tokens[r]),
+            "keys": [int(g.keys[r, 0]), int(g.keys[r, 1])],
+            "temp": float(g.temps[r]),
+            "topp": float(g.topps[r]),
+            "room": int(st.room),
+            "budget": int(st.budget),
+            "offered": int(st.offered),
+            "emitted": int(st.emitted),
+            "stop_tokens": list(st.stop_tokens),
+            "leaves": leaves,
+        }
+
+    def admit_from_export(self, prompt_tokens: list, snap: dict) -> int:
+        """Admit a row exported by a sibling replica WARM: alias every
+        full prompt block the local radix cache already holds (the wire
+        payload for those blocks is dropped — the local pages are exact),
+        allocate private pages for the rest, scatter the imported
+        payloads in ONE dispatch, publish the prompt blocks into the
+        local radix tree, and arm the row with the carried decode state.
+        Decoding then continues bit-identically to the exporting replica
+        having kept the row (both replicas run the same serve config, so
+        chunk boundaries — and with them the sampler-chain schedule —
+        line up). Raises RuntimeError when the local pool can't fit the
+        row; the caller falls back to re-prefilling."""
+        if not self.paged:
+            raise RuntimeError(
+                "admit_from_export needs a paged session (--kv-pages)")
+        if self._closed:
+            raise RuntimeError("batch session is closed")
+        if int(snap["page_tokens"]) != self.page:
+            raise ValueError(
+                f"page size mismatch: wire {snap['page_tokens']} vs "
+                f"local {self.page}")
+        plen = int(snap["plen"])
+        if plen != len(prompt_tokens):
+            raise ValueError(
+                f"snapshot prompt length {plen} != {len(prompt_tokens)}")
+        budget = int(snap["budget"])
+        if int(snap["emitted"]) >= budget:
+            raise ValueError("snapshot row already finished")
+        faults.fire("kv_import")
+        nblk = int(snap["n_blocks"])
+        cap_tokens = max(plen, plen - 1 + budget)
+        total = paged_kv.pages_for(cap_tokens, self.page)
+        # alias what the local cache already holds (blocks strictly below
+        # plen-1, never written by this row); everything else — the
+        # decode-written tail included — imports privately
+        path = self._radix.match(prompt_tokens)
+        nfull = min(len(path), (plen - 1) // self.page, nblk)
+        full = path[:nfull]
+        priv = total - nfull
+        for n in full:
+            self._alloc.ref(n.page)
+        if not self._alloc.can_reserve(priv) or (
+                self._budget is not None
+                and not self._budget.can_fit(priv * self.page)):
+            for n in full:
+                self._alloc.unref(n.page)
+            raise RuntimeError(
+                f"no free KV pages for imported row "
+                f"({self._alloc.free_count} free + "
+                f"{self._alloc.evictable_count} evictable, need {priv})")
+        self._alloc.reserve(priv)
+        reserved = priv * self.page
+        self._reserved_tokens += reserved
+        if self._budget is not None:
+            self._budget.reserve(reserved)
+        rp = _RowPages([n.page for n in full], priv, cap_tokens, plen)
+        for b in range(nfull, nblk):
+            rp.blocks.append(self._page_alloc(rp))
+        if nblk > nfull:
+            pages = self._pad_pages(rp.blocks[nfull:nblk])
+            m = int(pages.shape[0])
+            blob = []
+            for leaf in snap["leaves"]:
+                x = np.asarray(leaf)[:, nfull:nblk]
+                if m > x.shape[1]:
+                    pad = np.zeros(
+                        (x.shape[0], m - x.shape[1]) + x.shape[2:],
+                        x.dtype)
+                    x = np.concatenate([x, pad], axis=1)
+                blob.append(x)
+            self._arena = self.eng._pages_import(
+                self._arena, pages,
+                jax.tree.unflatten(jax.tree.structure(self._arena), blob))
+        g, row = self._alloc_prow(self._nb_for(max(1, len(rp.blocks))))
+        handle = self._next_handle
+        self._next_handle += 1
+        st = _SlotState(
+            room=int(snap["room"]), budget=budget,
+            stop_tokens=tuple(snap["stop_tokens"]), reserved=reserved,
+            offered=int(snap["offered"]), emitted=int(snap["emitted"]))
+        self._slots[handle] = st
+        self._where[handle] = (g, row)
+        self._rowpages[handle] = rp
+        g.rows[row] = handle
+        g.tokens[row] = int(snap["token"])
+        g.pos[row] = int(snap["pos"])
+        g.keys[row] = np.asarray(snap["keys"], np.uint32)
+        g.temps[row] = float(snap["temp"])
+        g.topps[row] = float(snap["topp"])
+        self._sync_table(handle)
+        # the imported prompt blocks are valid local KV now: publish them
+        # so future admits (and imports) of the same prefix alias local
+        # pages instead of paying the wire or a re-prefill again
+        nins = min((plen - 1) // self.page, len(rp.blocks))
+        for p in self._radix.insert(prompt_tokens, rp.blocks[:nins]):
+            self._alloc.hold(p)
+        matched = nfull * self.page
+        if matched > 0:
+            self.prefix_hits += 1
+            self.prefix_tokens_matched += matched
+            if self.eng._m_prefix_hits is not None:
+                self.eng._m_prefix_hits.inc()
+                self.eng._m_prefix_tokens.inc(matched)
+        else:
+            self.prefix_misses += 1
+            if self.eng._m_prefix_misses is not None:
+                self.eng._m_prefix_misses.inc()
+        return handle
 
     def _go_live(self, handle: int, prompt_tokens: list,
                  scfg: SamplerConfig) -> None:
@@ -2703,6 +2917,12 @@ class BatchSession:
         pf = self._prefills.pop(slot, None)
         if pf is not None:
             st.prefilling = False
+            if self.paged:
+                # retract the publish-at-admit nodes this prefill never
+                # filled: their pages hold garbage no admit may alias
+                self._radix.unpublish(
+                    [n for n in pf.pub_nodes
+                     if n is not None and not n.ready], self._alloc)
             for leaf in jax.tree.leaves(pf.cache):
                 leaf.delete()
 
@@ -2716,6 +2936,10 @@ class BatchSession:
             raise ValueError(f"slot {slot} is not occupied")
         pf = self._prefills.pop(slot, None)
         if pf is not None:
+            if self.paged:
+                self._radix.unpublish(
+                    [n for n in pf.pub_nodes
+                     if n is not None and not n.ready], self._alloc)
             for leaf in jax.tree.leaves(pf.cache):
                 leaf.delete()
         pool, row = self._where.pop(slot)

@@ -215,17 +215,23 @@ class PageAllocator:
 
 class _Node:
     """One cached page-block: ``key`` is its page-sized token tuple, edges
-    hang off ``children`` keyed the same way."""
+    hang off ``children`` keyed the same way. ``ready`` is False while the
+    publishing row's chunked prefill has not yet written the page's KV —
+    the node exists (publish-at-admit) so concurrent admits of the same
+    prefix converge on one chain, but ``match`` refuses to alias it until
+    the owner flips it ready."""
 
-    __slots__ = ("key", "page", "children", "parent", "last_use")
+    __slots__ = ("key", "page", "children", "parent", "last_use", "ready")
 
     def __init__(self, key: Optional[tuple], page: int,
-                 parent: Optional["_Node"], last_use: int):
+                 parent: Optional["_Node"], last_use: int,
+                 ready: bool = True):
         self.key = key
         self.page = page
         self.children: dict = {}
         self.parent = parent
         self.last_use = last_use
+        self.ready = ready
 
 
 @guarded_by(None, "_root", "_clock", "_count")
@@ -254,13 +260,15 @@ class RadixPrefixCache:
 
     def match(self, tokens: Sequence[int]) -> List[_Node]:
         """Nodes caching the longest block-aligned prefix of ``tokens``
-        (root-first). Touches the whole path for LRU."""
+        (root-first). Touches the whole path for LRU. Stops at the first
+        non-``ready`` node: its KV is still being prefilled by the
+        publishing row and MUST NOT be aliased yet."""
         self._clock += 1
         path: List[_Node] = []
         node = self._root
         for b in range(len(tokens) // self.page_tokens):
             child = node.children.get(self._block(tokens, b))
-            if child is None:
+            if child is None or not child.ready:
                 break
             child.last_use = self._clock
             path.append(child)
@@ -290,6 +298,53 @@ class RadixPrefixCache:
                 child.last_use = self._clock
             node = child
         return created
+
+    def publish_pending(self, tokens: Sequence[int],
+                        pages: Sequence[int]) -> List[Optional[_Node]]:
+        """Publish-at-admit: like :meth:`insert` but NEW nodes are created
+        ``ready=False`` (invisible to ``match`` until the publishing row's
+        prefill fills their pages and flips them). Returns a list aligned
+        with ``pages`` whose entry ``b`` is the node CREATED for block b,
+        or None where a node already existed (that block's page in
+        ``pages`` stays the caller's private, uncached duplicate). The
+        caller marks each created node's page held."""
+        self._clock += 1
+        out: List[Optional[_Node]] = []
+        node = self._root
+        for b, page in enumerate(pages):
+            key = self._block(tokens, b)
+            child = node.children.get(key)
+            if child is None:
+                child = _Node(key, page, node, self._clock, ready=False)
+                node.children[key] = child
+                self._count += 1
+                out.append(child)
+            else:
+                child.last_use = self._clock
+                out.append(None)
+            node = child
+        return out
+
+    def unpublish(self, nodes: Sequence[_Node],
+                  alloc: PageAllocator) -> int:
+        """Retract nodes a cancelled/abandoned admission published (its
+        never-filled ``ready=False`` ones are garbage no admit may ever
+        alias). Deepest-first so a chain removes cleanly; a node that
+        grew children under it (a longer concurrent publish) is left in
+        place — unreachable to ``match`` while not ready, reclaimed by
+        leaf-LRU eviction once its subtree goes. Returns nodes removed."""
+        removed = 0
+        for node in reversed(list(nodes)):
+            if node is None or node.children or node.parent is None:
+                continue
+            if node.parent.children.get(node.key) is not node:
+                continue  # already evicted/replaced
+            del node.parent.children[node.key]
+            node.parent = None
+            self._count -= 1
+            alloc.drop(node.page)
+            removed += 1
+        return removed
 
     def evict(self, n: int, alloc: PageAllocator) -> int:
         """Free up to ``n`` pages by dropping LRU refcount-zero *leaves*

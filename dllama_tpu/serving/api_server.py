@@ -29,6 +29,7 @@ cache); concurrent connections queue on a lock rather than corrupting state.
 from __future__ import annotations
 
 import codecs
+import itertools
 import json
 import os
 import queue as queue_mod
@@ -42,6 +43,7 @@ from dllama_tpu.analysis.sanitize import guarded_by
 from dllama_tpu.observability import RequestTrace
 from dllama_tpu.runtime.generate import NumericHealthError
 from dllama_tpu.runtime.sampler import SamplerConfig
+from dllama_tpu.serving import kv_transfer
 from dllama_tpu.serving.lifecycle import (
     AdmissionGate,
     CancelToken,
@@ -177,14 +179,26 @@ class Batcher:
 
     class _Slot:
         __slots__ = ("prompt", "steps", "sampler", "tokens", "error", "done",
-                     "queue", "deadline", "cancel", "trace")
+                     "queue", "deadline", "cancel", "trace", "kind", "snap",
+                     "export")
 
         def __init__(self, prompt, steps, sampler, streaming: bool,
-                     deadline=None, cancel=None, trace=None):
+                     deadline=None, cancel=None, trace=None,
+                     kind: str = "completion", snap=None):
             self.prompt, self.steps, self.sampler = prompt, steps, sampler
             self.tokens = None
             self.error = None
             self.done = threading.Event()
+            #: disaggregation job kind: "completion" (the normal request),
+            #: "prefill" (admit + first chunk, then export the row's KV
+            #: pages for migration) or "import" (admit a row warm from a
+            #: sibling replica's export snapshot and continue its decode)
+            self.kind = kind
+            #: decoded kv_transfer snapshot (kind "import" only)
+            self.snap = snap
+            #: export_row snapshot (kind "prefill", when the row migrated
+            #: instead of finishing inside its first chunk)
+            self.export = None
             # streaming protocol: list-of-token-ids items, then exactly one
             # terminal item — None (clean end) or an Exception
             self.queue = queue_mod.Queue() if streaming else None
@@ -532,12 +546,35 @@ class Batcher:
                         self._resolve_err(s, err)
                 # paged sessions get the actual tokens so admission counts
                 # the radix prefix match (a warm prompt needs fewer pages)
-                while waiting and sess.can_admit(len(waiting[0].prompt),
-                                                 waiting[0].steps,
-                                                 waiting[0].prompt):
+                while waiting:
+                    if waiting[0].kind == "import":
+                        # migrated row arriving: admit it warm from its
+                        # export snapshot NOW — no can_admit wait (a full
+                        # pool must fail fast so the router can fall back
+                        # to re-prefilling, not queue behind cold prompts)
+                        s = waiting.pop(0)
+                        s.mark_start("import")
+                        self._m_path.inc(path="import")
+                        try:
+                            b = sess.admit_from_export(s.prompt, s.snap)
+                        except Exception as e:  # noqa: BLE001 — this row
+                            self.state._m_kv_imports.inc(outcome="error")
+                            self._fail([s], e)
+                            continue
+                        self.state._m_kv_imports.inc(outcome="ok")
+                        s.snap = None  # free the page payloads now
+                        s.tokens = []
+                        slot_map[b] = s
+                        continue
+                    if not sess.can_admit(len(waiting[0].prompt),
+                                          waiting[0].steps,
+                                          waiting[0].prompt):
+                        break
                     s = waiting.pop(0)
-                    s.mark_start("continuous")
-                    self._m_path.inc(path="continuous")
+                    path = ("prefill" if s.kind == "prefill"
+                            else "continuous")
+                    s.mark_start(path)
+                    self._m_path.inc(path=path)
                     pre_admit_ms = sess.prefill_ms
                     try:
                         if self.prefill_chunk > 0:
@@ -607,6 +644,27 @@ class Batcher:
                         if s.queue is not None:
                             s.queue.put(None)
                         s.done.set()
+                    elif s.kind == "prefill":
+                        # first chunk after go-live and the row is NOT done:
+                        # migrate now — snapshot its pages + decode state,
+                        # free the slot, and hand the snapshot (plus the
+                        # chunk's already-emitted tokens) to the exporting
+                        # HTTP handler. A faulted/failed export frees the
+                        # slot the same way and fails THIS waiter only.
+                        try:
+                            snap = sess.export_row(b)
+                        except Exception as e:  # noqa: BLE001
+                            self.state._m_kv_exports.inc(outcome="error")
+                            sess.cancel(b)
+                            sess.release(b)
+                            del slot_map[b]
+                            self._fail([s], e)
+                            continue
+                        self.state._m_kv_exports.inc(outcome="ok")
+                        sess.release(b)
+                        del slot_map[b]
+                        s.export = snap
+                        s.done.set()
                 while True:  # rolling admission: drain mid-chunk arrivals
                     try:
                         waiting.append(self._arrivals.get_nowait())
@@ -662,10 +720,13 @@ class Batcher:
             window = [s for s in window if not self._reap_slot(s)]
             if window:
                 t_win = time.monotonic()
+                # disaggregation jobs (prefill-export / import-admit) exist
+                # only in the paged slot pool: they never route solo or spec
+                plain = all(s.kind == "completion" for s in window)
                 with self.state.lock:  # the engine serves one pool at a time
-                    if len(window) == 1 and self._arrivals.empty():
+                    if plain and len(window) == 1 and self._arrivals.empty():
                         self._serve_solo(window[0])
-                    elif (len(window) <= self.max_batch
+                    elif (plain and len(window) <= self.max_batch
                             and self.state.spec_draft > 0
                             and getattr(self.state.engine,
                                         "supports_batch_spec", False)
@@ -760,6 +821,11 @@ class Batcher:
                           streaming=True, deadline=deadline, cancel=cancel,
                           trace=trace)
         self._enqueue(slot)
+        return self._drain_stream(slot, cancel)
+
+    def _drain_stream(self, slot, cancel):
+        """Consume a streaming slot's queue: yield bursts until the
+        terminal item (None = clean end, Exception = raised)."""
         while True:
             try:
                 item = slot.queue.get(timeout=0.25)
@@ -779,6 +845,59 @@ class Batcher:
                 raise item
             yield item
 
+    # -- disaggregation jobs (role-aware serving) -------------------------
+    def submit_prefill(self, prompt_tokens: list, max_tokens: int,
+                       sampler: SamplerConfig, deadline: Deadline = None,
+                       trace=None) -> tuple:
+        """Prefill ``prompt_tokens`` in the paged pool, decode ONE chunk,
+        and migrate: returns ``(export_snapshot, emitted_tokens)``. The
+        snapshot is None when the row finished inside its first chunk (a
+        stop token or a one-chunk budget) — then ``emitted_tokens`` is the
+        complete row and nothing migrates. Raises like :meth:`submit`."""
+        slot = self._Slot(list(prompt_tokens), max_tokens, sampler,
+                          streaming=False, deadline=deadline,
+                          trace=trace, kind="prefill")
+        self._enqueue(slot)
+        self._wait_resolution(slot)
+        if slot.error is not None:
+            raise slot.error
+        return slot.export, slot.tokens
+
+    def submit_import(self, snap: dict, deadline: Deadline = None,
+                      trace=None) -> list:
+        """Admit a migrated row from a decoded kv_transfer snapshot and
+        block until its remaining tokens are decoded. Raises like
+        :meth:`submit` (a pool that can't fit the row raises RuntimeError
+        — the caller's cue to fall back to re-prefilling)."""
+        slot = self._import_slot(snap, deadline=deadline, trace=trace,
+                                 streaming=False)
+        self._enqueue(slot)
+        self._wait_resolution(slot)
+        if slot.error is not None:
+            raise slot.error
+        return slot.tokens
+
+    def submit_import_stream(self, snap: dict, deadline: Deadline = None,
+                             cancel: CancelToken = None, trace=None):
+        """Streaming variant of :meth:`submit_import`: yields bursts of
+        freshly decoded token ids (the carried already-emitted tokens are
+        the CALLER's to prepend — they were streamed by the exporter's
+        chunk, not decoded here)."""
+        slot = self._import_slot(snap, deadline=deadline, cancel=cancel,
+                                 trace=trace, streaming=True)
+        self._enqueue(slot)
+        return self._drain_stream(slot, cancel)
+
+    def _import_slot(self, snap: dict, deadline=None, cancel=None,
+                     trace=None, streaming: bool = False):
+        sampler = SamplerConfig(temperature=float(snap["temp"]),
+                                topp=float(snap["topp"]), seed=0)
+        steps = max(1, int(snap["budget"]) - int(snap["emitted"]))
+        return self._Slot(list(snap["prompt"]), steps, sampler,
+                          streaming=streaming, deadline=deadline,
+                          cancel=cancel, trace=trace, kind="import",
+                          snap=snap)
+
 
 class ServerState:
     """Everything the handler needs; one instance per server."""
@@ -792,7 +911,8 @@ class ServerState:
                  kv_bucket_min: int = 0, kv_pages: int = 0,
                  request_timeout: float = 0.0, queue_depth: int = 64,
                  metrics=None, log_json: bool = False,
-                 log_prompts: bool = False, log_stream=None, flight=None):
+                 log_prompts: bool = False, log_stream=None, flight=None,
+                 role: str = "both"):
         """``default_seed``: seed for requests that send none — None means a
         fresh time-based seed per request (the launch-flag --seed plumbs in
         here so an operator can make the whole server reproducible).
@@ -824,7 +944,13 @@ class ServerState:
         covers all four layers). ``log_json``: emit one structured JSON
         line per finished request to ``log_stream`` (default stderr).
         ``log_prompts``: include raw prompt text in those logs — OFF by
-        default; logs carry only token counts and a sha256 prompt digest."""
+        default; logs carry only token counts and a sha256 prompt digest.
+        ``role``: this replica's disaggregation role (--role): "prefill"
+        (the fleet router sends it new prompts and migrates their KV to a
+        decode replica at first token), "decode" (receives migrated rows)
+        or "both" (the default — a colocated replica). The role only
+        steers the ROUTER's placement; every replica answers every
+        endpoint, so a lone "both" fleet behaves exactly as before."""
         self.engine = engine
         self.tokenizer = tokenizer
         self.cfg = cfg
@@ -833,6 +959,10 @@ class ServerState:
         self.default_sampler = default_sampler
         self.default_seed = default_seed
         self.spec_draft = spec_draft
+        if role not in ("prefill", "decode", "both"):
+            raise ValueError(
+                f"role must be prefill/decode/both, got {role!r}")
+        self.role = role
         self.session_cache = max(1, session_cache)
         #: HBM bound shared by the batcher AND the `n` parameter: a batch's
         #: KV cache holds this many full-context caches
@@ -899,6 +1029,26 @@ class ServerState:
             "dllama_sse_disconnects_total",
             "Streaming responses whose client vanished mid-stream (the "
             "decode row is cancelled at its next chunk boundary)")
+        # disaggregated serving: KV page-stream handoff between replicas.
+        # outcome="error" moves when the kv_export/kv_import fault sites
+        # fire — a failed transfer is machine-visible fleet-wide via the
+        # router's federated /metrics/fleet, same as every dllama_* series
+        self._m_kv_exports = reg.counter(
+            "dllama_kv_transfer_exports_total",
+            "KV page-stream export attempts (a migrating row leaving "
+            "this replica), by outcome", ("outcome",))
+        self._m_kv_imports = reg.counter(
+            "dllama_kv_transfer_imports_total",
+            "KV page-stream import attempts (a migrating row arriving at "
+            "this replica), by outcome", ("outcome",))
+        self._m_kv_bytes = reg.counter(
+            "dllama_kv_transfer_bytes_total",
+            "Framed KV page-stream wire bytes, by direction (in/out)",
+            ("direction",))
+        self._m_kv_pages = reg.counter(
+            "dllama_kv_transfer_pages_total",
+            "KV pages shipped on the transfer wire, by direction (in/out)",
+            ("direction",))
         # info-style gauge (value 1, identity in the labels): the resolved
         # TP wire format and overlap mode ride /metrics — and therefore the
         # router's federated /metrics/fleet — so a q80 request that was
@@ -1067,6 +1217,10 @@ class ServerState:
             # replica's trace-clock offset (skew + RTT/2) from time_us
             # against its own probe send/recv timestamps
             "replica_id": self.replica_id,
+            # disaggregation role: the router routes new prompts to
+            # prefill-capable replicas and migrated rows to decode-capable
+            # ones off this single field
+            "role": self.role,
             "started_at": round(self.started_at, 3),
             "time_us": observability.mono_to_us(),
             "draining": self.gate.draining,
@@ -1176,7 +1330,8 @@ class OpenAIHandler(BaseHTTPRequestHandler):
     #: SSE streams, and every 4xx/5xx alike
     _KNOWN_ROUTES = ("/v1/chat/completions", "/chat/completions",
                      "/v1/models", "/health", "/healthz", "/ready",
-                     "/metrics", "/stats", "/debug/flight")
+                     "/metrics", "/stats", "/debug/flight",
+                     "/v1/prefill", "/v1/kv/import")
 
     def _route(self) -> str:
         """Route label for the HTTP counter: known paths verbatim, anything
@@ -1314,12 +1469,24 @@ class OpenAIHandler(BaseHTTPRequestHandler):
 
     def do_POST(self):
         self._begin_request()
-        if self.path not in ("/v1/chat/completions", "/chat/completions"):
+        if self.path in ("/v1/chat/completions", "/chat/completions"):
+            handle, binary = self._handle_completions, False
+        elif self.path == "/v1/prefill":
+            # disaggregated serving, hop 1: prefill + first chunk here,
+            # then answer either the finished completion or a framed KV
+            # page stream for the router to hand a decode replica
+            handle, binary = self._handle_prefill, False
+        elif self.path == "/v1/kv/import":
+            # hop 2: admit a migrated row warm from its page stream and
+            # decode the rest (body is kv_transfer-framed bytes, not JSON)
+            handle, binary = self._handle_kv_import, True
+        else:
             self._error(404, f"unknown path {self.path}")
             return
         try:
             length = int(self.headers.get("Content-Length", "0"))
-            req = json.loads(self.rfile.read(length) or b"{}")
+            body = self.rfile.read(length)
+            req = body if binary else json.loads(body or b"{}")
         except (ValueError, json.JSONDecodeError) as e:
             self._error(400, f"bad JSON body: {e}")
             return
@@ -1346,7 +1513,7 @@ class OpenAIHandler(BaseHTTPRequestHandler):
         self.state.flight.record("request_start", request_id=self._rid,
                                  depth=trace.admission_depth)
         try:
-            self._handle_completions(req, trace)
+            handle(req, trace)
         except LifecycleError as e:
             # typed lifecycle end that escaped before any bytes were
             # written (non-streaming deadline/crash): speak its status
@@ -1364,7 +1531,9 @@ class OpenAIHandler(BaseHTTPRequestHandler):
 
     def _stream_batched(self, base: dict, sampler: SamplerConfig,
                         prompt_tokens: list, max_tokens: int,
-                        deadline: Deadline = None, trace=None) -> None:
+                        deadline: Deadline = None, trace=None,
+                        carried: list = None, source=None,
+                        cancel: CancelToken = None) -> None:
         """SSE streaming from the shared pool decode: bursts of up to
         batch-chunk tokens per event instead of one event per token (the
         granularity trade for sharing one device program across concurrent
@@ -1375,10 +1544,16 @@ class OpenAIHandler(BaseHTTPRequestHandler):
         ``stream:raise`` fault, which simulates exactly that) flips the
         request's CancelToken instead of decoding on for a dead socket; the
         scheduler releases the row's slot at the next chunk boundary. A
-        deadline expiry ends the stream with finish_reason "timeout"."""
+        deadline expiry ends the stream with finish_reason "timeout".
+
+        Disaggregation reuse: ``source`` (a callable taking the
+        CancelToken, returning a burst iterator) swaps in the import-admit
+        decode of a migrated row, and ``carried`` prepends the tokens the
+        exporting replica already emitted — the client's stream is the
+        solo stream whichever replica decoded which half."""
         st = self.state
         tok = st.tokenizer
-        cancel = CancelToken()
+        cancel = cancel if cancel is not None else CancelToken()
         self._send_sse_headers()
 
         client_gone = False
@@ -1408,9 +1583,13 @@ class OpenAIHandler(BaseHTTPRequestHandler):
         finish_reason = "length"
         n_generated = 0
         try:
-            for burst in st.batcher.submit_stream(prompt_tokens, max_tokens,
-                                                  sampler, deadline=deadline,
-                                                  cancel=cancel, trace=trace):
+            bursts = (source(cancel) if source is not None
+                      else st.batcher.submit_stream(
+                          prompt_tokens, max_tokens, sampler,
+                          deadline=deadline, cancel=cancel, trace=trace))
+            if carried:
+                bursts = itertools.chain([list(carried)], bursts)
+            for burst in bursts:
                 parts = []
                 stopped = False
                 for t in burst:
@@ -1740,6 +1919,210 @@ class OpenAIHandler(BaseHTTPRequestHandler):
             }))
 
 
+    # -- disaggregated serving (role-aware fleet) -------------------------
+    def _finished_row_response(self, base: dict, prompt_tokens: list,
+                               row: list, stream: bool, trace) -> None:
+        """Answer a COMPLETE token row in the client's requested shape —
+        the prefill hop uses this when the row finished inside its first
+        chunk (nothing migrated), and the import hop for its final
+        non-streaming answer. SSE here is a replay of finished tokens,
+        not a live stream; the router relays the bytes verbatim."""
+        st = self.state
+        text, finish, n_gen = decode_token_row(
+            st.tokenizer, prompt_tokens[-1], row, st.stop_token_ids(), [])
+        trace.tokens_out = n_gen
+        trace.finish_reason = finish
+        if not stream:
+            self._json(200, dict(base, choices=[{
+                "index": 0,
+                "message": {"role": "assistant", "content": text},
+                "finish_reason": finish,
+            }], usage={
+                "prompt_tokens": len(prompt_tokens),
+                "completion_tokens": n_gen,
+                "total_tokens": len(prompt_tokens) + n_gen,
+            }))
+            return
+        self._send_sse_headers()
+        try:
+            for delta, fin in ((({"role": "assistant"}), None),
+                               (({"content": text} if text else None), None),
+                               ({}, finish)):
+                if delta is None and fin is None:
+                    continue
+                chunk = dict(base, object="chat.completion.chunk",
+                             choices=[{"index": 0, "delta": delta or {},
+                                       "finish_reason": fin}])
+                self.wfile.write(b"data: " + json.dumps(chunk).encode()
+                                 + b"\n\n")
+            self.wfile.write(b"data: [DONE]\n\n")
+            self.wfile.flush()
+        except (BrokenPipeError, ConnectionResetError):
+            pass  # client vanished; nothing is decoding on its behalf
+        self.close_connection = True
+
+    def _handle_prefill(self, req: dict, trace: RequestTrace) -> None:
+        """POST /v1/prefill — hop 1 of a disaggregated request: admit the
+        prompt into the paged pool, decode its FIRST chunk here, then
+        export the row (pages + carried sampler-chain state) as a framed
+        KV stream for the router to deliver to a decode replica. A row
+        that finishes inside that first chunk answers the client's shape
+        directly (nothing to migrate). Body = the chat-completions JSON
+        plus optional "kv_wire" ("f32" bit-exact, default / "q80"
+        block-quantized)."""
+        st = self.state
+        if st.batcher is None or st.batcher.kv_pages <= 0:
+            self._error(400, "disaggregated prefill needs --batch-window "
+                             "> 0 and --kv-pages (paged KV pool)")
+            return
+        messages = req.get("messages")
+        if not isinstance(messages, list) or not messages:
+            self._error(400, "messages must be a non-empty list")
+            return
+        for m in messages:
+            if not isinstance(m, dict) or "role" not in m \
+                    or "content" not in m:
+                self._error(400, "each message needs role and content")
+                return
+        try:
+            sampler = SamplerConfig(
+                temperature=float(req.get(
+                    "temperature", st.default_sampler.temperature)),
+                topp=float(req.get("top_p", st.default_sampler.topp)),
+                seed=int(req["seed"]) if req.get("seed") is not None
+                else st.default_seed if st.default_seed is not None
+                else int(time.time_ns() % (1 << 31)),
+            )
+            stream = bool(req.get("stream", False))
+            mt = req.get("max_tokens")
+            max_tokens = None if mt is None else max(1, int(mt))
+            wire = str(req.get("kv_wire", "f32"))
+        except (TypeError, ValueError) as e:
+            self._error(400, f"bad request parameter: {e}")
+            return
+        if req.get("stop"):
+            # stop STRINGS need the solo path's host-side detector; the
+            # router never migrates such requests (fallback matrix)
+            self._error(400, "stop strings cannot be served "
+                             "disaggregated; route this request normally")
+            return
+        if int(req.get("n", 1) or 1) != 1:
+            self._error(400, "n > 1 cannot be served disaggregated")
+            return
+        if wire not in kv_transfer.WIRE_MODES:
+            self._error(400, f"unknown kv_wire {wire!r} "
+                             f"(know {kv_transfer.WIRE_MODES})")
+            return
+        tok = st.tokenizer
+        prompt = st.build_prompt(messages)
+        prompt_tokens = tok.encode(prompt, add_bos=True)
+        trace.tokens_in = len(prompt_tokens)
+        trace.prompt_sha = observability.prompt_digest(prompt)
+        room = st.cfg.seq_len - len(prompt_tokens)
+        if room <= 0:
+            self._error(400, f"prompt of {len(prompt_tokens)} tokens "
+                             f"exceeds the {st.cfg.seq_len}-token context")
+            return
+        max_tokens = room if max_tokens is None else min(max_tokens, room)
+        deadline = Deadline.start(st.request_timeout)
+        base = {"id": _completion_id(), "object": "chat.completion",
+                "created": int(time.time()), "model": st.model_name}
+        try:
+            snap, emitted = st.batcher.submit_prefill(
+                prompt_tokens, max_tokens, sampler, deadline=deadline,
+                trace=trace)
+        except LifecycleError:
+            raise  # do_POST speaks its status
+        except RuntimeError as e:
+            self._error(500, f"prefill-export failed: {e}")
+            return
+        if snap is None:
+            # finished inside the first chunk: answer the client directly
+            self._finished_row_response(base, prompt_tokens, emitted,
+                                        stream, trace)
+            return
+        payload = kv_transfer.encode_snapshot(
+            snap, prompt_tokens, mode=wire,
+            extra={"stream": stream,
+                   "emitted_tokens": [int(t) for t in emitted],
+                   "request_id": self._rid})
+        st._m_kv_bytes.inc(len(payload), direction="out")
+        st._m_kv_pages.inc(float(snap["n_blocks"]), direction="out")
+        trace.tokens_out = len(emitted)
+        trace.finish_reason = "migrated"
+        self.send_response(200)
+        self.send_header("Content-Type", kv_transfer.CONTENT_TYPE)
+        self.send_header("Content-Length", str(len(payload)))
+        self.send_header("X-Request-Id", self._rid)
+        self.send_header("Server-Timing", self._server_timing())
+        self.end_headers()
+        self._count(200)
+        self.wfile.write(payload)
+
+    def _handle_kv_import(self, body: bytes, trace: RequestTrace) -> None:
+        """POST /v1/kv/import — hop 2: decode the framed page stream
+        FULLY (a torn stream is rejected before the pool is touched),
+        admit the row warm, and serve the remaining decode in the
+        client's shape — carried tokens the exporter already emitted are
+        prepended, so the client sees one seamless stream."""
+        st = self.state
+        if st.batcher is None or st.batcher.kv_pages <= 0:
+            self._error(400, "KV import needs --batch-window > 0 and "
+                             "--kv-pages (paged KV pool)")
+            return
+        try:
+            snap = kv_transfer.decode_snapshot(body)
+        except kv_transfer.TransferError as e:
+            st._m_kv_imports.inc(outcome="rejected")
+            self._error(422, f"rejected KV stream: {e}")
+            return
+        st._m_kv_bytes.inc(len(body), direction="in")
+        st._m_kv_pages.inc(float(snap["n_blocks"]), direction="in")
+        extra = snap.get("extra") or {}
+        stream = bool(extra.get("stream"))
+        carried = [int(t) for t in extra.get("emitted_tokens") or []]
+        prompt_tokens = list(snap["prompt"])
+        trace.tokens_in = len(prompt_tokens)
+        deadline = Deadline.start(st.request_timeout)
+        base = {"id": _completion_id(), "object": "chat.completion",
+                "created": int(time.time()), "model": st.model_name}
+        if stream:
+            sampler = SamplerConfig(temperature=float(snap["temp"]),
+                                    topp=float(snap["topp"]), seed=0)
+            # pre-pull the FIRST burst before any SSE byte leaves: a row
+            # the pool can't admit must answer 5xx (the router's fallback
+            # cue), not a 200 stream that dies mid-flight
+            cancel = CancelToken()
+            gen = st.batcher.submit_import_stream(
+                snap, deadline=deadline, cancel=cancel, trace=trace)
+            try:
+                first = next(gen, None)
+            except LifecycleError:
+                raise
+            except RuntimeError as e:
+                self._error(503, f"KV import failed: {e}")
+                return
+            self._stream_batched(
+                base, sampler, prompt_tokens,
+                int(snap["budget"]) - int(snap["emitted"]),
+                deadline=deadline, trace=trace, carried=carried,
+                source=lambda _c: (itertools.chain([first], gen)
+                                   if first is not None else gen),
+                cancel=cancel)
+            return
+        try:
+            fresh = st.batcher.submit_import(snap, deadline=deadline,
+                                             trace=trace)
+        except LifecycleError:
+            raise
+        except RuntimeError as e:
+            # includes "no free KV pages": the router's cue to fall back
+            self._error(503, f"KV import failed: {e}")
+            return
+        self._finished_row_response(base, prompt_tokens, carried + fresh,
+                                    stream, trace)
+
+
 def create_server(state: ServerState, host: str = "0.0.0.0", port: int = 9990):
     handler = type("Handler", (OpenAIHandler,), {"state": state})
     srv = ThreadingHTTPServer((host, port), handler)
@@ -1796,6 +2179,7 @@ def serve(args) -> None:
         queue_depth=getattr(args, "queue_depth", 64),
         log_json=getattr(args, "log_json", False),
         log_prompts=getattr(args, "log_prompts", False),
+        role=getattr(args, "role", "both") or "both",
     )
     srv = create_server(state, host=args.host, port=args.port)
     # label this pid's track group in a merged fleet trace (no-op when

@@ -64,7 +64,8 @@ class Fleet:
     def __init__(self, model: str, tokenizer: str, n_replicas: int = 2,
                  base_port: int = 9990, host: str = "127.0.0.1",
                  replica_args: list = (), max_restarts: int = 3,
-                 log_dir: str = None, env: dict = None):
+                 log_dir: str = None, env: dict = None,
+                 roles: list = None):
         self.host = host
         self.max_restarts = max_restarts
         self.log_dir = log_dir
@@ -73,12 +74,18 @@ class Fleet:
         self._draining = False
         self._stopped = threading.Event()
         self._supervision: threading.Thread = None
+        # per-replica disaggregation role ("prefill"/"decode"/"both"),
+        # aligned by index; a role rides the replica's argv so a restart
+        # comes back with the same role it crashed with
+        roles = list(roles or [])
         self.replicas = tuple(
             ReplicaProc(i, host, base_port + i, [
                 sys.executable, "-m", "dllama_tpu.cli", "serve",
                 "--model", model, "--tokenizer", tokenizer,
                 "--host", host, "--port", str(base_port + i),
-            ] + list(replica_args))
+            ] + (["--role", roles[i]]
+                 if i < len(roles) and roles[i] != "both" else [])
+              + list(replica_args))
             for i in range(n_replicas))
         # each replica writes its own trace PART file next to the
         # supervisor's: N processes appending to one file would interleave
@@ -250,14 +257,30 @@ def run_fleet(args) -> None:
     replica_args = []
     for extra in getattr(args, "replica_arg", None) or []:
         replica_args.extend(extra.split())
+    # --prefill N --decode M carve the first N+M replicas into dedicated
+    # disaggregation roles (the rest stay "both"); the router migrates
+    # only when it can see at least one routable replica of EACH
+    n_pre = getattr(args, "prefill", 0) or 0
+    n_dec = getattr(args, "decode", 0) or 0
+    if bool(n_pre) != bool(n_dec):
+        raise SystemExit("--prefill and --decode go together: migration "
+                         "needs at least one replica of each role")
+    if n_pre + n_dec > args.replicas:
+        raise SystemExit(f"--prefill {n_pre} + --decode {n_dec} exceeds "
+                         f"--replicas {args.replicas}")
+    roles = (["prefill"] * n_pre + ["decode"] * n_dec
+             + ["both"] * (args.replicas - n_pre - n_dec))
     fleet = Fleet(
         args.model, args.tokenizer,
         n_replicas=args.replicas, base_port=args.base_port,
         host=args.replica_host, replica_args=replica_args,
-        max_restarts=args.max_restarts, log_dir=args.log_dir)
+        max_restarts=args.max_restarts, log_dir=args.log_dir,
+        roles=roles)
     print(f"🚀 spawning {args.replicas} replicas on "
           f"{args.replica_host}:{args.base_port}..."
-          f"{args.base_port + args.replicas - 1}")
+          f"{args.base_port + args.replicas - 1}"
+          + (f" ({n_pre} prefill + {n_dec} decode + "
+             f"{args.replicas - n_pre - n_dec} both)" if n_pre else ""))
     fleet.start()
     state = None
     try:

@@ -65,6 +65,18 @@ Fleet observability (this is the stitching half of observability.py):
   upstream errors, replica generation changes) and ``GET /debug/flight``
   returns it together with every replica's ring — the one call a
   postmortem starts from.
+
+Disaggregated serving: when the fleet declares both dedicated ``prefill``
+and dedicated ``decode`` replicas (the ``role`` field each publishes on
+``/ready``), new chat completions take the migration path instead —
+``POST /v1/prefill`` on a prefill replica runs the prompt and the FIRST
+decode chunk, then answers with a framed KV page stream
+(:mod:`kv_transfer`); the router relays that stream into
+``POST /v1/kv/import`` on a decode replica, which admits the row warm and
+streams the rest. The ``migrate`` fault seam sits at the decision point,
+and EVERY failure along the two hops falls back to normal routing (a full
+re-prefill on whatever replica pick() chooses) — a torn transfer is a
+performance event, never a client-visible error.
 """
 
 from __future__ import annotations
@@ -81,6 +93,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from dllama_tpu import faults, observability
 from dllama_tpu.analysis.sanitize import guarded_by
+from dllama_tpu.serving import kv_transfer
 from dllama_tpu.serving.lifecycle import LifecycleError, Supervisor
 
 #: longest prompt prefix the affinity index keys on, in blocks — bounds the
@@ -291,6 +304,11 @@ class Replica:
         with self._lock:
             return {
                 "name": self.name,
+                # disaggregation role the replica declared on /ready:
+                # "prefill" replicas take new prompts and hand their KV to
+                # a "decode" replica at first token; "both" (the default,
+                # and every pre-role replica) serves end-to-end
+                "role": self._info.get("role") or "both",
                 "ready": self._ready,
                 "circuit_open": time.monotonic() < self._circuit_until,
                 "consecutive_failures": self._failures,
@@ -405,6 +423,7 @@ class RouterState:
                  upstream_timeout_s: float = 0.0,
                  affinity_block: int = 256,
                  affinity_capacity: int = 4096,
+                 kv_wire: str = "f32",
                  metrics=None, enable_flight: bool = True):
         self.replicas = tuple(replicas)
         self.retry_budget = retry_budget
@@ -412,6 +431,12 @@ class RouterState:
         self.connect_timeout_s = connect_timeout_s
         self.upstream_timeout_s = upstream_timeout_s
         self.affinity_block = affinity_block
+        if kv_wire not in kv_transfer.WIRE_MODES:
+            raise ValueError(f"unknown --kv-wire {kv_wire!r} "
+                             f"(know {kv_transfer.WIRE_MODES})")
+        # wire mode the prefill replica is asked to encode migrating rows
+        # in: "f32" is bit-exact, "q80" ~3.76x smaller but error-bounded
+        self.kv_wire = kv_wire
         self.affinity = AffinityIndex(affinity_capacity)
         self.started_at = time.time()
         # a fresh registry per router (not the process default): in-process
@@ -463,6 +488,14 @@ class RouterState:
             "(connect/parse/injected); the replica drops out of that merged "
             "exposition, never the endpoint",
             ("replica",))
+        self._m_migrations = reg.counter(
+            "dllama_kv_transfer_migrations_total",
+            "Disaggregated prefill->decode migration attempts the router "
+            "orchestrated, by outcome (ok = handoff relayed end-to-end; "
+            "prefill_done = the row finished during prefill so nothing "
+            "migrated; every *_fallback/injected/no_* outcome degraded to "
+            "normal routing, i.e. a full re-prefill, never a client error)",
+            ("outcome",))
         self._m_probe_age = reg.gauge(
             "dllama_router_probe_age_seconds",
             "Seconds since each replica's last completed /ready probe "
@@ -481,21 +514,38 @@ class RouterState:
 
     # -- routing ----------------------------------------------------------
 
-    def pick(self, hashes: list, exclude=frozenset()):
+    def pick(self, hashes: list, exclude=frozenset(), role: str = None):
         """Choose the replica for one dispatch attempt: (replica, reason).
 
         Fires the ``route_pick`` seam (an injected fault here surfaces as
         a 5xx the ingress counter sees). Affinity wins when its target is
         routable and unsaturated; otherwise weighted least-load over every
-        routable replica not already tried this request."""
+        routable replica not already tried this request.
+
+        ``role`` narrows the candidate set to replicas that DECLARED that
+        disaggregation role (the migration hops). Normal picks
+        (``role=None``) exclude dedicated-prefill replicas — their slots
+        exist to turn prompts around fast, not to hold whole decodes —
+        unless they are the only routable capacity left (availability
+        beats placement policy)."""
         faults.fire("route_pick")
         candidates = []
+        spares = []  # dedicated-prefill replicas, normal traffic's last resort
         for r in self.replicas:
             if r.name in exclude:
                 continue
             s = r.snapshot()
-            if s["ready"] and not s["circuit_open"]:
+            if not (s["ready"] and not s["circuit_open"]):
+                continue
+            if role is not None:
+                if s["role"] == role:
+                    candidates.append((r, s))
+            elif s["role"] == "prefill":
+                spares.append((r, s))
+            else:
                 candidates.append((r, s))
+        if role is None and not candidates:
+            candidates = spares
         if not candidates:
             raise NoReplicaAvailable(len(self.replicas), len(exclude),
                                      retry_after_s=max(
@@ -524,6 +574,18 @@ class RouterState:
                               and rs[1]["probed_age_s"] > stale_after_s)))
         self._m_picks.inc(reason=reason)
         return r, reason
+
+    def disagg_ready(self) -> bool:
+        """Is the migration path open RIGHT NOW? Requires at least one
+        routable dedicated-prefill AND one routable dedicated-decode
+        replica. "both" replicas don't count toward either side — they
+        serve end-to-end, and a fleet of only those never migrates."""
+        roles = set()
+        for r in self.replicas:
+            s = r.snapshot()
+            if s["ready"] and not s["circuit_open"]:
+                roles.add(s["role"])
+        return "prefill" in roles and "decode" in roles
 
     # -- probing ----------------------------------------------------------
 
@@ -824,16 +886,185 @@ class RouterHandler(BaseHTTPRequestHandler):
         except (ValueError, OSError) as e:
             self._error(400, f"bad request body: {e}")
             return
+        try:
+            req = json.loads(body or b"{}")
+        except ValueError:
+            req = None  # let the replica speak the 400; neither affinity
+            #             nor migration applies to an unparseable body
         hashes = []
-        if self.state.affinity_block > 0:
+        if self.state.affinity_block > 0 and isinstance(req, dict):
             try:
-                req = json.loads(body or b"{}")
                 hashes = prefix_hashes(req.get("messages") or [],
                                        self.state.affinity_block)
             except (ValueError, AttributeError):
-                pass  # unparseable body: let the replica speak the 400;
-                #       affinity simply doesn't apply
+                pass  # malformed messages: no affinity hint, routing
+                #       still proceeds (the replica owns the 400)
+        if isinstance(req, dict) and self._try_disagg(req, hashes):
+            return  # migrated (or finished at the prefill replica)
         self._proxy("POST", body, affinity_hashes=hashes)
+
+    # -- disaggregated migration ------------------------------------------
+
+    def _try_disagg(self, req: dict, hashes: list) -> bool:
+        """One migration attempt: prefill hop -> KV relay -> decode hop.
+
+        Returns True iff the request was fully answered here — either the
+        decode replica took the handoff and streamed the rest of the row,
+        or the row finished during prefill and the prefill replica's
+        client-shape answer was relayed verbatim. EVERY failure path
+        returns False so do_POST falls back to normal routing (a full
+        re-prefill on whatever replica pick() chooses): a dead decode
+        replica or torn transfer costs latency, never a client error.
+
+        Fires the ``migrate`` seam at the decision point (an injected
+        fault here exercises exactly that fallback); the hops fire the
+        same ``route_pick``/``proxy_upstream`` seams as normal traffic."""
+        st = self.state
+        if not st.disagg_ready():
+            return False
+        if req.get("stop") or int(req.get("n") or 1) != 1:
+            # the prefill endpoint rejects these (stop strings need the
+            # decoded text on one replica, n>1 fans out) — route normally
+            return False
+        outcome = "prefill_fallback"
+        detail: dict = {}
+        t0 = time.monotonic()
+        try:
+            try:
+                faults.fire("migrate")
+            except faults.FaultInjected:
+                outcome = "injected"
+                return False
+            # -- hop 1: prefill -------------------------------------------
+            try:
+                prefill, _ = st.pick(hashes, role="prefill")
+            except (NoReplicaAvailable, faults.FaultInjected):
+                outcome = "no_prefill"
+                return False
+            detail["prefill"] = prefill.name
+            body = json.dumps(dict(req, kv_wire=st.kv_wire)).encode()
+            prefill.begin()
+            conn = None
+            try:
+                try:
+                    faults.fire("proxy_upstream")
+                    conn = http.client.HTTPConnection(
+                        prefill.host, prefill.port,
+                        timeout=st.connect_timeout_s)
+                    conn.request("POST", "/v1/prefill", body,
+                                 headers=self._upstream_headers())
+                    if conn.sock is not None:
+                        conn.sock.settimeout(st.upstream_timeout_s or None)
+                    resp = conn.getresponse()
+                except (OSError, http.client.HTTPException,
+                        faults.FaultInjected) as e:
+                    prefill.mark_conn_failure()
+                    st._m_upstream_errors.inc(replica=prefill.name)
+                    detail["error"] = repr(e)[:200]
+                    return False
+                if resp.status != 200:
+                    if resp.status == 503:
+                        prefill.mark_unready()  # draining: out of rotation
+                    st._m_upstream_errors.inc(replica=prefill.name)
+                    detail["status"] = resp.status
+                    return False
+                prefill.mark_conn_success()
+                ctype = resp.getheader("Content-Type") or ""
+                if kv_transfer.CONTENT_TYPE not in ctype:
+                    # the row finished during prefill: the reply already
+                    # IS the client-shape answer — relay it verbatim
+                    outcome = "prefill_done"
+                    if "text/event-stream" in ctype:
+                        self._relay_sse(resp, conn, prefill)
+                    else:
+                        self._relay_buffered(resp.status, resp.read(),
+                                             self._relay_headers(resp))
+                    if hashes:
+                        st.affinity.record(hashes, prefill.name)
+                    return True
+                stream = resp.read()  # the framed KV page stream, whole
+            finally:
+                prefill.end()
+                if conn is not None:
+                    conn.close()
+            # -- hop 2: decode import -------------------------------------
+            tried: set = set()
+            for _ in range(1 + st.retry_budget):
+                try:
+                    decode, _ = st.pick(hashes, role="decode",
+                                        exclude=tried)
+                except (NoReplicaAvailable, faults.FaultInjected):
+                    break
+                tried.add(decode.name)
+                detail["decode"] = decode.name
+                decode.begin()
+                conn = None
+                try:
+                    try:
+                        faults.fire("proxy_upstream")
+                        conn = http.client.HTTPConnection(
+                            decode.host, decode.port,
+                            timeout=st.connect_timeout_s)
+                        headers = self._upstream_headers()
+                        headers["Content-Type"] = kv_transfer.CONTENT_TYPE
+                        conn.request("POST", "/v1/kv/import", stream,
+                                     headers=headers)
+                        if conn.sock is not None:
+                            conn.sock.settimeout(
+                                st.upstream_timeout_s or None)
+                        resp = conn.getresponse()
+                    except (OSError, http.client.HTTPException,
+                            faults.FaultInjected) as e:
+                        decode.mark_conn_failure()
+                        st._m_upstream_errors.inc(replica=decode.name)
+                        detail["error"] = repr(e)[:200]
+                        continue
+                    if resp.status != 200:
+                        # 503 = draining, 422 = torn stream, 5xx = import
+                        # blew up: none did decode work, try the next one
+                        if resp.status == 503:
+                            decode.mark_unready()
+                        st._m_upstream_errors.inc(replica=decode.name)
+                        detail["status"] = resp.status
+                        continue
+                    decode.mark_conn_success()
+                    outcome = "ok"
+                    if "text/event-stream" in (resp.getheader("Content-Type")
+                                               or ""):
+                        self._relay_sse(resp, conn, decode)
+                    else:
+                        self._relay_buffered(resp.status, resp.read(),
+                                             self._relay_headers(resp))
+                    # affinity points at the PREFILL replica: the next
+                    # turn's prompt prefix is warm THERE (published at
+                    # admit), and warm prefill is where affinity saves
+                    # compute — the wire ships every block regardless of
+                    # decode-side warmth
+                    if hashes:
+                        st.affinity.record(hashes, prefill.name)
+                    return True
+                finally:
+                    decode.end()
+                    if conn is not None:
+                        conn.close()
+            outcome = "import_fallback"
+            return False
+        finally:
+            st._m_migrations.inc(outcome=outcome)
+            if st.flight is not None:
+                st.flight.record("migrate", request_id=self._rid,
+                                 outcome=outcome, **detail)
+            if observability.trace_path() is not None:
+                us = observability.mono_to_us
+                observability.emit_trace_events([
+                    {"name": "router_migrate", "ph": "X",
+                     "pid": os.getpid(), "tid": self._span_id,
+                     "ts": us(t0),
+                     "dur": max(1, us(time.monotonic()) - us(t0)),
+                     "cat": "router",
+                     "args": dict(detail, request_id=self._rid,
+                                  outcome=outcome)},
+                ])
 
     # -- the proxy core ---------------------------------------------------
 
@@ -1128,6 +1359,7 @@ def state_from_args(args, replica_addrs: list) -> RouterState:
         connect_timeout_s=getattr(args, "connect_timeout", 2.0),
         upstream_timeout_s=getattr(args, "upstream_timeout", 0.0),
         affinity_block=getattr(args, "affinity_block", 256),
+        kv_wire=getattr(args, "kv_wire", "f32") or "f32",
     )
 
 

@@ -1,0 +1,345 @@
+"""Disaggregated prefill/decode KV handoff (the PR 11 tentpole).
+
+Four layers under test. (1) The wire codec: f32 page streams round-trip
+bit-exactly — partial last pages included — and q80 streams round-trip
+within the bound the quant model itself implies; every torn/corrupted
+stream is rejected whole (``TransferError``), never half-decoded. (2) The
+engine seam: ``export_row`` after the first decode chunk, shipped over
+either wire, re-admitted with ``admit_from_export`` on a *different*
+engine, continues the stream bit-identically to the row never having
+moved (f32), because chunk boundaries and the carried sampler chain line
+up. (3) The fault seams: ``kv_export`` / ``kv_import`` raise on command
+at their sites (the serving layer's fallback paths key on exactly that),
+and the ``migrate`` site is registered with its metric. (4) The fleet
+surface: role-aware ``pick()`` keeps normal traffic off dedicated
+prefill replicas, ``disagg_ready()`` gates migration on both roles being
+routable, and ``/metrics/fleet`` federation dedups the
+``dllama_kv_transfer_*`` HELP/TYPE families.
+"""
+
+import json
+import zlib
+
+import numpy as np
+import pytest
+
+from dllama_tpu import faults, observability
+from dllama_tpu.models import llama
+from dllama_tpu.models.config import ModelConfig
+from dllama_tpu.runtime.generate import Engine
+from dllama_tpu.runtime.sampler import SamplerConfig
+from dllama_tpu.serving import kv_transfer
+from dllama_tpu.serving import router as router_mod
+
+CFG = ModelConfig(
+    arch="llama", dim=64, hidden_dim=128, n_layers=2, n_heads=4, n_kv_heads=2,
+    vocab_size=96, seq_len=64, head_size=16, kv_dim=32, dtype="float32",
+)
+
+LONG_PROMPT = [(i * 7 + 3) % 96 for i in range(23)]
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+def _solo(params, prompt, steps, sampler=None):
+    eng = Engine(CFG, params, SamplerConfig(temperature=0.0))
+    return [t for t, _ in eng.generate(list(prompt), steps=steps,
+                                       sampler=sampler)]
+
+
+def _drain(sess, out):
+    while any(not sess.is_done(b) for b in out):
+        sess.prefill_step()
+        for b, burst in sess.step_chunk().items():
+            if b in out:
+                out[b].extend(burst)
+    return out
+
+
+def _fake_snap(pos=20, page=8, nblk=3, plen=10, seed=0):
+    """A synthetic export_row snapshot: 2 arena leaves of [L, nblk, page,
+    kv, hd] with pos landing MID-page (the partial-frame case)."""
+    rng = np.random.default_rng(seed)
+    leaves = [np.asarray(rng.standard_normal((2, nblk, page, 4, 8)) * 3.0,
+                         np.float32) for _ in range(2)]
+    return {
+        "page_tokens": page, "n_blocks": nblk, "plen": plen, "pos": pos,
+        "token": 7, "keys": [123, 456], "temp": 0.8, "topp": 0.9,
+        "room": 32, "budget": 12, "offered": 3, "emitted": 2,
+        "stop_tokens": [2], "leaves": leaves,
+    }
+
+
+def _tamper_header(data: bytes, **overrides) -> bytes:
+    """Rewrite header fields WITH a valid CRC (so validation, not the
+    checksum, must reject) while keeping the page frames verbatim."""
+    hlen = int.from_bytes(data[4:8], "big")
+    hdr = json.loads(data[8:8 + hlen].decode())
+    hdr.update(overrides)
+    new = json.dumps(hdr, separators=(",", ":")).encode()
+    return (kv_transfer.MAGIC + len(new).to_bytes(4, "big") + new
+            + zlib.crc32(new).to_bytes(4, "big") + data[8 + hlen + 4:])
+
+
+# ---------------------------------------------------------------------------
+# wire codec: round-trips and rejection
+# ---------------------------------------------------------------------------
+
+def test_f32_round_trip_partial_page_bit_exact():
+    """pos=20 at page=8 means block 2 ships a 4-token partial frame: the
+    valid prefix must come back bit-exact, the never-attended tail
+    zero-filled, and every scalar/prompt/extra field intact."""
+    snap = _fake_snap()
+    prompt = list(range(snap["plen"]))
+    wire = kv_transfer.encode_snapshot(snap, prompt, mode="f32",
+                                       extra={"stream": True, "rid": "abc"})
+    got = kv_transfer.decode_snapshot(wire)
+    assert got["mode"] == "f32" and got["prompt"] == prompt
+    assert got["extra"] == {"stream": True, "rid": "abc"}
+    for k in ("page_tokens", "n_blocks", "plen", "pos", "token", "room",
+              "budget", "offered", "emitted"):
+        assert got[k] == snap[k], k
+    assert got["keys"] == snap["keys"]
+    assert got["stop_tokens"] == snap["stop_tokens"]
+    page = snap["page_tokens"]
+    for want, have in zip(snap["leaves"], got["leaves"]):
+        for b in range(snap["n_blocks"]):
+            ntok = max(0, min(snap["pos"] - b * page, page))
+            assert np.array_equal(have[:, b, :ntok], want[:, b, :ntok])
+            assert not have[:, b, ntok:].any(), "tail must zero-fill"
+
+
+def test_q80_round_trip_error_bounded_and_smaller():
+    """The q80 wire is lossy but bounded: every reconstructed element
+    within q80_error_bound of the original (the bound is derived from
+    the quant model, so this is the codec gating itself), at a wire size
+    well under half of f32's."""
+    snap = _fake_snap(seed=3)
+    prompt = list(range(snap["plen"]))
+    f32 = kv_transfer.encode_snapshot(snap, prompt, mode="f32")
+    q80 = kv_transfer.encode_snapshot(snap, prompt, mode="q80")
+    assert len(q80) < len(f32) / 2
+    got = kv_transfer.decode_snapshot(q80)
+    page = snap["page_tokens"]
+    for want, have in zip(snap["leaves"], got["leaves"]):
+        for b in range(snap["n_blocks"]):
+            ntok = max(0, min(snap["pos"] - b * page, page))
+            w = want[:, b, :ntok]
+            bound = kv_transfer.q80_error_bound(w)
+            err = float(np.abs(have[:, b, :ntok] - w).max()) if ntok else 0.0
+            assert err <= bound, f"block {b}: {err} > bound {bound}"
+            assert not have[:, b, ntok:].any()
+
+
+def test_torn_stream_rejected_at_every_cut():
+    """A stream cut ANYWHERE — mid-magic, mid-header, mid-frame — raises
+    TransferError; truncation can never half-admit a row."""
+    snap = _fake_snap(pos=6, page=4, nblk=2, plen=5, seed=1)
+    wire = kv_transfer.encode_snapshot(snap, list(range(5)), mode="f32")
+    for cut in range(len(wire)):
+        with pytest.raises(kv_transfer.TransferError):
+            kv_transfer.decode_snapshot(wire[:cut])
+    # bit corruption: a flipped payload byte fails that frame's CRC, a
+    # flipped header byte fails the header CRC, a bad magic never parses
+    torn = bytearray(wire)
+    torn[-6] ^= 0x01
+    with pytest.raises(kv_transfer.TransferError):
+        kv_transfer.decode_snapshot(bytes(torn))
+    torn = bytearray(wire)
+    torn[10] ^= 0x01
+    with pytest.raises(kv_transfer.TransferError):
+        kv_transfer.decode_snapshot(bytes(torn))
+    with pytest.raises(kv_transfer.TransferError):
+        kv_transfer.decode_snapshot(b"NOPE" + wire[4:])
+
+
+def test_malformed_headers_rejected():
+    snap = _fake_snap(pos=6, page=4, nblk=2, plen=5, seed=2)
+    wire = kv_transfer.encode_snapshot(snap, list(range(5)), mode="f32")
+    with pytest.raises(ValueError):
+        kv_transfer.encode_snapshot(snap, [], mode="zstd")
+    for bad in (dict(mode="zstd"), dict(v=2), dict(plen=99),
+                dict(page_tokens=0), dict(leaf_shapes=[[2, 9, 4, 8]] * 2)):
+        with pytest.raises(kv_transfer.TransferError):
+            kv_transfer.decode_snapshot(_tamper_header(wire, **bad))
+    # more blocks than frames on the wire = short read, same rejection
+    with pytest.raises(kv_transfer.TransferError):
+        kv_transfer.decode_snapshot(_tamper_header(wire, n_blocks=3))
+
+
+# ---------------------------------------------------------------------------
+# engine seam: migrated decode == solo decode
+# ---------------------------------------------------------------------------
+
+def _first_chunk(sess, handle):
+    """Run prefill + exactly one decode chunk (the serving layer's
+    /v1/prefill shape: the row migrates at first token)."""
+    first = []
+    while not first:
+        sess.prefill_step()
+        burst = sess.step_chunk().get(handle)
+        if burst:
+            first = list(burst)
+    return first
+
+
+def test_migration_bit_identical_over_f32_wire():
+    """Replica A prefills + decodes ONE chunk, exports, the snapshot
+    rides the f32 wire, replica B (a different Engine) imports warm and
+    finishes. carried-chunk + B's stream must equal the solo stream
+    token for token — sampled, not greedy, so the carried sampler chain
+    is load-bearing."""
+    params = llama.random_params(CFG, seed=31, dtype=np.float32)
+    scfg = SamplerConfig(temperature=0.9, topp=0.95, seed=7)
+    want = _solo(params, LONG_PROMPT, 12, scfg)
+
+    eng_a = Engine(CFG, params, SamplerConfig(temperature=0.0))
+    sess_a = eng_a.batch_session(max_batch=3, chunk=4, prefill_chunk=5,
+                                 kv_pages=8)
+    h = sess_a.admit_begin(LONG_PROMPT, steps=12, sampler=scfg)
+    first = _first_chunk(sess_a, h)
+    snap = sess_a.export_row(h)
+    sess_a.release(h)  # the export is host copies: releasing loses nothing
+    sess_a.close()
+
+    wire = kv_transfer.encode_snapshot(snap, LONG_PROMPT, mode="f32")
+    got = kv_transfer.decode_snapshot(wire)
+
+    eng_b = Engine(CFG, params, SamplerConfig(temperature=0.0))
+    sess_b = eng_b.batch_session(max_batch=3, chunk=4, prefill_chunk=5,
+                                 kv_pages=8)
+    h2 = sess_b.admit_from_export(got["prompt"], got)
+    rest = _drain(sess_b, {h2: []})[h2]
+    sess_b.release(h2)
+    sess_b._alloc.check()
+    sess_b.close()
+    assert first + rest == want, "migrated stream diverged from solo"
+
+
+def test_migration_over_q80_wire_completes():
+    """The lossy wire still carries a servable row: geometry, budget and
+    sampler state are exact (only page payloads quantize), so the import
+    admits and finishes with exactly the remaining token budget."""
+    params = llama.random_params(CFG, seed=32, dtype=np.float32)
+    eng_a = Engine(CFG, params, SamplerConfig(temperature=0.0))
+    sess_a = eng_a.batch_session(max_batch=2, chunk=4, kv_pages=8)
+    h = sess_a.admit_begin(LONG_PROMPT, steps=10)
+    first = _first_chunk(sess_a, h)
+    snap = sess_a.export_row(h)
+    sess_a.release(h)
+    sess_a.close()
+
+    got = kv_transfer.decode_snapshot(
+        kv_transfer.encode_snapshot(snap, LONG_PROMPT, mode="q80"))
+    eng_b = Engine(CFG, params, SamplerConfig(temperature=0.0))
+    sess_b = eng_b.batch_session(max_batch=2, chunk=4, kv_pages=8)
+    h2 = sess_b.admit_from_export(got["prompt"], got)
+    rest = _drain(sess_b, {h2: []})[h2]
+    sess_b.release(h2)
+    sess_b.close()
+    assert len(first) + len(rest) == 10
+
+
+# ---------------------------------------------------------------------------
+# fault seams
+# ---------------------------------------------------------------------------
+
+def test_kv_export_and_kv_import_fault_sites_raise():
+    """The serving layer's whole fallback matrix keys on these raises:
+    a faulted kv_export fails the /v1/prefill request, a faulted
+    kv_import bounces the decode replica so the router re-prefills.
+    Neither may corrupt the session — the export retries clean and the
+    failed import leaks no pages."""
+    params = llama.random_params(CFG, seed=21, dtype=np.float32)
+    eng = Engine(CFG, params, SamplerConfig(temperature=0.0))
+    sess = eng.batch_session(max_batch=2, chunk=4, kv_pages=8)
+    prompt = LONG_PROMPT[:9]
+    h = sess.admit(prompt, steps=8)
+    sess.step_chunk()
+    faults.install("kv_export:raise:times=1")
+    with pytest.raises(faults.FaultInjected):
+        sess.export_row(h)
+    faults.clear()
+    snap = sess.export_row(h)  # the seam fires once: a retry is clean
+    faults.install("kv_import:raise:times=1")
+    with pytest.raises(faults.FaultInjected):
+        sess.admit_from_export(list(prompt), snap)
+    faults.clear()
+    sess.release(h)
+    sess._alloc.check()  # the faulted import left no page refs behind
+    sess.close()
+
+
+def test_disagg_fault_sites_registered_with_metrics():
+    for site in ("kv_export", "kv_import", "migrate"):
+        assert site in faults.SITES
+        assert faults.SITE_METRICS[site].startswith("dllama_kv_transfer_")
+
+
+# ---------------------------------------------------------------------------
+# fleet surface: role-aware routing + federation
+# ---------------------------------------------------------------------------
+
+def _mk_replica(port, role, ready=True):
+    r = router_mod.Replica("127.0.0.1", port)
+    r.mark_probe(ready, {"role": role, "slots_total": 2,
+                         "slots_occupied": 0, "queue_depth": 0})
+    return r
+
+
+def test_router_role_aware_pick_and_disagg_ready():
+    pre = _mk_replica(9801, "prefill")
+    dec = _mk_replica(9802, "decode")
+    both = _mk_replica(9803, "both")
+    st = router_mod.RouterState([pre, dec, both], enable_flight=False)
+    assert st.disagg_ready()
+    assert st.pick([], role="prefill")[0] is pre
+    assert st.pick([], role="decode")[0] is dec
+    # normal traffic stays off the dedicated prefill replica...
+    for _ in range(5):
+        assert st.pick([])[0] is not pre
+    # ...unless it is the only routable capacity left
+    dec.mark_probe(False, None)
+    both.mark_probe(False, None)
+    assert st.pick([])[0] is pre
+    assert not st.disagg_ready()
+    with pytest.raises(router_mod.NoReplicaAvailable):
+        st.pick([], role="decode")
+    # a fleet of only "both" replicas never migrates
+    st2 = router_mod.RouterState([_mk_replica(9804, "both")],
+                                 enable_flight=False)
+    assert not st2.disagg_ready()
+
+
+def test_router_rejects_unknown_kv_wire():
+    with pytest.raises(ValueError):
+        router_mod.RouterState([_mk_replica(9805, "both")], kv_wire="zstd",
+                               enable_flight=False)
+
+
+def test_fleet_federation_dedups_kv_transfer_families():
+    """/metrics/fleet must merge two replicas' dllama_kv_transfer_*
+    series under the replica label with ONE HELP/TYPE pair per family —
+    the exposition stays valid and the counters sum downstream."""
+    parts = []
+    for name in ("r1", "r2"):
+        reg = observability.MetricsRegistry()
+        reg.counter("dllama_kv_transfer_exports_total",
+                    "KV page-stream export attempts", ("outcome",)
+                    ).inc(outcome="ok")
+        reg.counter("dllama_kv_transfer_bytes_total",
+                    "wire bytes", ("direction",)).inc(512.0, direction="out")
+        parts.append((name, reg.render()))
+    merged = router_mod.merge_expositions(parts)
+    assert merged.count("# HELP dllama_kv_transfer_exports_total") == 1
+    assert merged.count("# TYPE dllama_kv_transfer_exports_total") == 1
+    assert merged.count("# HELP dllama_kv_transfer_bytes_total") == 1
+    for name in ("r1", "r2"):
+        assert (f'dllama_kv_transfer_exports_total{{replica="{name}"'
+                in merged)
+    assert merged.count("dllama_kv_transfer_bytes_total{replica=") == 2

@@ -310,10 +310,12 @@ def test_eviction_under_pressure_keeps_identity():
 
 def test_admit_copy_dispatch_counts():
     """The paged-admit batching bar: a W-block warm prefix preloads its
-    staging cache in ONE gather dispatch and a completed prefill's tail
-    blocks scatter into the arena in ONE dispatch — O(1) device calls in
-    the number of blocks, where the per-page loop was O(W). Streams stay
-    bit-identical (the batched copies move the exact same KV)."""
+    staging cache in ONE gather dispatch, and prefilled blocks scatter
+    into the arena in ONE batched dispatch per prefill CHUNK (publish-at-
+    admit lands each chunk's completed blocks so concurrent admits can
+    alias them) plus one for the partial tail — O(chunks) device calls,
+    never the per-page O(W) loop. Streams stay bit-identical (the batched
+    copies move the exact same KV)."""
     params = llama.random_params(CFG, seed=9, dtype=np.float32)
     scfg = SamplerConfig(temperature=0.0, seed=4)
     prompt = [(i * 13 + 5) % 96 for i in range(60)]  # 8 pages: 7 full + tail
@@ -335,7 +337,10 @@ def test_admit_copy_dispatch_counts():
     h1 = sess.admit_begin(prompt, steps=4, sampler=scfg)
     cold = _drain_interleaved(sess, {h1: []})[h1]
     assert calls["gather"] == 0  # nothing cached yet — no preload at all
-    assert calls["scatter"] == 1, "cold tail must scatter in ONE dispatch"
+    # 59-token prefix at prefill_chunk=16 -> 4 chunks, each landing its
+    # completed blocks in one batched scatter, +1 for the partial tail
+    assert calls["scatter"] == 5, \
+        "prefill must scatter once per chunk (+ tail), not per page"
     sess.release(h1)
 
     calls["gather"] = calls["scatter"] = 0
@@ -346,6 +351,54 @@ def test_admit_copy_dispatch_counts():
     assert calls["gather"] == 1, \
         "a 7-block warm prefix must preload in ONE gather dispatch"
     assert calls["scatter"] == 1
+    sess.close()
+
+
+def test_publish_at_admit_shares_pages_between_live_rows():
+    """Publish-at-admit: a row's full prompt blocks hang in the radix
+    tree from the moment it is ADMITTED (ready=False until each prefill
+    chunk fills them), so a second row admitted while the first is still
+    mid-prefill aliases every block already landed — page sharing between
+    two CONCURRENTLY-live rows, not only after go-live. Both streams must
+    stay bit-identical to solo runs and the refcount oracle green at
+    every step."""
+    params = llama.random_params(CFG, seed=12, dtype=np.float32)
+    scfg_a = SamplerConfig(temperature=0.7, seed=5)
+    scfg_b = SamplerConfig(temperature=0.7, seed=9)
+    prompt = [(i * 13 + 5) % 96 for i in range(33)]
+    want_a = _solo(params, prompt, 6, scfg_a)
+    want_b = _solo(params, prompt, 6, scfg_b)
+    eng = Engine(CFG, params, SamplerConfig(temperature=0.0))
+    sess = eng.batch_session(max_batch=2, chunk=4, prefill_chunk=8,
+                             kv_pages=8)
+    ha = sess.admit_begin(prompt, steps=6, sampler=scfg_a)
+    sess._alloc.check()
+    # the prompt's four full blocks are published immediately...
+    assert len(sess._radix) == (len(prompt) - 1) // 8
+    # ...but none are aliasable before a chunk lands
+    assert sess._radix.match(prompt) == []
+    sess.prefill_step(ha)
+    sess._alloc.check()
+    ready = len(sess._radix.match(prompt))
+    assert ready >= 1, "first chunk must flip its completed blocks ready"
+    hb = sess.admit_begin(prompt, steps=6, sampler=scfg_b)
+    sess._alloc.check()
+    assert sess._slots[ha].prefilling, "A must still be mid-prefill"
+    assert sess.prefix_tokens_matched >= ready * 8
+    shared = sess._rowpages[hb].blocks[:ready]
+    assert shared == sess._rowpages[ha].blocks[:ready], \
+        "B must alias A's ready blocks, not copy them"
+    for p in shared:
+        assert sess._alloc.refcount(p) == 2  # both live rows hold it
+    out = _drain_interleaved(sess, {ha: [], hb: []})
+    sess._alloc.check()
+    assert out[ha] == want_a, "sharer A diverged from solo"
+    assert out[hb] == want_b, "sharer B diverged from solo"
+    sess.release(ha)
+    sess.release(hb)
+    sess._alloc.check()
+    for p in shared:
+        assert sess._alloc.refcount(p) == 0 and sess._alloc.is_cached(p)
     sess.close()
 
 
