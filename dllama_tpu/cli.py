@@ -64,11 +64,22 @@ def build_parser() -> argparse.ArgumentParser:
             "conversations route to the replica whose radix cache holds "
             "their warm KV pages; 0 disables affinity (pure least-load)")
         rp.add_argument(
-            "--kv-wire", default="f32", choices=["f32", "q80"],
-            help="wire mode for the prefill->decode KV page handoff (only "
-            "used when the fleet declares both roles): f32 is bit-exact — "
-            "a migrated stream is token-for-token the solo stream; q80 "
-            "ships ~3.76x fewer bytes, block-quantized and error-bounded")
+            "--kv-wire", default="f32", choices=["f32", "q80", "q80+f32"],
+            help="wire mode for KV page handoffs (migrations and "
+            "mid-stream checkpoints): f32 is bit-exact — a migrated "
+            "stream is token-for-token the solo stream; q80 ships ~3.76x "
+            "fewer bytes, block-quantized and error-bounded; q80+f32 "
+            "ships full pages as q80 but the partial tail page bit-exact "
+            "f32 — the page still being decoded into carries no "
+            "quantization error, at near-q80 cost")
+        rp.add_argument(
+            "--ckpt-interval", type=int, default=32, metavar="K",
+            help="mid-stream failover: ask each streamed request's "
+            "replica for a session checkpoint every K emitted tokens "
+            "(token-count based, so deterministic); on an upstream death "
+            "mid-SSE the router resumes the stream bit-identically on a "
+            "sibling replica from the latest checkpoint. 0 disables "
+            "checkpoint frames and resume orchestration")
 
     # the fleet front door: stdlib-only, no model artifacts, no jax — it
     # proxies the OpenAI surface across N running `serve` replicas
@@ -287,6 +298,17 @@ def build_parser() -> argparse.ArgumentParser:
                 "default) serves end-to-end. Needs --kv-pages for the "
                 "migration endpoints; the role is advisory — the router "
                 "enforces placement",
+            )
+            sp.add_argument(
+                "--ckpt-interval",
+                type=int,
+                default=32,
+                metavar="K",
+                help="mid-stream failover: default checkpoint cadence (in "
+                "emitted tokens) for streams the router opts in via the "
+                "X-Dllama-Ckpt header without naming its own K; 0 refuses "
+                "checkpointing entirely on this replica. Checkpoints need "
+                "--kv-pages (the paged pool is what export_row snapshots)",
             )
         sp.add_argument("--model", required=True)
         sp.add_argument("--tokenizer", required=True)

@@ -39,6 +39,12 @@ Spec grammar (sites separated by ``;``)::
   fallback matrix handles) and ``migrate`` (every router-orchestrated
   prefill→decode migration — a faulted migration degrades to
   re-prefilling on the decode replica, never a client-visible error).
+  The failover seams are ``ckpt_write`` (every periodic mid-stream
+  session checkpoint a replica ships to the router — a faulted write is
+  a skipped checkpoint, counted, never a stream error) and ``resume``
+  (every router-side resume attempt after an upstream died mid-SSE — a
+  faulted resume degrades to the clean SSE ``error`` + ``[DONE]``
+  termination the fallback matrix guarantees).
 * ``action`` — ``raise`` (throw :class:`FaultInjected`), ``slow`` (sleep
   ``delay_ms``, default 50), or a *data* action the seam itself interprets:
   ``truncate`` (weights_open: pretend the file is ``drop`` bytes short,
@@ -67,7 +73,7 @@ SITES = ("admit", "step_chunk", "prefill", "prefill_chunk", "prefix_match",
          "page_alloc", "stream", "scheduler", "weights_open", "weights_read",
          "logits", "route_pick", "proxy_upstream", "probe",
          "federate_scrape", "flight_dump", "overlap_split",
-         "kv_export", "kv_import", "migrate")
+         "kv_export", "kv_import", "migrate", "ckpt_write", "resume")
 ACTIONS = ("raise", "slow", "truncate", "bitflip", "nan")
 
 #: site -> the metric family that proves the site's failure is VISIBLE on
@@ -109,6 +115,11 @@ SITE_METRICS = {
     "kv_export": "dllama_kv_transfer_exports_total",
     "kv_import": "dllama_kv_transfer_imports_total",
     "migrate": "dllama_kv_transfer_migrations_total",
+    # mid-stream failover seams: a faulted checkpoint write is a skipped
+    # (counted) checkpoint; a faulted resume is one more row of the
+    # router's resume fallback matrix, counted by outcome
+    "ckpt_write": "dllama_ckpt_writes_total",
+    "resume": "dllama_stream_resume_total",
 }
 
 

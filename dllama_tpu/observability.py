@@ -687,6 +687,58 @@ def parse_server_timing(header: Optional[str]) -> Dict[str, float]:
 
 
 # ---------------------------------------------------------------------------
+# SSE event framing (mid-stream failover)
+
+class SSEScanner:
+    """Incremental server-sent-events splitter: feed raw socket chunks,
+    get back complete ``\\n\\n``-terminated events as they close. The
+    router's resumable relay uses this to strip checkpoint control frames
+    and count forwarded bytes exactly; tests use it to assert splice
+    arithmetic. Single-threaded by construction (one relay loop owns one
+    scanner), so no lock."""
+
+    def __init__(self):
+        self._buf = bytearray()
+
+    def feed(self, chunk: bytes) -> list:
+        """Append ``chunk``; return every COMPLETE event now available,
+        each as raw bytes INCLUDING its terminating blank line — so
+        forwarding the returned events verbatim plus :meth:`tail` at EOF
+        reproduces the input byte-for-byte."""
+        self._buf += chunk
+        out = []
+        while True:
+            i = self._buf.find(b"\n\n")
+            if i < 0:
+                return out
+            out.append(bytes(self._buf[:i + 2]))
+            del self._buf[:i + 2]
+
+    def tail(self) -> bytes:
+        """Bytes buffered past the last complete event (flush at EOF)."""
+        return bytes(self._buf)
+
+
+def sse_event_fields(event: bytes) -> Dict[str, bytes]:
+    """Minimal SSE field parse of one complete event: ``{field: value}``
+    with multi-``data`` lines joined by ``\\n`` per the SSE spec; comment
+    lines (leading ``:``) and garbage are skipped."""
+    fields: Dict[str, bytes] = {}
+    for line in event.split(b"\n"):
+        if not line or line.startswith(b":"):
+            continue
+        name, sep, value = line.partition(b":")
+        if not sep:
+            continue
+        if value.startswith(b" "):
+            value = value[1:]
+        key = name.decode("ascii", "replace")
+        fields[key] = (fields[key] + b"\n" + value) if key in fields \
+            else value
+    return fields
+
+
+# ---------------------------------------------------------------------------
 # Flight recorder: the process's black box
 
 @guarded_by("_lock", "_events", "_seq")

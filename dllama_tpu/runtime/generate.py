@@ -2537,7 +2537,7 @@ class BatchSession:
         pf.scattered = done * self.page
 
     # -- migration (disaggregated serving) --------------------------------
-    def export_row(self, handle: int) -> dict:
+    def export_row(self, handle: int, fire_fault: bool = True) -> dict:
         """Snapshot a live paged row for migration to a sibling replica:
         its page payloads (host numpy, arena leaf order), page-table
         geometry, and the decode state a solo run would carry across the
@@ -2547,7 +2547,10 @@ class BatchSession:
         same model and chunk size continues the stream bit-identically to
         the row never having moved. The row itself is untouched — the
         caller releases it once the transfer is acknowledged (a failed
-        transfer loses nothing)."""
+        transfer loses nothing). ``fire_fault=False`` skips the
+        ``kv_export`` fault seam — the mid-stream checkpoint path fires
+        its own ``ckpt_write`` seam instead, so each export flavor is
+        drilled (and counted) separately."""
         if not self.paged:
             raise RuntimeError(
                 "export_row needs a paged session (--kv-pages)")
@@ -2557,7 +2560,8 @@ class BatchSession:
         if st.done:
             raise RuntimeError(
                 f"slot {handle} already finished — nothing to migrate")
-        faults.fire("kv_export")
+        if fire_fault:
+            faults.fire("kv_export")
         g, r = self._where[handle]
         rp = self._rowpages[handle]
         idx = jnp.asarray(rp.blocks, jnp.int32)

@@ -28,6 +28,7 @@ cache); concurrent connections queue on a lock rather than corrupting state.
 
 from __future__ import annotations
 
+import base64
 import codecs
 import itertools
 import json
@@ -98,6 +99,20 @@ class StopDetector:
     def flush(self) -> str:
         out, self.hold = self.hold, ""
         return out
+
+    def state(self) -> dict:
+        """Scanback state for the kv_transfer v2 header — what a
+        stop-string session must carry to migrate/resume without leaking
+        (or double-emitting) a held stop-prefix tail."""
+        return {"stops": list(self.stops), "hold": self.hold,
+                "stopped": self.stopped}
+
+    @classmethod
+    def from_state(cls, state: dict) -> "StopDetector":
+        d = cls([str(s) for s in state.get("stops", [])])
+        d.hold = str(state.get("hold", ""))
+        d.stopped = bool(state.get("stopped", False))
+        return d
 
 
 def padded_batch(prompts: list, row_steps: list) -> tuple:
@@ -180,11 +195,12 @@ class Batcher:
     class _Slot:
         __slots__ = ("prompt", "steps", "sampler", "tokens", "error", "done",
                      "queue", "deadline", "cancel", "trace", "kind", "snap",
-                     "export")
+                     "export", "ckpt_every", "since_ckpt")
 
         def __init__(self, prompt, steps, sampler, streaming: bool,
                      deadline=None, cancel=None, trace=None,
-                     kind: str = "completion", snap=None):
+                     kind: str = "completion", snap=None,
+                     ckpt_every: int = 0):
             self.prompt, self.steps, self.sampler = prompt, steps, sampler
             self.tokens = None
             self.error = None
@@ -199,6 +215,11 @@ class Batcher:
             #: export_row snapshot (kind "prefill", when the row migrated
             #: instead of finishing inside its first chunk)
             self.export = None
+            #: mid-stream failover: checkpoint this streaming row every N
+            #: emitted tokens (0 = off). Token-count based, so the ckpt
+            #: schedule is deterministic across identical greedy runs.
+            self.ckpt_every = int(ckpt_every) if streaming else 0
+            self.since_ckpt = 0
             # streaming protocol: list-of-token-ids items, then exactly one
             # terminal item — None (clean end) or an Exception
             self.queue = queue_mod.Queue() if streaming else None
@@ -665,6 +686,27 @@ class Batcher:
                         del slot_map[b]
                         s.export = snap
                         s.done.set()
+                    elif s.ckpt_every > 0 and s.queue is not None and burst:
+                        # mid-stream failover checkpoint, taken AT the
+                        # chunk boundary (so it lines up with an SSE event
+                        # boundary downstream) and pushed THROUGH the
+                        # queue: the writer attaches its rendering state
+                        # at exactly the point the snapshot describes. The
+                        # row stays live — a failed write is a skipped
+                        # checkpoint (shorter resume coverage), never a
+                        # stream error.
+                        s.since_ckpt += len(burst)
+                        if s.since_ckpt >= s.ckpt_every:
+                            s.since_ckpt = 0
+                            try:
+                                faults.fire("ckpt_write")
+                                snap = sess.export_row(b, fire_fault=False)
+                            except Exception:  # noqa: BLE001
+                                self.state._m_ckpt_writes.inc(
+                                    outcome="error")
+                            else:
+                                self.state._m_ckpt_writes.inc(outcome="ok")
+                                s.queue.put(("ckpt", snap))
                 while True:  # rolling admission: drain mid-chunk arrivals
                     try:
                         waiting.append(self._arrivals.get_nowait())
@@ -720,9 +762,11 @@ class Batcher:
             window = [s for s in window if not self._reap_slot(s)]
             if window:
                 t_win = time.monotonic()
-                # disaggregation jobs (prefill-export / import-admit) exist
-                # only in the paged slot pool: they never route solo or spec
-                plain = all(s.kind == "completion" for s in window)
+                # disaggregation jobs (prefill-export / import-admit) and
+                # checkpointing streams exist only in the paged slot pool:
+                # they never route solo or spec
+                plain = all(s.kind == "completion" and not s.ckpt_every
+                            for s in window)
                 with self.state.lock:  # the engine serves one pool at a time
                     if plain and len(window) == 1 and self._arrivals.empty():
                         self._serve_solo(window[0])
@@ -812,20 +856,26 @@ class Batcher:
 
     def submit_stream(self, prompt_tokens: list, max_tokens: int,
                       sampler: SamplerConfig, deadline: Deadline = None,
-                      cancel: CancelToken = None, trace=None):
+                      cancel: CancelToken = None, trace=None,
+                      ckpt_every: int = 0):
         """Yields bursts (lists) of token ids as the pool decodes — from
         admission, not from batch completion. Raises the decode failure as
         RuntimeError. A set ``cancel`` token ends the generator (the
-        scheduler releases the row's slot at its next chunk boundary)."""
+        scheduler releases the row's slot at its next chunk boundary).
+        ``ckpt_every`` > 0 interleaves ``("ckpt", export_snapshot)``
+        markers into the yielded stream every that-many tokens — the SSE
+        writer serializes them into checkpoint frames for the router."""
         slot = self._Slot(list(prompt_tokens), max_tokens, sampler,
                           streaming=True, deadline=deadline, cancel=cancel,
-                          trace=trace)
+                          trace=trace, ckpt_every=ckpt_every)
         self._enqueue(slot)
         return self._drain_stream(slot, cancel)
 
     def _drain_stream(self, slot, cancel):
-        """Consume a streaming slot's queue: yield bursts until the
-        terminal item (None = clean end, Exception = raised)."""
+        """Consume a streaming slot's queue: yield bursts — token-id lists
+        interleaved with ``("ckpt", snapshot)`` markers when the slot
+        checkpoints — until the terminal item (None = clean end,
+        Exception = raised)."""
         while True:
             try:
                 item = slot.queue.get(timeout=0.25)
@@ -878,25 +928,30 @@ class Batcher:
         return slot.tokens
 
     def submit_import_stream(self, snap: dict, deadline: Deadline = None,
-                             cancel: CancelToken = None, trace=None):
+                             cancel: CancelToken = None, trace=None,
+                             ckpt_every: int = 0):
         """Streaming variant of :meth:`submit_import`: yields bursts of
         freshly decoded token ids (the carried already-emitted tokens are
         the CALLER's to prepend — they were streamed by the exporter's
-        chunk, not decoded here)."""
+        chunk, not decoded here). ``ckpt_every`` keeps the resumed row
+        checkpointing, so a SECOND death during resume is itself
+        resumable."""
         slot = self._import_slot(snap, deadline=deadline, cancel=cancel,
-                                 trace=trace, streaming=True)
+                                 trace=trace, streaming=True,
+                                 ckpt_every=ckpt_every)
         self._enqueue(slot)
         return self._drain_stream(slot, cancel)
 
     def _import_slot(self, snap: dict, deadline=None, cancel=None,
-                     trace=None, streaming: bool = False):
+                     trace=None, streaming: bool = False,
+                     ckpt_every: int = 0):
         sampler = SamplerConfig(temperature=float(snap["temp"]),
                                 topp=float(snap["topp"]), seed=0)
         steps = max(1, int(snap["budget"]) - int(snap["emitted"]))
         return self._Slot(list(snap["prompt"]), steps, sampler,
                           streaming=streaming, deadline=deadline,
                           cancel=cancel, trace=trace, kind="import",
-                          snap=snap)
+                          snap=snap, ckpt_every=ckpt_every)
 
 
 class ServerState:
@@ -912,7 +967,7 @@ class ServerState:
                  request_timeout: float = 0.0, queue_depth: int = 64,
                  metrics=None, log_json: bool = False,
                  log_prompts: bool = False, log_stream=None, flight=None,
-                 role: str = "both"):
+                 role: str = "both", ckpt_interval: int = 32):
         """``default_seed``: seed for requests that send none — None means a
         fresh time-based seed per request (the launch-flag --seed plumbs in
         here so an operator can make the whole server reproducible).
@@ -950,7 +1005,13 @@ class ServerState:
         decode replica at first token), "decode" (receives migrated rows)
         or "both" (the default — a colocated replica). The role only
         steers the ROUTER's placement; every replica answers every
-        endpoint, so a lone "both" fleet behaves exactly as before."""
+        endpoint, so a lone "both" fleet behaves exactly as before.
+        ``ckpt_interval``: default mid-stream checkpoint cadence in
+        emitted tokens (--ckpt-interval) for streams that opt in via the
+        ``X-Dllama-Ckpt`` header without naming their own K; 0 disables
+        even opted-in checkpointing. A stream never checkpoints unless
+        the request asks — direct (router-less) clients never see
+        checkpoint control frames."""
         self.engine = engine
         self.tokenizer = tokenizer
         self.cfg = cfg
@@ -963,6 +1024,7 @@ class ServerState:
             raise ValueError(
                 f"role must be prefill/decode/both, got {role!r}")
         self.role = role
+        self.ckpt_interval = max(0, int(ckpt_interval))
         self.session_cache = max(1, session_cache)
         #: HBM bound shared by the batcher AND the `n` parameter: a batch's
         #: KV cache holds this many full-context caches
@@ -1049,6 +1111,15 @@ class ServerState:
             "dllama_kv_transfer_pages_total",
             "KV pages shipped on the transfer wire, by direction (in/out)",
             ("direction",))
+        # mid-stream failover: periodic session checkpoints shipped in-band
+        # to the router. outcome="error" moves when the ckpt_write fault
+        # site fires (or a live export fails) — a failed checkpoint only
+        # shrinks resume coverage, never the stream
+        self._m_ckpt_writes = reg.counter(
+            "dllama_ckpt_writes_total",
+            "Mid-stream session checkpoint attempts (every --ckpt-interval "
+            "emitted tokens on an opted-in stream), by outcome",
+            ("outcome",))
         # info-style gauge (value 1, identity in the labels): the resolved
         # TP wire format and overlap mode ride /metrics — and therefore the
         # router's federated /metrics/fleet — so a q80 request that was
@@ -1331,7 +1402,7 @@ class OpenAIHandler(BaseHTTPRequestHandler):
     _KNOWN_ROUTES = ("/v1/chat/completions", "/chat/completions",
                      "/v1/models", "/health", "/healthz", "/ready",
                      "/metrics", "/stats", "/debug/flight",
-                     "/v1/prefill", "/v1/kv/import")
+                     "/v1/prefill", "/v1/kv/import", "/v1/kv/resume")
 
     def _route(self) -> str:
         """Route label for the HTTP counter: known paths verbatim, anything
@@ -1350,6 +1421,23 @@ class OpenAIHandler(BaseHTTPRequestHandler):
             self.headers.get("X-Dllama-Parent-Span"))
         self._trace = None
         self._t_begin = time.monotonic()
+
+    def _ckpt_request(self) -> tuple:
+        """Parse the router's ``X-Dllama-Ckpt`` / ``X-Dllama-Ckpt-Wire``
+        headers into ``(ckpt_every, wire)``. 0 = checkpointing not
+        requested — or disabled on this replica (--ckpt-interval 0
+        outranks any header); a bare/"auto" value takes the replica's
+        --ckpt-interval default. An unknown wire falls back to f32, the
+        bit-exact mode a resume can always trust."""
+        st = self.state
+        raw = (self.headers.get("X-Dllama-Ckpt") or "").strip().lower()
+        if not raw or st.ckpt_interval <= 0:
+            return 0, "f32"
+        k = (st.ckpt_interval if not raw.isdigit() else int(raw))
+        wire = (self.headers.get("X-Dllama-Ckpt-Wire") or "f32").strip()
+        if wire not in kv_transfer.WIRE_MODES:
+            wire = "f32"
+        return max(0, k), wire
 
     def _count(self, code: int) -> None:
         self.state._m_http.inc(route=self._route(), code=str(code))
@@ -1383,7 +1471,7 @@ class OpenAIHandler(BaseHTTPRequestHandler):
         self._count(code)
         self.wfile.write(body)
 
-    def _send_sse_headers(self) -> None:
+    def _send_sse_headers(self, extra: dict = None) -> None:
         self.send_response(200)
         self.send_header("Content-Type", "text/event-stream")
         self.send_header("Cache-Control", "no-cache")
@@ -1392,6 +1480,8 @@ class OpenAIHandler(BaseHTTPRequestHandler):
         # headers leave before decode runs: only the phases known NOW (queue
         # wait at best) appear; the router attributes the rest to stream time
         self.send_header("Server-Timing", self._server_timing())
+        for k, v in (extra or {}).items():
+            self.send_header(k, v)
         self.end_headers()
         self._count(200)
 
@@ -1480,6 +1570,11 @@ class OpenAIHandler(BaseHTTPRequestHandler):
             # hop 2: admit a migrated row warm from its page stream and
             # decode the rest (body is kv_transfer-framed bytes, not JSON)
             handle, binary = self._handle_kv_import, True
+        elif self.path == "/v1/kv/resume":
+            # mid-stream failover: admit a dead sibling's checkpointed
+            # session and continue its SSE stream bit-identically (body
+            # is the checkpoint's kv_transfer-framed bytes)
+            handle, binary = self._handle_kv_resume, True
         else:
             self._error(404, f"unknown path {self.path}")
             return
@@ -1533,12 +1628,18 @@ class OpenAIHandler(BaseHTTPRequestHandler):
                         prompt_tokens: list, max_tokens: int,
                         deadline: Deadline = None, trace=None,
                         carried: list = None, source=None,
-                        cancel: CancelToken = None) -> None:
+                        cancel: CancelToken = None,
+                        detector: StopDetector = None,
+                        ckpt_every: int = 0, ckpt_wire: str = "f32",
+                        resume_state: dict = None,
+                        extra_headers: dict = None) -> None:
         """SSE streaming from the shared pool decode: bursts of up to
         batch-chunk tokens per event instead of one event per token (the
         granularity trade for sharing one device program across concurrent
-        requests). Stop strings never reach here (the batch gate routes
-        them solo), so only stop TOKENS and budgets truncate.
+        requests). ``detector`` enables stop-string truncation here (a
+        tripped detector cancels the row at its next chunk boundary);
+        without one, only stop TOKENS and budgets truncate — the batch
+        gate still routes plain stop-string requests solo.
 
         Lifecycle: a write failure (client FIN/RST — or an injected
         ``stream:raise`` fault, which simulates exactly that) flips the
@@ -1550,46 +1651,107 @@ class OpenAIHandler(BaseHTTPRequestHandler):
         CancelToken, returning a burst iterator) swaps in the import-admit
         decode of a migrated row, and ``carried`` prepends the tokens the
         exporting replica already emitted — the client's stream is the
-        solo stream whichever replica decoded which half."""
+        solo stream whichever replica decoded which half.
+
+        Mid-stream failover: ``ckpt_every`` > 0 serializes each
+        ``("ckpt", snapshot)`` marker the scheduler interleaves into one
+        in-band ``event: dllama-ckpt`` control frame — the snapshot plus
+        THIS writer's rendering state (emitted byte count, incremental
+        UTF-8 decoder state, pending-token/render counters, the response
+        ``base`` identity, the detector's scanback) — which the router
+        strips into its checkpoint store; clients talking to the replica
+        directly never request checkpoints and never see the frames.
+        ``resume_state`` is the other half: /v1/kv/resume rehydrates that
+        rendering state so the continued stream's bytes are EXACTLY what
+        the dead replica would have written, letting the router splice by
+        byte offset alone."""
         st = self.state
         tok = st.tokenizer
         cancel = cancel if cancel is not None else CancelToken()
-        self._send_sse_headers()
+        self._send_sse_headers(extra=extra_headers)
 
         client_gone = False
+        #: client-visible SSE bytes written so far — checkpoint control
+        #: frames excluded, so the count matches what the ROUTER forwards
+        #: and the resume splice is pure byte arithmetic
+        bytes_emitted = 0
 
-        def emit_chunk(delta: dict, finish=None) -> None:
-            nonlocal client_gone
+        def emit_frame(frame: bytes, fire: bool = True) -> None:
+            nonlocal client_gone, bytes_emitted
             if client_gone:
                 return
             try:
-                faults.fire("stream")
-                chunk = dict(base, object="chat.completion.chunk",
-                             choices=[{"index": 0, "delta": delta,
-                                       "finish_reason": finish}])
-                self.wfile.write(b"data: " + json.dumps(chunk).encode()
-                                 + b"\n\n")
+                if fire:
+                    faults.fire("stream")
+                self.wfile.write(frame)
                 self.wfile.flush()
+                if fire:  # ckpt control frames are stripped by the
+                    #       router, so they never count toward the
+                    #       client-visible splice offset
+                    bytes_emitted += len(frame)
             except (BrokenPipeError, ConnectionResetError,
                     faults.FaultInjected):
                 st._m_sse_disconnect.inc()
                 client_gone = True
                 cancel.cancel("client disconnected mid-stream")
 
-        emit_chunk({"role": "assistant"})
+        def emit_chunk(delta: dict, finish=None) -> None:
+            chunk = dict(base, object="chat.completion.chunk",
+                         choices=[{"index": 0, "delta": delta,
+                                   "finish_reason": finish}])
+            emit_frame(b"data: " + json.dumps(chunk).encode() + b"\n\n")
+
         utf8 = codecs.getincrementaldecoder("utf-8")("replace")
+        if resume_state is not None:
+            # continue the dead replica's stream mid-sentence: same byte
+            # position, same half-decoded UTF-8 tail, same pending token —
+            # and NO role preamble (the client got it long ago)
+            bytes_emitted = int(resume_state["bytes"])
+            utf8.setstate((bytes.fromhex(resume_state["utf8"][0]),
+                           int(resume_state["utf8"][1])))
+            prev = int(resume_state["prev"])
+            n_generated = int(resume_state["n_generated"])
+        else:
+            emit_chunk({"role": "assistant"})
+            prev = prompt_tokens[-1]
+            n_generated = 0
         stop_ids = st.stop_token_ids()
-        prev = prompt_tokens[-1]
         finish_reason = "length"
-        n_generated = 0
+
+        def emit_ckpt(snap: dict) -> None:
+            # at a chunk boundary the writer is exactly between SSE
+            # events, so bytes_emitted IS the splice point. A failed
+            # serialize is a skipped checkpoint, never a stream error.
+            try:
+                ustate = utf8.getstate()
+                payload = kv_transfer.encode_snapshot(
+                    snap, prompt_tokens, mode=ckpt_wire,
+                    extra={"resume": {
+                        "base": base, "bytes": bytes_emitted,
+                        "utf8": [ustate[0].hex(), int(ustate[1])],
+                        "prev": prev, "n_generated": n_generated,
+                        "request_id": self._rid}},
+                    stop_state=(detector.state() if detector is not None
+                                else None))
+            except Exception:  # noqa: BLE001
+                st._m_ckpt_writes.inc(outcome="error")
+                return
+            emit_frame(b"event: dllama-ckpt\ndata: "
+                       + str(bytes_emitted).encode() + b" "
+                       + base64.b64encode(payload) + b"\n\n", fire=False)
+
         try:
             bursts = (source(cancel) if source is not None
                       else st.batcher.submit_stream(
                           prompt_tokens, max_tokens, sampler,
-                          deadline=deadline, cancel=cancel, trace=trace))
+                          deadline=deadline, cancel=cancel, trace=trace,
+                          ckpt_every=ckpt_every))
             if carried:
                 bursts = itertools.chain([list(carried)], bursts)
             for burst in bursts:
+                if isinstance(burst, tuple) and burst[0] == "ckpt":
+                    emit_ckpt(burst[1])
+                    continue
                 parts = []
                 stopped = False
                 for t in burst:
@@ -1597,13 +1759,26 @@ class OpenAIHandler(BaseHTTPRequestHandler):
                     if t in stop_ids:
                         stopped = True
                         break
-                    parts.append(utf8.decode(tok.decode_piece(prev, t)))
+                    piece = utf8.decode(tok.decode_piece(prev, t))
                     prev = t
+                    if detector is not None:
+                        out, hit = detector.feed(piece)
+                        if out:
+                            parts.append(out)
+                        if hit:
+                            stopped = True
+                            break
+                    else:
+                        parts.append(piece)
                 text = "".join(parts)
                 if text:
                     emit_chunk({"content": text})
                 if stopped:
                     finish_reason = "stop"
+                    # a stop-STRING trip leaves the pool row live: cancel
+                    # so the scheduler frees its slot at the next chunk
+                    # boundary instead of decoding to budget
+                    cancel.cancel("stop string hit mid-stream")
                     break
                 if client_gone:
                     break  # cancel is set; the scheduler reaps the row at
@@ -1620,6 +1795,8 @@ class OpenAIHandler(BaseHTTPRequestHandler):
         except RuntimeError as e:
             emit_chunk({"content": f"\n[error: {e}]"})
         tail = utf8.decode(b"", True)
+        if detector is not None and not detector.stopped:
+            tail = detector.flush() + tail
         if tail:
             emit_chunk({"content": tail})
         emit_chunk({}, finish=finish_reason)
@@ -1751,14 +1928,29 @@ class OpenAIHandler(BaseHTTPRequestHandler):
             }))
             return
 
-        if (st.batcher is not None and not stops
+        # mid-stream failover: the router opts a stream into periodic
+        # checkpointing with X-Dllama-Ckpt. Only a streaming request in
+        # the PAGED batcher pool can checkpoint (export_row needs pages);
+        # anything else ignores the header and degrades to the router's
+        # no-checkpoint fallback (clean SSE error on death).
+        ckpt_every, ckpt_wire = self._ckpt_request()
+        if not (stream and st.batcher is not None
+                and st.batcher.kv_pages > 0):
+            ckpt_every = 0
+
+        if (st.batcher is not None and (not stops or ckpt_every > 0)
                 and not st.has_prefix_session(prompt_tokens)):
             # stop STRINGS stay on the solo path: its host loop aborts at
             # the string, while a batch would decode the row's whole budget
-            # on device before the host truncates. So does a prompt that
-            # EXTENDS a cached conversation: the batch path skips the
-            # prefix cache, and re-prefilling a growing history every turn
-            # would regress multi-turn latency with zero concurrency.
+            # on device before the host truncates. EXCEPT when the router
+            # asked for checkpoints — resumability needs the paged pool,
+            # so a checkpointing stop-string stream runs batched with an
+            # in-handler StopDetector (its scanback state rides every
+            # checkpoint), trading the early-abort for failover coverage.
+            # A prompt that EXTENDS a cached conversation also stays solo:
+            # the batch path skips the prefix cache, and re-prefilling a
+            # growing history every turn would regress multi-turn latency
+            # with zero concurrency.
             # Everything else — greedy or sampled, streaming or not —
             # merges into one batched decode; every row runs its own
             # sampler chain, so tokens are bit-identical to the solo path
@@ -1768,7 +1960,11 @@ class OpenAIHandler(BaseHTTPRequestHandler):
             # singletons speculate on the solo path either way.
             if stream:
                 self._stream_batched(base, sampler, prompt_tokens, max_tokens,
-                                     deadline=deadline, trace=trace)
+                                     deadline=deadline, trace=trace,
+                                     detector=(StopDetector(stops)
+                                               if stops else None),
+                                     ckpt_every=ckpt_every,
+                                     ckpt_wire=ckpt_wire)
             else:
                 try:
                     row = st.batcher.submit(prompt_tokens, max_tokens, sampler,
@@ -1921,7 +2117,8 @@ class OpenAIHandler(BaseHTTPRequestHandler):
 
     # -- disaggregated serving (role-aware fleet) -------------------------
     def _finished_row_response(self, base: dict, prompt_tokens: list,
-                               row: list, stream: bool, trace) -> None:
+                               row: list, stream: bool, trace,
+                               stops: list = None) -> None:
         """Answer a COMPLETE token row in the client's requested shape —
         the prefill hop uses this when the row finished inside its first
         chunk (nothing migrated), and the import hop for its final
@@ -1929,7 +2126,8 @@ class OpenAIHandler(BaseHTTPRequestHandler):
         not a live stream; the router relays the bytes verbatim."""
         st = self.state
         text, finish, n_gen = decode_token_row(
-            st.tokenizer, prompt_tokens[-1], row, st.stop_token_ids(), [])
+            st.tokenizer, prompt_tokens[-1], row, st.stop_token_ids(),
+            stops or [])
         trace.tokens_out = n_gen
         trace.finish_reason = finish
         if not stream:
@@ -2000,11 +2198,12 @@ class OpenAIHandler(BaseHTTPRequestHandler):
         except (TypeError, ValueError) as e:
             self._error(400, f"bad request parameter: {e}")
             return
-        if req.get("stop"):
-            # stop STRINGS need the solo path's host-side detector; the
-            # router never migrates such requests (fallback matrix)
-            self._error(400, "stop strings cannot be served "
-                             "disaggregated; route this request normally")
+        stops = req.get("stop") or []
+        if isinstance(stops, str):
+            stops = [stops]
+        if not (isinstance(stops, list)
+                and all(isinstance(s, str) for s in stops)):
+            self._error(400, "stop must be a string or list of strings")
             return
         if int(req.get("n", 1) or 1) != 1:
             self._error(400, "n > 1 cannot be served disaggregated")
@@ -2039,13 +2238,19 @@ class OpenAIHandler(BaseHTTPRequestHandler):
         if snap is None:
             # finished inside the first chunk: answer the client directly
             self._finished_row_response(base, prompt_tokens, emitted,
-                                        stream, trace)
+                                        stream, trace, stops=stops)
             return
+        # stop STRINGS migrate with the row: the exporter decoded only
+        # token ids (never text), so a FRESH detector state travels in
+        # the v2 header and the importer scans carried + fresh text
+        # through it — the same scanback the solo path would have run
         payload = kv_transfer.encode_snapshot(
             snap, prompt_tokens, mode=wire,
             extra={"stream": stream,
                    "emitted_tokens": [int(t) for t in emitted],
-                   "request_id": self._rid})
+                   "request_id": self._rid},
+            stop_state=({"stops": stops, "hold": "", "stopped": False}
+                        if stops else None))
         st._m_kv_bytes.inc(len(payload), direction="out")
         st._m_kv_pages.inc(float(snap["n_blocks"]), direction="out")
         trace.tokens_out = len(emitted)
@@ -2083,18 +2288,28 @@ class OpenAIHandler(BaseHTTPRequestHandler):
         carried = [int(t) for t in extra.get("emitted_tokens") or []]
         prompt_tokens = list(snap["prompt"])
         trace.tokens_in = len(prompt_tokens)
+        # a v2 stream migrates its stop-string scanback; the carried
+        # tokens' text runs through the same detector before any fresh
+        # decode, so the stop fires exactly where the solo path's would
+        stop_state = snap.get("stop_state")
+        detector = (StopDetector.from_state(stop_state)
+                    if stop_state else None)
         deadline = Deadline.start(st.request_timeout)
         base = {"id": _completion_id(), "object": "chat.completion",
                 "created": int(time.time()), "model": st.model_name}
         if stream:
             sampler = SamplerConfig(temperature=float(snap["temp"]),
                                     topp=float(snap["topp"]), seed=0)
+            # a migrated stream can opt into checkpointing too — a decode
+            # replica death after a migration is just another failover
+            ckpt_every, ckpt_wire = self._ckpt_request()
             # pre-pull the FIRST burst before any SSE byte leaves: a row
             # the pool can't admit must answer 5xx (the router's fallback
             # cue), not a 200 stream that dies mid-flight
             cancel = CancelToken()
             gen = st.batcher.submit_import_stream(
-                snap, deadline=deadline, cancel=cancel, trace=trace)
+                snap, deadline=deadline, cancel=cancel, trace=trace,
+                ckpt_every=ckpt_every)
             try:
                 first = next(gen, None)
             except LifecycleError:
@@ -2108,7 +2323,8 @@ class OpenAIHandler(BaseHTTPRequestHandler):
                 deadline=deadline, trace=trace, carried=carried,
                 source=lambda _c: (itertools.chain([first], gen)
                                    if first is not None else gen),
-                cancel=cancel)
+                cancel=cancel, detector=detector,
+                ckpt_every=ckpt_every, ckpt_wire=ckpt_wire)
             return
         try:
             fresh = st.batcher.submit_import(snap, deadline=deadline,
@@ -2119,8 +2335,82 @@ class OpenAIHandler(BaseHTTPRequestHandler):
             # includes "no free KV pages": the router's cue to fall back
             self._error(503, f"KV import failed: {e}")
             return
-        self._finished_row_response(base, prompt_tokens, carried + fresh,
-                                    stream, trace)
+        self._finished_row_response(
+            base, prompt_tokens, carried + fresh, stream, trace,
+            stops=(list(stop_state["stops"]) if stop_state else None))
+
+    def _handle_kv_resume(self, body: bytes, trace: RequestTrace) -> None:
+        """POST /v1/kv/resume — mid-stream failover: decode a dead
+        sibling's checkpoint FULLY, admit the row warm, rehydrate the
+        dead writer's rendering state (byte offset, half-decoded UTF-8
+        tail, pending token, stop-string scanback) and continue the SSE
+        stream from the NEXT token. The continued bytes are EXACTLY what
+        the dead replica would have written, so the router splices by
+        discarding the prefix the client already holds — echoed in the
+        X-Dllama-Resume-Offset header before any SSE byte leaves. A row
+        this pool can't admit answers 5xx (the router tries the next
+        sibling, then degrades to the clean SSE error termination)."""
+        st = self.state
+        if st.batcher is None or st.batcher.kv_pages <= 0:
+            self._error(400, "KV resume needs --batch-window > 0 and "
+                             "--kv-pages (paged KV pool)")
+            return
+        try:
+            snap = kv_transfer.decode_snapshot(body)
+        except kv_transfer.TransferError as e:
+            st._m_kv_imports.inc(outcome="rejected")
+            self._error(422, f"rejected KV stream: {e}")
+            return
+        resume = (snap.get("extra") or {}).get("resume")
+        try:
+            base = dict(resume["base"])
+            bytes.fromhex(str(resume["utf8"][0]))  # validated BEFORE the
+            # SSE headers go out — a torn hex tail must 422, not crash a
+            # 200 stream
+            resume_state = {"bytes": int(resume["bytes"]),
+                            "utf8": [str(resume["utf8"][0]),
+                                     int(resume["utf8"][1])],
+                            "prev": int(resume["prev"]),
+                            "n_generated": int(resume["n_generated"])}
+        except (KeyError, IndexError, TypeError, ValueError) as e:
+            st._m_kv_imports.inc(outcome="rejected")
+            self._error(422, f"not a resumable checkpoint: {e}")
+            return
+        st._m_kv_bytes.inc(len(body), direction="in")
+        st._m_kv_pages.inc(float(snap["n_blocks"]), direction="in")
+        prompt_tokens = list(snap["prompt"])
+        trace.tokens_in = len(prompt_tokens)
+        detector = (StopDetector.from_state(snap["stop_state"])
+                    if snap.get("stop_state") else None)
+        # the resumed stream keeps checkpointing at the router's cadence:
+        # a SECOND death mid-resume is just another resume
+        ckpt_every, ckpt_wire = self._ckpt_request()
+        deadline = Deadline.start(st.request_timeout)
+        sampler = SamplerConfig(temperature=float(snap["temp"]),
+                                topp=float(snap["topp"]), seed=0)
+        cancel = CancelToken()
+        gen = st.batcher.submit_import_stream(
+            snap, deadline=deadline, cancel=cancel, trace=trace,
+            ckpt_every=ckpt_every)
+        try:
+            first = next(gen, None)
+        except LifecycleError:
+            raise
+        except RuntimeError as e:
+            # includes "no free KV pages" and "row already finished"
+            self._error(503, f"KV resume failed: {e}")
+            return
+        self._stream_batched(
+            base, sampler, prompt_tokens,
+            int(snap["budget"]) - int(snap["emitted"]),
+            deadline=deadline, trace=trace,
+            source=lambda _c: (itertools.chain([first], gen)
+                               if first is not None else gen),
+            cancel=cancel, detector=detector,
+            ckpt_every=ckpt_every, ckpt_wire=ckpt_wire,
+            resume_state=resume_state,
+            extra_headers={"X-Dllama-Resume-Offset":
+                           str(resume_state["bytes"])})
 
 
 def create_server(state: ServerState, host: str = "0.0.0.0", port: int = 9990):
@@ -2180,6 +2470,7 @@ def serve(args) -> None:
         log_json=getattr(args, "log_json", False),
         log_prompts=getattr(args, "log_prompts", False),
         role=getattr(args, "role", "both") or "both",
+        ckpt_interval=getattr(args, "ckpt_interval", 32),
     )
     srv = create_server(state, host=args.host, port=args.port)
     # label this pid's track group in a merged fleet trace (no-op when

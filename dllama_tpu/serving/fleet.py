@@ -257,6 +257,14 @@ def run_fleet(args) -> None:
     replica_args = []
     for extra in getattr(args, "replica_arg", None) or []:
         replica_args.extend(extra.split())
+    # the router's --ckpt-interval rides every replica's argv as the
+    # serve-side default cadence, so fleet-wide checkpointing is one flag;
+    # an explicit --replica-arg '--ckpt-interval ...' later in the argv
+    # wins (argparse keeps the last occurrence)
+    if "--ckpt-interval" not in replica_args:
+        replica_args = (["--ckpt-interval",
+                         str(getattr(args, "ckpt_interval", 32))]
+                        + replica_args)
     # --prefill N --decode M carve the first N+M replicas into dedicated
     # disaggregation roles (the rest stay "both"); the router migrates
     # only when it can see at least one routable replica of EACH

@@ -13,7 +13,7 @@ an opaque ``extra`` dict for HTTP-level fields); the frames carry each
 page's VALID token prefix only — the last, partially-filled page ships
 short, and the decoder zero-fills the never-attended tail.
 
-Two wire modes:
+Three wire modes:
 
 * ``f32`` — bit-exact: pages travel as raw float32 (a superset of the
   bf16/f32 arena dtypes), so a migrated row's stream is token-for-token
@@ -24,6 +24,21 @@ Two wire modes:
   bytes. Lossy but error-bounded: :func:`q80_error_bound` derives the
   per-element bound from the same quant model, and the tolerance test
   gates the codec against it.
+* ``q80+f32`` — hybrid: FULL pages ship q80, the partially-filled tail
+  page ships bit-exact f32. The tail page is the only KV the very next
+  decode steps attend to with fresh queries, so shipping it exact keeps
+  greedy continuation bit-identical in practice at near-q80 wire cost
+  (the full-page error bound still applies to the q80 frames). The
+  frame split is derived from the header geometry on both sides —
+  ``ntok == page_tokens`` means q80 — so no per-frame mode byte rides
+  the wire.
+
+Header versions: ``v=1`` is the original header; ``v=2`` adds the
+optional ``stop_state`` field carrying a ``StopDetector``'s scanback
+state (``{"stops": [...], "hold": "...", "stopped": false}``) so
+stop-string sessions can migrate/resume. Decoders accept both; a v1
+stream simply has ``stop_state=None``, and anything newer than v2 is
+rejected with a reason (``TransferError``) rather than half-admitted.
 
 Every length is read exactly and every frame CRC-checked; a short read or
 checksum mismatch raises :class:`TransferError` — a torn stream can never
@@ -42,7 +57,7 @@ import numpy as np
 from ..quants.blocks import QK, dequantize_q80, quantize_q80
 
 MAGIC = b"DKV1"
-WIRE_MODES = ("f32", "q80")
+WIRE_MODES = ("f32", "q80", "q80+f32")
 #: HTTP content type of a framed page stream (the prefill endpoint answers
 #: with this when the row migrates, plain JSON when it finished in place)
 CONTENT_TYPE = "application/x-dllama-kv"
@@ -64,7 +79,11 @@ def q80_error_bound(x: np.ndarray) -> float:
     blocks with ``delta = f16(absmax/127)``, round-half-even — so the
     reconstruction error is at most ``delta/2`` per block plus the f16
     rounding of delta (relative ``2**-11``) scaled by the +-127 quant
-    range. Tests assert the actual round-trip error under this bound."""
+    range. Tests assert the actual round-trip error under this bound.
+
+    Under the hybrid ``q80+f32`` wire this bound applies only to FULL
+    pages — the partial tail page travels f32 and round-trips exactly
+    (error 0), which is what keeps greedy continuation bit-identical."""
     flat = np.asarray(x, np.float32).reshape(-1)
     if flat.size == 0:
         return 0.0
@@ -93,10 +112,20 @@ def _q80_decode(payload: bytes, n: int) -> np.ndarray:
     return dequantize_q80(raw, padded)[:n]
 
 
+def _frame_is_f32(mode: str, ntok: int, page: int) -> bool:
+    """Per-frame wire choice, derived identically on both sides: hybrid
+    ships full pages q80 and the partial tail page bit-exact f32."""
+    return mode == "f32" or (mode == "q80+f32" and ntok < page)
+
+
 def encode_snapshot(snap: dict, prompt_tokens, mode: str = "f32",
-                    extra: Optional[dict] = None) -> bytes:
+                    extra: Optional[dict] = None,
+                    stop_state: Optional[dict] = None) -> bytes:
     """Frame an ``export_row`` snapshot (plus the row's prompt and an
-    opaque ``extra`` dict for the serving layer) into one byte stream."""
+    opaque ``extra`` dict for the serving layer) into one byte stream.
+    ``stop_state`` (a StopDetector's ``{"stops", "hold", "stopped"}``
+    scanback state) bumps the header to v=2 so pre-v2 importers reject
+    the stream with a reason instead of silently dropping the stops."""
     if mode not in WIRE_MODES:
         raise ValueError(f"unknown wire mode {mode!r} (know {WIRE_MODES})")
     leaves = snap["leaves"]
@@ -105,7 +134,8 @@ def encode_snapshot(snap: dict, prompt_tokens, mode: str = "f32",
     # positions [0, pos) are written KV; the rest of the last page is
     # garbage the decode overwrites before attending — don't ship it
     tokens = max(0, min(int(snap["pos"]), nblk * page))
-    header = {"v": 1, "mode": mode, "tokens": tokens,
+    header = {"v": 2 if stop_state is not None else 1,
+              "mode": mode, "tokens": tokens,
               "prompt": [int(t) for t in prompt_tokens],
               "keys": [int(k) for k in snap["keys"]],
               "temp": float(snap["temp"]), "topp": float(snap["topp"]),
@@ -116,6 +146,11 @@ def encode_snapshot(snap: dict, prompt_tokens, mode: str = "f32",
               "leaf_shapes": [[int(lf.shape[0])] + list(lf.shape[2:])
                               for lf in leaves],
               "extra": extra or {}}
+    if stop_state is not None:
+        header["stop_state"] = {
+            "stops": [str(s) for s in stop_state.get("stops", [])],
+            "hold": str(stop_state.get("hold", "")),
+            "stopped": bool(stop_state.get("stopped", False))}
     for k in _SCALARS:
         header[k] = int(snap[k])
     hdr = json.dumps(header, separators=(",", ":")).encode()
@@ -130,7 +165,7 @@ def encode_snapshot(snap: dict, prompt_tokens, mode: str = "f32",
             ntok = max(0, min(tokens - b * page, page))
             x = np.ascontiguousarray(lf[:, b, :ntok])
             flat = x.reshape(-1)
-            payload = (flat.tobytes() if mode == "f32"
+            payload = (flat.tobytes() if _frame_is_f32(mode, ntok, page)
                        else _q80_encode(flat))
             out.write(len(payload).to_bytes(4, "big"))
             out.write(payload)
@@ -168,9 +203,17 @@ def decode_snapshot(data) -> dict:
     except (UnicodeDecodeError, json.JSONDecodeError) as e:
         raise TransferError(f"unparseable header: {e}") from None
     mode = header.get("mode")
-    if header.get("v") != 1 or mode not in WIRE_MODES:
+    if header.get("v") not in (1, 2) or mode not in WIRE_MODES:
         raise TransferError(
             f"unsupported stream (v={header.get('v')!r}, mode={mode!r})")
+    stop_state = header.get("stop_state")
+    if stop_state is not None:
+        if (not isinstance(stop_state, dict)
+                or not isinstance(stop_state.get("stops"), list)):
+            raise TransferError("malformed stop_state in v2 header")
+        stop_state = {"stops": [str(s) for s in stop_state["stops"]],
+                      "hold": str(stop_state.get("hold", "")),
+                      "stopped": bool(stop_state.get("stopped", False))}
     try:
         page = int(header["page_tokens"])
         nblk = int(header["n_blocks"])
@@ -200,7 +243,7 @@ def decode_snapshot(data) -> dict:
             fcrc = int.from_bytes(_read_exact(rd, 4, "frame crc"), "big")
             if zlib.crc32(payload) != fcrc:
                 raise TransferError(f"frame crc mismatch at block {b}")
-            if mode == "f32":
+            if _frame_is_f32(mode, ntok, page):
                 if payload_len != 4 * n:
                     raise TransferError(
                         f"f32 frame size {payload_len} != {4 * n}")
@@ -219,4 +262,5 @@ def decode_snapshot(data) -> dict:
     snap["prompt"] = prompt
     snap["mode"] = mode
     snap["extra"] = header.get("extra") or {}
+    snap["stop_state"] = stop_state  # None for v1 streams
     return snap

@@ -77,10 +77,28 @@ streams the rest. The ``migrate`` fault seam sits at the decision point,
 and EVERY failure along the two hops falls back to normal routing (a full
 re-prefill on whatever replica pick() chooses) — a torn transfer is a
 performance event, never a client-visible error.
+
+Mid-stream failover: with ``--ckpt-interval`` > 0 every proxied stream
+asks its replica (``X-Dllama-Ckpt``) to interleave in-band
+``event: dllama-ckpt`` control frames — the row's KV pages + sampler
+chain (:mod:`kv_transfer`) plus the SSE writer's exact rendering state,
+prefixed with the client-visible byte offset the snapshot describes. The
+relay strips those frames into a bounded per-request
+:class:`CheckpointStore` (clients never see them) and, when the upstream
+dies mid-SSE without ``[DONE]``, picks a sibling, POSTs the checkpoint to
+``/v1/kv/resume`` and splices the continued stream into the SAME client
+connection, discarding the byte prefix the client already holds — the
+bytes are what the dead replica would have written, so the client sees no
+repeat and no gap. The ``resume`` fault seam sits at the decision point;
+every outcome (ok or any fallback-matrix row) is counted in
+``dllama_stream_resume_total{outcome}``, flight-recorded, and closed with
+a clean SSE ``error`` event + ``[DONE]`` when resume is exhausted —
+never a silent TCP cut.
 """
 
 from __future__ import annotations
 
+import base64
 import hashlib
 import http.client
 import json
@@ -359,6 +377,53 @@ class AffinityIndex:
             return len(self._map)
 
 
+@guarded_by("_lock", "_map")
+class CheckpointStore:
+    """Bounded LRU of the latest mid-stream checkpoint per request id.
+
+    One live stream keeps at most ONE entry (each ``dllama-ckpt`` frame
+    replaces the last — only the newest snapshot can splice without
+    re-generating already-forwarded tokens for nothing), and the relay
+    pops the entry the moment its stream ends, so steady-state occupancy
+    is the number of in-flight checkpointing streams. Capacity eviction
+    drops the least-recently-touched stream, which degrades THAT stream's
+    failover to the fallback matrix's ``no_ckpt`` row — a bounded store
+    costs coverage under pressure, never correctness or memory."""
+
+    def __init__(self, capacity: int = 256):
+        self.capacity = max(1, int(capacity))
+        self._lock = threading.Lock()
+        self._map: OrderedDict = OrderedDict()
+
+    def put(self, rid: str, payload: bytes, offset: int,
+            replica: str) -> None:
+        """Store/replace ``rid``'s checkpoint: the kv_transfer payload and
+        the client-visible byte offset its rendering state describes."""
+        with self._lock:
+            self._map[rid] = {"payload": payload, "offset": int(offset),
+                              "replica": replica,
+                              "stored_at": time.monotonic()}
+            self._map.move_to_end(rid)
+            while len(self._map) > self.capacity:
+                self._map.popitem(last=False)
+
+    def get(self, rid: str):
+        """The latest entry for ``rid`` (LRU-touched), or None."""
+        with self._lock:
+            e = self._map.get(rid)
+            if e is not None:
+                self._map.move_to_end(rid)
+            return e
+
+    def pop(self, rid: str) -> None:
+        with self._lock:
+            self._map.pop(rid, None)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._map)
+
+
 def merge_expositions(parts: list) -> str:
     """Merge per-replica Prometheus text expositions into one fleet view.
 
@@ -424,6 +489,8 @@ class RouterState:
                  affinity_block: int = 256,
                  affinity_capacity: int = 4096,
                  kv_wire: str = "f32",
+                 ckpt_interval: int = 32,
+                 ckpt_capacity: int = 256,
                  metrics=None, enable_flight: bool = True):
         self.replicas = tuple(replicas)
         self.retry_budget = retry_budget
@@ -435,8 +502,14 @@ class RouterState:
             raise ValueError(f"unknown --kv-wire {kv_wire!r} "
                              f"(know {kv_transfer.WIRE_MODES})")
         # wire mode the prefill replica is asked to encode migrating rows
-        # in: "f32" is bit-exact, "q80" ~3.76x smaller but error-bounded
+        # in: "f32" is bit-exact, "q80" ~3.76x smaller but error-bounded,
+        # "q80+f32" q80 for full pages with a bit-exact f32 tail page
         self.kv_wire = kv_wire
+        # mid-stream failover: ask every streamed request's replica for a
+        # checkpoint each ckpt_interval emitted tokens (0 disables both
+        # the checkpoint frames and the resume orchestration)
+        self.ckpt_interval = max(0, int(ckpt_interval))
+        self.ckpt_store = CheckpointStore(ckpt_capacity)
         self.affinity = AffinityIndex(affinity_capacity)
         self.started_at = time.time()
         # a fresh registry per router (not the process default): in-process
@@ -496,6 +569,20 @@ class RouterState:
             "migrated; every *_fallback/injected/no_* outcome degraded to "
             "normal routing, i.e. a full re-prefill, never a client error)",
             ("outcome",))
+        self._m_resumes = reg.counter(
+            "dllama_stream_resume_total",
+            "Mid-stream failover resume attempts after an upstream died "
+            "mid-SSE, by outcome (ok = the stream continued bit-identically "
+            "on a sibling replica; every other outcome — no_ckpt, "
+            "stale_ckpt, admit_failed, no_replica, injected, exhausted — "
+            "ended the stream with a clean SSE error event + [DONE], never "
+            "a silent TCP cut)",
+            ("outcome",))
+        self._m_ckpt_entries = reg.gauge(
+            "dllama_router_ckpt_entries",
+            "Live checkpoints in the router's bounded resume store (one "
+            "per in-flight checkpointing stream; popped at stream end)")
+        self._m_ckpt_entries.set_function(self.ckpt_store.__len__)
         self._m_probe_age = reg.gauge(
             "dllama_router_probe_age_seconds",
             "Seconds since each replica's last completed /ready probe "
@@ -922,9 +1009,10 @@ class RouterHandler(BaseHTTPRequestHandler):
         st = self.state
         if not st.disagg_ready():
             return False
-        if req.get("stop") or int(req.get("n") or 1) != 1:
-            # the prefill endpoint rejects these (stop strings need the
-            # decoded text on one replica, n>1 fans out) — route normally
+        if int(req.get("n") or 1) != 1:
+            # the prefill endpoint rejects n>1 (it fans out) — route
+            # normally. Stop strings migrate fine since the detector's
+            # scanback travels in the v2 transfer header.
             return False
         outcome = "prefill_fallback"
         detail: dict = {}
@@ -1074,6 +1162,13 @@ class RouterHandler(BaseHTTPRequestHandler):
              "Content-Type": self.headers.get("Content-Type",
                                               "application/json"),
              "Accept": self.headers.get("Accept", "*/*")}
+        st = self.state
+        if st.ckpt_interval > 0:
+            # opt every upstream stream into mid-stream checkpointing (the
+            # replica ignores this for anything that can't checkpoint);
+            # the checkpoint rides the same wire mode as migrations
+            h["X-Dllama-Ckpt"] = str(st.ckpt_interval)
+            h["X-Dllama-Ckpt-Wire"] = st.kv_wire
         return h
 
     def _proxy(self, method: str, body: bytes, affinity_hashes: list) -> None:
@@ -1301,7 +1396,13 @@ class RouterHandler(BaseHTTPRequestHandler):
         mid-stream, close the UPSTREAM connection immediately — the
         replica's cancel-on-disconnect frees the decode slot within one
         chunk. Closing at generator/handler GC instead would keep the
-        dead stream decoding for its full completion."""
+        dead stream decoding for its full completion.
+
+        With ``--ckpt-interval`` > 0 the relay is RESUMABLE instead:
+        event-aligned forwarding that strips ``dllama-ckpt`` control
+        frames into the checkpoint store and, on upstream death without
+        ``[DONE]``, splices a sibling's /v1/kv/resume stream into this
+        same client connection (:meth:`_relay_sse_resumable`)."""
         self.send_response(200)
         self.send_header("Content-Type",
                          resp.getheader("Content-Type", "text/event-stream"))
@@ -1314,6 +1415,9 @@ class RouterHandler(BaseHTTPRequestHandler):
         self.send_header("Server-Timing", self._server_timing())
         self.end_headers()
         self._count(200)
+        if self.state.ckpt_interval > 0:
+            self._relay_sse_resumable(resp, conn, replica)
+            return
         try:
             while True:
                 try:
@@ -1333,6 +1437,228 @@ class RouterHandler(BaseHTTPRequestHandler):
             # the immediacy guarantee: upstream socket down NOW, on every
             # exit path (client gone, upstream EOF, relay error)
             conn.close()
+
+    def _relay_sse_resumable(self, resp, conn, replica) -> None:
+        """The failover relay (client headers already sent): forward the
+        upstream stream EVENT-aligned, stripping ``dllama-ckpt`` control
+        frames into the checkpoint store, and treat an upstream end
+        without ``[DONE]`` as a mid-stream death. One death resumes on a
+        sibling via :meth:`_resume_stream` — the continued stream's first
+        ``forwarded - offset`` bytes are what the client already holds
+        (bit-identical regeneration from the checkpoint), so they are
+        discarded and the splice leaves no repeat and no gap. A SECOND
+        death, or any fallback-matrix row, terminates cleanly: a typed
+        SSE ``error`` event + ``[DONE]`` instead of a bare TCP cut."""
+        st = self.state
+        rid = self._rid
+        forwarded = 0  # client-visible bytes forwarded (event-aligned —
+        #                exactly the replica writer's bytes_emitted count)
+        skip = 0  # resumed-stream prefix the client already holds
+        saw_done = False
+        client_gone = False
+        owned = False  # True once `replica` was begin()-ed by a resume
+        #                (the original caller begin/ends the FIRST hop)
+
+        def to_client(data: bytes) -> None:
+            nonlocal forwarded, client_gone
+            if client_gone or not data:
+                return
+            try:
+                self.wfile.write(data)
+                self.wfile.flush()
+            except OSError:
+                st._m_client_disconnects.inc()
+                client_gone = True
+            else:
+                forwarded += len(data)
+
+        def fail_stream(message: str) -> None:
+            # the torn-stream bugfix: resume exhausted -> the client gets
+            # a typed terminal error event and a [DONE], so "torn" is
+            # distinguishable from "complete" without timeout heuristics
+            to_client(b"data: " + json.dumps(
+                {"error": {"message": message, "type": "upstream_error",
+                           "code": 502}}).encode() + b"\n\n")
+            to_client(b"data: [DONE]\n\n")
+
+        try:
+            while True:
+                scanner = observability.SSEScanner()
+                while True:  # one upstream's lifetime
+                    try:
+                        chunk = resp.read1(65536)
+                    except (OSError, http.client.HTTPException):
+                        chunk = b""  # a torn read is a death, same as EOF
+                    if not chunk:
+                        break
+                    for ev in scanner.feed(chunk):
+                        fields = observability.sse_event_fields(ev)
+                        if fields.get("event") == b"dllama-ckpt":
+                            off, _, b64 = fields.get(
+                                "data", b"").partition(b" ")
+                            try:
+                                st.ckpt_store.put(rid, base64.b64decode(b64),
+                                                  int(off), replica.name)
+                            except ValueError:
+                                pass  # malformed frame: keep the last
+                                #       good checkpoint
+                            continue
+                        if skip:  # resumed prefix the client already holds
+                            if skip >= len(ev):
+                                skip -= len(ev)
+                                continue
+                            ev = ev[skip:]
+                            skip = 0
+                        if fields.get("data", b"").strip() == b"[DONE]":
+                            saw_done = True
+                        to_client(ev)
+                    if client_gone or saw_done:
+                        break
+                if saw_done or client_gone:
+                    return
+                # upstream ended without [DONE]: a mid-stream death
+                replica.mark_conn_failure()
+                st._m_upstream_errors.inc(replica=replica.name)
+                if st.flight is not None:
+                    st.flight.record("upstream_stream_death",
+                                     replica=replica.name, request_id=rid,
+                                     forwarded=forwarded)
+                if owned:
+                    # second death during resume: the fallback matrix says
+                    # terminate cleanly, don't chase replicas forever
+                    self._account_resume(
+                        "exhausted", {"dead": replica.name,
+                                      "forwarded": forwarded},
+                        time.monotonic())
+                    fail_stream("upstream replica died again after a "
+                                "resume; stream incomplete")
+                    return
+                got = self._resume_stream(rid, replica, forwarded)
+                if isinstance(got, str):
+                    fail_stream(got)  # outcome already accounted
+                    return
+                conn.close()  # the dead upstream's socket
+                resp, conn, replica, offset = got
+                skip = forwarded - offset
+                owned = True
+        finally:
+            conn.close()
+            if owned:
+                replica.end()
+            st.ckpt_store.pop(rid)
+
+    def _resume_stream(self, rid: str, dead, forwarded: int):
+        """One resume orchestration after ``dead`` died mid-SSE at byte
+        ``forwarded``. Fires the ``resume`` seam at the decision point.
+
+        Returns ``(resp, conn, replica, offset)`` on success — outcome
+        "ok", the sibling's in-flight count held (begin without end) until
+        the relay finishes — or a client-facing failure message string
+        with the fallback-matrix outcome (no_ckpt / stale_ckpt /
+        no_replica / admit_failed / injected) already accounted."""
+        st = self.state
+        outcome = "no_ckpt"
+        detail: dict = {"dead": dead.name, "forwarded": forwarded}
+        t0 = time.monotonic()
+        try:
+            try:
+                faults.fire("resume")
+            except faults.FaultInjected:
+                outcome = "injected"
+                return "resume fault injected; stream incomplete"
+            entry = st.ckpt_store.get(rid)
+            if entry is None:
+                outcome = "no_ckpt"
+                return ("upstream replica died mid-stream and no "
+                        "checkpoint exists; stream incomplete")
+            offset = int(entry["offset"])
+            detail["offset"] = offset
+            if offset > forwarded:
+                # the snapshot claims MORE bytes than the client holds:
+                # splicing would leave a gap — refuse rather than corrupt
+                outcome = "stale_ckpt"
+                return ("checkpoint is ahead of the forwarded stream; "
+                        "stream incomplete")
+            tried = {dead.name}
+            attempted = 0
+            for _ in range(1 + st.retry_budget):
+                try:
+                    sibling, _ = st.pick([], exclude=tried)
+                except (NoReplicaAvailable, faults.FaultInjected):
+                    break
+                tried.add(sibling.name)
+                attempted += 1
+                detail["sibling"] = sibling.name
+                sibling.begin()
+                ok = False
+                conn = None
+                try:
+                    try:
+                        faults.fire("proxy_upstream")
+                        conn = http.client.HTTPConnection(
+                            sibling.host, sibling.port,
+                            timeout=st.connect_timeout_s)
+                        headers = self._upstream_headers()
+                        headers["Content-Type"] = kv_transfer.CONTENT_TYPE
+                        conn.request("POST", "/v1/kv/resume",
+                                     entry["payload"], headers=headers)
+                        if conn.sock is not None:
+                            conn.sock.settimeout(
+                                st.upstream_timeout_s or None)
+                        resp = conn.getresponse()
+                    except (OSError, http.client.HTTPException,
+                            faults.FaultInjected) as e:
+                        sibling.mark_conn_failure()
+                        st._m_upstream_errors.inc(replica=sibling.name)
+                        detail["error"] = repr(e)[:200]
+                        continue
+                    if (resp.status != 200 or resp.getheader(
+                            "X-Dllama-Resume-Offset") is None):
+                        # 503 = draining/full pool, 422 = the checkpoint
+                        # itself was rejected; either way THIS sibling did
+                        # no decode work — try the next one
+                        if resp.status == 503:
+                            sibling.mark_unready()
+                        st._m_upstream_errors.inc(replica=sibling.name)
+                        detail["status"] = resp.status
+                        continue
+                    sibling.mark_conn_success()
+                    outcome = "ok"
+                    ok = True
+                    return resp, conn, sibling, offset
+                finally:
+                    if not ok:
+                        sibling.end()
+                        if conn is not None:
+                            conn.close()
+            outcome = "admit_failed" if attempted else "no_replica"
+            return ("no sibling replica accepted the checkpoint; "
+                    "stream incomplete" if attempted else
+                    "no sibling replica available for resume; "
+                    "stream incomplete")
+        finally:
+            self._account_resume(outcome, detail, t0)
+
+    def _account_resume(self, outcome: str, detail: dict, t0: float) -> None:
+        """Every resume decision — ok or any fallback-matrix row — lands
+        in the counter, the flight ring, and (when tracing) a
+        ``router_resume`` hop span, mirroring the migrate accounting."""
+        st = self.state
+        st._m_resumes.inc(outcome=outcome)
+        if st.flight is not None:
+            st.flight.record("resume", request_id=self._rid,
+                             outcome=outcome, **detail)
+        if observability.trace_path() is not None:
+            us = observability.mono_to_us
+            observability.emit_trace_events([
+                {"name": "router_resume", "ph": "X",
+                 "pid": os.getpid(), "tid": self._span_id,
+                 "ts": us(t0),
+                 "dur": max(1, us(time.monotonic()) - us(t0)),
+                 "cat": "router",
+                 "args": dict(detail, request_id=self._rid,
+                              outcome=outcome)},
+            ])
 
 
 def create_router_server(state: RouterState, host: str = "0.0.0.0",
@@ -1360,6 +1686,7 @@ def state_from_args(args, replica_addrs: list) -> RouterState:
         upstream_timeout_s=getattr(args, "upstream_timeout", 0.0),
         affinity_block=getattr(args, "affinity_block", 256),
         kv_wire=getattr(args, "kv_wire", "f32") or "f32",
+        ckpt_interval=getattr(args, "ckpt_interval", 32),
     )
 
 

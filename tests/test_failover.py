@@ -1,0 +1,461 @@
+"""Mid-stream decode failover (the PR 12 tentpole).
+
+Four layers under test. (1) The checkpoint frames: a stream that opts
+in via ``X-Dllama-Ckpt`` interleaves ``event: dllama-ckpt`` control
+frames whose payloads decode to resumable snapshots (splice offset,
+UTF-8 decoder state, sampler chain position) without perturbing the
+client-visible bytes. (2) The replica resume endpoint:
+``POST /v1/kv/resume`` continues a checkpointed stream BYTE-identically
+— the raw continuation equals the original stream's visible bytes from
+the splice offset on, for every checkpoint taken, on a cold or a warm
+(same prompt already served) sibling, stop-string sessions included.
+(3) The router orchestration: an upstream death mid-SSE resumes on a
+sibling behind the same client connection (outcome="ok"), and every
+fallback-matrix row — injected / no_ckpt / stale_ckpt / admit_failed /
+exhausted — terminates with a typed SSE error event plus ``[DONE]``,
+never a bare TCP cut, each counted in
+``dllama_stream_resume_total``. (4) The bounded checkpoint store: LRU
+eviction, get-touches, pop-on-completion.
+
+The ``ckpt_write`` and ``resume`` fault seams are exercised by name
+(FAULT-004)."""
+
+import base64
+import codecs
+import http.client
+import json
+import threading
+
+import pytest
+
+from dllama_tpu import faults
+from dllama_tpu.formats.tokenizer_file import TokenizerData
+from dllama_tpu.models import llama
+from dllama_tpu.runtime.generate import Engine
+from dllama_tpu.runtime.sampler import SamplerConfig
+from dllama_tpu.serving import kv_transfer
+from dllama_tpu.serving import router as router_mod
+from dllama_tpu.serving.api_server import ServerState, create_server
+from dllama_tpu.tokenizer.bpe import Tokenizer
+
+from tests.test_llama_forward import tiny_cfg
+
+OUTCOMES = ("ok", "no_ckpt", "stale_ckpt", "admit_failed", "no_replica",
+            "injected", "exhausted")
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+def _make_tokenizer():
+    vocab = [b"<unk>", b"<s>", b"</s>"]
+    vocab += [b"<0x%02X>" % b for b in range(256)]
+    vocab += [b" ", b"e", b"t", b"he", b" the", b"hello", b" world"]
+    scores = [0.0] * 259 + [-1.0, -2.0, -2.0, -1.5, -1.2, -1.1, -1.1]
+    return Tokenizer(TokenizerData(vocab=vocab, scores=scores,
+                                   bos_id=1, eos_id=2))
+
+
+@pytest.fixture(scope="module")
+def pair():
+    """Two in-process replica servers over the SAME tiny weights (so a
+    resumed row regenerates the dead replica's tokens exactly)."""
+    tok = _make_tokenizer()
+    cfg = tiny_cfg(vocab_size=tok.vocab_size, seq_len=512, dim=32,
+                   kv_dim=16, head_size=8, hidden_dim=64)
+    params = llama.random_params(cfg, seed=13)
+    servers = []
+    ports = []
+    for _ in range(2):
+        engine = Engine(cfg, params, SamplerConfig(temperature=0.0, seed=1))
+        state = ServerState(engine, tok, cfg, model_name="tiny-test",
+                            template="llama3", batch_window_ms=5.0,
+                            batch_chunk=2, kv_pages=16, ckpt_interval=2)
+        srv = create_server(state, host="127.0.0.1", port=0)
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        servers.append(srv)
+        ports.append(srv.server_address[1])
+    yield ports
+    for srv in servers:
+        srv.shutdown()
+
+
+def _post(port, path, body, headers=None, timeout=120):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    try:
+        conn.request("POST", path,
+                     body if isinstance(body, bytes)
+                     else json.dumps(body).encode(),
+                     headers={"Content-Type": "application/json",
+                              **(headers or {})})
+        resp = conn.getresponse()
+        return resp.status, dict(resp.getheaders()), resp.read()
+    finally:
+        conn.close()
+
+
+def _chat(max_tokens=12, **kw):
+    body = {"model": "m", "max_tokens": max_tokens, "temperature": 0.0,
+            "stream": True,
+            "messages": [{"role": "user", "content": "hello world"}]}
+    body.update(kw)
+    return body
+
+
+def _split_stream(data: bytes):
+    """-> (visible_bytes, [(offset, payload_bytes), ...]): the client's
+    view with ckpt control frames stripped, plus the decoded frames."""
+    visible, frames = [], []
+    for ev in data.split(b"\n\n"):
+        if not ev:
+            continue
+        if ev.startswith(b"event: dllama-ckpt"):
+            line = next(ln for ln in ev.split(b"\n")
+                        if ln.startswith(b"data: "))
+            off, _, b64 = line[6:].partition(b" ")
+            frames.append((int(off), base64.b64decode(b64)))
+        else:
+            visible.append(ev + b"\n\n")
+    return b"".join(visible), frames
+
+
+def _parts(data: bytes):
+    """-> (content_text, finish_reason, saw_done, error_message)."""
+    text, finish, done, err = [], None, False, None
+    for line in data.split(b"\n"):
+        if not line.startswith(b"data: "):
+            continue
+        if line == b"data: [DONE]":
+            done = True
+            continue
+        try:
+            obj = json.loads(line[6:])
+        except ValueError:
+            continue
+        if "error" in obj:
+            err = obj["error"]
+        for ch in obj.get("choices", []):
+            text.append((ch.get("delta") or {}).get("content") or "")
+            finish = ch.get("finish_reason") or finish
+    return "".join(text), finish, done, err
+
+
+def _mk_router(ports, ckpt_interval=2, **kw):
+    state = router_mod.RouterState(
+        [router_mod.Replica("127.0.0.1", p) for p in ports],
+        probe_interval_s=60.0, ckpt_interval=ckpt_interval, **kw)
+    state.probe_once()
+    srv = router_mod.create_router_server(state, "127.0.0.1", 0)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return state, srv, srv.server_address[1]
+
+
+def _resumes(state):
+    return {o: state._m_resumes.value(outcome=o) for o in OUTCOMES
+            if state._m_resumes.value(outcome=o)}
+
+
+# ---------------------------------------------------------------------------
+# checkpoint frames on the direct replica surface
+# ---------------------------------------------------------------------------
+
+def test_ckpt_frames_opt_in_and_resumable(pair):
+    """No header -> no control frames. With the header, frames arrive at
+    the requested cadence with increasing splice offsets, each decoding
+    to a v-headered snapshot carrying the resume block — and stripping
+    them leaves the visible stream's content untouched."""
+    st, _, data = _post(pair[0], "/v1/chat/completions", _chat())
+    assert st == 200 and b"dllama-ckpt" not in data
+
+    st, _, data = _post(pair[0], "/v1/chat/completions", _chat(),
+                        headers={"X-Dllama-Ckpt": "2"})
+    assert st == 200
+    visible, frames = _split_stream(data)
+    assert len(frames) >= 3
+    assert b"dllama-ckpt" not in visible and visible.endswith(
+        b"data: [DONE]\n\n")
+    offsets = [off for off, _ in frames]
+    assert offsets == sorted(offsets) and offsets[0] > 0
+    for off, payload in frames:
+        snap = kv_transfer.decode_snapshot(payload)
+        resume = snap["extra"]["resume"]
+        assert resume["bytes"] == off
+        for key in ("base", "utf8", "prev", "n_generated", "request_id"):
+            assert key in resume, key
+    # content must match the plain stream's (frame boundaries may differ:
+    # a ckpt'd stream always takes the batched path)
+    plain_text = _parts(_post(pair[0], "/v1/chat/completions",
+                              _chat())[2])[0]
+    assert _parts(data)[0] == plain_text
+
+
+@pytest.mark.parametrize("which", ["first", "middle", "last"])
+def test_direct_resume_splices_byte_identically(pair, which):
+    """THE tentpole invariant, at its sharpest: for a checkpoint taken
+    at splice offset B, POSTing the payload to a sibling's /v1/kv/resume
+    returns raw bytes EQUAL to the original stream's visible bytes from
+    B on — same token bytes, same frame boundaries, same terminal chunk,
+    same [DONE]. Covers death exactly on a checkpoint boundary and (via
+    "last") zero tokens decoded since the checkpoint."""
+    st, _, data = _post(pair[0], "/v1/chat/completions", _chat(),
+                        headers={"X-Dllama-Ckpt": "2"})
+    assert st == 200
+    visible, frames = _split_stream(data)
+    idx = {"first": 0, "middle": len(frames) // 2,
+           "last": len(frames) - 1}[which]
+    off, payload = frames[idx]
+    st, headers, cont = _post(
+        pair[1], "/v1/kv/resume", payload,
+        headers={"Content-Type": kv_transfer.CONTENT_TYPE})
+    assert st == 200, cont
+    assert int(headers.get("X-Dllama-Resume-Offset", -1)) == off
+    assert cont == visible[off:]
+
+
+def test_resume_on_warm_sibling_bit_identical(pair):
+    """Satellite: the sibling already served the SAME prompt (its prefix
+    cache is warm) — admission and continuation must still splice
+    byte-identically, not replay cached frames."""
+    warm = _post(pair[1], "/v1/chat/completions", _chat())
+    assert warm[0] == 200
+    st, _, data = _post(pair[0], "/v1/chat/completions", _chat(),
+                        headers={"X-Dllama-Ckpt": "2"})
+    assert st == 200
+    visible, frames = _split_stream(data)
+    off, payload = frames[len(frames) // 2]
+    st, headers, cont = _post(
+        pair[1], "/v1/kv/resume", payload,
+        headers={"Content-Type": kv_transfer.CONTENT_TYPE})
+    assert st == 200 and cont == visible[off:]
+
+
+def test_stop_string_session_resumes_with_scanback(pair):
+    """Satellite: stop-string sessions checkpoint too (the scanback
+    rides the v2 header) and the spliced continuation still honors the
+    stop — closing the ROADMAP carry that pinned stop sessions to one
+    replica."""
+    plain = _parts(_post(pair[0], "/v1/chat/completions",
+                         _chat(max_tokens=20))[2])[0]
+    assert len(plain) >= 10
+    # a stop the stream WILL emit, whose FIRST occurrence lands late
+    # enough that a checkpoint precedes the stop hit, yet strictly
+    # inside the stream (a stop completing only in the final dangling-
+    # byte UTF-8 flush is a different edge than the one under test)
+    stop = max((plain[i:i + 3] for i in range(len(plain) - 7)),
+               key=lambda s: plain.find(s) if plain.find(s)
+               <= len(plain) - 8 else -1)
+    assert 4 <= plain.find(stop) <= len(plain) - 8, (plain, stop)
+    st, _, data = _post(pair[0], "/v1/chat/completions",
+                        _chat(max_tokens=20, stop=[stop]),
+                        headers={"X-Dllama-Ckpt": "2"})
+    assert st == 200
+    visible, frames = _split_stream(data)
+    text, finish, done, _ = _parts(data)
+    assert finish == "stop" and done
+    assert frames, "stop session produced no checkpoints"
+    snap = kv_transfer.decode_snapshot(frames[0][1])
+    assert snap["stop_state"] is not None
+    assert snap["stop_state"]["stops"] == [stop]
+    off, payload = frames[0]
+    st, _, cont = _post(
+        pair[1], "/v1/kv/resume", payload,
+        headers={"Content-Type": kv_transfer.CONTENT_TYPE})
+    assert st == 200 and cont == visible[off:]
+    assert _parts(cont)[1] == "stop"
+
+
+def test_resume_rejects_non_resumable_payload_with_reason(pair):
+    """A v1 migration payload (no resume block) is a valid KV snapshot
+    but NOT a resumable checkpoint: /v1/kv/resume must 422 with the
+    reason, never guess a splice offset."""
+    st, _, data = _post(pair[0], "/v1/chat/completions", _chat(),
+                        headers={"X-Dllama-Ckpt": "2"})
+    assert st == 200
+    _, frames = _split_stream(data)
+    snap = kv_transfer.decode_snapshot(frames[0][1])
+    bare = kv_transfer.encode_snapshot(snap, snap["prompt"], mode="f32")
+    st, _, body = _post(pair[1], "/v1/kv/resume", bare,
+                        headers={"Content-Type": kv_transfer.CONTENT_TYPE})
+    assert st == 422
+    assert b"resumable" in body
+    st, _, body = _post(pair[1], "/v1/kv/resume", b"garbage",
+                        headers={"Content-Type": kv_transfer.CONTENT_TYPE})
+    assert st == 422
+
+
+# ---------------------------------------------------------------------------
+# router orchestration: the happy path and the fallback matrix
+# ---------------------------------------------------------------------------
+
+def test_router_resume_after_death_content_identical(pair):
+    """A replica death mid-SSE is a non-event: one client connection,
+    the complete stream, outcome="ok" counted, no control-frame leak."""
+    state, srv, port = _mk_router(pair)
+    try:
+        ref = _post(port, "/v1/chat/completions", _chat())
+        assert ref[0] == 200
+        ref_text, ref_finish, ref_done, _ = _parts(ref[2])
+        assert ref_done and ref_text
+        faults.install("stream:raise:after=4,times=1")
+        st, _, data = _post(port, "/v1/chat/completions", _chat())
+        faults.clear()
+        assert st == 200 and b"dllama-ckpt" not in data
+        text, finish, done, err = _parts(data)
+        assert err is None and done
+        assert (text, finish) == (ref_text, ref_finish)
+        assert _resumes(state) == {"ok": 1.0}
+        assert len(state.ckpt_store) == 0  # popped at stream end
+    finally:
+        srv.shutdown()
+
+
+def test_router_death_between_ckpts_discards_regenerated_prefix(pair):
+    """Death BETWEEN checkpoints: the resumed stream regenerates bytes
+    the client already holds; the router must discard exactly that
+    prefix (no duplicate, no gap). Interval 4 with chunk 2 makes every
+    other burst un-checkpointed."""
+    state, srv, port = _mk_router(pair, ckpt_interval=4)
+    try:
+        ref_text = _parts(_post(port, "/v1/chat/completions",
+                                _chat())[2])[0]
+        faults.install("stream:raise:after=4,times=1")
+        st, _, data = _post(port, "/v1/chat/completions", _chat())
+        faults.clear()
+        text, _, done, err = _parts(data)
+        assert st == 200 and done and err is None
+        assert text == ref_text
+        assert _resumes(state) == {"ok": 1.0}
+    finally:
+        srv.shutdown()
+
+
+def test_router_exhausted_emits_typed_error_event(pair):
+    """Satellite bugfix pin: when resume is exhausted (second death),
+    the client gets a typed SSE error event AND a [DONE] — a torn
+    stream is distinguishable from a complete one without timeout
+    heuristics."""
+    state, srv, port = _mk_router(pair)
+    try:
+        faults.install("stream:raise:after=4,times=2")
+        st, _, data = _post(port, "/v1/chat/completions", _chat())
+        faults.clear()
+        assert st == 200
+        _, _, done, err = _parts(data)
+        assert done, "no terminal [DONE] after exhaustion"
+        assert err is not None and err["type"] == "upstream_error"
+        assert "died again" in err["message"]
+        assert data.rstrip().endswith(b"data: [DONE]")
+        got = _resumes(state)
+        assert got.get("ok") == 1.0 and got.get("exhausted") == 1.0
+    finally:
+        srv.shutdown()
+
+
+@pytest.mark.parametrize("name,plan,outcome", [
+    ("injected", "stream:raise:after=4,times=1;resume:raise:times=1",
+     "injected"),
+    ("no_ckpt", "stream:raise:after=4,times=1;ckpt_write:raise",
+     "no_ckpt"),
+    ("admit_failed", "stream:raise:after=4,times=1;kv_import:raise",
+     "admit_failed"),
+])
+def test_router_fallback_matrix_clean_termination(pair, name, plan,
+                                                  outcome):
+    """Every injectable fallback row: HTTP 200, a typed error event, a
+    [DONE], and exactly one increment of the matching outcome."""
+    state, srv, port = _mk_router(pair)
+    try:
+        faults.install(plan)
+        st, _, data = _post(port, "/v1/chat/completions", _chat())
+        faults.clear()
+        assert st == 200, name
+        _, _, done, err = _parts(data)
+        assert done and err is not None, (name, data[-300:])
+        assert _resumes(state) == {outcome: 1.0}
+    finally:
+        srv.shutdown()
+
+
+def test_router_stale_checkpoint_refused(pair):
+    """A checkpoint claiming MORE bytes than the client holds would
+    splice a gap — the router must refuse (stale_ckpt) and terminate
+    cleanly rather than corrupt the stream."""
+    state, srv, port = _mk_router(pair)
+    real_put = state.ckpt_store.put
+    state.ckpt_store.put = (
+        lambda rid, payload, offset, replica:
+        real_put(rid, payload, offset + 10**9, replica))
+    try:
+        faults.install("stream:raise:after=4,times=1")
+        st, _, data = _post(port, "/v1/chat/completions", _chat())
+        faults.clear()
+        _, _, done, err = _parts(data)
+        assert st == 200 and done and err is not None
+        assert _resumes(state) == {"stale_ckpt": 1.0}
+    finally:
+        srv.shutdown()
+
+
+def test_router_no_replica_when_fleet_is_one(pair):
+    state, srv, port = _mk_router(pair[:1])
+    try:
+        faults.install("stream:raise:after=4,times=1")
+        st, _, data = _post(port, "/v1/chat/completions", _chat())
+        faults.clear()
+        _, _, done, err = _parts(data)
+        assert st == 200 and done and err is not None
+        assert _resumes(state) == {"no_replica": 1.0}
+    finally:
+        srv.shutdown()
+
+
+def test_router_ckpt_disabled_passthrough(pair):
+    """--ckpt-interval 0 keeps the old passthrough relay: no header sent
+    upstream, no frames, no resume orchestration."""
+    state, srv, port = _mk_router(pair, ckpt_interval=0)
+    try:
+        st, _, data = _post(port, "/v1/chat/completions", _chat())
+        assert st == 200 and b"dllama-ckpt" not in data
+        assert _parts(data)[2]  # [DONE]
+        assert _resumes(state) == {}
+    finally:
+        srv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# the bounded store and the splice plumbing
+# ---------------------------------------------------------------------------
+
+def test_checkpoint_store_lru_bounds():
+    store = router_mod.CheckpointStore(capacity=3)
+    for i in range(4):
+        store.put(f"r{i}", b"p%d" % i, i * 10, "rep")
+    assert len(store) == 3 and store.get("r0") is None
+    entry = store.get("r1")  # touch: r1 becomes most-recent
+    assert entry["payload"] == b"p1" and entry["offset"] == 10
+    store.put("r4", b"p4", 40, "rep")
+    assert store.get("r2") is None and store.get("r1") is not None
+    store.put("r1", b"p1b", 99, "rep")  # same rid overwrites, no growth
+    assert len(store) == 3 and store.get("r1")["offset"] == 99
+    store.pop("r1")
+    assert store.get("r1") is None and len(store) == 2
+    store.pop("missing")  # pop is idempotent
+
+
+def test_utf8_decoder_state_survives_hex_round_trip():
+    """The checkpoint carries the incremental UTF-8 decoder state as
+    (hex, flag) — restoring it mid-multi-byte-character must continue
+    the character, not emit a replacement char (the splice-through-a-
+    UTF-8-token edge)."""
+    one = codecs.getincrementaldecoder("utf-8")("replace")
+    whole = one.decode("héllo".encode("utf-8"))
+    src = codecs.getincrementaldecoder("utf-8")("replace")
+    first = src.decode("héllo".encode("utf-8")[:2])  # cut mid é
+    buf, flag = src.getstate()
+    dst = codecs.getincrementaldecoder("utf-8")("replace")
+    dst.setstate((bytes.fromhex(buf.hex()), int(flag)))
+    assert first + dst.decode("héllo".encode("utf-8")[2:]) == whole

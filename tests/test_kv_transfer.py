@@ -163,13 +163,72 @@ def test_malformed_headers_rejected():
     wire = kv_transfer.encode_snapshot(snap, list(range(5)), mode="f32")
     with pytest.raises(ValueError):
         kv_transfer.encode_snapshot(snap, [], mode="zstd")
-    for bad in (dict(mode="zstd"), dict(v=2), dict(plen=99),
+    for bad in (dict(mode="zstd"), dict(v=3), dict(plen=99),
                 dict(page_tokens=0), dict(leaf_shapes=[[2, 9, 4, 8]] * 2)):
         with pytest.raises(kv_transfer.TransferError):
             kv_transfer.decode_snapshot(_tamper_header(wire, **bad))
     # more blocks than frames on the wire = short read, same rejection
     with pytest.raises(kv_transfer.TransferError):
         kv_transfer.decode_snapshot(_tamper_header(wire, n_blocks=3))
+
+
+def test_hybrid_wire_q80_full_pages_f32_partial_tail():
+    """The q80+f32 hybrid: FULL pages travel quantized (bounded error,
+    q80-sized), the partial tail page travels f32 (bit-exact — it is the
+    page still being decoded into, where drift would compound into the
+    next attention step). Wire size lands between pure q80 and pure f32."""
+    snap = _fake_snap()  # pos=20, page=8: blocks 0,1 full, block 2 partial
+    prompt = list(range(snap["plen"]))
+    f32 = kv_transfer.encode_snapshot(snap, prompt, mode="f32")
+    q80 = kv_transfer.encode_snapshot(snap, prompt, mode="q80")
+    hyb = kv_transfer.encode_snapshot(snap, prompt, mode="q80+f32")
+    assert len(q80) < len(hyb) < len(f32)
+    got = kv_transfer.decode_snapshot(hyb)
+    assert got["mode"] == "q80+f32"
+    page = snap["page_tokens"]
+    for want, have in zip(snap["leaves"], got["leaves"]):
+        for b in range(snap["n_blocks"]):
+            ntok = max(0, min(snap["pos"] - b * page, page))
+            w = want[:, b, :ntok]
+            if ntok == page:  # full page: q80 frame, bounded error
+                bound = kv_transfer.q80_error_bound(w)
+                assert float(np.abs(have[:, b, :ntok] - w).max()) <= bound
+            else:  # partial tail: f32 frame, bit-exact
+                assert np.array_equal(have[:, b, :ntok], w), b
+            assert not have[:, b, ntok:].any()
+
+
+def test_stop_state_rides_v2_header_and_v1_reads_none():
+    """A checkpoint carrying StopDetector scanback writes a v2 header;
+    decode hands the normalized state back. A plain v1 stream (no stop
+    session) reads back stop_state=None — old payloads stay admissible
+    for plain streams."""
+    snap = _fake_snap(pos=6, page=4, nblk=2, plen=5, seed=4)
+    prompt = list(range(5))
+    v1 = kv_transfer.encode_snapshot(snap, prompt, mode="f32")
+    assert kv_transfer.decode_snapshot(v1)["stop_state"] is None
+    v2 = kv_transfer.encode_snapshot(
+        snap, prompt, mode="f32",
+        stop_state={"stops": ["END", "\n\n"], "hold": "EN",
+                    "stopped": False})
+    got = kv_transfer.decode_snapshot(v2)["stop_state"]
+    assert got == {"stops": ["END", "\n\n"], "hold": "EN",
+                   "stopped": False}
+
+
+def test_malformed_stop_state_rejected_with_reason():
+    """A v2 header whose stop_state is garbage is rejected whole, with
+    the reason naming the field — never half-admitted with stops
+    silently dropped."""
+    snap = _fake_snap(pos=6, page=4, nblk=2, plen=5, seed=5)
+    wire = kv_transfer.encode_snapshot(
+        snap, list(range(5)), mode="f32",
+        stop_state={"stops": ["X"], "hold": "", "stopped": False})
+    for bad in ("nope", 7, {"hold": "x"}, {"stops": "END"}):
+        with pytest.raises(kv_transfer.TransferError,
+                           match="stop_state"):
+            kv_transfer.decode_snapshot(
+                _tamper_header(wire, v=2, stop_state=bad))
 
 
 # ---------------------------------------------------------------------------
