@@ -2205,6 +2205,409 @@ def run_failover_bench(n: int) -> dict:
     return result
 
 
+def run_workloads_bench(n: int) -> dict:
+    """BENCH_WORKLOADS=N: the SLO-class chaos battery, jax-free IN THIS
+    PROCESS (replicas are `cli serve` subprocesses pinned to CPU). One
+    2-replica fleet boots with per-class lanes on
+    (``--slo-classes interactive:...;batch:...``) and the deterministic
+    scenarios from scripts/workloads.py replay against it:
+
+      pin      preemption bit-identity, direct against one replica: a
+               batch-class stream sized to saturate the KV page budget
+               runs solo (the reference), then again with an interactive
+               arrival forcing a chunk-boundary preemption — the
+               preempted+resumed output must be byte-identical, with
+               dllama_preemptions_total{outcome="resumed"} >= 1 and
+               zero outcome="error"
+      bursty   interactive bursts through the router while batch jobs
+               saturate the batch lane: zero errors in either class and
+               interactive TTFT p99 <= WORKLOADS_TTFT_P99_MS (default
+               30000 — "bounded", with CPU CI slack, not a latency claim)
+      mixed    long-context + multi-turn prefix reuse + abusive mid-SSE
+               disconnects: zero errors outside the deliberate drops,
+               and the fleet still answers afterwards
+      kill     a replica SIGKILLed mid-burst with router checkpointing
+               on: every stream still ends 200/[DONE]/no error event,
+               and the router counted >= 1 ok resume
+
+    Plus a federation gate: after the bursty mix, /metrics/fleet must
+    carry the per-class gauge series (lane pressure is an operator
+    surface, not replica-local state). BENCH_WORKLOADS_OUT writes the
+    full report JSON for CI artifacts. The final metric line is the
+    bursty-mix interactive TTFT p99; vs_baseline divides the unloaded
+    interactive TTFT by it (below 1.0 = saturation costs latency)."""
+    import http.client
+    import importlib.util
+    import shutil
+    import signal
+    import socket
+    import tempfile
+    import threading
+
+    import numpy as np
+
+    from dllama_tpu.formats.spec import ArchType, ModelSpec
+    from dllama_tpu.formats.tokenizer_file import (TokenizerData,
+                                                   write_tokenizer)
+    from dllama_tpu.formats.weights import tensor_plan, write_model
+    from dllama_tpu.quants import blocks
+    from dllama_tpu.serving import fleet as fleet_mod
+    from dllama_tpu.serving import router as router_mod
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    spec_wl = importlib.util.spec_from_file_location(
+        "dllama_workloads", os.path.join(repo, "scripts", "workloads.py"))
+    wl = importlib.util.module_from_spec(spec_wl)
+    spec_wl.loader.exec_module(wl)
+
+    bursts = max(2, min(n, 6))
+    ttft_bound_ms = float(os.environ.get("WORKLOADS_TTFT_P99_MS", "30000"))
+    # batch request budget deliberately past any row's room: admission
+    # clamps steps to seq_len - plen, so ONE such row reserves exactly
+    # half the 2-slot paged budget and TWO saturate it — the interactive
+    # arrival then must preempt, whatever the chat template's overhead
+    batch_steps = 450
+    tmp = tempfile.mkdtemp(prefix="bench_workloads_")
+    spec = ModelSpec(arch=ArchType.LLAMA, dim=128, hidden_dim=256,
+                     n_layers=2, n_heads=4, n_kv_heads=2, vocab_size=512,
+                     seq_len=512, weights_float_type=blocks.Q40)
+    rng = np.random.default_rng(0)
+    model, tok = os.path.join(tmp, "m.m"), os.path.join(tmp, "t.t")
+    write_model(model, spec,
+                {e.name: 0.05 * rng.standard_normal(e.d * e.n).astype(
+                    np.float32) for e in tensor_plan(spec)})
+    vocab = ([b"<unk>", b"<s>", b"</s>"] + [bytes([i]) for i in range(256)]
+             + [b"hi"] * (512 - 259))
+    write_tokenizer(tok, TokenizerData(vocab=vocab, scores=[0.0] * 512,
+                                       bos_id=1, eos_id=2))
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("JAX_PLATFORM_NAME", None)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env.pop("DLLAMA_FAULTS", None)
+
+    def _free_base(span: int) -> int:
+        for _ in range(64):
+            with socket.socket() as s:
+                s.bind(("127.0.0.1", 0))
+                base = s.getsockname()[1]
+            if base + span > 65500:
+                continue
+            try:
+                for i in range(1, span):
+                    with socket.socket() as t:
+                        t.bind(("127.0.0.1", base + i))
+                return base
+            except OSError:
+                continue
+        raise RuntimeError("no free port span for the replica fleet")
+
+    def _scrape(port, family, match=(), path="/metrics"):
+        """Sum of the family's samples whose label text contains every
+        ``match`` fragment."""
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10.0)
+        try:
+            conn.request("GET", path)
+            text = conn.getresponse().read().decode()
+        finally:
+            conn.close()
+        total = 0.0
+        for line in text.splitlines():
+            if line.startswith(family) and all(m in line for m in match):
+                total += float(line.rsplit(" ", 1)[1])
+        return total
+
+    gates = []
+    phases: dict = {}
+    fl = fleet_mod.Fleet(
+        model, tok, n_replicas=2, base_port=_free_base(2), host="127.0.0.1",
+        # --batch-max 2 sizes the paged budget at 2*seq_len tokens (paged
+        # rows are bounded by pages, not slots); --batch-chunk 2 makes
+        # chunk-boundary preemption latency two tokens
+        replica_args=["--batch-window", "5", "--batch-max", "2",
+                      "--batch-chunk", "2", "--prefill-chunk", "64",
+                      "--kv-pages", "16", "--tp", "1",
+                      "--ckpt-interval", "2",
+                      "--slo-classes",
+                      "interactive:depth=32,deadline=240;batch:depth=8"],
+        log_dir=os.path.join(tmp, "logs"), env=env, roles=["both", "both"])
+    rstate = rsrv = None
+    try:
+        log("workloads bench: booting both+both fleet "
+            f"(ports {[r.port for r in fl.replicas]})...")
+        t0 = time.perf_counter()
+        fl.start()
+        if not fl.wait_ready(timeout_s=300.0):
+            raise RuntimeError("replicas never became ready")
+        log(f"fleet ready in {time.perf_counter() - t0:.1f}s")
+        ports = [r.port for r in fl.replicas]
+
+        # warm-up: compile every replica's programs outside the clocks;
+        # the LAST warm request per replica doubles as the unloaded-TTFT
+        # baseline sample
+        base_ttfts = []
+        for p in ports:
+            for w in range(2):
+                r = wl.do_request("127.0.0.1", p, wl.Req(
+                    0.0, f"warm-{p}-{w}", "interactive",
+                    [{"role": "user", "content": f"warm {w} up"}], 8),
+                    timeout=300.0)
+                if r["status"] != 200 or r["error"]:
+                    raise RuntimeError(
+                        f"warm-up on :{p} failed: {r['status']} "
+                        f"{r['error']!r}")
+                if w == 1 and r["ttft_ms"] is not None:
+                    base_ttfts.append(r["ttft_ms"])
+        baseline_ttft = _pct(base_ttfts, 50)
+
+        # ---- pin: preemption bit-identity (replica 0, direct) --------
+        p0 = ports[0]
+        pin_req = wl.Req(0.0, "pin", "batch",
+                         [{"role": "user",
+                           "content": "pin me alpha bravo cedar delta"}],
+                         batch_steps)
+        fill_req = wl.Req(0.0, "fill", "batch",
+                          [{"role": "user",
+                            "content": "fill me echo fjord gamma haze"}],
+                          batch_steps)
+        solo = wl.do_request("127.0.0.1", p0, pin_req, timeout=600.0)
+        if solo["status"] != 200 or solo["error"] or not solo["text"]:
+            gates.append(f"pin solo run failed: {solo['status']} "
+                         f"{solo['error']!r}")
+            raise RuntimeError(gates[-1])
+        res0 = _scrape(p0, "dllama_preemptions_total",
+                       ('outcome="resumed"',))
+        err0 = _scrape(p0, "dllama_preemptions_total",
+                       ('outcome="error"',))
+        slots = [None, None]
+        # filler first, pin second: the preemptor exports the YOUNGEST
+        # batch row, so the pin is the one parked and resumed
+        t_fill = threading.Thread(target=lambda: slots.__setitem__(
+            0, wl.do_request("127.0.0.1", p0, fill_req, timeout=600.0)),
+            daemon=True)
+        t_fill.start()
+        deadline = time.monotonic() + 60.0
+        while time.monotonic() < deadline:
+            if _scrape(p0, "dllama_class_resident_rows",
+                       ('slo_class="batch"',)) >= 1:
+                break
+            time.sleep(0.01)
+        t_pin = threading.Thread(target=lambda: slots.__setitem__(
+            1, wl.do_request("127.0.0.1", p0, pin_req, timeout=600.0)),
+            daemon=True)
+        t_pin.start()
+        deadline = time.monotonic() + 60.0
+        while time.monotonic() < deadline:
+            if _scrape(p0, "dllama_class_resident_rows",
+                       ('slo_class="batch"',)) >= 2:
+                break
+            time.sleep(0.01)
+        inter = wl.do_request("127.0.0.1", p0, wl.Req(
+            0.0, "pin-int", "interactive",
+            [{"role": "user", "content": "quick question"}], 8),
+            timeout=600.0)
+        t_fill.join(timeout=600.0)
+        t_pin.join(timeout=600.0)
+        resumed = _scrape(p0, "dllama_preemptions_total",
+                          ('outcome="resumed"',)) - res0
+        perrs = _scrape(p0, "dllama_preemptions_total",
+                        ('outcome="error"',)) - err0
+        phases["pin"] = {"solo_len": len(solo["text"]),
+                         "resumed": resumed, "preempt_errors": perrs,
+                         "interactive_status": inter["status"]}
+        if inter["status"] != 200 or inter["error"]:
+            gates.append(f"interactive arrival failed during saturation: "
+                         f"{inter['status']} {inter['error']!r}")
+        if resumed < 1:
+            gates.append("no preemption resumed during the pin phase — "
+                         "the bit-identity comparison never exercised "
+                         "the park/resume path")
+        if perrs:
+            gates.append(f"{perrs:.0f} preemption export errors")
+        pinned = slots[1]
+        if pinned is None or pinned["status"] != 200 or pinned["error"]:
+            gates.append(f"pinned batch stream failed: {pinned!r}"[:300])
+        elif pinned["text"] != solo["text"]:
+            gates.append(
+                "preempted batch output != unpreempted reference "
+                f"(lens {len(pinned['text'])} vs {len(solo['text'])})")
+        log(f"[pin] resumed {resumed:.0f}, errors {perrs:.0f}, "
+            f"bit-identical={pinned is not None and pinned['text'] == solo['text']}")
+
+        # ---- router up for the fleet phases --------------------------
+        rstate = router_mod.RouterState(
+            [router_mod.Replica("127.0.0.1", p) for p in ports],
+            probe_interval_s=0.3, ckpt_interval=2)
+        rstate.probe_once()
+        rsrv = router_mod.create_router_server(rstate, "127.0.0.1", 0)
+        threading.Thread(target=rsrv.serve_forever, daemon=True).start()
+        rstate.start_probes()
+        r_port = rsrv.server_address[1]
+
+        # ---- bursty: interactive TTFT under a saturated batch lane ---
+        sched = wl.bursty_mix(seed=11, bursts=bursts, burst_size=4,
+                              gap_s=1.5, batch_jobs=2, batch_tokens=160,
+                              interactive_tokens=12)
+        results = wl.run_schedule("127.0.0.1", r_port, sched,
+                                  timeout=600.0)
+        summ = wl.summarize(results)
+        phases["bursty"] = summ
+        for cls in ("interactive", "batch"):
+            for msg in summ.get(cls, {}).get("errors", []):
+                gates.append(f"bursty {cls}: {msg}")
+        ttft_p99 = (summ.get("interactive") or {}).get("ttft_p99_ms")
+        if ttft_p99 is None:
+            gates.append("bursty mix produced no interactive TTFT sample")
+        elif ttft_p99 > ttft_bound_ms:
+            gates.append(f"interactive TTFT p99 {ttft_p99:.0f} ms exceeds "
+                         f"the {ttft_bound_ms:.0f} ms class bound under "
+                         "the saturated batch lane")
+        log(f"[bursty] {json.dumps(summ, sort_keys=True)}")
+        # federation: the per-class gauges must be visible fleet-wide
+        conn = http.client.HTTPConnection("127.0.0.1", r_port,
+                                          timeout=10.0)
+        try:
+            conn.request("GET", "/metrics/fleet")
+            fed = conn.getresponse().read().decode()
+        finally:
+            conn.close()
+        for fam in ("dllama_class_queue_depth", "dllama_class_ttft_ms"):
+            if fam not in fed:
+                gates.append(f"{fam} missing from /metrics/fleet — "
+                             "lane pressure is not federated")
+
+        # ---- mixed: long-context + prefix reuse + mid-SSE drops ------
+        mixed = (wl.long_context(seed=5, n=3, target_chars=280,
+                                 max_tokens=16)
+                 + wl.multi_turn(seed=3, conversations=2, turns=3,
+                                 max_tokens=12)
+                 + wl.abusive_disconnects(seed=9, n=3, max_tokens=64))
+        msumm = wl.summarize(
+            wl.run_schedule("127.0.0.1", r_port, mixed, timeout=600.0))
+        phases["mixed"] = msumm
+        for cls, c in msumm.items():
+            for msg in c["errors"]:
+                gates.append(f"mixed {cls}: {msg}")
+        after = wl.do_request("127.0.0.1", r_port, wl.Req(
+            0.0, "post-abuse", "interactive",
+            [{"role": "user", "content": "still there?"}], 4),
+            timeout=300.0)
+        if after["status"] != 200 or after["error"]:
+            gates.append("fleet unhealthy after the mid-SSE disconnects: "
+                         f"{after['status']} {after['error']!r}")
+        log(f"[mixed] {json.dumps(msumm, sort_keys=True)}")
+
+        # ---- kill: SIGKILL a replica mid-burst -----------------------
+        ok0 = rstate._m_resumes.value(outcome="ok")
+        kres = [None] * 4
+        killed = {}
+
+        def _streamer(i, rq):
+            kres[i] = wl.do_request("127.0.0.1", r_port, rq,
+                                    timeout=600.0)
+
+        # streams long enough that the kill lands mid-decode: past the
+        # first router checkpoint (interval 2), well before [DONE]
+        burst = wl.kill_burst(seed=13, n=4, max_tokens=160)
+        th = [threading.Thread(target=_streamer, args=(i, rq),
+                               daemon=True)
+              for i, rq in enumerate(burst[:2])]
+        for t in th:
+            t.start()
+        deadline = time.monotonic() + 60.0
+        while time.monotonic() < deadline:
+            busy = [r for r in rstate.replicas
+                    if r.snapshot().get("inflight", 0) > 0]
+            if len(busy) >= 1 and sum(
+                    r.snapshot().get("inflight", 0)
+                    for r in rstate.replicas) >= 2:
+                break
+            time.sleep(0.01)
+        time.sleep(0.3)  # let the first checkpoints land in the store
+        for i, r in enumerate(rstate.replicas):
+            if r.snapshot().get("inflight", 0) > 0:
+                os.kill(fl.replicas[i].proc.pid, signal.SIGKILL)
+                killed["replica"] = r.name
+                log(f"[kill] SIGKILLed {r.name} mid-burst")
+                break
+        # the back half of the burst arrives AFTER the kill: routed (or
+        # retried) onto the survivor without the client noticing
+        th += [threading.Thread(target=_streamer, args=(2 + i, rq),
+                                daemon=True)
+               for i, rq in enumerate(burst[2:])]
+        for t in th[2:]:
+            t.start()
+        for t in th:
+            t.join(timeout=600.0)
+        resumes = rstate._m_resumes.value(outcome="ok") - ok0
+        phases["kill"] = {"killed": killed.get("replica"),
+                          "resumes_ok": resumes,
+                          "results": [
+                              {"name": r["name"], "status": r["status"],
+                               "done": r["done"], "error": r["error"]}
+                              if r else None for r in kres]}
+        if not killed:
+            gates.append("no in-flight replica found to SIGKILL")
+        for r in kres:
+            if r is None or r["status"] != 200 or r["error"] \
+                    or not r["done"]:
+                gates.append(
+                    "client-visible error across the kill: "
+                    + (f"{r['name']}: {r['status']} {r['error']!r} "
+                       f"done={r['done']}" if r else "stream never "
+                       "resolved"))
+        if killed and resumes < 1:
+            gates.append("replica killed but the router counted no ok "
+                         f"resume (got {resumes:.0f})")
+        log(f"[kill] resumes ok {resumes:.0f}, "
+            f"results {[r['status'] if r else None for r in kres]}")
+    finally:
+        if rstate is not None:
+            rstate.stop_probes()
+        if rsrv is not None:
+            rsrv.shutdown()
+            rsrv.server_close()
+        fl.drain(timeout_s=10.0)
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    report = {
+        "bursts": bursts, "batch_steps": batch_steps,
+        "ttft_bound_ms": ttft_bound_ms,
+        "cpu_count": os.cpu_count(),
+        # CPU smoke: class-lane correctness, preemption bit-identity and
+        # chaos survival. The TTFT bound is a CI noise envelope — the
+        # real interactive SLO is a hardware claim (numbers owed once
+        # the TPU tunnel resolves; ROADMAP carried follow-up).
+        "tpu_deltas_owed": True,
+        "baseline_ttft_ms": (round(baseline_ttft, 3)
+                             if baseline_ttft is not None else None),
+        "interactive_ttft_p99_ms": (round(ttft_p99, 3)
+                                    if ttft_p99 is not None else None),
+        "phases": phases,
+        "gates_failed": gates,
+    }
+    out_path = os.environ.get("BENCH_WORKLOADS_OUT")
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump(report, f, indent=2, default=str)
+        log(f"report written to {out_path}")
+    result = {
+        "metric": "smoke_workloads_ttft_ms",
+        "value": round(ttft_p99, 3) if ttft_p99 is not None else None,
+        "unit": "ms",
+        "vs_baseline": (round(baseline_ttft / ttft_p99, 2)
+                        if ttft_p99 and baseline_ttft else None),
+        "baseline": "unloaded interactive TTFT p50 on the same fleet "
+                    "(warm replicas, empty lanes)",
+        "weights": "q40-workloads-fleet2",
+        "platform": "cpu-subprocess-fleet",
+        "n_devices": 2,
+    }
+    if gates:
+        result["error"] = "; ".join(gates)
+    return result
+
+
 def main() -> None:
     # metric name for the error path, resolvable without touching jax
     choice = os.environ.get("BENCH_MODEL", "")
@@ -2218,6 +2621,7 @@ def main() -> None:
                  else "router" if _env_count("BENCH_ROUTER")
                  else "disagg" if _env_count("BENCH_DISAGG")
                  else "failover" if _env_count("BENCH_FAILOVER")
+                 else "workloads" if _env_count("BENCH_WORKLOADS")
                  else "decode")
     err_metric = {"tiny": "tinyllama_1.1b", "llama3": "llama3_8b",
                   "moe": "mixtral_lite", "grok": "grok1_lite",
@@ -2252,15 +2656,17 @@ def main() -> None:
     nrouter = _env_count("BENCH_ROUTER")
     ndisagg = _env_count("BENCH_DISAGG")
     nfailover = _env_count("BENCH_FAILOVER")
-    if nrouter or ndisagg or nfailover:
-        # the router, disaggregation, and failover replays are jax-free
-        # IN THIS PROCESS (replicas are CPU subprocesses), so branch
-        # before the backend probes: a dead TPU tunnel must not block a
-        # pure-CPU fleet replay
+    nworkloads = _env_count("BENCH_WORKLOADS")
+    if nrouter or ndisagg or nfailover or nworkloads:
+        # the router, disaggregation, failover and workload replays are
+        # jax-free IN THIS PROCESS (replicas are CPU subprocesses), so
+        # branch before the backend probes: a dead TPU tunnel must not
+        # block a pure-CPU fleet replay
         try:
             result = (run_router_bench(nrouter) if nrouter
                       else run_disagg_bench(ndisagg) if ndisagg
-                      else run_failover_bench(nfailover))
+                      else run_failover_bench(nfailover) if nfailover
+                      else run_workloads_bench(nworkloads))
         except Exception as e:  # noqa: BLE001 — emit the machine-readable record
             result = {"metric": err_metric, "value": None,
                       "unit": "req/s" if nrouter else "ms",

@@ -130,6 +130,11 @@ def build_parser() -> argparse.ArgumentParser:
     fp.add_argument("--log-dir", default=None, metavar="DIR",
                     help="per-replica stdout/stderr logs (replica-N.log); "
                     "default: inherit this terminal")
+    fp.add_argument(
+        "--slo-classes", default=None, metavar="SPEC",
+        help="per-class SLO lane config passed to every replica's serve "
+        "argv (see `serve --slo-classes`); a --replica-arg "
+        "'--slo-classes ...' overrides")
     add_router_flags(fp, default_port=9900)
 
     # live fleet terminal view: polls the router's /stats + /metrics/fleet
@@ -255,6 +260,23 @@ def build_parser() -> argparse.ArgumentParser:
                 help="max requests in flight (decoding + waiting): overflow "
                 "is rejected immediately with 429 + Retry-After instead of "
                 "queuing unboundedly",
+            )
+            sp.add_argument(
+                "--slo-classes",
+                default=None,
+                metavar="SPEC",
+                help="per-class SLO lanes for the admission gate and "
+                "batch scheduler, e.g. 'interactive:depth=48,deadline=30;"
+                "batch:depth=16,resident=2'. Requests pick their lane "
+                "with X-Dllama-Class (default interactive). depth bounds "
+                "the lane's in-flight count (429 + lane-scoped "
+                "Retry-After past it), deadline is the lane's default "
+                "wall-clock budget in seconds (outranks "
+                "--request-timeout), resident caps the lane's decoding "
+                "rows — interactive arrivals preempt batch rows at chunk "
+                "boundaries and resume them bit-identically when "
+                "pressure drops. Unset = one classless lane "
+                "(pre-SLO behavior)",
             )
             sp.add_argument(
                 "--drain-timeout",
@@ -840,6 +862,34 @@ def _top_fleet_families(text: str) -> dict:
     return out
 
 
+def _top_class_series(text: str, families: tuple) -> dict:
+    """Fold the named per-class families of a /metrics/fleet exposition
+    into {(family, replica, slo_class): value}. The plain families fold
+    (:func:`_top_fleet_families`) SUMS across non-replica labels — exactly
+    wrong for lane gauges, where interactive and batch pressure must stay
+    distinguishable."""
+    out: dict = {}
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        head, _, value = line.rpartition(" ")
+        name, _, labels = head.partition("{")
+        if name not in families:
+            continue
+        replica = slo_class = None
+        for part in labels.rstrip("}").split(","):
+            if part.startswith('replica="'):
+                replica = part[len('replica="'):].rstrip('"')
+            elif part.startswith('slo_class="'):
+                slo_class = part[len('slo_class="'):].rstrip('"')
+        try:
+            out[(name, replica, slo_class)] = float(value)
+        except ValueError:
+            continue  # a torn exposition line (replica died mid-write):
+            #           skip the sample, the next scrape heals the cell
+    return out
+
+
 def run_top(args) -> int:
     """``cli top``: a refreshing terminal view of the fleet — per-replica
     rotation/load from the router's /stats, per-replica request counters
@@ -864,9 +914,14 @@ def run_top(args) -> int:
                 _, stats_body = _top_get(host, port, "/stats")
                 stats = json_mod.loads(stats_body)
                 code, fleet_body = _top_get(host, port, "/metrics/fleet")
-                fams = (_top_fleet_families(
-                    fleet_body.decode("utf-8", "replace"))
-                    if code == 200 else {})
+                fleet_text = (fleet_body.decode("utf-8", "replace")
+                              if code == 200 else "")
+                fams = _top_fleet_families(fleet_text)
+                # lane gauges keep their slo_class label (a summed fold
+                # would blur interactive and batch pressure together)
+                lanes = _top_class_series(
+                    fleet_text, ("dllama_class_queue_depth",
+                                 "dllama_class_resident_rows"))
                 load = stats.get("load") or {}
                 lines.append(
                     f"dllama top — router {args.router}  "
@@ -877,7 +932,8 @@ def run_top(args) -> int:
                 lines.append("")
                 lines.append(
                     f"{'replica':<22}{'role':<9}{'state':<10}{'infl':>5}"
-                    f"{'occ':>8}{'queue':>7}{'kv_free':>9}{'probe_age':>11}"
+                    f"{'occ':>8}{'queue':>7}{'q i/b':>8}{'res i/b':>9}"
+                    f"{'kv_free':>9}{'probe_age':>11}"
                     f"{'reqs':>8}{'ttft_ms':>9}{'tpot_ms':>9}"
                     f"{'kv_kB/s':>9}")
                 for snap in load.get("replicas") or []:
@@ -891,6 +947,16 @@ def run_top(args) -> int:
                         s = fams.get((f"{fam}_sum", name))
                         c = fams.get((f"{fam}_count", name))
                         return f"{s / c:.1f}" if s is not None and c else "-"
+
+                    def lane_pair(fam):
+                        # "i/b": the replica's interactive vs batch value
+                        # of a lane gauge; "-" until the replica exposes
+                        # per-class series (mixed-version fleets)
+                        i = lanes.get((fam, name, "interactive"))
+                        b = lanes.get((fam, name, "batch"))
+                        if i is None and b is None:
+                            return "-"
+                        return f"{int(i or 0)}/{int(b or 0)}"
 
                     reqs = fams.get(("dllama_http_requests_total", name))
                     # KV handoff wire rate (in+out summed — the families
@@ -912,6 +978,8 @@ def run_top(args) -> int:
                         f"{rload.get('slots_occupied', 0):>4}/"
                         f"{rload.get('slots_total', 0):<3}"
                         f"{rload.get('queue_depth', 0):>7}"
+                        f"{lane_pair('dllama_class_queue_depth'):>8}"
+                        f"{lane_pair('dllama_class_resident_rows'):>9}"
                         f"{rload.get('kv_pages_free', '-'):>9}"
                         f"{(f'{age:.1f}s' if age is not None else '-'):>11}"
                         f"{(f'{reqs:.0f}' if reqs is not None else '-'):>8}"

@@ -44,7 +44,11 @@ Spec grammar (sites separated by ``;``)::
   a skipped checkpoint, counted, never a stream error) and ``resume``
   (every router-side resume attempt after an upstream died mid-SSE — a
   faulted resume degrades to the clean SSE ``error`` + ``[DONE]``
-  termination the fallback matrix guarantees).
+  termination the fallback matrix guarantees). The SLO-class seam is
+  ``preempt`` (every chunk-boundary preemption of a batch-class row to
+  make room for queued interactive work — a faulted preemption leaves
+  the batch row running untouched and the interactive request waiting,
+  never a torn stream).
 * ``action`` — ``raise`` (throw :class:`FaultInjected`), ``slow`` (sleep
   ``delay_ms``, default 50), or a *data* action the seam itself interprets:
   ``truncate`` (weights_open: pretend the file is ``drop`` bytes short,
@@ -73,7 +77,8 @@ SITES = ("admit", "step_chunk", "prefill", "prefill_chunk", "prefix_match",
          "page_alloc", "stream", "scheduler", "weights_open", "weights_read",
          "logits", "route_pick", "proxy_upstream", "probe",
          "federate_scrape", "flight_dump", "overlap_split",
-         "kv_export", "kv_import", "migrate", "ckpt_write", "resume")
+         "kv_export", "kv_import", "migrate", "ckpt_write", "resume",
+         "preempt")
 ACTIONS = ("raise", "slow", "truncate", "bitflip", "nan")
 
 #: site -> the metric family that proves the site's failure is VISIBLE on
@@ -120,6 +125,9 @@ SITE_METRICS = {
     # router's resume fallback matrix, counted by outcome
     "ckpt_write": "dllama_ckpt_writes_total",
     "resume": "dllama_stream_resume_total",
+    # SLO-class seam: a faulted preemption is a batch row that keeps
+    # decoding (outcome="injected"), never a client-visible error
+    "preempt": "dllama_preemptions_total",
 }
 
 

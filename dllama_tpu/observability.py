@@ -842,7 +842,7 @@ class RequestTrace:
         "request_id", "span_id", "parent_span", "t0", "path", "t_start",
         "prefill_ms", "t_first", "t_last", "admission_depth", "queue_depth",
         "tokens_in", "tokens_out", "finish_reason", "status",
-        "prompt_sha", "prompt_text", "model", "prefill_chunks",
+        "prompt_sha", "prompt_text", "model", "prefill_chunks", "slo_class",
     )
 
     def __init__(self, request_id: str, parent_span: Optional[str] = None):
@@ -871,6 +871,9 @@ class RequestTrace:
         #: --log-prompts; never written to logs otherwise (privacy default)
         self.prompt_text: Optional[str] = None
         self.model: Optional[str] = None
+        #: the request's SLO lane ("interactive"/"batch", from
+        #: X-Dllama-Class) — drives the per-class TTFT/TPOT series
+        self.slo_class: str = "interactive"
         #: (t_begin, t_end) monotonic pairs, one per chunked-prefill piece
         self.prefill_chunks: List[tuple] = []
 
@@ -929,6 +932,7 @@ class RequestTrace:
             "tokens_out": self.tokens_out,
             "admission_depth": self.admission_depth,
             "queue_depth": self.queue_depth,
+            "slo_class": self.slo_class,
             "queue_wait_ms": _r(self.queue_wait_ms),
             "prefill_ms": _r(self.prefill_ms),
             "ttft_ms": _r(self.ttft_ms),

@@ -53,7 +53,9 @@ from dllama_tpu.serving.lifecycle import (
     KVBudget,
     LifecycleError,
     SchedulerCrashed,
+    SLO_CLASSES,
     Supervisor,
+    parse_slo_classes,
 )
 from dllama_tpu.serving.templates import render_llama2_turn, render_llama3_chat
 
@@ -153,7 +155,8 @@ def decode_token_row(tok, prev: int, row: list, stop_ids: tuple,
     return "".join(text_parts), finish, n_gen
 
 
-@guarded_by("_lock", "_supervisor", "_window", "_active_sess", "_keep_sess")
+@guarded_by("_lock", "_supervisor", "_window", "_active_sess", "_keep_sess",
+            "_class_stats")
 class Batcher:
     """CONTINUOUS batching scheduler: concurrent completions — greedy AND
     sampled, non-streaming AND streaming — share one resident slot-pool
@@ -195,16 +198,24 @@ class Batcher:
     class _Slot:
         __slots__ = ("prompt", "steps", "sampler", "tokens", "error", "done",
                      "queue", "deadline", "cancel", "trace", "kind", "snap",
-                     "export", "ckpt_every", "since_ckpt")
+                     "export", "ckpt_every", "since_ckpt", "slo_class",
+                     "preempted")
 
         def __init__(self, prompt, steps, sampler, streaming: bool,
                      deadline=None, cancel=None, trace=None,
                      kind: str = "completion", snap=None,
-                     ckpt_every: int = 0):
+                     ckpt_every: int = 0, slo_class: str = "interactive"):
             self.prompt, self.steps, self.sampler = prompt, steps, sampler
             self.tokens = None
             self.error = None
             self.done = threading.Event()
+            #: SLO lane ("interactive"/"batch"): drives lane ordering at
+            #: admission and marks batch rows preemptible
+            self.slo_class = slo_class
+            #: True while this row sits in the scheduler's preempted
+            #: parking lot (exported at a chunk boundary to make room for
+            #: interactive work; re-admitted via admit_from_export)
+            self.preempted = False
             #: disaggregation job kind: "completion" (the normal request),
             #: "prefill" (admit + first chunk, then export the row's KV
             #: pages for migration) or "import" (admit a row warm from a
@@ -269,8 +280,12 @@ class Batcher:
     def __init__(self, state, window_ms: float = 15.0, max_batch: int = 8,
                  chunk: int = 8, prefill_chunk: int = -1,
                  kv_buckets: bool = True, kv_bucket_min: int = 0,
-                 kv_pages: int = 0):
+                 kv_pages: int = 0, slo_classes: dict = None):
         self.state = state
+        #: {name: lifecycle.SLOClass} — per-lane admission order and
+        #: residency caps (see _serve_continuous's lane-aware admission)
+        self.slo_classes = (slo_classes if slo_classes is not None
+                            else parse_slo_classes(""))
         self.window_s = window_ms / 1000.0
         #: HBM bound: the pool's KV budget is max_batch full-context caches
         #: (--batch-max; size against seq_len x n_layers x kv x cache dtype)
@@ -313,6 +328,34 @@ class Batcher:
             "Occupied slots of the pooled decode session, observed per "
             "fused chunk",
             buckets=tuple(float(i) for i in range(1, self.max_batch + 1)))
+        # SLO-class scheduling telemetry: every preemption decision by
+        # outcome, plus live per-lane pressure (these two gauges are what
+        # `cli top`'s lane columns read off /metrics/fleet)
+        self._m_preemptions = reg.counter(
+            "dllama_preemptions_total",
+            "Chunk-boundary preemptions of batch-class rows, by outcome "
+            "(ok=exported+parked, resumed=re-admitted bit-identically, "
+            "retry=re-admission deferred, injected/error=preemption "
+            "aborted, row kept decoding)",
+            ("outcome",))
+        self._m_class_queue = reg.gauge(
+            "dllama_class_queue_depth",
+            "Requests waiting for a decode slot, by SLO class",
+            ("slo_class",))
+        self._m_class_resident = reg.gauge(
+            "dllama_class_resident_rows",
+            "Rows resident in the decode slot pool, by SLO class",
+            ("slo_class",))
+        self._m_class_preempted = reg.gauge(
+            "dllama_class_preempted_rows",
+            "Preempted rows parked awaiting re-admission, by SLO class",
+            ("slo_class",))
+        #: latest per-lane scheduler snapshot ({class: {waiting, resident,
+        #: preempted}}), published each chunk tick for /ready (the router's
+        #: class-aware scoring reads it there)
+        self._class_stats = {name: {"waiting": 0, "resident": 0,
+                                    "preempted": 0}
+                             for name in SLO_CLASSES}
         #: lifecycle.Supervisor owning the scheduler thread: a crashed loop
         #: fails its window's slots 503 and restarts instead of leaving
         #: every later submit() hanging on a dead daemon
@@ -378,6 +421,80 @@ class Batcher:
             info["kv_pages_total"] = pages.get("pages_total", 0)
             info["prefix_hit_rate"] = pages.get("prefix_hit_rate", 0.0)
         return info
+
+    def class_stats(self) -> dict:
+        """Per-SLO-class lane pressure ({class: {waiting, resident,
+        preempted}}) as of the last scheduler tick — the readiness probe's
+        lane view (the router scores classes off this)."""
+        with self._lock:
+            return {k: dict(v) for k, v in self._class_stats.items()}
+
+    def _publish_class_stats(self, waiting: list, slot_map: dict,
+                             preempted: list) -> None:
+        """One chunk tick's lane picture -> gauges + readiness snapshot."""
+        stats = {name: {"waiting": 0, "resident": 0, "preempted": 0}
+                 for name in SLO_CLASSES}
+        for s in waiting:
+            if s.slo_class in stats:
+                stats[s.slo_class]["waiting"] += 1
+        for s in slot_map.values():
+            if s.slo_class in stats:
+                stats[s.slo_class]["resident"] += 1
+        for s in preempted:
+            if s.slo_class in stats:
+                stats[s.slo_class]["preempted"] += 1
+        for name, row in stats.items():
+            self._m_class_queue.set(row["waiting"], slo_class=name)
+            self._m_class_resident.set(row["resident"], slo_class=name)
+            self._m_class_preempted.set(row["preempted"], slo_class=name)
+        with self._lock:
+            self._class_stats = stats
+
+    def _class_resident_cap(self, slo_class: str) -> int:
+        """The lane's max resident decode rows (0 = unbounded)."""
+        cls = self.slo_classes.get(slo_class)
+        return max(0, cls.max_resident) if cls is not None else 0
+
+    def _preempt_one(self, sess, slot_map: dict, preempted: list) -> bool:
+        """Preempt ONE batch-class resident row at this chunk boundary to
+        make room for queued interactive work: snapshot its KV pages +
+        sampler chain with the failover export machinery, free its slot,
+        and park the SAME slot (queue and all — its SSE stream just pauses)
+        for bit-identical re-admission via admit_from_export once pressure
+        drops. A faulted/failed export leaves the row decoding untouched —
+        preemption must never tear a healthy stream."""
+        mid_prefill = set(sess.pending_prefills)
+        victims = [b for b, s in slot_map.items()
+                   if s.slo_class == "batch" and s.kind != "prefill"
+                   and b not in mid_prefill  # a half-built cache has no
+                   #  resumable snapshot — it waits out its prefill
+                   and not sess.is_done(b)
+                   and s.lifecycle_error() is None]
+        if not victims:
+            return False
+        b = victims[-1]  # youngest batch row: least decode work discarded
+        s = slot_map[b]
+        try:
+            faults.fire("preempt")
+            snap = sess.export_row(b, fire_fault=False)
+        except faults.FaultInjected:
+            self._m_preemptions.inc(outcome="injected")
+            return False
+        except Exception:  # noqa: BLE001 — mid-prefill/unexportable row
+            self._m_preemptions.inc(outcome="error")
+            return False
+        self._m_preemptions.inc(outcome="ok")
+        self.state.flight.record(
+            "preempt", request_id=(s.trace.request_id
+                                   if s.trace is not None else None),
+            emitted=int(snap.get("emitted", 0)))
+        sess.release(b)
+        del slot_map[b]
+        s.kind = "import"
+        s.snap = snap
+        s.preempted = True
+        preempted.append(s)
+        return True
 
     def _serve_solo(self, s) -> None:
         """A batch of ONE delegates to the solo engine path, WITH prefix-
@@ -534,6 +651,11 @@ class Batcher:
         stop_ids = st.stop_token_ids()
         waiting = list(batch)
         slot_map: dict = {}  # session slot handle -> _Slot
+        #: batch-class rows exported out of the pool to make room for
+        #: interactive work; re-admitted (bit-identically) once no
+        #: interactive request is waiting. Scheduler-thread-local, like
+        #: ``waiting`` — readiness reads the _publish_class_stats snapshot.
+        preempted: list = []
         sess = None
         try:
             sess = self._keep_sess
@@ -550,13 +672,24 @@ class Batcher:
                         self._keep_sess = sess
             with self._lock:
                 self._active_sess = sess
-            while waiting or slot_map:
+            while waiting or slot_map or preempted:
                 # lifecycle reap, BETWEEN chunks: a cancelled (client gone)
                 # or deadline-expired row is released NOW — its slab goes to
                 # the next waiter this very loop pass — and dead waiters
                 # never occupy a slot at all (a mid-prefill row's half-built
-                # cache is dropped the same way)
+                # cache is dropped the same way). Parked preempted rows reap
+                # identically: a batch client that gave up while parked
+                # resolves here instead of being pointlessly re-admitted.
                 waiting = [s for s in waiting if not self._reap_slot(s)]
+                preempted = [s for s in preempted if not self._reap_slot(s)]
+                # pressure dropped (no interactive work queued): move every
+                # parked batch row back to the FRONT of the line — resumed
+                # work outranks new batch arrivals (it already paid for its
+                # decoded prefix once)
+                if preempted and not any(s.slo_class == "interactive"
+                                         for s in waiting):
+                    waiting = preempted + waiting
+                    preempted = []
                 for b in list(slot_map):
                     s = slot_map[b]
                     err = s.lifecycle_error()
@@ -568,30 +701,75 @@ class Batcher:
                 # paged sessions get the actual tokens so admission counts
                 # the radix prefix match (a warm prompt needs fewer pages)
                 while waiting:
-                    if waiting[0].kind == "import":
+                    # per-class lanes: interactive admits first (FIFO
+                    # within a lane); a batch waiter additionally honors
+                    # its lane's max_resident cap. Import jobs (disagg
+                    # migrations, preempted resumes) skip the cap — a
+                    # migration refused residency would fail the transfer.
+                    resident: dict = {}
+                    for sl in slot_map.values():
+                        resident[sl.slo_class] = \
+                            resident.get(sl.slo_class, 0) + 1
+                    pick = None
+                    for lane in SLO_CLASSES:
+                        cap = self._class_resident_cap(lane)
+                        for i, w in enumerate(waiting):
+                            if w.slo_class != lane:
+                                continue
+                            if (w.kind != "import" and cap
+                                    and resident.get(lane, 0) >= cap):
+                                break  # lane at its residency cap (FIFO
+                                #        holds: no later same-lane waiter
+                                #        may jump the capped head)
+                            pick = i
+                            break
+                        if pick is not None:
+                            break
+                    if pick is None:
+                        break  # every lane capped out this tick
+                    s = waiting[pick]
+                    if s.kind == "import":
                         # migrated row arriving: admit it warm from its
                         # export snapshot NOW — no can_admit wait (a full
                         # pool must fail fast so the router can fall back
-                        # to re-prefilling, not queue behind cold prompts)
-                        s = waiting.pop(0)
-                        s.mark_start("import")
-                        self._m_path.inc(path="import")
+                        # to re-prefilling, not queue behind cold prompts).
+                        # A preempted row coming back rides the same path,
+                        # but a failed RE-admission re-parks it (retry next
+                        # tick) instead of failing the client.
+                        waiting.pop(pick)
+                        resumed = s.preempted
+                        if not resumed:
+                            s.mark_start("import")
+                            self._m_path.inc(path="import")
                         try:
                             b = sess.admit_from_export(s.prompt, s.snap)
                         except Exception as e:  # noqa: BLE001 — this row
+                            if resumed:
+                                self._m_preemptions.inc(outcome="retry")
+                                preempted.append(s)
+                                break  # no room this tick; decode on
                             self.state._m_kv_imports.inc(outcome="error")
                             self._fail([s], e)
                             continue
-                        self.state._m_kv_imports.inc(outcome="ok")
+                        if resumed:
+                            s.preempted = False
+                            self._m_preemptions.inc(outcome="resumed")
+                        else:
+                            self.state._m_kv_imports.inc(outcome="ok")
+                            s.tokens = []
                         s.snap = None  # free the page payloads now
-                        s.tokens = []
                         slot_map[b] = s
                         continue
-                    if not sess.can_admit(len(waiting[0].prompt),
-                                          waiting[0].steps,
-                                          waiting[0].prompt):
+                    if not sess.can_admit(len(s.prompt), s.steps, s.prompt):
+                        # pool full for the highest-priority waiter: an
+                        # interactive one reclaims batch residency at this
+                        # very chunk boundary and retries immediately
+                        if (s.slo_class == "interactive"
+                                and self._preempt_one(sess, slot_map,
+                                                      preempted)):
+                            continue
                         break
-                    s = waiting.pop(0)
+                    waiting.pop(pick)
                     path = ("prefill" if s.kind == "prefill"
                             else "continuous")
                     s.mark_start(path)
@@ -631,6 +809,7 @@ class Batcher:
                             s.mark_prefill_chunk(t_pf, time.monotonic())
                             if finished:
                                 s.mark_prefill(sess.prefill_ms_of(b))
+                self._publish_class_stats(waiting, slot_map, preempted)
                 if slot_map:
                     self._m_occupancy.observe(float(len(slot_map)))
                     # the black box keeps the in-flight request ids per
@@ -713,12 +892,13 @@ class Batcher:
                     except queue_mod.Empty:
                         break
         except Exception as e:  # noqa: BLE001 — every waiter gets a 500
-            self._fail(list(slot_map.values()) + waiting, e)
+            self._fail(list(slot_map.values()) + waiting + preempted, e)
             # a session that threw mid-window is suspect: never keep it
             if sess is not None and sess is self._keep_sess:
                 with self._lock:
                     self._keep_sess = None
         finally:
+            self._publish_class_stats([], {}, [])
             with self._lock:
                 self._active_sess = None
             if sess is not None and sess is not self._keep_sess:
@@ -764,8 +944,12 @@ class Batcher:
                 t_win = time.monotonic()
                 # disaggregation jobs (prefill-export / import-admit) and
                 # checkpointing streams exist only in the paged slot pool:
-                # they never route solo or spec
+                # they never route solo or spec. Batch-class rows route
+                # continuous too — solo/spec run-to-completion would make
+                # them unpreemptible, and preemptibility is the lane's
+                # contract
                 plain = all(s.kind == "completion" and not s.ckpt_every
+                            and s.slo_class == "interactive"
                             for s in window)
                 with self.state.lock:  # the engine serves one pool at a time
                     if plain and len(window) == 1 and self._arrivals.empty():
@@ -841,13 +1025,14 @@ class Batcher:
 
     def submit(self, prompt_tokens: list, max_tokens: int,
                sampler: SamplerConfig, deadline: Deadline = None,
-               cancel: CancelToken = None, trace=None) -> list:
+               cancel: CancelToken = None, trace=None,
+               slo_class: str = "interactive") -> list:
         """Blocks until this request's tokens are decoded (by the scheduler
         thread's pool). Thread-safe; raises the decode's failure as
         RuntimeError (typed LifecycleError for deadline/cancel/crash)."""
         slot = self._Slot(list(prompt_tokens), max_tokens, sampler,
                           streaming=False, deadline=deadline, cancel=cancel,
-                          trace=trace)
+                          trace=trace, slo_class=slo_class)
         self._enqueue(slot)
         self._wait_resolution(slot)
         if slot.error is not None:
@@ -857,7 +1042,7 @@ class Batcher:
     def submit_stream(self, prompt_tokens: list, max_tokens: int,
                       sampler: SamplerConfig, deadline: Deadline = None,
                       cancel: CancelToken = None, trace=None,
-                      ckpt_every: int = 0):
+                      ckpt_every: int = 0, slo_class: str = "interactive"):
         """Yields bursts (lists) of token ids as the pool decodes — from
         admission, not from batch completion. Raises the decode failure as
         RuntimeError. A set ``cancel`` token ends the generator (the
@@ -867,7 +1052,8 @@ class Batcher:
         writer serializes them into checkpoint frames for the router."""
         slot = self._Slot(list(prompt_tokens), max_tokens, sampler,
                           streaming=True, deadline=deadline, cancel=cancel,
-                          trace=trace, ckpt_every=ckpt_every)
+                          trace=trace, ckpt_every=ckpt_every,
+                          slo_class=slo_class)
         self._enqueue(slot)
         return self._drain_stream(slot, cancel)
 
@@ -967,7 +1153,8 @@ class ServerState:
                  request_timeout: float = 0.0, queue_depth: int = 64,
                  metrics=None, log_json: bool = False,
                  log_prompts: bool = False, log_stream=None, flight=None,
-                 role: str = "both", ckpt_interval: int = 32):
+                 role: str = "both", ckpt_interval: int = 32,
+                 slo_classes=None):
         """``default_seed``: seed for requests that send none — None means a
         fresh time-based seed per request (the launch-flag --seed plumbs in
         here so an operator can make the whole server reproducible).
@@ -1011,7 +1198,11 @@ class ServerState:
         ``X-Dllama-Ckpt`` header without naming their own K; 0 disables
         even opted-in checkpointing. A stream never checkpoints unless
         the request asks — direct (router-less) clients never see
-        checkpoint control frames."""
+        checkpoint control frames.
+        ``slo_classes``: per-class admission policy (--slo-classes) — a
+        {name: lifecycle.SLOClass} dict or the raw spec string (see
+        lifecycle.parse_slo_classes). Defaults leave every lane bounded
+        only by ``queue_depth``, i.e. exactly the single-class behavior."""
         self.engine = engine
         self.tokenizer = tokenizer
         self.cfg = cfg
@@ -1030,10 +1221,17 @@ class ServerState:
         #: KV cache holds this many full-context caches
         self.batch_max = max(1, batch_max)
         self.request_timeout = max(0.0, request_timeout or 0.0)
+        #: per-class admission policy: parsed --slo-classes (dict form
+        #: accepted so in-process tests can hand SLOClass objects straight
+        #: in; every class in lifecycle.SLO_CLASSES has an entry)
+        self.slo_classes = (parse_slo_classes(slo_classes)
+                            if isinstance(slo_classes, str) or slo_classes
+                            is None else dict(slo_classes))
         #: bounded admission: EVERY completion request (solo or batched)
         #: acquires before doing work, so backpressure is a fast 429 at the
-        #: door rather than an unbounded pile of blocked HTTP threads
-        self.gate = AdmissionGate(queue_depth)
+        #: door rather than an unbounded pile of blocked HTTP threads —
+        #: lane-scoped (429s carry class-aware Retry-After) under classes
+        self.gate = AdmissionGate(queue_depth, classes=self.slo_classes)
         self.lock = threading.Lock()  # engine serves one request at a time
         # -- observability: server-layer series (HTTP + per-request latency).
         # Registered BEFORE the batcher so its scheduler-layer handles share
@@ -1072,6 +1270,17 @@ class ServerState:
         self._m_queue_wait = reg.histogram(
             "dllama_queue_wait_ms",
             "Arrival-to-scheduling wait (admission + batching window)")
+        # per-SLO-class latency series: the workload harness's per-class
+        # SLO gates (and `cli top`'s lane view) read these off the
+        # federated /metrics/fleet
+        self._m_class_ttft = reg.histogram(
+            "dllama_class_ttft_ms",
+            "Time to first token (from request arrival), by SLO class",
+            ("slo_class",))
+        self._m_class_tpot = reg.histogram(
+            "dllama_class_tpot_ms",
+            "Mean time per output token after the first, by SLO class",
+            ("slo_class",))
         self._m_tokens_in = reg.counter(
             "dllama_prompt_tokens_total", "Prompt tokens accepted")
         self._m_tokens_out = reg.counter(
@@ -1153,7 +1362,7 @@ class ServerState:
                     chunk=batch_chunk, prefill_chunk=prefill_chunk,
                     kv_buckets=bool(kv_buckets),
                     kv_bucket_min=kv_bucket_min,
-                    kv_pages=kv_pages)
+                    kv_pages=kv_pages, slo_classes=self.slo_classes)
             if batch_window_ms > 0 else None
         )
         # prefix cache: KV state + token history of recent completions, LRU.
@@ -1313,8 +1522,31 @@ class ServerState:
                                            False) else "off"),
             "tp_overlap_reason": getattr(self.engine, "tp_overlap_reason",
                                          "not requested"),
+            # per-SLO-class lane picture: gate in-flight depth + the
+            # scheduler's waiting/resident/preempted counts. The router's
+            # class-aware scoring penalizes a replica by ITS lane's
+            # pressure, not the aggregate
+            "classes": self._class_readiness(),
             **kv,
         }
+
+    def _class_readiness(self) -> dict:
+        """{class: {inflight, capacity, waiting, resident, preempted}} —
+        the per-lane slice of the readiness payload."""
+        depths = self.gate.class_depths()
+        stats = (self.batcher.class_stats() if self.batcher is not None
+                 else {})
+        out = {}
+        for name in self.slo_classes:
+            lane = stats.get(name, {})
+            out[name] = {
+                "inflight": depths.get(name, 0),
+                "capacity": self.gate.class_capacity(name),
+                "waiting": lane.get("waiting", 0),
+                "resident": lane.get("resident", 0),
+                "preempted": lane.get("preempted", 0),
+            }
+        return out
 
     def finish_request(self, trace: RequestTrace) -> None:
         """Per-request telemetry sink, called once per completion request
@@ -1324,10 +1556,13 @@ class ServerState:
         never reaches the log unless --log-prompts: the record carries
         token counts and a sha256 digest instead."""
         path = trace.path or "none"
+        slo_class = trace.slo_class or "interactive"
         if trace.ttft_ms is not None:
             self._m_ttft.observe(trace.ttft_ms, path=path)
+            self._m_class_ttft.observe(trace.ttft_ms, slo_class=slo_class)
         if trace.tpot_ms is not None:
             self._m_tpot.observe(trace.tpot_ms, path=path)
+            self._m_class_tpot.observe(trace.tpot_ms, slo_class=slo_class)
         if trace.queue_wait_ms is not None:
             self._m_queue_wait.observe(trace.queue_wait_ms)
         if trace.tokens_in:
@@ -1438,6 +1673,15 @@ class OpenAIHandler(BaseHTTPRequestHandler):
         if wire not in kv_transfer.WIRE_MODES:
             wire = "f32"
         return max(0, k), wire
+
+    def _start_deadline(self) -> "Deadline":
+        """Effective wall-clock budget for this request: the class lane's
+        configured deadline when one is set (the SLO the lane promised its
+        clients), else the server-wide --request-timeout."""
+        st = self.state
+        lane = st.gate.deadline_for(getattr(self, "_slo_class",
+                                            "interactive"))
+        return Deadline.start(lane or st.request_timeout)
 
     def _count(self, code: int) -> None:
         self.state._m_http.inc(route=self._route(), code=str(code))
@@ -1593,12 +1837,24 @@ class OpenAIHandler(BaseHTTPRequestHandler):
         trace = self._trace = RequestTrace(self._rid,
                                            parent_span=self._parent_span)
         trace.model = self.state.model_name
+        # SLO lane: X-Dllama-Class names the request's class. An UNKNOWN
+        # class is a 400, never a silent default — a typo'd "bulk" job
+        # must not land in (and blow) the interactive lane
+        slo_class = (self.headers.get("X-Dllama-Class")
+                     or "interactive").strip().lower()
+        if slo_class not in SLO_CLASSES:
+            self._error(400, f"unknown SLO class {slo_class!r} "
+                             f"(known: {', '.join(SLO_CLASSES)})")
+            return
+        trace.slo_class = self._slo_class = slo_class
         # bounded admission at the door: gate capacity covers EVERY in-
         # flight completion (solo and batched alike), so overflow is an
         # immediate 429 + Retry-After and a draining server answers 503
-        # instead of stranding requests behind a closing engine
+        # instead of stranding requests behind a closing engine. Lane-
+        # scoped: a saturated batch lane 429s its own clients (with ITS
+        # Retry-After) while interactive admission continues
         try:
-            admitted_at = self.state.gate.acquire()
+            admitted_at = self.state.gate.acquire(slo_class)
         except LifecycleError as e:
             self._lifecycle_error(e)
             trace.finish_reason = "rejected"
@@ -1606,7 +1862,8 @@ class OpenAIHandler(BaseHTTPRequestHandler):
             return
         trace.admission_depth = self.state.gate.depth
         self.state.flight.record("request_start", request_id=self._rid,
-                                 depth=trace.admission_depth)
+                                 depth=trace.admission_depth,
+                                 slo_class=slo_class)
         try:
             handle(req, trace)
         except LifecycleError as e:
@@ -1621,7 +1878,7 @@ class OpenAIHandler(BaseHTTPRequestHandler):
             # ConnectionReset); per-request isolation like the reference's
             # per-request catch (`dllama-api.cpp:347-351`)
         finally:
-            self.state.gate.release(admitted_at)
+            self.state.gate.release(admitted_at, slo_class)
             self.state.finish_request(trace)
 
     def _stream_batched(self, base: dict, sampler: SamplerConfig,
@@ -1745,7 +2002,9 @@ class OpenAIHandler(BaseHTTPRequestHandler):
                       else st.batcher.submit_stream(
                           prompt_tokens, max_tokens, sampler,
                           deadline=deadline, cancel=cancel, trace=trace,
-                          ckpt_every=ckpt_every))
+                          ckpt_every=ckpt_every,
+                          slo_class=getattr(self, "_slo_class",
+                                            "interactive")))
             if carried:
                 bursts = itertools.chain([list(carried)], bursts)
             for burst in bursts:
@@ -1867,8 +2126,9 @@ class OpenAIHandler(BaseHTTPRequestHandler):
             return
         max_tokens = room if max_tokens is None else min(max_tokens, room)
         # wall-clock budget counted from HERE (admission), not from first
-        # token: queue time burns budget too, by design
-        deadline = Deadline.start(st.request_timeout)
+        # token: queue time burns budget too, by design. Class-scoped: a
+        # lane's configured deadline outranks the global --request-timeout
+        deadline = self._start_deadline()
 
         cid = _completion_id()
         created = int(time.time())
@@ -1968,7 +2228,10 @@ class OpenAIHandler(BaseHTTPRequestHandler):
             else:
                 try:
                     row = st.batcher.submit(prompt_tokens, max_tokens, sampler,
-                                            deadline=deadline, trace=trace)
+                                            deadline=deadline, trace=trace,
+                                            slo_class=getattr(
+                                                self, "_slo_class",
+                                                "interactive"))
                 except LifecycleError:
                     raise  # do_POST speaks its status (504/503) — must
                     # outrank the RuntimeError catch below (LifecycleError
@@ -2223,7 +2486,7 @@ class OpenAIHandler(BaseHTTPRequestHandler):
                              f"exceeds the {st.cfg.seq_len}-token context")
             return
         max_tokens = room if max_tokens is None else min(max_tokens, room)
-        deadline = Deadline.start(st.request_timeout)
+        deadline = self._start_deadline()
         base = {"id": _completion_id(), "object": "chat.completion",
                 "created": int(time.time()), "model": st.model_name}
         try:
@@ -2294,7 +2557,7 @@ class OpenAIHandler(BaseHTTPRequestHandler):
         stop_state = snap.get("stop_state")
         detector = (StopDetector.from_state(stop_state)
                     if stop_state else None)
-        deadline = Deadline.start(st.request_timeout)
+        deadline = self._start_deadline()
         base = {"id": _completion_id(), "object": "chat.completion",
                 "created": int(time.time()), "model": st.model_name}
         if stream:
@@ -2385,7 +2648,7 @@ class OpenAIHandler(BaseHTTPRequestHandler):
         # the resumed stream keeps checkpointing at the router's cadence:
         # a SECOND death mid-resume is just another resume
         ckpt_every, ckpt_wire = self._ckpt_request()
-        deadline = Deadline.start(st.request_timeout)
+        deadline = self._start_deadline()
         sampler = SamplerConfig(temperature=float(snap["temp"]),
                                 topp=float(snap["topp"]), seed=0)
         cancel = CancelToken()
@@ -2471,6 +2734,7 @@ def serve(args) -> None:
         log_prompts=getattr(args, "log_prompts", False),
         role=getattr(args, "role", "both") or "both",
         ckpt_interval=getattr(args, "ckpt_interval", 32),
+        slo_classes=getattr(args, "slo_classes", None),
     )
     srv = create_server(state, host=args.host, port=args.port)
     # label this pid's track group in a merged fleet trace (no-op when

@@ -265,6 +265,11 @@ def run_fleet(args) -> None:
         replica_args = (["--ckpt-interval",
                          str(getattr(args, "ckpt_interval", 32))]
                         + replica_args)
+    # --slo-classes rides every replica's argv the same way: one fleet
+    # flag configures every lane, --replica-arg still overrides
+    slo_spec = getattr(args, "slo_classes", None)
+    if slo_spec and "--slo-classes" not in replica_args:
+        replica_args = ["--slo-classes", slo_spec] + replica_args
     # --prefill N --decode M carve the first N+M replicas into dedicated
     # disaggregation roles (the rest stay "both"); the router migrates
     # only when it can see at least one routable replica of EACH

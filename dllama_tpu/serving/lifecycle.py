@@ -52,6 +52,14 @@ _M_DEADLINES = _REG.counter(
 _M_INFLIGHT = _REG.gauge(
     "dllama_inflight_requests",
     "Requests currently admitted past the gate")
+_M_CLASS_INFLIGHT = _REG.gauge(
+    "dllama_class_inflight",
+    "Requests currently admitted past the gate, by SLO class",
+    ("slo_class",))
+_M_CLASS_REJECTIONS = _REG.counter(
+    "dllama_class_rejections_total",
+    "Requests rejected at the admission gate, by SLO class and reason",
+    ("slo_class", "reason"))
 _M_KV_RESERVED = _REG.gauge(
     "dllama_kv_tokens_reserved",
     "KV token-slots reserved against the session's modeled HBM budget")
@@ -83,15 +91,24 @@ class LifecycleError(RuntimeError):
 
 
 class QueueFull(LifecycleError):
-    """Admission rejected: the bounded queue is at capacity (HTTP 429)."""
+    """Admission rejected: the bounded queue is at capacity (HTTP 429).
+
+    With SLO classes the rejection is lane-scoped: ``slo_class`` names the
+    lane that overflowed and ``retry_after_s`` is computed from THAT lane's
+    service-time EWMA and depth, so a saturated batch lane tells its clients
+    to back off for minutes while interactive clients keep sub-second
+    retry hints."""
 
     http_status = 429
 
-    def __init__(self, depth: int, capacity: int, retry_after_s: float):
+    def __init__(self, depth: int, capacity: int, retry_after_s: float,
+                 slo_class: str = None):
+        lane = f" in the {slo_class!r} lane" if slo_class else ""
         super().__init__(
-            f"server at capacity ({depth}/{capacity} requests in flight); "
-            "retry later")
+            f"server at capacity ({depth}/{capacity} requests in flight"
+            f"{lane}); retry later")
         self.retry_after_s = retry_after_s
+        self.slo_class = slo_class
 
 
 class ServerDraining(LifecycleError):
@@ -186,7 +203,76 @@ class CancelToken:
         return RequestCancelled(self.reason or "cancelled")
 
 
-@guarded_by("_lock", "_inflight", "_draining", "_service_ewma_s")
+#: the SLO classes the server speaks. A request names its lane with
+#: ``X-Dllama-Class``; anything else is a 400, never silently defaulted.
+SLO_CLASSES = ("interactive", "batch")
+
+
+class SLOClass:
+    """Per-lane admission policy: queue depth, deadline, residency cap.
+
+    ``depth`` bounds how many requests of this class may be in flight at
+    once (<=0: inherit the gate's total capacity). ``deadline_s`` is the
+    class's default wall-clock budget when the server has no global
+    ``--request-timeout`` (<=0: none). ``max_resident`` caps how many
+    decode-pool rows the class may hold resident at once (<=0: unbounded);
+    the batcher enforces it at admission and it is what makes a batch lane
+    *preemptible* — rows beyond interactive's needs are reclaimable.
+    """
+
+    __slots__ = ("name", "depth", "deadline_s", "max_resident")
+
+    def __init__(self, name: str, depth: int = 0, deadline_s: float = 0.0,
+                 max_resident: int = 0):
+        self.name = name
+        self.depth = int(depth)
+        self.deadline_s = float(deadline_s)
+        self.max_resident = int(max_resident)
+
+    def to_dict(self) -> dict:
+        return {"depth": self.depth, "deadline_s": self.deadline_s,
+                "max_resident": self.max_resident}
+
+
+def parse_slo_classes(spec: str) -> dict:
+    """Parse ``--slo-classes`` into {class_name: SLOClass}.
+
+    Grammar (classes separated by ``;``)::
+
+        interactive:depth=48,deadline=30;batch:depth=16,resident=2
+
+    Every class in :data:`SLO_CLASSES` gets an entry (unnamed classes get
+    defaults), so callers never KeyError on a valid class name."""
+    classes = {name: SLOClass(name) for name in SLO_CLASSES}
+    for part in (spec or "").split(";"):
+        part = part.strip()
+        if not part:
+            continue
+        name, _, rest = part.partition(":")
+        name = name.strip()
+        if name not in SLO_CLASSES:
+            raise ValueError(
+                f"unknown SLO class {name!r} (known: {SLO_CLASSES})")
+        cls = classes[name]
+        for kv in filter(None, (s.strip() for s in rest.split(","))):
+            if "=" not in kv:
+                raise ValueError(f"bad SLO option {kv!r} in {part!r}")
+            k, v = (s.strip() for s in kv.split("=", 1))
+            if k == "depth":
+                cls.depth = int(v)
+            elif k == "deadline":
+                cls.deadline_s = float(v)
+            elif k == "resident":
+                cls.max_resident = int(v)
+            else:
+                raise ValueError(
+                    f"unknown SLO option {k!r} (want depth/deadline/"
+                    "resident)")
+    return classes
+
+
+@guarded_by("_lock", "_inflight", "_draining", "_service_ewma_s",
+            "_class_inflight", "_class_ewma_s")
 class AdmissionGate:
     """Bounded in-flight request counter with drain support.
 
@@ -195,15 +281,25 @@ class AdmissionGate:
     the whole point: backpressure is a fast typed rejection the client can
     act on, not an invisible queue. ``retry_after`` scales with how loaded
     the gate is, seeded by an EWMA of recent request service times.
+
+    With ``classes`` (see :func:`parse_slo_classes`) the gate keeps one
+    bounded lane per SLO class on top of the total capacity: a class whose
+    lane is full 429s with a *class-scoped* Retry-After (that lane's EWMA x
+    that lane's depth) even while the other lane still admits. The bare
+    ``acquire()``/``release()`` calls keep their pre-class behavior (they
+    ride the "interactive" lane), so single-class callers are untouched.
     """
 
-    def __init__(self, capacity: int, flight=None):
+    def __init__(self, capacity: int, flight=None, classes: dict = None):
         self.capacity = max(1, capacity)
+        self.classes = classes if classes is not None else parse_slo_classes("")
         self._lock = threading.Lock()
         self._idle = threading.Condition(self._lock)
         self._inflight = 0
         self._draining = False
         self._service_ewma_s = 1.0  # optimistic prior; updated per release
+        self._class_inflight = {name: 0 for name in self.classes}
+        self._class_ewma_s = {name: 1.0 for name in self.classes}
         # set-once black box (observability.FlightRecorder); every admission
         # decision lands in its ring so a crash dump shows what the gate was
         # doing in the final seconds
@@ -214,41 +310,87 @@ class AdmissionGate:
     def depth(self) -> int:
         return self._inflight
 
+    def class_depths(self) -> dict:
+        """{class: in-flight count} — the readiness probe's lane view."""
+        with self._lock:
+            return dict(self._class_inflight)
+
+    def class_capacity(self, slo_class: str) -> int:
+        """The lane's effective bound: its configured depth, else the
+        gate's total capacity."""
+        cls = self.classes.get(slo_class)
+        return cls.depth if cls is not None and cls.depth > 0 \
+            else self.capacity
+
+    def deadline_for(self, slo_class: str) -> float:
+        """The lane's default wall-clock budget (0.0: none configured)."""
+        cls = self.classes.get(slo_class)
+        return cls.deadline_s if cls is not None else 0.0
+
     @property
     def draining(self) -> bool:
         return self._draining
 
-    def retry_after_s(self) -> float:
+    def retry_after_s(self, slo_class: str = None) -> float:
         """Seconds a 429'd client should wait: one EWMA service time per
-        queued request ahead of it, floored at 1s so clients never busy-spin."""
+        queued request ahead of it, floored at 1s so clients never busy-spin.
+        Class-scoped when ``slo_class`` names a lane — a saturated batch
+        lane's backoff grows with *batch* service times, not the fleet's."""
+        if slo_class in self._class_ewma_s:
+            return max(1.0, self._class_ewma_s[slo_class]
+                       * self._class_inflight[slo_class])
         return max(1.0, self._service_ewma_s * self._inflight)
 
-    def acquire(self) -> float:
-        """Admit one request; returns its admit timestamp (pass back to
-        ``release`` for the service-time EWMA)."""
+    def acquire(self, slo_class: str = "interactive") -> float:
+        """Admit one request into its class lane; returns its admit
+        timestamp (pass back to ``release`` for the service-time EWMA)."""
         with self._lock:
             if self._draining:
                 _M_REJECTIONS.inc(reason="draining")
+                _M_CLASS_REJECTIONS.inc(slo_class=slo_class,
+                                        reason="draining")
                 self._flight.record("reject", reason="draining")
                 raise ServerDraining()
-            if self._inflight >= self.capacity:
+            lane_cap = self.class_capacity(slo_class)
+            lane_depth = self._class_inflight.get(slo_class, 0)
+            if self._inflight >= self.capacity or lane_depth >= lane_cap:
                 _M_REJECTIONS.inc(reason="queue_full")
+                _M_CLASS_REJECTIONS.inc(slo_class=slo_class,
+                                        reason="queue_full")
                 self._flight.record("reject", reason="queue_full",
-                                    depth=self._inflight)
+                                    depth=self._inflight,
+                                    slo_class=slo_class)
+                if lane_depth >= lane_cap:
+                    raise QueueFull(lane_depth, lane_cap,
+                                    self.retry_after_s(slo_class), slo_class)
                 raise QueueFull(self._inflight, self.capacity,
                                 self.retry_after_s())
             self._inflight += 1
+            if slo_class in self._class_inflight:
+                self._class_inflight[slo_class] += 1
+                _M_CLASS_INFLIGHT.set(self._class_inflight[slo_class],
+                                      slo_class=slo_class)
             _M_INFLIGHT.set(self._inflight)
-            self._flight.record("admit", depth=self._inflight)
+            self._flight.record("admit", depth=self._inflight,
+                                slo_class=slo_class)
             return time.monotonic()
 
-    def release(self, admitted_at: float = None) -> None:
+    def release(self, admitted_at: float = None,
+                slo_class: str = "interactive") -> None:
         with self._lock:
             self._inflight = max(0, self._inflight - 1)
             _M_INFLIGHT.set(self._inflight)
+            if slo_class in self._class_inflight:
+                self._class_inflight[slo_class] = max(
+                    0, self._class_inflight[slo_class] - 1)
+                _M_CLASS_INFLIGHT.set(self._class_inflight[slo_class],
+                                      slo_class=slo_class)
             if admitted_at is not None:
                 dt = max(0.0, time.monotonic() - admitted_at)
                 self._service_ewma_s += 0.2 * (dt - self._service_ewma_s)
+                if slo_class in self._class_ewma_s:
+                    self._class_ewma_s[slo_class] += 0.2 * (
+                        dt - self._class_ewma_s[slo_class])
             if self._inflight == 0:
                 self._idle.notify_all()
 

@@ -127,6 +127,12 @@ W_OCCUPANCY = 1.0
 W_QUEUE = 2.0
 W_KV = 0.5
 W_INFLIGHT = 0.25
+#: lane-pressure weight: when the request carries an SLO class, a replica
+#: whose matching lane is backed up (lane inflight + lane queue vs the
+#: lane's capacity, from /ready's per-class view) scores worse — an
+#: interactive turn steers away from the replica drowning in interactive
+#: work even when its TOTAL load ties with a sibling's
+W_CLASS = 1.5
 
 
 class NoReplicaAvailable(LifecycleError):
@@ -181,10 +187,17 @@ def prefix_hashes(messages: list, block: int) -> list:
     return out
 
 
-def load_score(snap: dict, stale: bool = False) -> float:
+def load_score(snap: dict, stale: bool = False,
+               slo_class: str = None) -> float:
     """Weighted least-load score for one replica snapshot (lower = better).
     Every term is normalized by the replica's slot count so heterogeneous
     fleets (different --batch-max) compare fairly.
+
+    ``slo_class`` adds the matching lane's pressure (its inflight + queued
+    count over its capacity, from the replica's per-class /ready view) so
+    classed traffic spreads by LANE load, not just total load. Replicas
+    predating the per-class view contribute no lane term — mixed fleets
+    keep comparing on the shared terms.
 
     ``stale`` means the probe snapshot is too old to trust (older than
     twice the probe interval — the probe loop is wedged or the replica is
@@ -200,8 +213,14 @@ def load_score(snap: dict, stale: bool = False) -> float:
     queue = load.get("queue_depth", 0) / total
     kv_total = load.get("kv_pages_total", 0)
     kv = (1.0 - load.get("kv_pages_free", 0) / kv_total) if kv_total else 0.0
+    lane = 0.0
+    if slo_class:
+        cls = (load.get("classes") or {}).get(slo_class)
+        if cls:
+            cap = cls.get("capacity", 0) or 1
+            lane = (cls.get("inflight", 0) + cls.get("waiting", 0)) / cap
     return (W_OCCUPANCY * occ + W_QUEUE * queue + W_KV * kv
-            + W_INFLIGHT * inflight)
+            + W_INFLIGHT * inflight + W_CLASS * lane)
 
 
 def saturated(snap: dict) -> bool:
@@ -601,13 +620,16 @@ class RouterState:
 
     # -- routing ----------------------------------------------------------
 
-    def pick(self, hashes: list, exclude=frozenset(), role: str = None):
+    def pick(self, hashes: list, exclude=frozenset(), role: str = None,
+             slo_class: str = None):
         """Choose the replica for one dispatch attempt: (replica, reason).
 
         Fires the ``route_pick`` seam (an injected fault here surfaces as
         a 5xx the ingress counter sees). Affinity wins when its target is
         routable and unsaturated; otherwise weighted least-load over every
-        routable replica not already tried this request.
+        routable replica not already tried this request, with the
+        request's SLO-class lane pressure folded into the score
+        (``slo_class`` — see :func:`load_score`).
 
         ``role`` narrows the candidate set to replicas that DECLARED that
         disaggregation role (the migration hops). Normal picks
@@ -658,7 +680,8 @@ class RouterState:
                    key=lambda rs: load_score(
                        rs[1],
                        stale=(rs[1]["probed_age_s"] is not None
-                              and rs[1]["probed_age_s"] > stale_after_s)))
+                              and rs[1]["probed_age_s"] > stale_after_s),
+                       slo_class=slo_class))
         self._m_picks.inc(reason=reason)
         return r, reason
 
@@ -988,7 +1011,9 @@ class RouterHandler(BaseHTTPRequestHandler):
                 #       still proceeds (the replica owns the 400)
         if isinstance(req, dict) and self._try_disagg(req, hashes):
             return  # migrated (or finished at the prefill replica)
-        self._proxy("POST", body, affinity_hashes=hashes)
+        self._proxy("POST", body, affinity_hashes=hashes,
+                    slo_class=(self.headers.get("X-Dllama-Class")
+                               or "").strip().lower() or None)
 
     # -- disaggregated migration ------------------------------------------
 
@@ -1169,9 +1194,16 @@ class RouterHandler(BaseHTTPRequestHandler):
             # the checkpoint rides the same wire mode as migrations
             h["X-Dllama-Ckpt"] = str(st.ckpt_interval)
             h["X-Dllama-Ckpt-Wire"] = st.kv_wire
+        # the SLO class rides every upstream hop untouched: the REPLICA
+        # owns validation (unknown class -> its 400 passes straight
+        # through), the router only scores by it
+        cls = (self.headers.get("X-Dllama-Class") or "").strip()
+        if cls:
+            h["X-Dllama-Class"] = cls
         return h
 
-    def _proxy(self, method: str, body: bytes, affinity_hashes: list) -> None:
+    def _proxy(self, method: str, body: bytes, affinity_hashes: list,
+               slo_class: str = None) -> None:
         """Dispatch one request with failover.
 
         Retriable = the hop died before the client received anything — a
@@ -1196,7 +1228,8 @@ class RouterHandler(BaseHTTPRequestHandler):
             while True:
                 try:
                     replica, _reason = st.pick(affinity_hashes,
-                                               exclude=tried)
+                                               exclude=tried,
+                                               slo_class=slo_class)
                 except NoReplicaAvailable as e:
                     if last_503 is not None:
                         hop["status"] = last_503[0]

@@ -10,7 +10,12 @@ delta. The client must still get HTTP 200, a ``[DONE]``, no error
 event, and byte-identical assembled content — zero duplicate and zero
 missing bytes across the splice — with the router's
 ``dllama_stream_resume_total{outcome="ok"}`` counter showing exactly
-the one resume.
+the one resume. Both replicas are warmed DIRECTLY (not through the
+router, whose affinity would park every warm-up on one sibling), so the
+survivor's radix cache holds the prompt pages when the resume lands —
+and the drill GATES that ``/v1/kv/resume`` aliased them instead of
+re-prefilling: ``dllama_prefix_tokens_matched_total`` must grow on the
+surviving replica across the resume.
 
 Part 2 — the fallback matrix. Two IN-PROCESS replica servers (so
 ``DLLAMA_FAULTS``-style plans installed via :mod:`dllama_tpu.faults`
@@ -216,6 +221,16 @@ def main() -> int:
         return {o: st._m_resumes.value(outcome=o) for o in RESUME_OUTCOMES
                 if st._m_resumes.value(outcome=o)}
 
+    def prefix_matched(port: int) -> float:
+        """The replica's dllama_prefix_tokens_matched_total reading."""
+        status, data = request(port, "GET", "/metrics", timeout=10)
+        if status != 200:
+            raise RuntimeError(f"/metrics on :{port} returned {status}")
+        for line in data.decode().splitlines():
+            if line.startswith("dllama_prefix_tokens_matched_total"):
+                return float(line.rsplit(" ", 1)[1])
+        return 0.0
+
     # ---- part 1: the real fleet, a real SIGKILL ----------------------
     ports = [free_port(), free_port()]
     procs = [spawn(i, p) for i, p in enumerate(ports)]
@@ -237,14 +252,16 @@ def main() -> int:
         threading.Thread(target=rsrv.serve_forever, daemon=True).start()
         print(f"router up: :{r_port} (ckpt interval {state.ckpt_interval})")
 
-        # reference: the SAME streamed request, nobody killed. One
-        # warm-up per replica first so compile time doesn't stretch the
-        # killed stream's token cadence.
-        for w in range(2):
-            status, _ = request(r_port, "POST", "/v1/chat/completions",
-                                chat())
+        # warm each replica DIRECTLY — the router's affinity would park
+        # both warm-ups on one sibling. This compiles both programs (so
+        # compile time doesn't stretch the killed stream's token cadence)
+        # AND leaves the prompt pages warm in each replica's radix cache,
+        # so the resume leg below can gate the skipped re-prefill.
+        for p in ports:
+            status, _ = request(p, "POST", "/v1/chat/completions", chat())
             if status != 200:
-                raise RuntimeError(f"warm-up {w} returned {status}")
+                raise RuntimeError(f"warm-up on :{p} returned {status}")
+        # reference: the SAME streamed request, nobody killed
         status, data = request(r_port, "POST", "/v1/chat/completions",
                                chat())
         if status != 200:
@@ -270,11 +287,26 @@ def main() -> int:
                     return
             failures.append("no in-flight replica found to kill")
 
+        matched0 = {p: prefix_matched(p) for p in ports}
         status, data = stream_with_kill(r_port, chat(),
                                         on_first_content=kill_serving)
         got_text, got_done, got_err = sse_parts(data)
         evidence["part1_resume_counters"] = resume_counts(state)
         evidence["part1_content_len"] = len(got_text)
+        # the skipped re-prefill, GATED: /v1/kv/resume on the survivor
+        # must have aliased the warm prompt pages out of its radix cache
+        # (the warm-ups above put them there), not re-imported or
+        # re-prefilled them
+        killed = evidence.get("killed_replica", "")
+        survivors = [p for p in ports if not killed.endswith(f":{p}")]
+        if killed and len(survivors) == 1:
+            delta = prefix_matched(survivors[0]) - matched0[survivors[0]]
+            evidence["part1_prefix_tokens_matched_delta"] = delta
+            if delta <= 0:
+                failures.append(
+                    "resume re-prefilled a warm prompt: "
+                    "dllama_prefix_tokens_matched_total grew by "
+                    f"{delta:.0f} on surviving replica :{survivors[0]}")
         if status != 200:
             failures.append(f"killed stream returned {status}")
         if not got_done:

@@ -1,0 +1,393 @@
+"""Deterministic workload generator for the serving stack.
+
+Every scenario is a pure function ``(seed, knobs) -> [Req|Conv]`` built
+on a private ``random.Random(seed)``: the same seed always produces the
+same prompts, the same arrival offsets, the same class mix — so a
+regression hunt can replay the exact traffic that tripped a gate.
+Scenarios model the traffic the fleet actually has to survive:
+
+    bursty        interactive bursts arriving while long batch-class
+                  jobs saturate the batch lane (the preemption mix)
+    longctx       prompts sized near the context window
+    multiturn     conversations whose turns share a growing prefix
+                  (radix-cache reuse traffic)
+    disconnects   abusive clients that drop the socket mid-SSE
+    killburst     a pure interactive burst sized for the replica-SIGKILL
+                  drill (the kill itself is orchestrated by the caller —
+                  this module only speaks HTTP)
+
+The runner fires each request at its deterministic offset, measures
+TTFT (request start -> first content delta) and TPOT, and returns one
+result dict per request. ``BENCH_WORKLOADS`` (bench.py) wires these
+into a gated battery; standalone use replays a scenario against any
+running replica or router:
+
+    JAX_PLATFORMS=cpu python scripts/workloads.py --port 9990 \
+        --scenario bursty --seed 0 --out report.json
+
+Stdlib only — importable from CPU smoke jobs without touching jax.
+"""
+
+from __future__ import annotations
+
+import argparse
+import http.client
+import json
+import random
+import sys
+import threading
+import time
+
+#: fixed lexicon the prompt builder draws from — tokenizes to plain
+#: bytes under the synthetic test vocabs, so prompt length in tokens
+#: tracks prompt length in characters
+WORDS = ("alpha", "bravo", "cedar", "delta", "ember", "fjord", "gamma",
+         "haze", "iris", "jolt", "karst", "lumen", "mesa", "noble",
+         "onyx", "pylon", "quartz", "ridge", "sable", "tundra", "umber",
+         "vertex", "willow", "xenon", "yonder", "zephyr")
+
+
+class Req:
+    """One scheduled request: fire at ``at_s`` after the run starts."""
+
+    __slots__ = ("at_s", "name", "slo_class", "messages", "max_tokens",
+                 "stream", "disconnect")
+
+    def __init__(self, at_s, name, slo_class, messages, max_tokens,
+                 stream=True, disconnect=False):
+        self.at_s = at_s
+        self.name = name
+        self.slo_class = slo_class
+        self.messages = messages
+        self.max_tokens = max_tokens
+        self.stream = stream
+        self.disconnect = disconnect
+
+
+class Conv:
+    """A multi-turn conversation: turns run sequentially, each carrying
+    the full transcript so far (the prefix-reuse traffic shape)."""
+
+    __slots__ = ("at_s", "name", "slo_class", "user_turns", "max_tokens")
+
+    def __init__(self, at_s, name, slo_class, user_turns, max_tokens):
+        self.at_s = at_s
+        self.name = name
+        self.slo_class = slo_class
+        self.user_turns = user_turns
+        self.max_tokens = max_tokens
+
+
+def _sentence(rng: random.Random, words: int) -> str:
+    return " ".join(rng.choice(WORDS) for _ in range(words))
+
+
+# ---- scenario generators (pure: seed -> schedule) ---------------------
+
+def bursty_mix(seed=0, bursts=3, burst_size=4, gap_s=2.0, batch_jobs=2,
+               batch_tokens=320, interactive_tokens=16):
+    """Long batch-class jobs admitted first, then interactive bursts
+    landing on top — the mix the preemption gate is specified against."""
+    rng = random.Random(seed)
+    reqs = []
+    for j in range(batch_jobs):
+        reqs.append(Req(
+            0.0, f"batch-{j}", "batch",
+            [{"role": "user",
+              "content": f"[job {j}] {_sentence(rng, 8)}"}],
+            batch_tokens))
+    for b in range(bursts):
+        base = 0.5 + b * gap_s
+        for i in range(burst_size):
+            reqs.append(Req(
+                base + rng.uniform(0.0, 0.25), f"int-{b}-{i}",
+                "interactive",
+                [{"role": "user",
+                  "content": f"[{b}/{i}] {_sentence(rng, 5)}"}],
+                interactive_tokens))
+    return reqs
+
+
+def long_context(seed=0, n=3, target_chars=300, max_tokens=24,
+                 slo_class="interactive"):
+    """Prompts sized near the window: ``target_chars`` of lexicon text
+    (roughly that many tokens under the byte-level test vocabs)."""
+    rng = random.Random(seed)
+    reqs = []
+    for i in range(n):
+        parts = []
+        while sum(len(p) + 1 for p in parts) < target_chars:
+            parts.append(_sentence(rng, 6) + ".")
+        reqs.append(Req(
+            i * 0.4, f"longctx-{i}", slo_class,
+            [{"role": "user", "content": " ".join(parts)}], max_tokens))
+    return reqs
+
+
+def multi_turn(seed=0, conversations=2, turns=3, max_tokens=16,
+               slo_class="interactive"):
+    rng = random.Random(seed)
+    convs = []
+    for c in range(conversations):
+        opener = _sentence(rng, 6)
+        users = [f"[conv {c}] {opener}"] + [
+            f"then {_sentence(rng, 4)}" for _ in range(turns - 1)]
+        convs.append(Conv(c * 0.3, f"conv-{c}", slo_class, users,
+                          max_tokens))
+    return convs
+
+
+def abusive_disconnects(seed=0, n=3, max_tokens=64):
+    """Streams whose client vanishes right after the first content
+    delta — the server must reap the row, not leak it."""
+    rng = random.Random(seed)
+    return [Req(i * 0.3, f"abuser-{i}", "interactive",
+                [{"role": "user",
+                  "content": f"[drop {i}] {_sentence(rng, 5)}"}],
+                max_tokens, disconnect=True)
+            for i in range(n)]
+
+
+def kill_burst(seed=0, n=6, max_tokens=48):
+    """A pure interactive streamed burst for the SIGKILL drill: every
+    request must survive the caller killing a replica mid-burst."""
+    rng = random.Random(seed)
+    return [Req(0.15 * i, f"kill-{i}", "interactive",
+                [{"role": "user",
+                  "content": f"[kill {i}] {_sentence(rng, 5)}"}],
+                max_tokens)
+            for i in range(n)]
+
+
+SCENARIOS = {
+    "bursty": bursty_mix,
+    "longctx": long_context,
+    "multiturn": multi_turn,
+    "disconnects": abusive_disconnects,
+    "killburst": kill_burst,
+}
+
+
+# ---- the runner -------------------------------------------------------
+
+def sse_parts(data: bytes):
+    """-> (content_text, n_deltas, saw_done, error-or-None)."""
+    text, n, done, err = [], 0, False, None
+    for ev in data.split(b"\n\n"):
+        for line in ev.split(b"\n"):
+            if not line.startswith(b"data: "):
+                continue
+            payload = line[6:]
+            if payload == b"[DONE]":
+                done = True
+                continue
+            try:
+                obj = json.loads(payload)
+            except ValueError:
+                continue
+            if "error" in obj:
+                err = obj["error"].get("message")
+            for ch in obj.get("choices", []):
+                piece = (ch.get("delta") or {}).get("content")
+                if piece:
+                    text.append(piece)
+                    n += 1
+    return "".join(text), n, done, err
+
+
+def do_request(host: str, port: int, rq: Req, timeout: float = 300.0,
+               headers: dict = None) -> dict:
+    """Fire one request NOW; returns the measured result record. A
+    ``disconnect`` request closes the socket right after its first
+    content delta (``disconnected: True``) — by design a torn stream,
+    not an error."""
+    body = {"model": "workloads", "messages": rq.messages,
+            "max_tokens": rq.max_tokens, "temperature": 0.0,
+            "stream": rq.stream}
+    hdrs = {"Content-Type": "application/json",
+            "X-Dllama-Class": rq.slo_class}
+    if headers:
+        hdrs.update(headers)
+    out = {"name": rq.name, "slo_class": rq.slo_class, "status": None,
+           "ttft_ms": None, "total_ms": None, "tpot_ms": None,
+           "text": "", "done": False, "error": None,
+           "disconnected": False}
+    t0 = time.perf_counter()
+    conn = http.client.HTTPConnection(host, port, timeout=timeout)
+    try:
+        conn.request("POST", "/v1/chat/completions",
+                     json.dumps(body).encode(), headers=hdrs)
+        resp = conn.getresponse()
+        out["status"] = resp.status
+        if resp.status != 200:
+            raw = resp.read()
+            try:
+                out["error"] = json.loads(raw)["error"]["message"]
+            except (ValueError, KeyError, TypeError):
+                out["error"] = raw[:200].decode("utf-8", "replace")
+            out["retry_after"] = resp.getheader("Retry-After")
+            return out
+        if not rq.stream:
+            raw = resp.read()
+            out["total_ms"] = out["ttft_ms"] = \
+                (time.perf_counter() - t0) * 1000.0
+            try:
+                obj = json.loads(raw)
+                out["text"] = obj["choices"][0]["message"]["content"]
+                out["done"] = True
+            except (ValueError, KeyError, IndexError, TypeError) as e:
+                out["error"] = f"malformed body: {e}"
+            return out
+        buf = b""
+        while True:
+            chunk = resp.read1(65536)
+            if not chunk:
+                break
+            buf += chunk
+            if out["ttft_ms"] is None and b'"content"' in buf:
+                out["ttft_ms"] = (time.perf_counter() - t0) * 1000.0
+                if rq.disconnect:
+                    out["disconnected"] = True
+                    return out  # finally: the socket dies mid-stream
+            if buf.endswith(b"data: [DONE]\n\n"):
+                break
+        out["total_ms"] = (time.perf_counter() - t0) * 1000.0
+        text, n, done, err = sse_parts(buf)
+        out["text"], out["done"], out["error"] = text, done, err
+        if not done and err is None:
+            out["error"] = "stream ended without [DONE]"
+        if (out["ttft_ms"] is not None and n > 1
+                and out["total_ms"] is not None):
+            out["tpot_ms"] = (out["total_ms"] - out["ttft_ms"]) / (n - 1)
+        return out
+    except OSError as e:
+        out["error"] = f"transport: {e}"
+        return out
+    finally:
+        conn.close()
+
+
+def run_conversation(host: str, port: int, conv: Conv,
+                     timeout: float = 300.0) -> list:
+    """Sequential turns, each carrying the transcript so far. Stops at
+    the first failed turn."""
+    msgs, results = [], []
+    for t, user in enumerate(conv.user_turns):
+        msgs.append({"role": "user", "content": user})
+        r = do_request(host, port,
+                       Req(0.0, f"{conv.name}-t{t}", conv.slo_class,
+                           list(msgs), conv.max_tokens), timeout)
+        results.append(r)
+        if r["status"] != 200 or r["error"]:
+            break
+        msgs.append({"role": "assistant", "content": r["text"]})
+    return results
+
+
+def run_schedule(host: str, port: int, schedule: list, actions=(),
+                 timeout: float = 300.0) -> list:
+    """Replay a scenario: every Req fires at ``start + at_s`` on its own
+    thread; a Conv occupies one thread for its sequential turns.
+    ``actions`` is ``[(at_s, callable)]`` for out-of-band chaos (e.g.
+    the bench's replica SIGKILL). Returns one result per Req plus one
+    per conversation TURN, in schedule order."""
+    start = time.perf_counter() + 0.05
+    slots = [None] * len(schedule)
+
+    def fire(i, item):
+        delay = start + item.at_s - time.perf_counter()
+        if delay > 0:
+            time.sleep(delay)
+        if isinstance(item, Conv):
+            slots[i] = run_conversation(host, port, item, timeout)
+        else:
+            slots[i] = [do_request(host, port, item, timeout)]
+
+    threads = [threading.Thread(target=fire, args=(i, item), daemon=True)
+               for i, item in enumerate(schedule)]
+    for at_s, fn in actions:
+        threads.append(threading.Thread(
+            target=lambda at_s=at_s, fn=fn: (
+                time.sleep(max(0.0, start + at_s - time.perf_counter())),
+                fn()),
+            daemon=True))
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return [r for slot in slots if slot for r in slot]
+
+
+def pct(values, q: float):
+    """Nearest-rank percentile; None for an empty sample."""
+    if not values:
+        return None
+    xs = sorted(values)
+    return xs[min(len(xs) - 1, max(0, int(round(q / 100.0 * len(xs))) - 1))]
+
+
+def summarize(results: list) -> dict:
+    """Per-class rollup: counts, error list, TTFT p50/p95/p99, TPOT p50.
+    Deliberate disconnects are counted, never errors."""
+    by = {}
+    for r in results:
+        c = by.setdefault(r["slo_class"], {
+            "n": 0, "ok": 0, "disconnected": 0, "errors": [],
+            "_ttft": [], "_tpot": []})
+        c["n"] += 1
+        if r["disconnected"]:
+            c["disconnected"] += 1
+        elif r["status"] == 200 and not r["error"]:
+            c["ok"] += 1
+        else:
+            c["errors"].append(
+                f"{r['name']}: {r['status']} {r['error']!r}")
+        if r["ttft_ms"] is not None:
+            c["_ttft"].append(r["ttft_ms"])
+        if r["tpot_ms"] is not None:
+            c["_tpot"].append(r["tpot_ms"])
+    out = {}
+    for cls, c in by.items():
+        out[cls] = {
+            "n": c["n"], "ok": c["ok"],
+            "disconnected": c["disconnected"], "errors": c["errors"],
+            "ttft_p50_ms": pct(c["_ttft"], 50),
+            "ttft_p95_ms": pct(c["_ttft"], 95),
+            "ttft_p99_ms": pct(c["_ttft"], 99),
+            "tpot_p50_ms": pct(c["_tpot"], 50),
+        }
+    return out
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, required=True)
+    ap.add_argument("--scenario", choices=sorted(SCENARIOS) + ["all"],
+                    default="bursty")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--out", default=None,
+                    help="write the full per-request results JSON here")
+    args = ap.parse_args()
+    names = sorted(SCENARIOS) if args.scenario == "all" \
+        else [args.scenario]
+    report, bad = {}, False
+    for name in names:
+        schedule = SCENARIOS[name](seed=args.seed)
+        t0 = time.perf_counter()
+        results = run_schedule(args.host, args.port, schedule)
+        summ = summarize(results)
+        report[name] = {"wall_s": round(time.perf_counter() - t0, 2),
+                        "summary": summ, "results": results}
+        for cls, c in summ.items():
+            if c["errors"]:
+                bad = True
+        print(f"[{name}] " + json.dumps(summ, sort_keys=True))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(report, f, indent=2, sort_keys=True)
+    return 1 if bad else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -304,6 +304,66 @@ def test_migration_over_q80_wire_completes():
     assert len(first) + len(rest) == 10
 
 
+def test_migration_sampled_over_q80_wire_error_bounded():
+    """A SAMPLED (temperature>0) session over the PURE q80 wire — the
+    case the suite used to leave to the greedy/hybrid tests. The carried
+    sampler chain is exact (keys/temp/topp ride the header verbatim), so
+    the ONLY perturbation is the quantized KV payload: the test holds
+    every page's divergence within the q80_error_bound model at both
+    ends — off the wire, and re-exported from the importing session
+    after the scatter landed on device — and the sampled stream still
+    finishes with exactly the remaining budget."""
+    params = llama.random_params(CFG, seed=33, dtype=np.float32)
+    scfg = SamplerConfig(temperature=0.9, topp=0.95, seed=7)
+    want = _solo(params, LONG_PROMPT, 12, scfg)
+
+    eng_a = Engine(CFG, params, SamplerConfig(temperature=0.0))
+    sess_a = eng_a.batch_session(max_batch=2, chunk=4, kv_pages=8)
+    h = sess_a.admit_begin(LONG_PROMPT, steps=12, sampler=scfg)
+    first = _first_chunk(sess_a, h)
+    # replica A is exact: the carried chunk must equal the solo prefix
+    assert first == want[:len(first)]
+    snap = sess_a.export_row(h)
+    sess_a.release(h)
+    sess_a.close()
+
+    got = kv_transfer.decode_snapshot(
+        kv_transfer.encode_snapshot(snap, LONG_PROMPT, mode="q80"))
+    assert list(got["keys"]) == list(snap["keys"])
+    assert got["temp"] == snap["temp"] and got["topp"] == snap["topp"]
+    page = int(snap["page_tokens"])
+
+    def _hold_bound(ref_leaves, leaves, where):
+        for want_l, have_l in zip(ref_leaves, leaves):
+            for b in range(int(snap["n_blocks"])):
+                ntok = max(0, min(int(snap["pos"]) - b * page, page))
+                if not ntok:
+                    continue
+                w = np.asarray(want_l)[:, b, :ntok]
+                bound = kv_transfer.q80_error_bound(w)
+                err = float(np.abs(
+                    np.asarray(have_l)[:, b, :ntok] - w).max())
+                assert err <= bound, \
+                    f"{where} block {b}: {err} > bound {bound}"
+
+    _hold_bound(snap["leaves"], got["leaves"], "wire")
+
+    eng_b = Engine(CFG, params, SamplerConfig(temperature=0.0))
+    sess_b = eng_b.batch_session(max_batch=2, chunk=4, kv_pages=8)
+    h2 = sess_b.admit_from_export(got["prompt"], got)
+    # re-export BEFORE decoding: what B serves from is the wire payload
+    # scattered through the device verbatim — still within the bound of
+    # replica A's original pages (no second quantization, no drift)
+    _hold_bound(snap["leaves"],
+                sess_b.export_row(h2, fire_fault=False)["leaves"],
+                "imported")
+    rest = _drain(sess_b, {h2: []})[h2]
+    sess_b.release(h2)
+    sess_b._alloc.check()
+    sess_b.close()
+    assert len(first) + len(rest) == 12
+
+
 # ---------------------------------------------------------------------------
 # fault seams
 # ---------------------------------------------------------------------------

@@ -1,0 +1,522 @@
+"""SLO-class scheduling (the PR 13 tentpole).
+
+Three layers under test. (1) The lane config + admission gate:
+``parse_slo_classes`` grammar, per-lane depth caps with lane-scoped
+429/Retry-After, and the classless default staying bit-compatible with
+the pre-SLO gate. (2) The HTTP surface: ``X-Dllama-Class`` picks the
+lane (an unknown class is a 400, NEVER a silent default), /ready
+reports per-lane pressure, and the per-class series land on /metrics.
+(3) Chunk-boundary preemption: an interactive arrival that finds the
+pool full reclaims a batch-class row via the failover export machinery
+and the row resumes BIT-IDENTICALLY — the client-visible token stream
+equals the same request run unpreempted — with the edge cases pinned:
+preemption at the row's last chunk, a client that cancels while its
+row is parked, and an injected fault at the ``preempt`` seam leaving
+the batch row decoding untouched (FAULT-004 exercises the site by
+name)."""
+
+import http.client
+import json
+import threading
+import time
+
+import pytest
+
+from dllama_tpu import faults
+from dllama_tpu.formats.tokenizer_file import TokenizerData
+from dllama_tpu.models import llama
+from dllama_tpu.runtime.generate import Engine
+from dllama_tpu.runtime.sampler import SamplerConfig
+from dllama_tpu.serving.api_server import ServerState, create_server
+from dllama_tpu.serving.lifecycle import (
+    AdmissionGate,
+    CancelToken,
+    QueueFull,
+    SLO_CLASSES,
+    parse_slo_classes,
+)
+from dllama_tpu.tokenizer.bpe import Tokenizer
+
+from tests.test_llama_forward import tiny_cfg
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+def _make_tokenizer():
+    vocab = [b"<unk>", b"<s>", b"</s>"]
+    vocab += [b"<0x%02X>" % b for b in range(256)]
+    vocab += [b" ", b"e", b"t", b"he", b" the", b"hello", b" world"]
+    scores = [0.0] * 259 + [-1.0, -2.0, -2.0, -1.5, -1.2, -1.1, -1.1]
+    return Tokenizer(TokenizerData(vocab=vocab, scores=scores,
+                                   bos_id=1, eos_id=2))
+
+
+TOK = _make_tokenizer()
+CFG = tiny_cfg(vocab_size=TOK.vocab_size, seq_len=512, dim=32, kv_dim=16,
+               head_size=8, hidden_dim=64)
+PARAMS = llama.random_params(CFG, seed=13)
+
+
+def _mk_server(**kw):
+    """One in-process replica server over the shared tiny weights."""
+    engine = Engine(CFG, PARAMS, SamplerConfig(temperature=0.0, seed=1))
+    state = ServerState(engine, TOK, CFG, model_name="tiny-test",
+                        template="llama3", **kw)
+    srv = create_server(state, host="127.0.0.1", port=0)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return state, srv, srv.server_address[1]
+
+
+@pytest.fixture(scope="module")
+def preempt_srv():
+    """A 1-slot paged pool: any interactive arrival during a batch-class
+    decode MUST preempt to admit."""
+    state, srv, port = _mk_server(
+        batch_window_ms=5.0, batch_max=1, batch_chunk=2, kv_pages=16,
+        slo_classes="interactive:depth=8;batch:depth=4")
+    yield state, port
+    srv.shutdown()
+
+
+def _post(port, body, headers=None, path="/v1/chat/completions",
+          timeout=120):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    try:
+        conn.request("POST", path, json.dumps(body).encode(),
+                     headers={"Content-Type": "application/json",
+                              **(headers or {})})
+        resp = conn.getresponse()
+        return resp.status, dict(resp.getheaders()), resp.read()
+    finally:
+        conn.close()
+
+
+def _get(port, path):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+    try:
+        conn.request("GET", path)
+        resp = conn.getresponse()
+        return resp.status, resp.read()
+    finally:
+        conn.close()
+
+
+def _chat(content="hello world", max_tokens=12, **kw):
+    body = {"model": "m", "max_tokens": max_tokens, "temperature": 0.0,
+            "messages": [{"role": "user", "content": content}]}
+    body.update(kw)
+    return body
+
+
+def _sse_text(data: bytes):
+    """-> (content_text, saw_done, error_message) of an SSE body."""
+    text, done, err = [], False, None
+    for line in data.split(b"\n"):
+        if not line.startswith(b"data: "):
+            continue
+        if line == b"data: [DONE]":
+            done = True
+            continue
+        try:
+            obj = json.loads(line[6:])
+        except ValueError:
+            continue
+        if "error" in obj:
+            err = obj["error"]
+        for ch in obj.get("choices", []):
+            text.append((ch.get("delta") or {}).get("content") or "")
+    return "".join(text), done, err
+
+
+def _preempt_counts(state):
+    m = state.batcher._m_preemptions
+    return {o: m.value(outcome=o)
+            for o in ("ok", "resumed", "retry", "injected", "error")}
+
+
+# ---------------------------------------------------------------------------
+# lane config + admission gate
+# ---------------------------------------------------------------------------
+
+def test_parse_slo_classes():
+    classes = parse_slo_classes(
+        "interactive:depth=48,deadline=30;batch:depth=16,resident=2")
+    assert set(classes) == set(SLO_CLASSES)
+    assert classes["interactive"].depth == 48
+    assert classes["interactive"].deadline_s == 30.0
+    assert classes["batch"].max_resident == 2
+    # unnamed classes get all-defaults entries (no KeyError anywhere)
+    only_batch = parse_slo_classes("batch:depth=4")
+    assert only_batch["interactive"].depth == 0
+    # empty/None spec -> pure defaults (the classless pre-SLO behavior)
+    assert all(c.depth == 0 and c.deadline_s == 0.0 and c.max_resident == 0
+               for c in parse_slo_classes("").values())
+    with pytest.raises(ValueError):
+        parse_slo_classes("bulk:depth=4")  # unknown class
+    with pytest.raises(ValueError):
+        parse_slo_classes("batch:weight=4")  # unknown option
+    with pytest.raises(ValueError):
+        parse_slo_classes("batch:depth")  # not k=v
+
+
+def test_gate_lane_caps_are_independent():
+    gate = AdmissionGate(
+        8, classes=parse_slo_classes("interactive:depth=2;batch:depth=1"))
+    t1 = gate.acquire("interactive")
+    gate.acquire("interactive")
+    with pytest.raises(QueueFull) as ei:
+        gate.acquire("interactive")
+    assert ei.value.slo_class == "interactive"
+    assert ei.value.http_status == 429
+    assert ei.value.retry_after_s >= 1.0
+    # the full interactive lane does NOT block the batch lane
+    gate.acquire("batch")
+    with pytest.raises(QueueFull) as eb:
+        gate.acquire("batch")
+    assert eb.value.slo_class == "batch"
+    assert gate.class_depths() == {"interactive": 2, "batch": 1}
+    # release reopens exactly the released lane
+    gate.release(t1, "interactive")
+    gate.acquire("interactive")
+    assert gate.class_depths()["interactive"] == 2
+
+
+def test_gate_total_capacity_still_binds():
+    """Lane depths never grant MORE than the gate's total capacity."""
+    gate = AdmissionGate(
+        2, classes=parse_slo_classes("interactive:depth=8;batch:depth=8"))
+    gate.acquire("interactive")
+    gate.acquire("batch")
+    with pytest.raises(QueueFull) as e:
+        gate.acquire("interactive")
+    assert e.value.slo_class is None  # TOTAL overflow, not a lane's
+
+
+def test_gate_classless_compat():
+    """The pre-SLO call shape (bare acquire/release) keeps working —
+    every existing caller treats the gate as one classless lane."""
+    gate = AdmissionGate(1)
+    t = gate.acquire()
+    with pytest.raises(QueueFull):
+        gate.acquire()
+    gate.release(t)
+    gate.acquire()
+
+
+def test_gate_deadline_and_capacity_lookups():
+    gate = AdmissionGate(
+        4, classes=parse_slo_classes("interactive:deadline=2;batch:depth=3"))
+    assert gate.deadline_for("interactive") == 2.0
+    assert gate.deadline_for("batch") == 0.0
+    assert gate.class_capacity("batch") == 3
+    assert gate.class_capacity("interactive") == 4  # inherits the total
+
+
+# ---------------------------------------------------------------------------
+# the HTTP surface
+# ---------------------------------------------------------------------------
+
+def test_unknown_class_is_400_not_default(preempt_srv):
+    """A typo'd class must NOT silently land in the interactive lane."""
+    _, port = preempt_srv
+    st, _, body = _post(port, _chat(max_tokens=2),
+                        headers={"X-Dllama-Class": "bulk"})
+    assert st == 400
+    assert b"unknown SLO class" in body and b"bulk" in body
+    # casing is forgiven; the value is not
+    st, _, _ = _post(port, _chat(max_tokens=2),
+                     headers={"X-Dllama-Class": "Interactive"})
+    assert st == 200
+    st, _, _ = _post(port, _chat(max_tokens=2),
+                     headers={"X-Dllama-Class": "batch"})
+    assert st == 200
+
+
+def test_ready_reports_lane_pressure(preempt_srv):
+    _, port = preempt_srv
+    st, body = _get(port, "/ready")
+    assert st == 200
+    classes = json.loads(body)["classes"]
+    assert set(classes) == set(SLO_CLASSES)
+    assert classes["interactive"]["capacity"] == 8
+    assert classes["batch"]["capacity"] == 4
+    for row in classes.values():
+        for key in ("inflight", "waiting", "resident", "preempted"):
+            assert key in row, key
+
+
+def test_batch_lane_429_leaves_interactive_open():
+    """Saturating the batch lane 429s batch clients (with the lane's
+    Retry-After) while interactive admission continues."""
+    state, srv, port = _mk_server(
+        batch_window_ms=5.0, batch_max=2, batch_chunk=2, kv_pages=16,
+        slo_classes="batch:depth=1")
+    try:
+        results = {}
+
+        def long_batch():
+            results["batch1"] = _post(
+                port, _chat(max_tokens=48), timeout=120,
+                headers={"X-Dllama-Class": "batch"})
+
+        t = threading.Thread(target=long_batch, daemon=True)
+        t.start()
+        # wait until the long batch request holds its lane slot
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if state.gate.class_depths().get("batch", 0) >= 1:
+                break
+            time.sleep(0.005)
+        else:
+            pytest.fail("batch request never acquired its lane slot")
+        st2, hdrs2, body2 = _post(port, _chat(max_tokens=2),
+                                  headers={"X-Dllama-Class": "batch"})
+        assert st2 == 429
+        assert float(hdrs2.get("Retry-After", 0)) >= 1.0
+        assert b"'batch' lane" in body2
+        st3, _, _ = _post(port, _chat(max_tokens=2),
+                          headers={"X-Dllama-Class": "interactive"})
+        assert st3 == 200
+        t.join(timeout=120)
+        assert results["batch1"][0] == 200
+    finally:
+        srv.shutdown()
+
+
+def test_per_class_series_on_metrics(preempt_srv):
+    _, port = preempt_srv
+    _post(port, _chat(max_tokens=2), headers={"X-Dllama-Class": "batch"})
+    _, body = _get(port, "/metrics")
+    text = body.decode()
+    assert 'dllama_class_ttft_ms_count{slo_class="batch"}' in text
+    assert 'dllama_class_queue_depth{slo_class="interactive"}' in text
+    assert 'dllama_class_resident_rows{slo_class="batch"}' in text
+    assert "dllama_preemptions_total" in text
+    assert 'dllama_class_inflight{slo_class="batch"}' in text
+
+
+# ---------------------------------------------------------------------------
+# chunk-boundary preemption
+# ---------------------------------------------------------------------------
+
+#: a batch request whose worst-case KV reservation (prompt + steps)
+#: covers ~the whole 1-row paged budget (seq_len tokens): any interactive
+#: arrival then MUST preempt to find pages. max_tokens is clamped to the
+#: prompt's room, so "big" simply means "reserve everything left".
+BATCH_STEPS = 440
+
+
+def _contend(state, port, batch_tokens=BATCH_STEPS, interactive_tokens=4,
+             batch_headers=None):
+    """Run one batch-class stream and, once it is decoding, one
+    interactive request against a 1-slot pool. Returns (batch_text,
+    saw_done, err, interactive_status, preemption_counter_deltas)."""
+    before = _preempt_counts(state)
+    out = {}
+
+    def batch_client():
+        out["batch"] = _post(
+            port, _chat(max_tokens=batch_tokens, stream=True), timeout=120,
+            headers={"X-Dllama-Class": "batch", **(batch_headers or {})})
+
+    t = threading.Thread(target=batch_client, daemon=True)
+    t.start()
+    # the batch row is resident once the scheduler publishes it
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        if state.batcher.class_stats()["batch"]["resident"] >= 1:
+            break
+        time.sleep(0.002)
+    else:
+        pytest.fail("batch row never became resident")
+    ist, _, _ = _post(port, _chat("the cat", max_tokens=interactive_tokens),
+                      headers={"X-Dllama-Class": "interactive"})
+    t.join(timeout=120)
+    assert not t.is_alive(), "batch stream never finished"
+    text, done, err = _sse_text(out["batch"][2])
+    after = _preempt_counts(state)
+    deltas = {k: after[k] - before[k] for k in after}
+    return text, done, err, ist, deltas
+
+
+def test_preempted_batch_row_is_bit_identical(preempt_srv):
+    """THE tentpole invariant: preempt + park + resume must be invisible
+    in the batch stream's bytes — same tokens as the uncontended run —
+    while the interactive request is served by the reclaimed slot."""
+    state, port = preempt_srv
+    # control: the same batch request with the pool to itself
+    st, _, body = _post(port, _chat(max_tokens=BATCH_STEPS, stream=True),
+                        headers={"X-Dllama-Class": "batch"}, timeout=120)
+    assert st == 200
+    want, done, err = _sse_text(body)
+    assert done and err is None and want
+
+    text, done, err, ist, deltas = _contend(state, port)
+    assert ist == 200
+    assert done and err is None
+    assert text == want, "preempted stream diverged from unpreempted run"
+    assert deltas["ok"] >= 1, f"no preemption happened: {deltas}"
+    assert deltas["resumed"] >= 1
+    assert deltas["error"] == 0
+    # parked rows all came back: nothing left in the preempted lane
+    assert state.batcher.class_stats()["batch"]["preempted"] == 0
+
+
+def test_preempt_fault_leaves_batch_row_decoding(preempt_srv):
+    """An injected fault at the ``preempt`` seam (FAULT-004: the site is
+    drilled by name) aborts the preemption, not the batch row: the row
+    decodes on untouched, the interactive request waits for the slot and
+    still completes — never a torn stream, never a client error."""
+    state, port = preempt_srv
+    st, _, body = _post(port, _chat(max_tokens=BATCH_STEPS, stream=True),
+                        headers={"X-Dllama-Class": "batch"}, timeout=120)
+    want = _sse_text(body)[0]
+
+    faults.install("preempt:raise")
+    try:
+        text, done, err, ist, deltas = _contend(state, port)
+    finally:
+        faults.clear()
+    assert ist == 200  # served after the batch row drained
+    assert done and err is None and text == want
+    assert deltas["injected"] >= 1
+    assert deltas["ok"] == 0 and deltas["resumed"] == 0
+
+
+def test_preempt_while_cancelling(preempt_srv):
+    """A batch client that gives up WHILE ITS ROW IS PARKED is reaped from
+    the preempted lane (never re-admitted, never hanging the scheduler);
+    the pool keeps serving afterwards."""
+    state, port = preempt_srv
+    cancel = CancelToken()
+    got = {"bursts": [], "error": None}
+
+    def batch_client():
+        try:
+            for burst in state.batcher.submit_stream(
+                    TOK.encode("hello world", add_bos=True), BATCH_STEPS,
+                    SamplerConfig(temperature=0.0, seed=1), cancel=cancel,
+                    slo_class="batch"):
+                got["bursts"].append(burst)
+        except Exception as e:  # noqa: BLE001 — the typed cancel error
+            got["error"] = e
+
+    t = threading.Thread(target=batch_client, daemon=True)
+    t.start()
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        if state.batcher.class_stats()["batch"]["resident"] >= 1:
+            break
+        time.sleep(0.002)
+    else:
+        pytest.fail("batch row never became resident")
+    # a LONG interactive request keeps the row parked while we cancel it
+    before = _preempt_counts(state)
+    out = {}
+
+    def interactive():
+        out["st"] = _post(port, _chat("the cat", max_tokens=48),
+                          headers={"X-Dllama-Class": "interactive"})[0]
+
+    ti = threading.Thread(target=interactive, daemon=True)
+    ti.start()
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        if _preempt_counts(state)["ok"] > before["ok"]:
+            break
+        time.sleep(0.002)
+    else:
+        pytest.fail("interactive arrival never preempted the batch row")
+    cancel.cancel("client gone while parked")
+    ti.join(timeout=120)
+    t.join(timeout=30)
+    assert not t.is_alive(), "cancelled parked stream never resolved"
+    assert out["st"] == 200
+    # the parked row was reaped, not resumed into the pool
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        if state.batcher.class_stats()["batch"]["preempted"] == 0:
+            break
+        time.sleep(0.01)
+    assert state.batcher.class_stats()["batch"]["preempted"] == 0
+    # the scheduler is healthy: a follow-up request round-trips
+    assert _post(port, _chat(max_tokens=2))[0] == 200
+
+
+def test_preempt_at_last_chunk_resumes_exactly():
+    """Preempting a row whose NEXT chunk is its last: the export/resume
+    machinery must hand back exactly the remaining tail. Engine-level —
+    this pins the snapshot math the scheduler's parking relies on."""
+    engine = Engine(CFG, PARAMS, SamplerConfig(temperature=0.0, seed=1))
+    prompt = TOK.encode("hello world", add_bos=True)
+    solo = [t for t, _ in engine.generate(list(prompt), steps=5)]
+    sess = engine.batch_session(max_batch=2, chunk=2, kv_pages=16)
+    b = sess.admit(prompt, steps=5)
+    got = []
+    for _ in range(2):  # 2+2 tokens: the next chunk is the last (1 token)
+        for h, burst in sess.step_chunk().items():
+            if h == b:
+                got.extend(burst)
+    assert len(got) == 4 and not sess.is_done(b)
+    snap = sess.export_row(b)
+    sess.release(b)
+    b2 = sess.admit_from_export(prompt, snap)
+    while not sess.is_done(b2):
+        for h, burst in sess.step_chunk().items():
+            if h == b2:
+                got.extend(burst)
+    sess.release(b2)
+    sess.close()
+    assert got == solo[:5]
+
+
+def test_batch_class_rows_route_continuous(preempt_srv):
+    """A lone batch-class request must take the CONTINUOUS path (solo and
+    spec windows run to completion — unpreemptible)."""
+    state, port = preempt_srv
+    before = state.batcher._m_path.value(path="continuous")
+    st, _, _ = _post(port, _chat("t e t", max_tokens=4),
+                     headers={"X-Dllama-Class": "batch"})
+    assert st == 200
+    assert state.batcher._m_path.value(path="continuous") == before + 1
+
+
+def test_batch_resident_cap_holds():
+    """batch:resident=1 keeps a second batch row WAITING while the first
+    decodes, even with free slots — interactive fills them instead."""
+    state, srv, port = _mk_server(
+        batch_window_ms=5.0, batch_max=2, batch_chunk=2, kv_pages=16,
+        slo_classes="batch:resident=1")
+    try:
+        results = []
+
+        def batch_client(content):
+            results.append(_post(port, _chat(content, max_tokens=32),
+                                 timeout=120,
+                                 headers={"X-Dllama-Class": "batch"}))
+
+        threads = [threading.Thread(target=batch_client, args=(c,),
+                                    daemon=True)
+                   for c in ("hello world", "the cat")]
+        for t in threads:
+            t.start()
+        saw_cap = False
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline and any(t.is_alive()
+                                                  for t in threads):
+            stats = state.batcher.class_stats()["batch"]
+            assert stats["resident"] <= 1, "resident cap violated"
+            if stats["resident"] == 1 and stats["waiting"] >= 1:
+                saw_cap = True
+            time.sleep(0.002)
+        for t in threads:
+            t.join(timeout=120)
+        assert all(st == 200 for st, _, _ in results)
+        assert saw_cap, "second batch row never waited on the cap"
+    finally:
+        srv.shutdown()
