@@ -1,6 +1,8 @@
-"""CLI: ``python -m dllama_tpu.analysis [--json] [--root DIR]``.
+"""CLI: ``python -m dllama_tpu.analysis [--json] [--sarif PATH] [--only RULE]
+[--files F ...] [--budget-s N] [--root DIR]``.
 
-Exit 0 when the tree has zero unsuppressed findings, 1 otherwise — the
+Exit 0 when the tree has zero unsuppressed findings (after ``--only`` /
+``--files`` filtering) AND the run beat ``--budget-s``, 1 otherwise — the
 ``dllama-check`` CI job is exactly this command.
 """
 
@@ -8,17 +10,42 @@ from __future__ import annotations
 
 import argparse
 import sys
+import time
 
 from . import core
+
+
+def _rule_match(rule: str, selectors) -> bool:
+    """``--only LOCK-001`` matches exactly; ``--only PROTO`` matches the
+    whole family."""
+    for sel in selectors:
+        if rule == sel or rule.startswith(sel.rstrip("-") + "-"):
+            return True
+    return False
 
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m dllama_tpu.analysis",
-        description="dllama-check: lock discipline, JAX trace-safety, "
-                    "fault-site coverage and exception hygiene.")
+        description="dllama-check: lock discipline (interprocedural), "
+                    "blocking-under-lock, wire-protocol conformance, JAX "
+                    "trace-safety, fault-site coverage and exception "
+                    "hygiene.")
     ap.add_argument("--json", action="store_true",
                     help="emit the full machine-readable report")
+    ap.add_argument("--sarif", metavar="PATH", default=None,
+                    help="also write a SARIF 2.1.0 report to PATH "
+                         "(CI uses it to annotate PR diffs)")
+    ap.add_argument("--only", metavar="RULE", action="append", default=[],
+                    help="report only this rule id (LOCK-001) or family "
+                         "(PROTO); repeatable")
+    ap.add_argument("--files", metavar="F", nargs="+", default=None,
+                    help="changed-files mode: analyze the whole tree (cross-"
+                         "file contracts need it) but report findings only "
+                         "in these repo-relative paths")
+    ap.add_argument("--budget-s", type=float, default=None,
+                    help="fail (exit 1) when the run takes longer than this "
+                         "many seconds, even with zero findings")
     ap.add_argument("--root", default=None,
                     help="repo root (default: the tree this package "
                          "was imported from)")
@@ -34,11 +61,25 @@ def main(argv=None) -> int:
         print(coverage.render_site_block(sites))
         return 0
 
-    report = core.run(args.root)
+    t0 = time.perf_counter()
+    report = core.run(args.root, only_files=args.files)
+    elapsed = time.perf_counter() - t0
+    if args.only:
+        report = core.Report(
+            findings=[f for f in report.findings
+                      if _rule_match(f.rule, args.only)],
+            files_scanned=report.files_scanned)
+    if args.sarif:
+        with open(args.sarif, "w", encoding="utf-8") as fh:
+            fh.write(report.to_sarif())
     if args.json:
         print(report.to_json())
     else:
         print(report.render())
+    if args.budget_s is not None and elapsed > args.budget_s:
+        print(f"dllama-check: runtime budget exceeded: {elapsed:.1f}s > "
+              f"{args.budget_s:.1f}s", file=sys.stderr)
+        return 1
     return 0 if report.ok else 1
 
 

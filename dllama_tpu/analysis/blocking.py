@@ -1,0 +1,154 @@
+"""BLOCK-00x: blocking operations while holding a guarded_by lock.
+
+BLOCK-001  blocking call lexically inside ``with self.<lock>`` where
+           <lock> is a guard lock declared by the enclosing class's
+           ``@guarded_by`` decorators — the classic router/api_server
+           latency-collapse shape (every other thread that touches the
+           guarded state stalls behind one slow socket).
+BLOCK-002  blocking call while holding a module-level lock (declared via
+           ``guard_globals`` or bound to ``threading.Lock()``/``RLock()``
+           at module scope).
+
+"Blocking" is a deliberate shortlist, not a taint analysis:
+
+* ``time.sleep`` / any dotted ``.sleep(...)``
+* ``subprocess.run/Popen/call/check_call/check_output``
+* socket I/O: ``.connect/.recv/.recvfrom/.recv_into/.accept/.sendall``
+  and ``socket.create_connection``
+* HTTP: ``.getresponse()``, ``urlopen(...)``, and ``.request(...)`` on a
+  receiver whose name mentions ``conn``
+* no-timeout queue/thread waits: zero-argument ``.get()`` (dict.get
+  always takes an argument) unless ``block=False``/``timeout=`` given,
+  and zero-argument ``.join()``
+* ``select.select(...)``
+
+``Condition.wait`` is deliberately NOT listed: waiting on a condition
+*releases* its lock — flagging it would punish the one blocking call
+that is correct under a lock.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import Finding, SourceFile
+from .locks import (_WithTracker, _dotted, harvest_classes,
+                    harvest_global_guards, _module_level_locks)
+
+_SUBPROCESS = frozenset({"run", "Popen", "call", "check_call", "check_output"})
+_SOCKET = frozenset({"connect", "recv", "recvfrom", "recv_into", "accept",
+                     "sendall", "create_connection"})
+
+
+def _kw(call: ast.Call, name: str):
+    for k in call.keywords:
+        if k.arg == name:
+            return k.value
+    return None
+
+
+def blocking_reason(call: ast.Call):
+    """Short human label when ``call`` is on the blocking shortlist."""
+    dotted = _dotted(call.func)
+    if not dotted:
+        return None
+    parts = dotted.split(".")
+    leaf = parts[-1]
+    recv = ".".join(parts[:-1])
+    if leaf == "sleep":
+        return f"{dotted}()"
+    if recv == "subprocess" and leaf in _SUBPROCESS:
+        return f"{dotted}()"
+    if leaf in _SOCKET:
+        return f"socket I/O via .{leaf}()"
+    if leaf in ("getresponse", "urlopen"):
+        return f"HTTP I/O via {dotted}()"
+    if leaf == "request" and "conn" in recv.lower():
+        return f"HTTP I/O via {dotted}()"
+    if dotted == "select.select":
+        return "select.select()"
+    if leaf == "get" and recv and not call.args:
+        block = _kw(call, "block")
+        if (isinstance(block, ast.Constant) and block.value is False):
+            return None
+        if _kw(call, "timeout") is None:
+            return f"no-timeout {recv}.get()"
+    if leaf == "join" and recv and not call.args and not call.keywords:
+        return f"no-timeout {recv}.join()"
+    return None
+
+
+class _BlockTracker(_WithTracker):
+    """_WithTracker that reports blocking calls with the held lock set."""
+
+    def __init__(self, on_block, held0=()):
+        super().__init__(lambda *_: None, held0)
+        self.on_block = on_block
+
+    def visit_Call(self, node: ast.Call):
+        reason = blocking_reason(node)
+        if reason is not None:
+            self.on_block(node, reason, list(self.held))
+        self.generic_visit(node)
+
+    def visit_FunctionDef(self, node):
+        inner = _BlockTracker(self.on_block, held0=())
+        for stmt in node.body:
+            inner.visit(stmt)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+
+def check_blocking(src: SourceFile):
+    """BLOCK-001/002 over one file."""
+    findings: list = []
+    classes = harvest_classes(src)
+    module_locks = set(_module_level_locks(src))
+    module_locks.update(harvest_global_guards(src).values())
+
+    # BLOCK-001: methods of guard-annotated classes
+    for node in ast.walk(src.tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        guards = classes.get(node.name) or {}
+        guard_locks = {v for v in guards.values() if v}
+        if not guard_locks:
+            continue
+        for meth in node.body:
+            if not isinstance(meth, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+
+            def on_block(call, reason, held, _m=meth):
+                for lock in sorted(guard_locks):
+                    if f"self.{lock}" in held:
+                        findings.append(Finding(
+                            "BLOCK-001", src.rel, call.lineno,
+                            f"blocking {reason} in {node.name}.{_m.name}() "
+                            f"while holding self.{lock} (guarded_by) — move "
+                            f"the I/O outside the lock or snapshot state "
+                            f"first"))
+                        return
+
+            tracker = _BlockTracker(on_block)
+            for stmt in meth.body:
+                tracker.visit(stmt)
+
+    # BLOCK-002: any function holding a module-level lock
+    if module_locks:
+        for node in ast.walk(src.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+
+            def on_block(call, reason, held, _f=node):
+                hot = sorted(set(held) & module_locks)
+                if hot:
+                    findings.append(Finding(
+                        "BLOCK-002", src.rel, call.lineno,
+                        f"blocking {reason} in {_f.name}() while holding "
+                        f"module lock {hot[0]} — move the I/O outside the "
+                        f"lock"))
+
+            tracker = _BlockTracker(on_block)
+            for stmt in node.body:
+                tracker.visit(stmt)
+    return findings

@@ -1,0 +1,139 @@
+"""Interprocedural LOCK-001: prove "caller always holds X" across methods.
+
+PR 7's LOCK-001 was deliberately lexical: a helper that writes a guarded
+field while *its caller* holds the lock needed an allow-comment.  This
+pass builds a per-class call graph (``self.<m>(...)`` sites with the
+lexically-held lock set at each site) and exempts a guarded write when
+the enclosing method is **provably** always entered with the lock held:
+
+* the method is private (``_``-prefixed, non-dunder) or ``_locked``-
+  suffixed — public methods are never proven, external callers are
+  invisible to a module-level graph;
+* it has at least one call site in the class; and
+* EVERY call site either lexically holds ``with self.<lock>``, sits in
+  ``__init__`` (construction is single-threaded), or is itself in a
+  provable method (transitively, cycles count as unproven).
+
+A genuinely unlocked call path is a finding whose message carries the
+full chain, e.g. ``Gate.flush() -> Gate._bump_locked() called at
+x.py:12``.  The conservative direction is preserved: this pass only ever
+*removes* findings relative to the lexical rule, never adds sites.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import Finding, SourceFile
+from .locks import _WithTracker, _writes_from_stmt, harvest_classes
+
+
+def _eligible(name: str) -> bool:
+    """Helpers we may try to prove; public methods are never provable."""
+    if name.endswith("_locked"):
+        return True
+    return name.startswith("_") and not name.startswith("__")
+
+
+class _Tracker(_WithTracker):
+    """_WithTracker that also reports ``self.<m>(...)`` call sites."""
+
+    def __init__(self, on_write, on_call, held0=()):
+        super().__init__(on_write, held0)
+        self.on_call = on_call
+
+    def visit_Call(self, node: ast.Call):
+        f = node.func
+        if (isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name)
+                and f.value.id == "self"):
+            self.on_call(f.attr, node.lineno, tuple(self.held))
+        super().visit_Call(node)
+
+    # nested defs run later with no lexically-held lock; their call sites
+    # still count, but with an empty held set (conservative)
+    def visit_FunctionDef(self, node):
+        inner = _Tracker(self.on_write, self.on_call, held0=())
+        for stmt in node.body:
+            inner.visit(stmt)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+
+def check_guarded_writes(src: SourceFile):
+    """LOCK-001 over one file, with interprocedural lock proofs."""
+    findings: list = []
+    classes = harvest_classes(src)
+    for node in ast.walk(src.tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        guards = classes.get(node.name) or {}
+        if not any(v is not None for v in guards.values()):
+            continue
+
+        methods = [m for m in node.body
+                   if isinstance(m, (ast.FunctionDef, ast.AsyncFunctionDef))]
+        writes: dict = {}       # method -> [(stmt, field, lock)] unlocked
+        call_sites: dict = {}   # callee -> [(caller, lineno, held)]
+        for meth in methods:
+            bucket: list = []
+
+            def on_write(stmt, held, _b=bucket):
+                _writes_from_stmt(
+                    stmt, held, guards,
+                    lambda h, lock: h == f"self.{lock}",
+                    lambda s, field, lock: _b.append((s, field, lock)))
+
+            def on_call(callee, lineno, held, _m=meth.name):
+                call_sites.setdefault(callee, []).append((_m, lineno, held))
+
+            tracker = _Tracker(on_write, on_call)
+            for stmt in meth.body:
+                tracker.visit(stmt)
+            writes[meth.name] = bucket
+
+        def provable(meth_name, lock, stack):
+            """Every call site of meth_name holds ``with self.<lock>``?"""
+            if not _eligible(meth_name) or meth_name in stack:
+                return False
+            sites = call_sites.get(meth_name)
+            if not sites:
+                return False
+            for caller, _lineno, held in sites:
+                if f"self.{lock}" in held or caller == "__init__":
+                    continue
+                if not provable(caller, lock, stack | {meth_name}):
+                    return False
+            return True
+
+        def unlocked_chain(meth_name, lock, stack):
+            """One call path reaching meth_name lock-free, as display hops."""
+            for caller, lineno, held in call_sites.get(meth_name) or ():
+                if f"self.{lock}" in held or caller == "__init__":
+                    continue
+                if provable(caller, lock, stack | {meth_name}):
+                    continue
+                sub = None
+                if caller not in stack:
+                    sub = unlocked_chain(caller, lock, stack | {meth_name})
+                head = sub or [f"{node.name}.{caller}()"]
+                return head + [f"{node.name}.{meth_name}() called at "
+                               f"{src.rel}:{lineno}"]
+            return None
+
+        for meth in methods:
+            if meth.name == "__init__":
+                continue
+            for stmt, field, lock in writes[meth.name]:
+                if provable(meth.name, lock, frozenset()):
+                    continue
+                msg = (f"{node.name}.{field} written in {meth.name}() "
+                       f"outside `with self.{lock}` (guarded_by({lock!r}))")
+                if _eligible(meth.name):
+                    chain = unlocked_chain(meth.name, lock, frozenset())
+                    if chain:
+                        msg += "; unlocked call path: " + " -> ".join(chain)
+                    elif not call_sites.get(meth.name):
+                        msg += ("; helper has no call site in this module — "
+                                "cannot prove callers hold the lock")
+                findings.append(Finding("LOCK-001", src.rel, stmt.lineno, msg))
+    return findings

@@ -4,14 +4,17 @@ Dependency-free by construction (``ast`` + stdlib only): the analyzer must be
 runnable in the leanest CI job *before* jax is even importable, and must never
 constrain what the runtime may import.
 
-Suppression syntax (audited, reason mandatory)::
+Suppression syntax (audited, reason mandatory; the rule id is spelled
+``LOCK-nnn`` here so this docstring is not itself parsed as one)::
 
-    self._hot = x  # dllama: allow[LOCK-001] reason=publish-only; readers tolerate tears
+    self._hot = x  # dllama: allow[LOCK-nnn] reason=publish-only; readers tolerate tears
 
 A suppression comment applies to findings on its own line or the line
 directly below (comment-above style). A suppression with no ``reason=`` text
-is itself a finding (SUP-001) — the gate counts unsuppressed findings only,
-so every exception to a rule stays visible in the JSON report.
+is itself a finding (SUP-001), and one whose rule no longer fires at that
+site is a finding too (SUP-002, stale suppression) — the gate counts
+unsuppressed findings only, so every exception to a rule stays visible in
+the JSON report and dies when it stops being needed.
 """
 
 from __future__ import annotations
@@ -129,6 +132,37 @@ class Report:
                    f"{n_sup} suppressed, {self.files_scanned} file(s)")
         return "\n".join(out)
 
+    def to_sarif(self) -> str:
+        """SARIF 2.1.0, so CI can annotate PR diffs with findings."""
+        rules = sorted({f.rule for f in self.findings})
+        results = []
+        for f in sorted(self.findings,
+                        key=lambda f: (f.path, f.line, f.rule)):
+            r = {
+                "ruleId": f.rule,
+                "level": "note" if f.suppressed else "error",
+                "message": {"text": f.message},
+                "locations": [{"physicalLocation": {
+                    "artifactLocation": {"uri": f.path},
+                    "region": {"startLine": f.line},
+                }}],
+            }
+            if f.suppressed:
+                r["suppressions"] = [{"kind": "inSource",
+                                      "justification": f.reason}]
+            results.append(r)
+        return json.dumps({
+            "version": "2.1.0",
+            "$schema": "https://json.schemastore.org/sarif-2.1.0.json",
+            "runs": [{
+                "tool": {"driver": {
+                    "name": "dllama-check",
+                    "rules": [{"id": r} for r in rules],
+                }},
+                "results": results,
+            }],
+        }, indent=2, sort_keys=True)
+
 
 def _apply_suppressions(findings: list, src: "SourceFile") -> list:
     for f in findings:
@@ -166,10 +200,30 @@ def find_root(start: str | None = None) -> str:
     raise SystemExit(f"dllama-check: no dllama_tpu package under {here}")
 
 
-def run(root: str | None = None) -> Report:
+def _stale_suppressions(sources, findings) -> list:
+    """SUP-002: allow-comments whose rule no longer fires at that site.
+    Interprocedural LOCK-001 made several suppressions obsolete; a stale
+    allow silently hides the *next* real finding at that line."""
+    out: list = []
+    for src in sources:
+        for s in src.suppressions:
+            hit = any(f.rule == s.rule and f.path == src.rel
+                      and f.line in (s.line, s.line + 1) for f in findings)
+            if not hit:
+                out.append(Finding(
+                    "SUP-002", src.rel, s.line,
+                    f"stale suppression: allow[{s.rule}] but {s.rule} no "
+                    f"longer fires here — delete the comment"))
+    return out
+
+
+def run(root: str | None = None, only_files=None) -> Report:
     """Run every pass over the tree rooted at ``root`` (default: the repo
-    this package was imported from)."""
-    from . import coverage, hygiene, locks, tracesafety
+    this package was imported from).  ``only_files`` (repo-relative paths)
+    filters the *reported* findings for changed-files mode — every pass
+    still sees the whole tree, so cross-file contracts stay sound."""
+    from . import (blocking, callgraph, coverage, hygiene, locks, protocol,
+                   tracesafety)
     root = find_root(root) if root is None else os.path.abspath(root)
     sources = []
     findings: list = []
@@ -184,7 +238,9 @@ def run(root: str | None = None) -> Report:
         sources.append(src)
         findings.extend(src.bad_suppressions)
 
-    per_file_passes = (locks.check_guarded_writes, locks.check_guarded_globals,
+    per_file_passes = (callgraph.check_guarded_writes,
+                       locks.check_guarded_globals,
+                       blocking.check_blocking,
                        tracesafety.check_trace_safety,
                        hygiene.check_exceptions)
     for src in sources:
@@ -194,7 +250,8 @@ def run(root: str | None = None) -> Report:
     # cross-file passes: suppressions still resolve against the file each
     # finding is anchored to
     by_rel = {s.rel: s for s in sources}
-    for p in (locks.check_lock_order, locks.check_external_writes):
+    for p in (locks.check_lock_order, locks.check_external_writes,
+              protocol.check_protocol):
         for f in p(sources):
             src = by_rel.get(f.path)
             if src is not None:
@@ -205,6 +262,10 @@ def run(root: str | None = None) -> Report:
         if src is not None:
             _apply_suppressions([f], src)
         findings.append(f)
+    findings.extend(_stale_suppressions(sources, findings))
+    if only_files:
+        keep = {p.replace(os.sep, "/") for p in only_files}
+        findings = [f for f in findings if f.path in keep]
     return Report(findings=findings, files_scanned=len(sources))
 
 
@@ -213,11 +274,12 @@ def analyze_source(text: str, filename: str = "snippet.py",
     """Run per-file passes over a source string — the fixture-test entry.
     ``passes`` defaults to all per-file passes plus the cross-file lock
     passes applied to this single file."""
-    from . import hygiene, locks, tracesafety
+    from . import blocking, callgraph, hygiene, locks, tracesafety
     src = SourceFile(filename, filename, text)
     findings: list = list(src.bad_suppressions)
-    chosen = passes or (locks.check_guarded_writes,
+    chosen = passes or (callgraph.check_guarded_writes,
                         locks.check_guarded_globals,
+                        blocking.check_blocking,
                         tracesafety.check_trace_safety,
                         hygiene.check_exceptions)
     for p in chosen:
@@ -229,4 +291,5 @@ def analyze_source(text: str, filename: str = "snippet.py",
         for f in locks.check_external_writes([src]):
             _apply_suppressions([f], src)
             findings.append(f)
+        findings.extend(_stale_suppressions([src], findings))
     return findings

@@ -14,11 +14,13 @@ LOCK-003  direct write to a field of an externally-serialized class
 LOCK-004  write to a ``guard_globals``-declared module global outside a
           ``with <module_lock>`` block.
 
-Lexical scope is the deliberate boundary: a helper that writes a guarded
-field while *its caller* holds the lock must either take the lock itself
-(both Lock->RLock or restructure) or carry an explicit allow-comment. That
-is a feature — "the lock is held somewhere up-stack" is exactly the
-convention that rots.
+LOCK-001 is interprocedural since dllama-check v2 (see callgraph.py): a
+guarded write inside a private or ``_locked``-suffixed helper is exempt
+when EVERY call site in the class provably holds the lock; anything
+weaker — a public method, an unlocked call path, a helper with no
+in-module caller — is still a finding, now with the offending call chain
+in the message.  "The lock is held somewhere up-stack" must be *proved*,
+never assumed.
 """
 
 from __future__ import annotations
@@ -259,35 +261,11 @@ def _writes_from_stmt(stmt, held, guards, lockname_ok, emit):
 
 
 def check_guarded_writes(src: SourceFile):
-    """LOCK-001 over one file."""
-    findings: list = []
-    classes = harvest_classes(src)
-    for node in ast.walk(src.tree):
-        if not isinstance(node, ast.ClassDef):
-            continue
-        guards = classes.get(node.name) or {}
-        if not any(v is not None for v in guards.values()):
-            continue
-        for meth in node.body:
-            if not isinstance(meth, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                continue
-            if meth.name == "__init__":
-                continue
-
-            def on_write(stmt, held, _meth=meth):
-                _writes_from_stmt(
-                    stmt, held, guards,
-                    lambda h, lock: h == f"self.{lock}",
-                    lambda s, field, lock: findings.append(Finding(
-                        "LOCK-001", src.rel, s.lineno,
-                        f"{node.name}.{field} written in {_meth.name}() "
-                        f"outside `with self.{lock}` (guarded_by"
-                        f"({lock!r}))")))
-
-            tracker = _WithTracker(on_write)
-            for stmt in meth.body:
-                tracker.visit(stmt)
-    return findings
+    """LOCK-001 over one file.  Since dllama-check v2 this delegates to
+    the interprocedural pass in callgraph.py, which proves "caller always
+    holds X" across method boundaries before flagging."""
+    from .callgraph import check_guarded_writes as _interprocedural
+    return _interprocedural(src)
 
 
 def check_guarded_globals(src: SourceFile):
@@ -445,6 +423,99 @@ def collect_acquisition_edges(sources):
     return edges
 
 
+def _annotation_name(node):
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value.strip("'\"")
+    return None
+
+
+def _per_instance_inversions(sources):
+    """LOCK-002 (per-instance): nesting the same lock attribute of two
+    *different instances of the same class* in one function.  The graph
+    check above canonicalizes ``self.X`` to ``ClassName.X``, so
+    ``a._lock`` then ``b._lock`` is one node and never a cycle — yet
+    ``a.merge(b)`` racing ``b.merge(a)`` deadlocks.  Only flagged when
+    both receivers' classes are known (``self``, an annotated parameter,
+    or a local bound to ``ClassName(...)``) and equal — receiver typing
+    is otherwise invisible to an AST pass."""
+    findings: list = []
+    for src in sources:
+        known_classes = set(harvest_classes(src))
+
+        def scan_function(fn, cls_name):
+            env: dict = {}
+            if cls_name is not None:
+                env["self"] = cls_name
+            args = list(fn.args.posonlyargs) + list(fn.args.args) + \
+                list(fn.args.kwonlyargs)
+            for a in args:
+                ann = _annotation_name(a.annotation)
+                if ann:
+                    env[a.arg] = ann
+            for sub in ast.walk(fn):
+                if (isinstance(sub, ast.Assign)
+                        and isinstance(sub.value, ast.Call)
+                        and isinstance(sub.value.func, ast.Name)
+                        and sub.value.func.id in known_classes):
+                    for t in sub.targets:
+                        if isinstance(t, ast.Name):
+                            env[t.id] = sub.value.func.id
+
+            def walk(body, held):
+                for node in body:
+                    if isinstance(node, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                        continue  # nested defs scanned on their own
+                    if isinstance(node, (ast.With, ast.AsyncWith)):
+                        acquired = []
+                        for item in node.items:
+                            d = _dotted(item.context_expr)
+                            parts = d.split(".") if d else []
+                            if len(parts) != 2 or "lock" not in \
+                                    parts[1].lower():
+                                continue
+                            recv, attr = parts
+                            cls = env.get(recv)
+                            if cls is None:
+                                continue
+                            for recv0, attr0, cls0 in held + acquired:
+                                if (attr0 == attr and cls0 == cls
+                                        and recv0 != recv):
+                                    findings.append(Finding(
+                                        "LOCK-002", src.rel, node.lineno,
+                                        f"per-instance inversion risk in "
+                                        f"{fn.name}(): acquiring "
+                                        f"{recv}.{attr} while holding "
+                                        f"{recv0}.{attr0} — two {cls} "
+                                        f"instances; a symmetric call "
+                                        f"takes them in the opposite "
+                                        f"order. Impose a canonical order "
+                                        f"(e.g. sort by id()) or take one "
+                                        f"lock at a time"))
+                            acquired.append((recv, attr, cls))
+                        walk(node.body, held + acquired)
+                        continue
+                    inner = [n for n in ast.iter_child_nodes(node)
+                             if isinstance(n, ast.stmt)]
+                    if inner:
+                        walk(inner, held)
+
+            walk(fn.body, [])
+
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.ClassDef):
+                for meth in node.body:
+                    if isinstance(meth, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                        scan_function(meth, node.name)
+        for node in src.tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                scan_function(node, None)
+    return findings
+
+
 def check_lock_order(sources):
     """LOCK-002: cycles in the union acquisition graph."""
     edges = collect_acquisition_edges(sources)
@@ -487,4 +558,5 @@ def check_lock_order(sources):
             onpath.discard(node)
 
         dfs(start)
+    findings.extend(_per_instance_inversions(sources))
     return findings

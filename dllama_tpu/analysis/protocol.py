@@ -1,0 +1,355 @@
+"""PROTO-00x: wire-contract conformance.
+
+PRs 11-13 grew a cross-process protocol surface — the DKV1 KV-wire
+codec, in-band SSE control frames, ``X-Dllama-*`` hop headers, and
+federated metric names — where a one-sided edit (writer updated, reader
+not) ships a silent fleet-wide bug no single-process unit test can
+catch.  All of those strings now live in ``serving/protocol.py``; these
+passes cross-check both directions, the way FAULT-001..004 does for
+fault sites.
+
+PROTO-001  DKV1 header fields: ``encode_snapshot`` writes vs
+           ``decode_snapshot`` parses vs ``DKV1_HEADER_FIELDS``.
+PROTO-002  SSE events: every registered event is referenced (via its
+           constant) by at least two modules — an emitter and a scanner
+           — and no raw event-name literal survives outside the
+           registry.
+PROTO-003  hop headers: HDR_* constants vs the HOP_HEADERS tuple,
+           two-module use, and no raw ``X-Dllama-*``/registered-header
+           literal outside the registry.
+PROTO-004  metric names: every ``dllama_*`` name consumed somewhere in
+           the package is registered via ``counter()``/``gauge()``/
+           ``histogram()`` (faults.py's SITE_METRICS is FAULT-003's
+           job and exempt here).
+
+The registry file is read with ``ast`` — never imported — so the
+analyzer stays dependency-free and a syntax error there is an AST-001,
+not a crash.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from .core import Finding
+
+_PROTO_REL = "serving/protocol.py"
+_METRIC_RE = re.compile(r"^dllama_[a-z0-9]+(?:_[a-z0-9]+)+$")
+_REGISTRARS = frozenset({"counter", "gauge", "histogram"})
+
+
+def _is_exempt(rel: str) -> bool:
+    """Files allowed to spell wire strings raw: the registry itself and
+    the analyzer (rule text quotes examples)."""
+    return "/analysis/" in rel or rel.endswith(_PROTO_REL)
+
+
+def _docstring_nodes(tree) -> set:
+    """ids of Constant nodes that are module/class/function docstrings."""
+    out: set = set()
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.Module, ast.ClassDef, ast.FunctionDef,
+                             ast.AsyncFunctionDef)):
+            body = node.body
+            if (body and isinstance(body[0], ast.Expr)
+                    and isinstance(body[0].value, ast.Constant)
+                    and isinstance(body[0].value.value, str)):
+                out.add(id(body[0].value))
+    return out
+
+
+class _Registry:
+    """The wire-contract registry, parsed (not imported) from
+    serving/protocol.py."""
+
+    def __init__(self, proto_src):
+        self.src = proto_src
+        self.consts: dict = {}   # NAME -> str/bytes value
+        self.lines: dict = {}    # NAME -> lineno
+        tuples: dict = {}
+        for node in proto_src.tree.body:
+            if not (isinstance(node, ast.Assign) and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)):
+                continue
+            name = node.targets[0].id
+            self.lines[name] = node.lineno
+            v = node.value
+            if isinstance(v, ast.Constant) and isinstance(v.value, (str, bytes)):
+                self.consts[name] = v.value
+            else:
+                tuples[name] = v
+
+        def resolve(n):
+            if isinstance(n, ast.Constant):
+                return [n.value]
+            if isinstance(n, ast.Name):
+                if n.id in self.consts:
+                    return [self.consts[n.id]]
+                if n.id in tuples:
+                    return resolve(tuples[n.id])
+                return []
+            if isinstance(n, ast.Tuple):
+                out = []
+                for e in n.elts:
+                    out.extend(resolve(e))
+                return out
+            if isinstance(n, ast.BinOp) and isinstance(n.op, ast.Add):
+                return resolve(n.left) + resolve(n.right)
+            return []
+
+        def tup(name):
+            return tuple(resolve(tuples[name])) if name in tuples else ()
+
+        self.hop_headers = tup("HOP_HEADERS")
+        self.sse_events = tup("SSE_EVENTS")
+        self.dkv1_fields = tup("DKV1_HEADER_FIELDS")
+        self.dkv1_scalars = tup("DKV1_SCALARS")
+        self.hdr_consts = {k: v for k, v in self.consts.items()
+                           if k.startswith("HDR_")}
+        self.sse_consts = {k: v for k, v in self.consts.items()
+                           if k.startswith("SSE_EVENT_")}
+
+    def line(self, name: str) -> int:
+        return self.lines.get(name, 1)
+
+
+def _find(sources, suffix):
+    for s in sources:
+        if s.rel.endswith(suffix):
+            return s
+    return None
+
+
+# ---------------------------------------------------------------------------
+# PROTO-001: DKV1 header fields
+# ---------------------------------------------------------------------------
+
+_SCALAR_TUPLE_NAMES = ("DKV1_SCALARS", "_SCALARS")
+
+
+def _codec_fields(fn, scalars):
+    """(stored, loaded) header-field name sets used inside ``fn``.  A
+    reference to the scalar registry tuple counts as touching every
+    scalar (both sides loop over it)."""
+    stored: set = set()
+    loaded: set = set()
+    saw_scalars = False
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Name) and node.id in _SCALAR_TUPLE_NAMES:
+            saw_scalars = True
+        elif (isinstance(node, ast.Subscript)
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "header"
+                and isinstance(node.slice, ast.Constant)
+                and isinstance(node.slice.value, str)):
+            bucket = stored if isinstance(node.ctx, ast.Store) else loaded
+            bucket.add(node.slice.value)
+        elif (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "get"
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id == "header"
+                and node.args and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)):
+            loaded.add(node.args[0].value)
+        elif isinstance(node, ast.Assign):
+            if (len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                    and node.targets[0].id == "header"
+                    and isinstance(node.value, ast.Dict)):
+                for k in node.value.keys:
+                    if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                        stored.add(k.value)
+    if saw_scalars:
+        stored.update(scalars)
+        loaded.update(scalars)
+    return stored, loaded
+
+
+def _check_dkv1(sources, reg):
+    kv = _find(sources, "serving/kv_transfer.py")
+    if kv is None or not reg.dkv1_fields:
+        return []
+    enc = dec = None
+    for node in ast.walk(kv.tree):
+        if isinstance(node, ast.FunctionDef):
+            if node.name == "encode_snapshot":
+                enc = node
+            elif node.name == "decode_snapshot":
+                dec = node
+    if enc is None or dec is None:
+        return []
+    fields = set(reg.dkv1_fields)
+    written = _codec_fields(enc, reg.dkv1_scalars)[0]
+    parsed = _codec_fields(dec, reg.dkv1_scalars)[1]
+    findings: list = []
+    for f in sorted(fields - written):
+        findings.append(Finding(
+            "PROTO-001", kv.rel, enc.lineno,
+            f"DKV1 field '{f}' is in protocol.DKV1_HEADER_FIELDS but "
+            f"encode_snapshot() never writes it"))
+    for f in sorted(fields - parsed):
+        findings.append(Finding(
+            "PROTO-001", kv.rel, dec.lineno,
+            f"DKV1 field '{f}' is in protocol.DKV1_HEADER_FIELDS but "
+            f"decode_snapshot() never parses it"))
+    for f in sorted(written - fields):
+        findings.append(Finding(
+            "PROTO-001", kv.rel, enc.lineno,
+            f"encode_snapshot() writes header field '{f}' that is not in "
+            f"protocol.DKV1_HEADER_FIELDS — register it or the reader "
+            f"will never see it"))
+    for f in sorted(parsed - fields):
+        findings.append(Finding(
+            "PROTO-001", kv.rel, dec.lineno,
+            f"decode_snapshot() parses header field '{f}' that is not in "
+            f"protocol.DKV1_HEADER_FIELDS — register it or the writer "
+            f"will never send it"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# PROTO-002 / PROTO-003: constant-reference counting + raw-literal bans
+# ---------------------------------------------------------------------------
+
+def _modules_referencing(sources, const_name):
+    mods = set()
+    for s in sources:
+        if s.rel.endswith(_PROTO_REL):
+            continue
+        for node in ast.walk(s.tree):
+            if ((isinstance(node, ast.Name) and node.id == const_name)
+                    or (isinstance(node, ast.Attribute)
+                        and node.attr == const_name)):
+                mods.add(s.rel)
+                break
+    return mods
+
+
+def _iter_raw_strings(src):
+    """(node, text) for every non-docstring str/bytes Constant."""
+    doc = _docstring_nodes(src.tree)
+    for node in ast.walk(src.tree):
+        if (isinstance(node, ast.Constant) and id(node) not in doc
+                and isinstance(node.value, (str, bytes))):
+            v = node.value
+            if isinstance(v, bytes):
+                v = v.decode("utf-8", "replace")
+            yield node, v
+
+
+def _check_sse(sources, reg):
+    findings: list = []
+    for cname, val in sorted(reg.sse_consts.items()):
+        if val not in reg.sse_events:
+            findings.append(Finding(
+                "PROTO-002", reg.src.rel, reg.line(cname),
+                f"{cname} = {val!r} is not listed in SSE_EVENTS"))
+        mods = _modules_referencing(sources, cname)
+        if len(mods) < 2:
+            findings.append(Finding(
+                "PROTO-002", reg.src.rel, reg.line(cname),
+                f"SSE event {cname} ({val!r}) referenced by {len(mods)} "
+                f"module(s) — a wire event needs both an emitter and a "
+                f"scanner importing the constant"))
+    for s in sources:
+        if _is_exempt(s.rel):
+            continue
+        for node, v in _iter_raw_strings(s):
+            hit = next((ev for ev in reg.sse_events if ev and ev in v), None)
+            if hit is not None:
+                findings.append(Finding(
+                    "PROTO-002", s.rel, node.lineno,
+                    f"raw SSE event literal {v!r} — import "
+                    f"serving/protocol.py's constant for {hit!r} instead"))
+            elif v.startswith("event:") and v[len("event:"):].strip():
+                findings.append(Finding(
+                    "PROTO-002", s.rel, node.lineno,
+                    f"SSE frame built from raw literal {v!r} — name the "
+                    f"event in serving/protocol.SSE_EVENTS and derive the "
+                    f"frame from the constant"))
+    return findings
+
+
+def _check_headers(sources, reg):
+    findings: list = []
+    hop = set(reg.hop_headers)
+    for cname, val in sorted(reg.hdr_consts.items()):
+        if val not in hop:
+            findings.append(Finding(
+                "PROTO-003", reg.src.rel, reg.line(cname),
+                f"{cname} = {val!r} is not listed in HOP_HEADERS"))
+        mods = _modules_referencing(sources, cname)
+        if len(mods) < 2:
+            findings.append(Finding(
+                "PROTO-003", reg.src.rel, reg.line(cname),
+                f"hop header {cname} ({val!r}) referenced by {len(mods)} "
+                f"module(s) — a hop header needs both a minter and a "
+                f"reader importing the constant"))
+    for val in sorted(hop - set(reg.hdr_consts.values())):
+        findings.append(Finding(
+            "PROTO-003", reg.src.rel, reg.line("HOP_HEADERS"),
+            f"HOP_HEADERS entry {val!r} has no HDR_* constant"))
+    for s in sources:
+        if _is_exempt(s.rel):
+            continue
+        for node, v in _iter_raw_strings(s):
+            if v in hop or (v.startswith("X-Dllama-") and " " not in v):
+                findings.append(Finding(
+                    "PROTO-003", s.rel, node.lineno,
+                    f"raw hop-header literal {v!r} — import the HDR_* "
+                    f"constant from serving/protocol.py"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# PROTO-004: metric names
+# ---------------------------------------------------------------------------
+
+def _check_metrics(sources):
+    registered: set = set()
+    registration_nodes: set = set()
+    for s in sources:
+        for node in ast.walk(s.tree):
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _REGISTRARS
+                    and node.args
+                    and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)):
+                registered.add(node.args[0].value)
+                registration_nodes.add(id(node.args[0]))
+    findings: list = []
+    for s in sources:
+        if _is_exempt(s.rel) or s.rel.endswith("dllama_tpu/faults.py"):
+            continue
+        for node, v in _iter_raw_strings(s):
+            if (id(node) in registration_nodes or not _METRIC_RE.match(v)
+                    or v in registered):
+                continue
+            findings.append(Finding(
+                "PROTO-004", s.rel, node.lineno,
+                f"metric '{v}' consumed here but never registered via "
+                f"counter()/gauge()/histogram() — a fleet dashboard would "
+                f"read zeros forever"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# entry
+# ---------------------------------------------------------------------------
+
+def check_protocol(sources):
+    """All PROTO passes.  Quietly inert when the tree has no registry —
+    fixture snippets that never grew a serving/ package stay clean."""
+    proto = _find(sources, _PROTO_REL)
+    if proto is None:
+        return []
+    reg = _Registry(proto)
+    findings: list = []
+    findings.extend(_check_dkv1(sources, reg))
+    findings.extend(_check_sse(sources, reg))
+    findings.extend(_check_headers(sources, reg))
+    findings.extend(_check_metrics(sources))
+    return findings

@@ -4,8 +4,9 @@ Two consumers share the annotations declared here:
 
 * the **static analyzer** (``python -m dllama_tpu.analysis``) reads the
   ``@guarded_by(...)`` / ``guard_globals(...)`` calls from the AST and proves
-  every write to an annotated attribute is lexically inside a
-  ``with self.<lock>`` block (rule LOCK-001 and friends);
+  every write to an annotated attribute happens under ``with self.<lock>`` —
+  lexically or via an always-called-under-lock helper (rule LOCK-001 and
+  friends, interprocedural since dllama-check v2);
 * the **runtime sanitizer**, enabled by ``DLLAMA_SANITIZE=1``, instruments the
   annotated classes at import time: each declared lock is replaced by a
   :class:`LockWitness` that records per-thread acquisition order into a global
@@ -27,19 +28,24 @@ Known limits, by design:
 * only **writes** (attribute rebinding) are checked at runtime; in-place
   container mutation (``self._rows[k] = v``) bypasses ``__setattr__`` and is
   covered by the static pass instead;
-* a lock shared with a ``threading.Condition`` (AdmissionGate's ``_idle``)
-  keeps mutual exclusion through the witness, but ownership bookkeeping is
-  best-effort across ``Condition.wait`` (the condition re-acquires the raw
-  lock directly); guarded writes immediately after a ``wait()`` may be
-  reported as unguarded — none exist in this tree;
-* lock-order nodes are keyed ``ClassName.<attr>``, so an inversion between
-  two *instances* of the same class is not distinguishable from re-entrancy
-  and is not reported.
+* a ``threading.Condition`` built in ``__init__`` on a declared lock is
+  retargeted to the witness after instrumentation, and the witness supplies
+  ``_release_save``/``_acquire_restore`` — so ownership bookkeeping is
+  **exact** across ``Condition.wait`` (the witness releases and reacquires
+  with the condition; a guarded write right after ``wait()`` is correctly
+  seen as guarded).  A condition constructed *after* ``__init__``, or on a
+  lock not declared via ``guarded_by``, stays raw and is best-effort;
+* lock-order nodes are keyed ``ClassName.<attr>`` across classes and
+  ``ClassName.<attr>#<instance-serial>`` within a class, so two instances
+  of the same class acquired in opposite orders IS a reported inversion;
+  re-entrant re-acquisition of a witness already on the thread's stack is
+  excluded by identity, never by name.
 """
 
 from __future__ import annotations
 
 import functools
+import itertools
 import os
 import threading
 
@@ -118,22 +124,28 @@ def _held_stack() -> list:
 
 def _record_acquire(witness: "LockWitness") -> None:
     stack = _held_stack()
-    if stack:
-        top = stack[-1].name
-        if top != witness.name:
-            with _order_lock:
-                edges = _order_edges.setdefault(top, set())
-                if witness.name not in edges:
-                    edges.add(witness.name)
-                    # adding top->new: a pre-existing path new->...->top
-                    # closes a cycle
-                    path = _find_path(_order_edges, witness.name, top)
-                    if path is not None:
-                        cycle = " -> ".join(path + [witness.name])
-                        raise LockOrderError(
-                            f"lock-order inversion: acquiring "
-                            f"{witness.name!r} while holding {top!r}, but the "
-                            f"process has also seen {cycle}")
+    # re-entrant re-acquisition (RLock held lower in the stack) records no
+    # edge: by identity, so two same-class instances are never mistaken for
+    # re-entrancy
+    if stack and not any(w is witness for w in stack):
+        top = stack[-1]
+        if top.name != witness.name:
+            src, dst = top.name, witness.name          # cross-class node
+        else:
+            src, dst = top.iname, witness.iname        # per-instance node
+        with _order_lock:
+            edges = _order_edges.setdefault(src, set())
+            if dst not in edges:
+                edges.add(dst)
+                # adding src->new: a pre-existing path new->...->src
+                # closes a cycle
+                path = _find_path(_order_edges, dst, src)
+                if path is not None:
+                    cycle = " -> ".join(path + [dst])
+                    raise LockOrderError(
+                        f"lock-order inversion: acquiring "
+                        f"{dst!r} while holding {src!r}, but the "
+                        f"process has also seen {cycle}")
     stack.append(witness)
 
 
@@ -145,16 +157,22 @@ def _record_release(witness: "LockWitness") -> None:
             break
 
 
+#: monotonically increasing witness serial: per-instance lock-order nodes
+#: are named ``ClassName.<attr>#<serial>``
+_witness_serial = itertools.count(1)
+
+
 class LockWitness:
     """Wraps a Lock/RLock; delegates acquire/release to the raw lock (so a
     ``threading.Condition`` built on the same raw lock stays correct) while
     recording ownership and acquisition order."""
 
-    __slots__ = ("raw", "name", "_owner", "_count")
+    __slots__ = ("raw", "name", "iname", "_owner", "_count")
 
     def __init__(self, raw, name: str):
         self.raw = raw
         self.name = name
+        self.iname = f"{name}#{next(_witness_serial)}"
         self._owner = None
         self._count = 0
 
@@ -196,6 +214,35 @@ class LockWitness:
         if owned is not None:
             return owned()
         return self.held_by_me()
+
+    # Condition.wait() delegates these when its lock provides them: the
+    # witness releases (bookkeeping included) around the wait and restores
+    # after, keeping ownership tracking exact across waits.
+    def _release_save(self):
+        saved = self._count
+        self._owner = None
+        self._count = 0
+        _record_release(self)
+        inner = getattr(self.raw, "_release_save", None)
+        if inner is not None:  # RLock: drop every recursion level at once
+            return (inner(), saved)
+        self.raw.release()
+        return (None, saved)
+
+    def _acquire_restore(self, state):
+        raw_state, saved = state
+        inner = getattr(self.raw, "_acquire_restore", None)
+        if inner is not None:
+            inner(raw_state)
+        else:
+            self.raw.acquire()
+        try:
+            _record_acquire(self)
+        except SanitizerError:
+            self.raw.release()
+            raise
+        self._owner = threading.get_ident()
+        self._count = max(1, saved)
 
     def locked(self):
         return self.raw.locked()
@@ -280,6 +327,23 @@ def _instrument(cls) -> None:
                 object.__setattr__(
                     self, lattr,
                     LockWitness(raw, f"{type(self).__name__}.{lattr}"))
+        # a Condition built in __init__ on a now-swapped lock still holds
+        # the RAW lock: retarget it onto the witness so wait()'s release/
+        # reacquire goes through the witness's bookkeeping (exact ownership
+        # across Condition.wait, see module docstring)
+        for val in list(vars(self).values()):
+            if not isinstance(val, threading.Condition):
+                continue
+            for lattr in lock_attrs:
+                w = getattr(self, lattr, None)
+                if isinstance(w, LockWitness) and val._lock is w.raw:
+                    val._lock = w
+                    val.acquire = w.acquire
+                    val.release = w.release
+                    val._is_owned = w._is_owned
+                    val._release_save = w._release_save
+                    val._acquire_restore = w._acquire_restore
+                    break
         object.__setattr__(self, "_dllama_sanitize_ready", True)
 
     cls.__init__ = init

@@ -695,7 +695,11 @@ class SSEScanner:
     router's resumable relay uses this to strip checkpoint control frames
     and count forwarded bytes exactly; tests use it to assert splice
     arithmetic. Single-threaded by construction (one relay loop owns one
-    scanner), so no lock."""
+    scanner), so no lock.
+
+    The scanner is name-agnostic: every *registered* event name a caller
+    matches against lives in ``serving/protocol.SSE_EVENTS`` (dllama-check
+    PROTO-002 bans raw event literals at the call sites)."""
 
     def __init__(self):
         self._buf = bytearray()

@@ -57,7 +57,15 @@ from dllama_tpu.serving.lifecycle import (
     Supervisor,
     parse_slo_classes,
 )
+from dllama_tpu.serving.protocol import (HDR_CKPT, HDR_CKPT_WIRE, HDR_CLASS,
+                                         HDR_PARENT_SPAN, HDR_REQUEST_ID,
+                                         HDR_RESUME_OFFSET,
+                                         HDR_SERVER_TIMING, SSE_EVENT_CKPT)
 from dllama_tpu.serving.templates import render_llama2_turn, render_llama3_chat
+
+#: the checkpoint control frame's prefix, derived from the registered event
+#: name so emitter and scanner can never drift
+_SSE_CKPT_PREFIX = b"event: " + SSE_EVENT_CKPT.encode() + b"\ndata: "
 
 
 class StopDetector:
@@ -1651,9 +1659,9 @@ class OpenAIHandler(BaseHTTPRequestHandler):
         response, the router's hop span (X-Dllama-Parent-Span) for trace
         stitching, and the not-yet-emitted trace for POSTs."""
         self._rid = observability.sanitize_request_id(
-            self.headers.get("X-Request-Id"))
+            self.headers.get(HDR_REQUEST_ID))
         self._parent_span = observability.sanitize_parent_span(
-            self.headers.get("X-Dllama-Parent-Span"))
+            self.headers.get(HDR_PARENT_SPAN))
         self._trace = None
         self._t_begin = time.monotonic()
 
@@ -1665,11 +1673,11 @@ class OpenAIHandler(BaseHTTPRequestHandler):
         --ckpt-interval default. An unknown wire falls back to f32, the
         bit-exact mode a resume can always trust."""
         st = self.state
-        raw = (self.headers.get("X-Dllama-Ckpt") or "").strip().lower()
+        raw = (self.headers.get(HDR_CKPT) or "").strip().lower()
         if not raw or st.ckpt_interval <= 0:
             return 0, "f32"
         k = (st.ckpt_interval if not raw.isdigit() else int(raw))
-        wire = (self.headers.get("X-Dllama-Ckpt-Wire") or "f32").strip()
+        wire = (self.headers.get(HDR_CKPT_WIRE) or "f32").strip()
         if wire not in kv_transfer.WIRE_MODES:
             wire = "f32"
         return max(0, k), wire
@@ -1707,8 +1715,8 @@ class OpenAIHandler(BaseHTTPRequestHandler):
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
-        self.send_header("X-Request-Id", self._rid)
-        self.send_header("Server-Timing", self._server_timing())
+        self.send_header(HDR_REQUEST_ID, self._rid)
+        self.send_header(HDR_SERVER_TIMING, self._server_timing())
         for k, v in (headers or {}).items():
             self.send_header(k, v)
         self.end_headers()
@@ -1720,10 +1728,10 @@ class OpenAIHandler(BaseHTTPRequestHandler):
         self.send_header("Content-Type", "text/event-stream")
         self.send_header("Cache-Control", "no-cache")
         self.send_header("Connection", "close")
-        self.send_header("X-Request-Id", self._rid)
+        self.send_header(HDR_REQUEST_ID, self._rid)
         # headers leave before decode runs: only the phases known NOW (queue
         # wait at best) appear; the router attributes the rest to stream time
-        self.send_header("Server-Timing", self._server_timing())
+        self.send_header(HDR_SERVER_TIMING, self._server_timing())
         for k, v in (extra or {}).items():
             self.send_header(k, v)
         self.end_headers()
@@ -1786,7 +1794,7 @@ class OpenAIHandler(BaseHTTPRequestHandler):
             self.send_header("Content-Type",
                              "text/plain; version=0.0.4; charset=utf-8")
             self.send_header("Content-Length", str(len(body)))
-            self.send_header("X-Request-Id", self._rid)
+            self.send_header(HDR_REQUEST_ID, self._rid)
             self.end_headers()
             self._count(200)
             self.wfile.write(body)
@@ -1840,7 +1848,7 @@ class OpenAIHandler(BaseHTTPRequestHandler):
         # SLO lane: X-Dllama-Class names the request's class. An UNKNOWN
         # class is a 400, never a silent default — a typo'd "bulk" job
         # must not land in (and blow) the interactive lane
-        slo_class = (self.headers.get("X-Dllama-Class")
+        slo_class = (self.headers.get(HDR_CLASS)
                      or "interactive").strip().lower()
         if slo_class not in SLO_CLASSES:
             self._error(400, f"unknown SLO class {slo_class!r} "
@@ -1993,7 +2001,7 @@ class OpenAIHandler(BaseHTTPRequestHandler):
             except Exception:  # noqa: BLE001
                 st._m_ckpt_writes.inc(outcome="error")
                 return
-            emit_frame(b"event: dllama-ckpt\ndata: "
+            emit_frame(_SSE_CKPT_PREFIX
                        + str(bytes_emitted).encode() + b" "
                        + base64.b64encode(payload) + b"\n\n", fire=False)
 
@@ -2521,8 +2529,8 @@ class OpenAIHandler(BaseHTTPRequestHandler):
         self.send_response(200)
         self.send_header("Content-Type", kv_transfer.CONTENT_TYPE)
         self.send_header("Content-Length", str(len(payload)))
-        self.send_header("X-Request-Id", self._rid)
-        self.send_header("Server-Timing", self._server_timing())
+        self.send_header(HDR_REQUEST_ID, self._rid)
+        self.send_header(HDR_SERVER_TIMING, self._server_timing())
         self.end_headers()
         self._count(200)
         self.wfile.write(payload)
@@ -2672,7 +2680,7 @@ class OpenAIHandler(BaseHTTPRequestHandler):
             cancel=cancel, detector=detector,
             ckpt_every=ckpt_every, ckpt_wire=ckpt_wire,
             resume_state=resume_state,
-            extra_headers={"X-Dllama-Resume-Offset":
+            extra_headers={HDR_RESUME_OFFSET:
                            str(resume_state["bytes"])})
 
 

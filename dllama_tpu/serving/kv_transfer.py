@@ -55,15 +55,13 @@ from typing import Optional
 import numpy as np
 
 from ..quants.blocks import QK, dequantize_q80, quantize_q80
-
-MAGIC = b"DKV1"
-WIRE_MODES = ("f32", "q80", "q80+f32")
-#: HTTP content type of a framed page stream (the prefill endpoint answers
-#: with this when the row migrates, plain JSON when it finished in place)
-CONTENT_TYPE = "application/x-dllama-kv"
-
-_SCALARS = ("page_tokens", "n_blocks", "plen", "pos", "token", "room",
-            "budget", "offered", "emitted")
+# wire-contract strings live in serving/protocol.py (PROTO-001 checks the
+# encode/decode field sets against DKV1_HEADER_FIELDS there); the names
+# below stay re-exported for existing importers
+from .protocol import DKV1_MAGIC as MAGIC
+from .protocol import DKV1_SCALARS as _SCALARS
+from .protocol import KV_CONTENT_TYPE as CONTENT_TYPE
+from .protocol import WIRE_MODES
 
 
 class TransferError(RuntimeError):

@@ -203,8 +203,9 @@ class CancelToken:
         return RequestCancelled(self.reason or "cancelled")
 
 
-#: the SLO classes the server speaks. A request names its lane with
-#: ``X-Dllama-Class``; anything else is a 400, never silently defaulted.
+#: the SLO classes the server speaks. A request names its lane with the
+#: ``serving/protocol.HDR_CLASS`` hop header; anything else is a 400,
+#: never silently defaulted.
 SLO_CLASSES = ("interactive", "batch")
 
 

@@ -1,0 +1,102 @@
+"""Shared wire-contract constants for the dllama fleet.
+
+Every string that crosses a process boundary — hop headers, SSE event
+names, DKV1 snapshot header fields, the KV content type — lives HERE
+and only here.  Writers and readers both import from this module, so a
+one-sided rename is an ImportError / NameError instead of a silent
+fleet-wide desync.  dllama-check's PROTO-00x passes enforce the rest:
+
+* PROTO-001 — DKV1 fields written by ``encode_snapshot`` vs parsed by
+  ``decode_snapshot`` vs the ``DKV1_HEADER_FIELDS`` registry below.
+* PROTO-002 — SSE event names emitted vs scanned; raw event literals
+  outside this module are findings.
+* PROTO-003 — hop header strings minted vs read; raw ``X-Dllama-*`` /
+  ``X-Request-Id`` literals outside this module are findings.
+* PROTO-004 — metric names consumed somewhere in the package must be
+  registered via ``counter()``/``gauge()``/``histogram()``.
+
+Keep every value a plain string literal (the analyzer reads this file
+with ``ast``, it never imports it).  Derive bytes at the use site with
+``.encode()``.
+"""
+
+# --------------------------------------------------------------------------
+# Hop headers (router <-> replica <-> client).
+# --------------------------------------------------------------------------
+
+HDR_REQUEST_ID = "X-Request-Id"
+HDR_PARENT_SPAN = "X-Dllama-Parent-Span"
+HDR_CKPT = "X-Dllama-Ckpt"
+HDR_CKPT_WIRE = "X-Dllama-Ckpt-Wire"
+HDR_CLASS = "X-Dllama-Class"
+HDR_RESUME_OFFSET = "X-Dllama-Resume-Offset"
+HDR_SERVER_TIMING = "Server-Timing"
+
+#: Every header the fleet mints or reads on a hop.  PROTO-003 checks this
+#: tuple against the HDR_* constants above and against actual use.
+HOP_HEADERS = (
+    HDR_REQUEST_ID,
+    HDR_PARENT_SPAN,
+    HDR_CKPT,
+    HDR_CKPT_WIRE,
+    HDR_CLASS,
+    HDR_RESUME_OFFSET,
+    HDR_SERVER_TIMING,
+)
+
+# --------------------------------------------------------------------------
+# SSE control frames (in-band on /v1/completions streams).
+# --------------------------------------------------------------------------
+
+SSE_EVENT_CKPT = "dllama-ckpt"
+
+#: Every named SSE event the fleet emits or scans for.  PROTO-002 checks
+#: each one has both an emitter and a scanner module.
+SSE_EVENTS = (
+    SSE_EVENT_CKPT,
+)
+
+# --------------------------------------------------------------------------
+# DKV1 snapshot codec (serving/kv_transfer.py).
+# --------------------------------------------------------------------------
+
+DKV1_MAGIC = b"DKV1"
+KV_CONTENT_TYPE = "application/x-dllama-kv"
+WIRE_MODES = ("f32", "q80", "q80+f32")
+
+#: Scalar header fields written/parsed in one loop on both sides.
+DKV1_SCALARS = (
+    "page_tokens",
+    "n_blocks",
+    "plen",
+    "pos",
+    "token",
+    "room",
+    "budget",
+    "offered",
+    "emitted",
+)
+
+#: Structural fields always present in a DKV1 JSON header.
+DKV1_BASE_FIELDS = (
+    "v",
+    "mode",
+    "tokens",
+    "prompt",
+    "keys",
+    "temp",
+    "topp",
+    "stop_tokens",
+    "n_leaves",
+    "leaf_shapes",
+    "extra",
+)
+
+#: Fields the encoder writes conditionally; the decoder must still parse
+#: them (with a default) or resumed sessions silently lose state.
+DKV1_OPTIONAL_FIELDS = (
+    "stop_state",
+)
+
+#: The full header contract.  PROTO-001 checks encode/decode against it.
+DKV1_HEADER_FIELDS = DKV1_BASE_FIELDS + DKV1_SCALARS + DKV1_OPTIONAL_FIELDS

@@ -113,6 +113,13 @@ from dllama_tpu import faults, observability
 from dllama_tpu.analysis.sanitize import guarded_by
 from dllama_tpu.serving import kv_transfer
 from dllama_tpu.serving.lifecycle import LifecycleError, Supervisor
+from dllama_tpu.serving.protocol import (HDR_CKPT, HDR_CKPT_WIRE, HDR_CLASS,
+                                         HDR_PARENT_SPAN, HDR_REQUEST_ID,
+                                         HDR_RESUME_OFFSET,
+                                         HDR_SERVER_TIMING, SSE_EVENT_CKPT)
+
+#: the checkpoint control frame's event name as the scanner sees it
+_CKPT_EVENT_B = SSE_EVENT_CKPT.encode()
 
 #: longest prompt prefix the affinity index keys on, in blocks — bounds the
 #: per-request hashing work and the index growth per conversation
@@ -710,7 +717,7 @@ class RouterState:
             try:
                 t_send = time.monotonic()
                 conn.request("GET", "/ready",
-                             headers={"X-Request-Id":
+                             headers={HDR_REQUEST_ID:
                                       observability.new_request_id()})
                 resp = conn.getresponse()
                 body = resp.read()
@@ -825,7 +832,7 @@ class RouterState:
             r.host, r.port, timeout=self.connect_timeout_s)
         try:
             conn.request("GET", path, headers={
-                "X-Request-Id": observability.new_request_id()})
+                HDR_REQUEST_ID: observability.new_request_id()})
             resp = conn.getresponse()
             body = resp.read()
             if resp.status != 200:
@@ -903,7 +910,7 @@ class RouterHandler(BaseHTTPRequestHandler):
 
     def _begin_request(self) -> None:
         self._rid = observability.sanitize_request_id(
-            self.headers.get("X-Request-Id"))
+            self.headers.get(HDR_REQUEST_ID))
         self._t_begin = time.monotonic()
         # one router span per request: its pid:span value is BOTH the
         # X-Dllama-Parent-Span the replica parents its trace under and the
@@ -922,8 +929,8 @@ class RouterHandler(BaseHTTPRequestHandler):
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
-        self.send_header("X-Request-Id", self._rid)
-        self.send_header("Server-Timing", self._server_timing())
+        self.send_header(HDR_REQUEST_ID, self._rid)
+        self.send_header(HDR_SERVER_TIMING, self._server_timing())
         for k, v in (headers or {}).items():
             self.send_header(k, v)
         self.end_headers()
@@ -935,8 +942,8 @@ class RouterHandler(BaseHTTPRequestHandler):
         self.send_header("Content-Type",
                          "text/plain; version=0.0.4; charset=utf-8")
         self.send_header("Content-Length", str(len(body)))
-        self.send_header("X-Request-Id", self._rid)
-        self.send_header("Server-Timing", self._server_timing())
+        self.send_header(HDR_REQUEST_ID, self._rid)
+        self.send_header(HDR_SERVER_TIMING, self._server_timing())
         self.end_headers()
         self._count(code)
         self.wfile.write(body)
@@ -1012,7 +1019,7 @@ class RouterHandler(BaseHTTPRequestHandler):
         if isinstance(req, dict) and self._try_disagg(req, hashes):
             return  # migrated (or finished at the prefill replica)
         self._proxy("POST", body, affinity_hashes=hashes,
-                    slo_class=(self.headers.get("X-Dllama-Class")
+                    slo_class=(self.headers.get(HDR_CLASS)
                                or "").strip().lower() or None)
 
     # -- disaggregated migration ------------------------------------------
@@ -1182,8 +1189,8 @@ class RouterHandler(BaseHTTPRequestHandler):
     # -- the proxy core ---------------------------------------------------
 
     def _upstream_headers(self) -> dict:
-        h = {"X-Request-Id": self._rid,
-             "X-Dllama-Parent-Span": self._parent_value,
+        h = {HDR_REQUEST_ID: self._rid,
+             HDR_PARENT_SPAN: self._parent_value,
              "Content-Type": self.headers.get("Content-Type",
                                               "application/json"),
              "Accept": self.headers.get("Accept", "*/*")}
@@ -1192,14 +1199,14 @@ class RouterHandler(BaseHTTPRequestHandler):
             # opt every upstream stream into mid-stream checkpointing (the
             # replica ignores this for anything that can't checkpoint);
             # the checkpoint rides the same wire mode as migrations
-            h["X-Dllama-Ckpt"] = str(st.ckpt_interval)
-            h["X-Dllama-Ckpt-Wire"] = st.kv_wire
+            h[HDR_CKPT] = str(st.ckpt_interval)
+            h[HDR_CKPT_WIRE] = st.kv_wire
         # the SLO class rides every upstream hop untouched: the REPLICA
         # owns validation (unknown class -> its 400 passes straight
         # through), the router only scores by it
-        cls = (self.headers.get("X-Dllama-Class") or "").strip()
+        cls = (self.headers.get(HDR_CLASS) or "").strip()
         if cls:
-            h["X-Dllama-Class"] = cls
+            h[HDR_CLASS] = cls
         return h
 
     def _proxy(self, method: str, body: bytes, affinity_hashes: list,
@@ -1272,7 +1279,7 @@ class RouterHandler(BaseHTTPRequestHandler):
                         hop["t_ttfb"] = time.monotonic()
                         hop["status"] = resp.status
                         hop["timing"] = observability.parse_server_timing(
-                            resp.getheader("Server-Timing") or "")
+                            resp.getheader(HDR_SERVER_TIMING) or "")
                         streaming = (resp.status == 200
                                      and "text/event-stream"
                                      in (resp.getheader("Content-Type")
@@ -1396,7 +1403,7 @@ class RouterHandler(BaseHTTPRequestHandler):
         second header — HTTP merges repeats); X-Request-Id is OURS (the
         replica echoes the same id we sent, so no conflict)."""
         out = {}
-        for k in ("Content-Type", "Retry-After", "Server-Timing"):
+        for k in ("Content-Type", "Retry-After", HDR_SERVER_TIMING):
             v = resp.getheader(k)
             if v is not None:
                 out[k] = v
@@ -1408,8 +1415,8 @@ class RouterHandler(BaseHTTPRequestHandler):
         for k, v in headers.items():
             self.send_header(k, v)
         self.send_header("Content-Length", str(len(body)))
-        self.send_header("X-Request-Id", self._rid)
-        self.send_header("Server-Timing", self._server_timing())
+        self.send_header(HDR_REQUEST_ID, self._rid)
+        self.send_header(HDR_SERVER_TIMING, self._server_timing())
         self.send_header("Connection", "close")
         self.end_headers()
         self._count(status)
@@ -1441,11 +1448,11 @@ class RouterHandler(BaseHTTPRequestHandler):
                          resp.getheader("Content-Type", "text/event-stream"))
         self.send_header("Cache-Control", "no-cache")
         self.send_header("Connection", "close")
-        self.send_header("X-Request-Id", self._rid)
-        upstream_timing = resp.getheader("Server-Timing")
+        self.send_header(HDR_REQUEST_ID, self._rid)
+        upstream_timing = resp.getheader(HDR_SERVER_TIMING)
         if upstream_timing:
-            self.send_header("Server-Timing", upstream_timing)
-        self.send_header("Server-Timing", self._server_timing())
+            self.send_header(HDR_SERVER_TIMING, upstream_timing)
+        self.send_header(HDR_SERVER_TIMING, self._server_timing())
         self.end_headers()
         self._count(200)
         if self.state.ckpt_interval > 0:
@@ -1526,7 +1533,7 @@ class RouterHandler(BaseHTTPRequestHandler):
                         break
                     for ev in scanner.feed(chunk):
                         fields = observability.sse_event_fields(ev)
-                        if fields.get("event") == b"dllama-ckpt":
+                        if fields.get("event") == _CKPT_EVENT_B:
                             off, _, b64 = fields.get(
                                 "data", b"").partition(b" ")
                             try:
@@ -1646,7 +1653,7 @@ class RouterHandler(BaseHTTPRequestHandler):
                         detail["error"] = repr(e)[:200]
                         continue
                     if (resp.status != 200 or resp.getheader(
-                            "X-Dllama-Resume-Offset") is None):
+                            HDR_RESUME_OFFSET) is None):
                         # 503 = draining/full pool, 422 = the checkpoint
                         # itself was rejected; either way THIS sibling did
                         # no decode work — try the next one

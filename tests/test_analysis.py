@@ -690,3 +690,594 @@ def test_sanitized_real_classes_roundtrip(sanitizer_on):
     g.release()
     g.acquire()
     g.release()
+
+
+# ---------------------------------------------------------------------------
+# LOCK-001 interprocedural: proofs across helper boundaries
+# ---------------------------------------------------------------------------
+
+
+def test_lock001_interprocedural_locked_helper_proven():
+    # a _locked helper whose every call site holds the lock needs no
+    # suppression: the call-graph pass proves the caller holds it
+    src = LOCK_CLASS + (
+        "    def flush(self):\n"
+        "        with self._lock:\n"
+        "            self._bump_locked()\n"
+        "\n"
+        "    def _bump_locked(self):\n"
+        "        self._count += 1\n")
+    assert "LOCK-001" not in _rules(analyze_source(src))
+
+
+def test_lock001_interprocedural_unlocked_path_names_the_chain():
+    src = LOCK_CLASS + (
+        "    def flush(self):\n"
+        "        self._bump_locked()\n"
+        "\n"
+        "    def _bump_locked(self):\n"
+        "        self._count += 1\n")
+    lock1 = [f for f in analyze_source(src) if f.rule == "LOCK-001"]
+    assert lock1
+    assert "unlocked call path" in lock1[0].message
+    assert "C.flush()" in lock1[0].message
+
+
+def test_lock001_interprocedural_uncalled_helper_flagged():
+    # no call site in the module: nothing to prove, so the write is reported
+    src = LOCK_CLASS + (
+        "    def _bump_locked(self):\n"
+        "        self._count += 1\n")
+    lock1 = [f for f in analyze_source(src) if f.rule == "LOCK-001"]
+    assert lock1
+    assert "no call site" in lock1[0].message
+
+
+def test_lock001_interprocedural_mixed_call_sites_flagged():
+    # one locked call site does not excuse an unlocked one
+    src = LOCK_CLASS + (
+        "    def flush(self):\n"
+        "        with self._lock:\n"
+        "            self._bump_locked()\n"
+        "\n"
+        "    def hot(self):\n"
+        "        self._bump_locked()\n"
+        "\n"
+        "    def _bump_locked(self):\n"
+        "        self._count += 1\n")
+    lock1 = [f for f in analyze_source(src) if f.rule == "LOCK-001"]
+    assert lock1
+    assert "C.hot()" in lock1[0].message
+
+
+def test_lock001_interprocedural_transitive_proof():
+    # flush -> _a -> _b: _b's only caller is _a, whose only caller holds
+    # the lock, so _b's write is proven two hops out
+    src = LOCK_CLASS + (
+        "    def flush(self):\n"
+        "        with self._lock:\n"
+        "            self._a()\n"
+        "\n"
+        "    def _a(self):\n"
+        "        self._b()\n"
+        "\n"
+        "    def _b(self):\n"
+        "        self._count += 1\n")
+    assert "LOCK-001" not in _rules(analyze_source(src))
+
+
+def test_lock001_interprocedural_init_only_call_site_ok():
+    # helpers called only from __init__ run before the object is shared
+    src = _snippet("""
+        import threading
+        from dllama_tpu.analysis.sanitize import guarded_by
+
+        @guarded_by("_lock", "_n")
+        class S:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._n = 0
+                self._seed()
+
+            def _seed(self):
+                self._n = 1
+        """)
+    assert "LOCK-001" not in _rules(analyze_source(src))
+
+
+def test_lock001_interprocedural_public_helper_still_flagged():
+    # only private / _locked-suffixed helpers are eligible for the proof;
+    # a public method writing without the lock is a finding even if every
+    # current caller happens to hold it
+    src = LOCK_CLASS + (
+        "    def flush(self):\n"
+        "        with self._lock:\n"
+        "            self.bump()\n"
+        "\n"
+        "    def bump(self):\n"
+        "        self._count += 1\n")
+    assert "LOCK-001" in _rules(analyze_source(src))
+
+
+# ---------------------------------------------------------------------------
+# LOCK-002 per-instance: same-class inversions
+# ---------------------------------------------------------------------------
+
+PAIR_CLASS = _snippet("""
+    import threading
+    from dllama_tpu.analysis.sanitize import guarded_by
+
+    @guarded_by("_lock", "_v")
+    class Cell:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._v = 0
+
+        def merge_into(self, other: "Cell"):
+            with self._lock:
+                with other._lock:
+                    self._v += 1
+    """)
+
+
+def test_lock002_per_instance_inversion_flagged():
+    msgs = [f.message for f in analyze_source(PAIR_CLASS)
+            if f.rule == "LOCK-002"]
+    assert any("per-instance" in m for m in msgs)
+
+
+def test_lock002_per_instance_unknown_type_not_flagged():
+    # receiver type unresolvable -> conservative, no finding
+    src = PAIR_CLASS.replace('other: "Cell"', "other")
+    msgs = [f.message for f in analyze_source(src) if f.rule == "LOCK-002"]
+    assert not any("per-instance" in m for m in msgs)
+
+
+# ---------------------------------------------------------------------------
+# BLOCK-001/002: blocking calls under a lock
+# ---------------------------------------------------------------------------
+
+
+def test_block001_sleep_under_guard_lock():
+    src = LOCK_CLASS + (
+        "    def slowpath(self):\n"
+        "        import time\n"
+        "        with self._lock:\n"
+        "            time.sleep(0.5)\n")
+    assert "BLOCK-001" in _rules(analyze_source(src))
+
+
+def test_block001_bare_queue_get_under_guard_lock():
+    src = LOCK_CLASS + (
+        "    def drain(self, q):\n"
+        "        with self._lock:\n"
+        "            return q.get()\n")
+    assert "BLOCK-001" in _rules(analyze_source(src))
+
+
+def test_block001_negative_sleep_outside_lock():
+    src = LOCK_CLASS + (
+        "    def slowpath(self):\n"
+        "        import time\n"
+        "        time.sleep(0.5)\n"
+        "        with self._lock:\n"
+        "            self._count += 1\n")
+    assert "BLOCK-001" not in _rules(analyze_source(src))
+
+
+def test_block001_negative_bounded_get_under_lock():
+    # a timeout-bounded Queue.get is not an unbounded stall
+    src = LOCK_CLASS + (
+        "    def drain(self, q):\n"
+        "        with self._lock:\n"
+        "            return q.get(timeout=0.1)\n")
+    assert "BLOCK-001" not in _rules(analyze_source(src))
+
+
+def test_block002_urlopen_under_module_lock():
+    src = _snippet("""
+        import threading
+        import urllib.request
+
+        _glock = threading.Lock()
+
+        def fetch(url):
+            with _glock:
+                return urllib.request.urlopen(url)
+        """)
+    assert "BLOCK-002" in _rules(analyze_source(src))
+
+
+def test_block002_negative_urlopen_outside_lock():
+    src = _snippet("""
+        import threading
+        import urllib.request
+
+        _glock = threading.Lock()
+
+        def fetch(url):
+            body = urllib.request.urlopen(url)
+            with _glock:
+                return body
+        """)
+    assert "BLOCK-002" not in _rules(analyze_source(src))
+
+
+# ---------------------------------------------------------------------------
+# PROTO-001..004: wire-protocol conformance (mini serving/ tree)
+# ---------------------------------------------------------------------------
+
+_PROTO_REG = _snippet("""
+    HDR_PING = "X-Dllama-Ping"
+    HOP_HEADERS = (HDR_PING,)
+
+    SSE_EVENT_TICK = "dllama-tick"
+    SSE_EVENTS = (SSE_EVENT_TICK,)
+
+    DKV1_SCALARS = ("pos",)
+    DKV1_BASE_FIELDS = ("v", "tokens")
+    DKV1_HEADER_FIELDS = DKV1_BASE_FIELDS + DKV1_SCALARS
+    """)
+
+_KV_OK = _snippet("""
+    from .protocol import DKV1_SCALARS as _SCALARS
+
+    def encode_snapshot(snap):
+        header = {"v": 1, "tokens": snap["tokens"]}
+        for k in _SCALARS:
+            header[k] = snap[k]
+        return header
+
+    def decode_snapshot(header):
+        scalars = {k: header[k] for k in _SCALARS}
+        return header["v"], header.get("tokens"), scalars
+    """)
+
+_EMITTER_OK = _snippet("""
+    from .protocol import HDR_PING, SSE_EVENT_TICK
+
+    _FRAME = b"event: " + SSE_EVENT_TICK.encode() + b"\\ndata: 1\\n\\n"
+
+    def send(conn, rid):
+        conn.putheader(HDR_PING, rid)
+        return _FRAME
+    """)
+
+_SCANNER_OK = _snippet("""
+    from .protocol import HDR_PING, SSE_EVENT_TICK
+
+    def read(headers, fields):
+        seen = fields.get("event") == SSE_EVENT_TICK.encode()
+        return headers.get(HDR_PING), seen
+    """)
+
+
+def _proto_findings(tmp_path, *, protocol=_PROTO_REG, kv=_KV_OK,
+                    emitter=_EMITTER_OK, scanner=_SCANNER_OK, extra=None):
+    from dllama_tpu.analysis import protocol as aprotocol
+    pkg = tmp_path / "dllama_tpu"
+    (pkg / "serving").mkdir(parents=True)
+    (pkg / "__init__.py").write_text("")
+    (pkg / "serving" / "__init__.py").write_text("")
+    files = {
+        "serving/protocol.py": protocol,
+        "serving/kv_transfer.py": kv,
+        "serving/emitter.py": emitter,
+        "serving/scanner.py": scanner,
+    }
+    files.update(extra or {})
+    sources = []
+    for rel, text in files.items():
+        p = pkg / rel
+        p.write_text(text)
+        sources.append(acore.load_source(str(p), str(tmp_path)))
+    return aprotocol.check_protocol(sources)
+
+
+def test_proto_conformant_tree_clean(tmp_path):
+    assert _proto_findings(tmp_path) == []
+
+
+def test_proto001_encoder_field_rename_caught(tmp_path):
+    kv = _KV_OK.replace('"tokens": snap["tokens"]', '"toks": snap["tokens"]')
+    assert "PROTO-001" in [f.rule for f in _proto_findings(tmp_path, kv=kv)]
+
+
+def test_proto001_decoder_drops_field_caught(tmp_path):
+    kv = _KV_OK.replace('header.get("tokens")', "None")
+    assert "PROTO-001" in [f.rule for f in _proto_findings(tmp_path, kv=kv)]
+
+
+def test_proto002_raw_event_literal_caught(tmp_path):
+    em = _snippet("""
+        from .protocol import HDR_PING, SSE_EVENT_TICK
+
+        def send(conn, rid):
+            conn.putheader(HDR_PING, rid)
+            return b"event: dllama-tick\\ndata: 1\\n\\n" + SSE_EVENT_TICK.encode()
+        """)
+    assert "PROTO-002" in [f.rule for f in _proto_findings(tmp_path, emitter=em)]
+
+
+def test_proto002_event_nobody_scans_caught(tmp_path):
+    # an event only the emitter knows about is write-only wire surface
+    sc = _snippet("""
+        from .protocol import HDR_PING
+
+        def read(headers):
+            return headers.get(HDR_PING)
+        """)
+    assert "PROTO-002" in [f.rule for f in _proto_findings(tmp_path, scanner=sc)]
+
+
+def test_proto003_raw_header_literal_caught(tmp_path):
+    sc = _SCANNER_OK.replace('headers.get(HDR_PING)',
+                             'headers.get("X-Dllama-Ping")')
+    assert "PROTO-003" in [f.rule for f in _proto_findings(tmp_path, scanner=sc)]
+
+
+def test_proto003_header_missing_from_hop_tuple(tmp_path):
+    proto = _PROTO_REG.replace("HOP_HEADERS = (HDR_PING,)", "HOP_HEADERS = ()")
+    assert "PROTO-003" in [f.rule
+                           for f in _proto_findings(tmp_path, protocol=proto)]
+
+
+def test_proto004_unregistered_metric_caught(tmp_path):
+    extra = {"serving/consumer.py": _snippet("""
+        def rows(m):
+            return m.get("dllama_bogus_rows_total")
+        """)}
+    assert "PROTO-004" in [f.rule
+                           for f in _proto_findings(tmp_path, extra=extra)]
+
+
+def test_proto004_registered_metric_clean(tmp_path):
+    extra = {
+        "serving/metrics.py": _snippet("""
+            def setup(reg):
+                return reg.counter("dllama_bogus_rows_total", "rows seen")
+            """),
+        "serving/consumer.py": _snippet("""
+            def rows(m):
+                return m.get("dllama_bogus_rows_total")
+            """),
+    }
+    assert "PROTO-004" not in [f.rule
+                               for f in _proto_findings(tmp_path, extra=extra)]
+
+
+# ---------------------------------------------------------------------------
+# SUP-002: stale suppressions
+# ---------------------------------------------------------------------------
+
+# suppression literals are concatenated so this test file never adds
+# grep-able allow-comments of its own
+
+
+def test_sup002_stale_suppression_flagged():
+    src = LOCK_CLASS.replace(
+        "self._count += 1",
+        "self._count += 1  # dllama: " + "allow[LOCK-001] reason=stale now")
+    assert "SUP-002" in _rules(analyze_source(src))
+
+
+def test_sup002_negative_live_suppression():
+    src = LOCK_CLASS + (
+        "    def bad(self):\n"
+        "        self._count += 1  # dllama: "
+        + "allow[LOCK-001] reason=known benign tear\n")
+    findings = analyze_source(src)
+    assert "SUP-002" not in _rules(findings)
+    assert all(f.suppressed for f in findings if f.rule == "LOCK-001")
+
+
+# ---------------------------------------------------------------------------
+# sanitizer: Condition.wait exactness + per-instance inversion (runtime)
+# ---------------------------------------------------------------------------
+
+
+def test_sanitizer_condition_wait_exact_ownership(sanitizer_on):
+    # the closed false positive: a guarded write AFTER cv.wait() used to
+    # trip UnguardedWriteError because another thread's acquire/release
+    # during the wait clobbered the witness bookkeeping
+    @sanitize.guarded_by("_lock", "_n")
+    class G:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._cv = threading.Condition(self._lock)
+            self._n = 0
+            self._go = False
+
+        def fire(self):
+            with self._lock:
+                self._go = True
+                self._cv.notify_all()
+
+        def wait_and_write(self):
+            with self._lock:
+                while not self._go:
+                    self._cv.wait(timeout=5.0)
+                self._n += 1  # must still count as lock-held post-wait
+                return self._n
+
+    g = G()
+    t = threading.Timer(0.05, g.fire)
+    t.start()
+    try:
+        assert g.wait_and_write() == 1
+    finally:
+        t.join()
+
+
+def test_sanitizer_condition_wait_inversion_smoke(sanitizer_on):
+    # the condition's lock leaves the held stack during wait() and comes
+    # back after, so an order inversion straddling the wait is still seen
+    import time
+
+    @sanitize.guarded_by("_lock", "_n")
+    class W:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._cv = threading.Condition(self._lock)
+            self._n = 0
+
+    @sanitize.guarded_by("_lock", "_x")
+    class Aux:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._x = 0
+
+    w, aux = W(), Aux()
+    done = []
+    errs = []
+
+    def waiter():
+        try:
+            with w._lock:
+                while not done:
+                    w._cv.wait(timeout=5.0)
+                with aux._lock:  # W._lock -> Aux._lock
+                    pass
+        except sanitize.LockOrderError as e:
+            errs.append(e)
+
+    def kicker():
+        time.sleep(0.05)
+        with aux._lock:
+            with w._lock:  # Aux._lock -> W._lock, while waiter waits
+                done.append(1)
+                w._cv.notify_all()
+
+    t1 = threading.Thread(target=waiter)
+    t2 = threading.Thread(target=kicker)
+    t1.start(); t2.start()
+    t1.join(timeout=10); t2.join(timeout=10)
+    assert errs, "inversion across Condition.wait must be detected"
+
+
+def test_sanitizer_per_instance_inversion_detected(sanitizer_on):
+    @sanitize.guarded_by("_lock", "_v")
+    class Cell:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._v = 0
+
+        def merge_into(self, other):
+            with self._lock:
+                with other._lock:
+                    pass
+
+    a, b = Cell(), Cell()
+    a.merge_into(b)
+    with pytest.raises(sanitize.LockOrderError):
+        b.merge_into(a)
+
+
+def test_sanitizer_reentrant_same_instance_not_inverted(sanitizer_on):
+    # re-entering the same witness (RLock) must not create a self-edge
+    @sanitize.guarded_by("_lock", "_v")
+    class R:
+        def __init__(self):
+            self._lock = threading.RLock()
+            self._v = 0
+
+        def outer(self):
+            with self._lock:
+                self._v += 1
+                self.inner()
+
+        def inner(self):
+            with self._lock:
+                self._v += 2
+
+    r = R()
+    r.outer()
+    assert r._v == 3
+
+
+# ---------------------------------------------------------------------------
+# desync drills: breaking any one wire contract fails the gate
+# ---------------------------------------------------------------------------
+
+
+def _copy_repo(tmp_path):
+    import shutil
+    root = _repo_root()
+    ignore = shutil.ignore_patterns("__pycache__", "*.pyc")
+    shutil.copytree(os.path.join(root, "dllama_tpu"),
+                    os.path.join(str(tmp_path), "dllama_tpu"), ignore=ignore)
+    shutil.copytree(os.path.join(root, "tests"),
+                    os.path.join(str(tmp_path), "tests"), ignore=ignore)
+    shutil.copy(os.path.join(root, "README.md"),
+                os.path.join(str(tmp_path), "README.md"))
+    return str(tmp_path)
+
+
+_DESYNCS = [
+    ("dkv1-field", "dllama_tpu/serving/kv_transfer.py",
+     '"tokens": tokens', '"toks": tokens'),
+    ("sse-event", "dllama_tpu/serving/api_server.py",
+     "emit_frame(_SSE_CKPT_PREFIX",
+     'emit_frame(b"event: dllama-ckpt2\\ndata: "'),
+    ("hop-header", "dllama_tpu/serving/router.py",
+     "self.send_header(HDR_REQUEST_ID, self._rid)",
+     'self.send_header("X-Request-Id", self._rid)'),
+    ("site-metric", "dllama_tpu/faults.py",
+     "SITE_METRICS = {",
+     'SITE_METRICS = {\n    "bogus_site": "dllama_bogus_total",'),
+]
+
+
+@pytest.mark.parametrize("name,rel,old,new",
+                         _DESYNCS, ids=[d[0] for d in _DESYNCS])
+def test_desync_drill_fails_the_gate(tmp_path, name, rel, old, new):
+    root = _copy_repo(tmp_path)
+    path = os.path.join(root, rel)
+    with open(path, encoding="utf-8") as fh:
+        text = fh.read()
+    assert old in text, f"drill anchor missing from {rel}"
+    with open(path, "w", encoding="utf-8") as fh:
+        fh.write(text.replace(old, new, 1))
+    report = acore.run(root)
+    assert not report.ok
+
+
+# ---------------------------------------------------------------------------
+# CLI: --sarif / --only / --files / --budget-s
+# ---------------------------------------------------------------------------
+
+
+def test_cli_sarif_output(tmp_path, capsys):
+    from dllama_tpu.analysis.__main__ import main
+    sarif = tmp_path / "out.sarif"
+    rc = main(["--root", _repo_root(), "--sarif", str(sarif),
+               "--budget-s", "120"])
+    capsys.readouterr()
+    assert rc == 0
+    data = json.loads(sarif.read_text())
+    assert data["version"] == "2.1.0"
+    assert data["runs"][0]["tool"]["driver"]["name"] == "dllama-check"
+
+
+def test_cli_only_rule_filter(capsys):
+    from dllama_tpu.analysis.__main__ import main
+    rc = main(["--root", _repo_root(), "--only", "PROTO", "--json"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert out["ok"] is True
+
+
+def test_cli_changed_files_mode(capsys):
+    from dllama_tpu.analysis.__main__ import main
+    rc = main(["--root", _repo_root(),
+               "--files", "dllama_tpu/faults.py", "--json"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert out["ok"] is True
+
+
+def test_cli_budget_gate_trips(capsys):
+    from dllama_tpu.analysis.__main__ import main
+    rc = main(["--root", _repo_root(), "--budget-s", "0.000001"])
+    capsys.readouterr()
+    assert rc == 1
