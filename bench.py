@@ -1014,7 +1014,7 @@ def run_decode_bench(cfg_dict: dict, bench_steps: int = None, quant_ok: bool = F
         def _timed_obs(e):
             e.generate_batch([[1]] * B, steps=osteps, sampler=greedy)
             best = None
-            for _ in range(5):
+            for _ in range(8):
                 t1 = time.perf_counter()
                 out = e.generate_batch([[1]] * B, steps=osteps,
                                        sampler=greedy)
@@ -1024,7 +1024,23 @@ def run_decode_bench(cfg_dict: dict, bench_steps: int = None, quant_ok: bool = F
             return best
 
         log(f"obs: timing telemetry overhead (B={B}, {osteps} steps)")
-        on_ms = _timed_obs(eng)
+        # the on-leg carries the FULL observability stack: the history
+        # sampler + burn-rate engine run at 4x production cadence (0.25s
+        # vs the 1s default) against the engine's registry while it
+        # decodes, so the <1% budget now covers the sampler thread too.
+        # (One full-registry pass costs ~0.8ms of GIL; 20Hz would burn
+        # 1.6% on the sampler alone — more than the whole budget.)
+        from dllama_tpu.obsv import (BurnRateEngine as _BurnEng,
+                                     Sampler as _TsSampler,
+                                     TimeSeriesStore as _TsStore)
+        from dllama_tpu.serving.lifecycle import parse_slo_classes as _pslo
+
+        _tstore = _TsStore()
+        _tsampler = _TsSampler(
+            _obs.default_registry(), _tstore, interval_s=0.25,
+            hooks=(_BurnEng(_tstore,
+                            _pslo("interactive:ttft=500,tpot=50,err=0.01"),
+                            _obs.default_registry()).evaluate,))
         if weights in ("q40", "q80"):
             params2 = llama.device_random_quant_params(cfg, kind=weights,
                                                        seed=0)
@@ -1034,10 +1050,29 @@ def run_decode_bench(cfg_dict: dict, bench_steps: int = None, quant_ok: bool = F
                          cache_dtype=cache_dtype, mesh=mesh,
                          decode_chunk=bench_steps, metrics=None)
         del params2
-        off_ms = _timed_obs(eng_off)
-        overhead = (on_ms - off_ms) / off_ms * 100.0
+        # paired trials, median delta — a fixed on-first ordering folds
+        # ambient machine noise into one side of a sub-percent
+        # comparison, and any single trial can catch a burst; a genuine
+        # per-token cost shifts every trial. The sampler thread only runs
+        # while the instrumented engine is the one being timed.
+        deltas, pairs = [], []
+        for _ in range(5):
+            off_t = _timed_obs(eng_off)
+            _tsampler.start()
+            try:
+                on_t = _timed_obs(eng)
+            finally:
+                _tsampler.stop()
+            pairs.append((on_t, off_t))
+            deltas.append((on_t - off_t) / off_t * 100.0)
+        overhead = sorted(deltas)[len(deltas) // 2]
+        on_ms, off_ms = pairs[sorted(range(len(deltas)),
+                                     key=lambda i: deltas[i])[
+                                         len(deltas) // 2]]
         log(f"telemetry overhead: on {on_ms:.4f} vs off {off_ms:.4f} "
-            f"ms/token effective = {overhead:+.2f}% (budget < 1%)")
+            f"ms/token effective, median of 5 trials = {overhead:+.2f}% "
+            "(budget < 1%; trials "
+            + " ".join(f"{d:+.2f}%" for d in deltas) + ")")
         if overhead >= 1.0:
             raise RuntimeError(
                 f"telemetry overhead {overhead:+.2f}% exceeds the 1% "
@@ -1163,7 +1198,12 @@ def run_decode_bench(cfg_dict: dict, bench_steps: int = None, quant_ok: bool = F
                             "queue;dur=0.1, prefill;dur=0.2, "
                             "decode;dur=0.3"),))
 
-        def _fleet_leg(obs_on):
+        def _fleet_up(obs_on):
+            """One router fleet (2 stub replicas) with observability on or
+            off; returns (router_port, teardown). The on fleet carries the
+            full stack — flight recorder, 0.05s history sampler, and a
+            hostile federation loop (/metrics/fleet at 20Hz, history +
+            alerts at 2Hz, 10-30x denser than any real dashboard)."""
             ups = [_TS(("127.0.0.1", 0), _StubReplica) for _ in range(2)]
             for u in ups:
                 _threading.Thread(target=u.serve_forever,
@@ -1172,59 +1212,98 @@ def run_decode_bench(cfg_dict: dict, bench_steps: int = None, quant_ok: bool = F
                 [_rt.Replica("127.0.0.1", u.server_address[1])
                  for u in ups],
                 probe_interval_s=3600.0, metrics=_obs.MetricsRegistry(),
-                enable_flight=obs_on)
+                enable_flight=obs_on,
+                # 0 = the sampler thread never starts on the off fleet
+                ts_interval=0.05 if obs_on else 0.0)
             state.probe_once()
+            state.sampler.start()
             srv = _rt.create_router_server(state, host="127.0.0.1", port=0)
             port = srv.server_address[1]
             _threading.Thread(target=srv.serve_forever, daemon=True).start()
             stop = _threading.Event()
             if obs_on:
                 def _scrape_loop():
+                    i = 0
                     while not stop.is_set():
                         state.federate()
-                        stop.wait(0.05)  # 300x denser than a real
-                        #   Prometheus scrape: a deliberately hostile cadence
+                        if i % 10 == 0:
+                            state.federate_history(60.0)
+                            state.federate_alerts()
+                        i += 1
+                        stop.wait(0.05)
                 _threading.Thread(target=_scrape_loop, daemon=True).start()
-            body = _jsn.dumps({
-                "model": "bench", "max_tokens": 1,
-                "messages": [{"role": "user", "content": "x"}]}).encode()
-            NREQ = 50
 
-            def _round():
-                conn = _hc.HTTPConnection("127.0.0.1", port)
-                t1 = time.perf_counter()
-                for _ in range(NREQ):
-                    conn.request("POST", "/v1/chat/completions", body=body,
-                                 headers={"Content-Type":
-                                          "application/json"})
-                    r = conn.getresponse()
-                    r.read()
-                dt = (time.perf_counter() - t1) * 1000.0 / NREQ
-                conn.close()
-                return dt
-
-            try:
-                _round()  # warm sockets, code paths, and the scrape loop
-                return min(_round() for _ in range(7))
-            finally:
+            def _down():
                 stop.set()
+                state.sampler.stop()
                 srv.shutdown()
                 srv.server_close()
                 for u in ups:
                     u.shutdown()
                     u.server_close()
+            return port, _down
 
         log("obs: fleet front-door A/B (proxy hot path, fleet obs on/off)")
-        fl_on = _fleet_leg(True)
-        fl_off = _fleet_leg(False)
-        fl_over = (fl_on - fl_off) / fl_off * 100.0
+        # Both fleets serve SIMULTANEOUSLY and the probe alternates single
+        # requests between them (swapping within-pair order every
+        # iteration), so both sides sample identical machine conditions —
+        # sequential legs fold ambient noise into whichever side runs in
+        # the worse window (measured at +-10% phantom deltas on this very
+        # comparison). Per trial the p10 per-request floor beats a min
+        # (a min is a rare-event statistic); the gate takes the median of
+        # three trial deltas — a genuine per-request cost shifts every
+        # trial, a burst shifts one.
+        body = _jsn.dumps({
+            "model": "bench", "max_tokens": 1,
+            "messages": [{"role": "user", "content": "x"}]}).encode()
+        port_off, down_off = _fleet_up(False)
+        port_on, down_on = _fleet_up(True)
+        try:
+            conn_off = _hc.HTTPConnection("127.0.0.1", port_off)
+            conn_on = _hc.HTTPConnection("127.0.0.1", port_on)
+
+            def _one(conn):
+                t1 = time.perf_counter()
+                conn.request("POST", "/v1/chat/completions", body=body,
+                             headers={"Content-Type": "application/json"})
+                r = conn.getresponse()
+                r.read()
+                return (time.perf_counter() - t1) * 1000.0
+
+            for _ in range(100):  # warm sockets, code paths, scrape loop
+                _one(conn_off), _one(conn_on)
+            deltas, floors = [], []
+            for _trial in range(3):
+                offs, ons = [], []
+                for i in range(400):
+                    if i % 2:
+                        offs.append(_one(conn_off))
+                        ons.append(_one(conn_on))
+                    else:
+                        ons.append(_one(conn_on))
+                        offs.append(_one(conn_off))
+                offs.sort()
+                ons.sort()
+                p_off, p_on = offs[len(offs) // 10], ons[len(ons) // 10]
+                floors.append((p_on, p_off))
+                deltas.append((p_on - p_off) / p_off * 100.0)
+            conn_off.close()
+            conn_on.close()
+        finally:
+            down_on()
+            down_off()
+        fl_over = sorted(deltas)[len(deltas) // 2]
+        fl_on, fl_off = floors[sorted(range(3),
+                                      key=lambda i: deltas[i])[1]]
         log(f"fleet front-door overhead: on {fl_on:.3f} vs off "
-            f"{fl_off:.3f} ms/request = {fl_over:+.2f}% (budget < 1%)")
+            f"{fl_off:.3f} ms/request p10, median of 3 trials = "
+            f"{fl_over:+.2f}% (budget < 1%; trials "
+            + " ".join(f"{d:+.2f}%" for d in deltas) + ")")
         if fl_over >= 1.0:
             raise RuntimeError(
                 f"fleet observability overhead {fl_over:+.2f}% exceeds "
-                "the 1% budget (flight+federation on vs off through the "
-                "router front door)")
+                "the 1% budget (flight+sampler+federation on vs off "
+                "through the router front door)")
         return (on_ms,
                 f"{weights}-obs-b{B}-overhead{overhead:.2f}pct{cfg_tag}")
 
@@ -2608,6 +2687,29 @@ def run_workloads_bench(n: int) -> dict:
     return result
 
 
+def _trajectory_note(status: str, result=None, error=None) -> None:
+    """Append this round to the durable bench trajectory
+    (results/trajectory.jsonl) and surface comparator regressions.
+
+    Every exit path of main() lands here — success, hard-fail gate,
+    deadline, and the backend-unreachable path that used to die as an
+    unstructured log line — so the trajectory records when the hardware
+    came and went, not just the runs that survived. Never raises."""
+    from dllama_tpu.obsv import trajectory as _traj
+
+    bench = (result or {}).get("metric") or "bench"
+    gates = {"deadline": status != "timeout",
+             "backend": status != "tpu_unreachable",
+             "hard_fail": status == "ok"}
+    rep = _traj.append_row(bench, status, result=result, gates=gates,
+                           error=error)
+    for flag in rep["regressions"]:
+        log(f"trajectory REGRESSION vs last same-host {bench} run: {flag}")
+    if rep["path"]:
+        log(f"trajectory: {status} row appended to {rep['path']} "
+            f"({len(rep['regressions'])} regression flag(s))")
+
+
 def main() -> None:
     # metric name for the error path, resolvable without touching jax
     choice = os.environ.get("BENCH_MODEL", "")
@@ -2638,14 +2740,17 @@ def main() -> None:
     deadline_s = float(os.environ.get("BENCH_DEADLINE_S", "1200"))
 
     def _deadline():
+        err = (f"bench exceeded {deadline_s:.0f}s deadline "
+               "(tunnel wedged mid-run?)")
         print(json.dumps({
             "metric": err_metric,
             "value": None,
             "unit": "ms/token",
             "vs_baseline": None,
-            "error": f"bench exceeded {deadline_s:.0f}s deadline "
-                     "(tunnel wedged mid-run?)",
+            "error": err,
         }), flush=True)
+        _trajectory_note("timeout", result={"metric": err_metric},
+                         error=err)
         os._exit(1)
 
     if deadline_s > 0:
@@ -2675,6 +2780,8 @@ def main() -> None:
         if deadline_s > 0:
             timer.cancel()
         print(json.dumps(result), flush=True)
+        _trajectory_note("error" if result.get("error") else "ok",
+                         result=result, error=result.get("error"))
         raise SystemExit(1 if result.get("error") else 0)
 
     if os.environ.get("DLLAMA_PLATFORM"):
@@ -2713,6 +2820,11 @@ def main() -> None:
                 "vs_baseline": None,
                 "error": f"backend unreachable: {bdetail}",
             }), flush=True)
+            # the round the trajectory exists for: a structured
+            # tpu_unreachable row instead of a vanished run
+            _trajectory_note("tpu_unreachable",
+                             result={"metric": err_metric},
+                             error=f"backend unreachable: {bdetail}")
             raise SystemExit(1)
         quant_ok = probed or "BENCH_WEIGHTS" in os.environ
     if not quant_ok and "BENCH_WEIGHTS" not in os.environ:
@@ -2803,6 +2915,8 @@ def main() -> None:
         # JSON record during teardown — the success line below is final
         timer.cancel()
     print(json.dumps(result), flush=True)
+    _trajectory_note("error" if result.get("error") else "ok",
+                     result=result, error=result.get("error"))
 
 
 if __name__ == "__main__":

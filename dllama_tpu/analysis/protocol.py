@@ -20,7 +20,10 @@ PROTO-003  hop headers: HDR_* constants vs the HOP_HEADERS tuple,
 PROTO-004  metric names: every ``dllama_*`` name consumed somewhere in
            the package is registered via ``counter()``/``gauge()``/
            ``histogram()`` (faults.py's SITE_METRICS is FAULT-003's
-           job and exempt here).
+           job and exempt here); cli.py — a cross-process consumer that
+           scrapes the wire instead of sharing a registry — may not
+           spell ANY raw ``dllama_*`` literal (it imports MET_*), and
+           the MET_*/WIRE_METRICS registry must itself stay registered.
 
 The registry file is read with ``ast`` — never imported — so the
 analyzer stays dependency-free and a syntax error there is an AST-001,
@@ -103,6 +106,7 @@ class _Registry:
 
         self.hop_headers = tup("HOP_HEADERS")
         self.sse_events = tup("SSE_EVENTS")
+        self.wire_metrics = tup("WIRE_METRICS")
         self.dkv1_fields = tup("DKV1_HEADER_FIELDS")
         self.dkv1_scalars = tup("DKV1_SCALARS")
         self.hdr_consts = {k: v for k, v in self.consts.items()
@@ -307,7 +311,7 @@ def _check_headers(sources, reg):
 # PROTO-004: metric names
 # ---------------------------------------------------------------------------
 
-def _check_metrics(sources):
+def _check_metrics(sources, reg=None):
     registered: set = set()
     registration_nodes: set = set()
     for s in sources:
@@ -324,15 +328,44 @@ def _check_metrics(sources):
     for s in sources:
         if _is_exempt(s.rel) or s.rel.endswith("dllama_tpu/faults.py"):
             continue
+        cross_process = s.rel.endswith("dllama_tpu/cli.py")
         for node, v in _iter_raw_strings(s):
-            if (id(node) in registration_nodes or not _METRIC_RE.match(v)
-                    or v in registered):
+            if id(node) in registration_nodes or not _METRIC_RE.match(v):
+                continue
+            if cross_process:
+                # cli scrapes the wire instead of sharing a registry, so a
+                # registered-elsewhere literal is STILL a desync waiting to
+                # happen: the family it spells can be renamed at the
+                # registration site without the dashboard noticing
+                findings.append(Finding(
+                    "PROTO-004", s.rel, node.lineno,
+                    f"raw metric literal '{v}' in cli.py — import the "
+                    f"MET_* constant from serving/protocol.py so the "
+                    f"dashboard can never desync from the registry"))
+                continue
+            if v in registered:
                 continue
             findings.append(Finding(
                 "PROTO-004", s.rel, node.lineno,
                 f"metric '{v}' consumed here but never registered via "
                 f"counter()/gauge()/histogram() — a fleet dashboard would "
                 f"read zeros forever"))
+    if reg is not None:
+        met_consts = {k: v for k, v in reg.consts.items()
+                      if k.startswith("MET_")}
+        wire = set(reg.wire_metrics)
+        for cname, val in sorted(met_consts.items()):
+            if val not in wire:
+                findings.append(Finding(
+                    "PROTO-004", reg.src.rel, reg.line(cname),
+                    f"{cname} = {val!r} is not listed in WIRE_METRICS"))
+        for val in sorted(wire):
+            if val not in registered:
+                findings.append(Finding(
+                    "PROTO-004", reg.src.rel, reg.line("WIRE_METRICS"),
+                    f"WIRE_METRICS entry {val!r} is not registered via "
+                    f"counter()/gauge()/histogram() anywhere — the "
+                    f"consumer would read zeros forever"))
     return findings
 
 
@@ -351,5 +384,5 @@ def check_protocol(sources):
     findings.extend(_check_dkv1(sources, reg))
     findings.extend(_check_sse(sources, reg))
     findings.extend(_check_headers(sources, reg))
-    findings.extend(_check_metrics(sources))
+    findings.extend(_check_metrics(sources, reg))
     return findings

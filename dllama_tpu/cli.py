@@ -80,6 +80,14 @@ def build_parser() -> argparse.ArgumentParser:
             "mid-SSE the router resumes the stream bit-identically on a "
             "sibling replica from the latest checkpoint. 0 disables "
             "checkpoint frames and resume orchestration")
+        rp.add_argument(
+            "--ts-interval", type=float, default=1.0, metavar="S",
+            help="metrics-history sampling cadence in seconds: a daemon "
+            "thread snapshots every counter/gauge/histogram-percentile "
+            "into the bounded in-process time-series store behind "
+            "GET /metrics/history (under `fleet` the flag also rides "
+            "every replica's serve argv, so one flag sets the whole "
+            "fleet's history resolution); 0 disables the sampler thread")
 
     # the fleet front door: stdlib-only, no model artifacts, no jax — it
     # proxies the OpenAI surface across N running `serve` replicas
@@ -147,6 +155,44 @@ def build_parser() -> argparse.ArgumentParser:
                     help="seconds between refreshes")
     tp.add_argument("--iterations", type=int, default=0, metavar="N",
                     help="stop after N refreshes (0 = run until ^C)")
+
+    # per-request latency forensics: join trace spans + flight-recorder
+    # events already on disk into one phase waterfall — stdlib only
+    ep = sub.add_parser(
+        "explain", help="phase waterfall for one request id from trace "
+        "+ flight-recorder files")
+    ep.add_argument("request_id", metavar="REQUEST_ID",
+                    help="the X-Request-Id to explain (as logged / "
+                    "returned in the response headers)")
+    ep.add_argument("--trace", action="append", default=[], metavar="PATH",
+                    help="trace file or directory of part files "
+                    "(repeatable); the DLLAMA_TRACE output, solo or "
+                    "fleet-merged")
+    ep.add_argument("--flight", action="append", default=[],
+                    metavar="PATH",
+                    help="flight-recorder snapshot JSON (a saved "
+                    "/debug/flight body or $DLLAMA_FLIGHT dump; "
+                    "repeatable)")
+    ep.add_argument("--json", action="store_true",
+                    help="emit the joined waterfall as JSON")
+    ep.add_argument("--width", type=int, default=48, metavar="COLS",
+                    help="waterfall bar width in columns")
+
+    # support bundle: one tarball of every observability surface of a
+    # running fleet — what you attach to a bug report
+    zp = sub.add_parser(
+        "snapshot", help="support bundle: tarball the fleet's metrics, "
+        "history, stats, alerts, flight rings and newest trace parts")
+    zp.add_argument("--router", default="127.0.0.1:9900",
+                    metavar="HOST:PORT", help="the router front door")
+    zp.add_argument("--out", default=None, metavar="PATH",
+                    help="output tarball path (default "
+                    "dllama-snapshot-<unixtime>.tar.gz)")
+    zp.add_argument("--window", type=float, default=300.0, metavar="S",
+                    help="history window to bundle from /metrics/history")
+    zp.add_argument("--trace-dir", default=None, metavar="DIR",
+                    help="directory holding DLLAMA_TRACE part files; the "
+                    "newest part per replica (and overall) is bundled")
 
     for mode in ("inference", "generate", "chat", "serve", "worker"):
         sp = sub.add_parser(mode)
@@ -276,7 +322,26 @@ def build_parser() -> argparse.ArgumentParser:
                 "rows — interactive arrivals preempt batch rows at chunk "
                 "boundaries and resume them bit-identically when "
                 "pressure drops. Unset = one classless lane "
-                "(pre-SLO behavior)",
+                "(pre-SLO behavior). Burn-rate targets ride the same "
+                "spec: ttft=MS / tpot=MS (per-class p95 latency SLO "
+                "targets) and err=FRACTION (5xx error budget) arm the "
+                "multi-window burn-rate alert engine behind GET /alerts",
+            )
+            sp.add_argument(
+                "--ts-interval", type=float, default=1.0, metavar="S",
+                help="metrics-history sampling cadence in seconds "
+                "(see `router --ts-interval`); the sampler also drives "
+                "SLO burn-rate evaluation; 0 disables both",
+            )
+            sp.add_argument(
+                "--burn-short", type=float, default=60.0, metavar="S",
+                help="short burn-rate window: an SLO alert fires only "
+                "when BOTH the short and long windows burn past the "
+                "threshold (short reacts, long filters blips)",
+            )
+            sp.add_argument(
+                "--burn-long", type=float, default=300.0, metavar="S",
+                help="long burn-rate window (see --burn-short)",
             )
             sp.add_argument(
                 "--drain-timeout",
@@ -890,12 +955,36 @@ def _top_class_series(text: str, families: tuple) -> dict:
     return out
 
 
+_SPARK_GLYPHS = "▁▂▃▄▅▆▇█"
+
+
+def _spark(values, width: int = 24) -> str:
+    """A unicode sparkline of the last ``width`` values (min..max scaled;
+    flat series render as a flat low line)."""
+    vals = [v for v in values if v is not None][-width:]
+    if not vals:
+        return "-"
+    lo, hi = min(vals), max(vals)
+    span = (hi - lo) or 1.0
+    return "".join(
+        _SPARK_GLYPHS[int((v - lo) / span * (len(_SPARK_GLYPHS) - 1))]
+        for v in vals)
+
+
 def run_top(args) -> int:
     """``cli top``: a refreshing terminal view of the fleet — per-replica
     rotation/load from the router's /stats, per-replica request counters
-    and latency means from /metrics/fleet. Read-only; safe against a
-    half-up fleet (unreachable router prints a retry line)."""
+    and latency means from /metrics/fleet, firing SLO alerts from
+    /alerts and TTFT-p95 sparklines from /metrics/history. Read-only;
+    safe against a half-up fleet (unreachable router prints a retry
+    line, pre-observability routers just lose the alert/spark rows)."""
     import json as json_mod
+
+    from dllama_tpu.serving.protocol import (MET_CLASS_QUEUE_DEPTH,
+                                             MET_CLASS_RESIDENT_ROWS,
+                                             MET_HTTP_REQUESTS,
+                                             MET_KV_TRANSFER_BYTES,
+                                             MET_TPOT_MS, MET_TTFT_MS)
 
     host, _, port_s = args.router.rpartition(":")
     if not host or not port_s.isdigit():
@@ -920,8 +1009,8 @@ def run_top(args) -> int:
                 # lane gauges keep their slo_class label (a summed fold
                 # would blur interactive and batch pressure together)
                 lanes = _top_class_series(
-                    fleet_text, ("dllama_class_queue_depth",
-                                 "dllama_class_resident_rows"))
+                    fleet_text, (MET_CLASS_QUEUE_DEPTH,
+                                 MET_CLASS_RESIDENT_ROWS))
                 load = stats.get("load") or {}
                 lines.append(
                     f"dllama top — router {args.router}  "
@@ -958,12 +1047,11 @@ def run_top(args) -> int:
                             return "-"
                         return f"{int(i or 0)}/{int(b or 0)}"
 
-                    reqs = fams.get(("dllama_http_requests_total", name))
+                    reqs = fams.get((MET_HTTP_REQUESTS, name))
                     # KV handoff wire rate (in+out summed — the families
                     # fold summed their direction label): delta since the
                     # previous refresh of this replica's bytes counter
-                    kv_bytes = fams.get(
-                        ("dllama_kv_transfer_bytes_total", name))
+                    kv_bytes = fams.get((MET_KV_TRANSFER_BYTES, name))
                     kv_rate = "-"
                     if kv_bytes is not None:
                         last = kv_prev.get(name)
@@ -978,14 +1066,55 @@ def run_top(args) -> int:
                         f"{rload.get('slots_occupied', 0):>4}/"
                         f"{rload.get('slots_total', 0):<3}"
                         f"{rload.get('queue_depth', 0):>7}"
-                        f"{lane_pair('dllama_class_queue_depth'):>8}"
-                        f"{lane_pair('dllama_class_resident_rows'):>9}"
+                        f"{lane_pair(MET_CLASS_QUEUE_DEPTH):>8}"
+                        f"{lane_pair(MET_CLASS_RESIDENT_ROWS):>9}"
                         f"{rload.get('kv_pages_free', '-'):>9}"
                         f"{(f'{age:.1f}s' if age is not None else '-'):>11}"
                         f"{(f'{reqs:.0f}' if reqs is not None else '-'):>8}"
-                        f"{mean('dllama_ttft_ms'):>9}"
-                        f"{mean('dllama_tpot_ms'):>9}"
+                        f"{mean(MET_TTFT_MS):>9}"
+                        f"{mean(MET_TPOT_MS):>9}"
                         f"{kv_rate:>9}")
+                # the SLO burn-rate picture: every firing alert gets its
+                # own row; pre-observability routers 404 -> row omitted
+                code, alerts_body = _top_get(host, port, "/alerts")
+                if code == 200:
+                    alerts = json_mod.loads(alerts_body)
+                    firing = [
+                        (rname, a)
+                        for rname, pay in (alerts.get("replicas")
+                                           or {}).items()
+                        for a in pay.get("alerts") or []
+                        if a.get("state") == "firing"]
+                    lines.append("")
+                    if firing:
+                        for rname, a in firing:
+                            lines.append(
+                                f"🔥 SLO {a.get('slo', '?'):<18}"
+                                f"{rname:<22}burn "
+                                f"{a.get('short_burn', 0):.2f}/"
+                                f"{a.get('long_burn', 0):.2f} "
+                                f"(short/long, fires >"
+                                f"{alerts.get('threshold', 1.0):g})")
+                    else:
+                        lines.append("alerts: none firing")
+                # TTFT p95 sparkline per replica, from the federated
+                # time-series history (empty until samplers have data)
+                code, hist_body = _top_get(
+                    host, port, "/metrics/history?window=120")
+                if code == 200:
+                    hist = json_mod.loads(hist_body)
+                    spark_key = f"{MET_TTFT_MS}:p95"
+                    rows = []
+                    for rname, pay in sorted(
+                            (hist.get("replicas") or {}).items()):
+                        pts = (pay.get("series") or {}).get(spark_key)
+                        if pts:
+                            rows.append(f"  {rname:<22}ttft_p95 "
+                                        f"{_spark([p[1] for p in pts])} "
+                                        f"{pts[-1][1]:.1f}ms")
+                    if rows:
+                        lines.append("")
+                        lines.extend(rows)
             except (OSError, ValueError) as e:
                 lines = [f"dllama top — router {args.router} "
                          f"unreachable ({e}); retrying..."]
@@ -996,6 +1125,108 @@ def run_top(args) -> int:
             time.sleep(args.interval)
     except KeyboardInterrupt:
         return 0  # ^C is how an interactive top session ends: clean exit
+
+
+def run_explain(args) -> int:
+    """``cli explain <request-id>``: join the request's trace spans
+    (replica phases + router hops) and flight-recorder events into one
+    phase waterfall. Pure file reader — nothing needs to be running."""
+    import json as json_mod
+
+    from dllama_tpu.obsv import forensics
+
+    if not args.trace and not args.flight:
+        print("❌ explain needs at least one --trace or --flight input "
+              "(the DLLAMA_TRACE file / a saved /debug/flight body)")
+        return 1
+    wf = forensics.build_waterfall(
+        args.request_id,
+        forensics.load_trace_events(args.trace),
+        forensics.load_flight_events(args.flight))
+    if args.json:
+        print(json_mod.dumps(wf, indent=2))
+        return 0 if (wf["rows"] or wf["events"]) else 1
+    print(forensics.render_waterfall(wf, width=args.width))
+    return 0 if (wf["rows"] or wf["events"]) else 1
+
+
+def run_snapshot(args) -> int:
+    """``cli snapshot``: one support-bundle tarball of a running fleet —
+    /metrics, /metrics/history, /stats, /alerts and /debug/flight from
+    the router plus every replica the router knows, and the newest trace
+    part per replica when --trace-dir is given. Unreachable targets
+    contribute an error note, never abort the bundle."""
+    import io
+    import json as json_mod
+    import tarfile
+
+    from dllama_tpu.obsv import forensics
+
+    host, _, port_s = args.router.rpartition(":")
+    if not host or not port_s.isdigit():
+        raise SystemExit(f"bad --router {args.router!r}: want HOST:PORT")
+    out_path = args.out or f"dllama-snapshot-{int(time.time())}.tar.gz"
+    paths = ("/metrics", f"/metrics/history?window={args.window:g}",
+             "/stats", "/alerts", "/debug/flight")
+
+    targets = [("router", host, int(port_s))]
+    try:
+        _, stats_body = _top_get(host, int(port_s), "/stats")
+        stats = json_mod.loads(stats_body)
+        for snap in (stats.get("load") or {}).get("replicas") or []:
+            name = snap.get("name") or ""
+            rhost, _, rport = name.rpartition(":")
+            if rhost and rport.isdigit():
+                targets.append((name.replace(":", "-"), rhost, int(rport)))
+    except (OSError, ValueError) as e:
+        print(f"⚠️  router {args.router} unreachable ({e}); bundling "
+              "router errors only")
+
+    n_ok = 0
+    with tarfile.open(out_path, "w:gz") as tar:
+
+        def add(arcname: str, data: bytes) -> None:
+            info = tarfile.TarInfo(arcname)
+            info.size = len(data)
+            info.mtime = int(time.time())
+            tar.addfile(info, io.BytesIO(data))
+
+        for tname, thost, tport in targets:
+            errors = []
+            for path in paths:
+                fname = (path.split("?", 1)[0].strip("/")
+                         .replace("/", "-") or "root")
+                try:
+                    code, body = _top_get(thost, tport, path,
+                                          timeout_s=5.0)
+                except (OSError, ValueError) as e:
+                    errors.append(f"GET {path}: {e}")
+                    continue
+                if code != 200:
+                    errors.append(f"GET {path}: HTTP {code}")
+                    continue
+                add(f"{tname}/{fname}", body)
+                n_ok += 1
+            if errors:
+                add(f"{tname}/error.txt",
+                    ("\n".join(errors) + "\n").encode())
+        if args.trace_dir:
+            seen = set()
+            # per-replica part (fleet names them .replica-<port>) plus
+            # the newest file overall (the merged/solo trace)
+            hints = [None] + [str(t[2]) for t in targets[1:]]
+            for hint in hints:
+                p = forensics.newest_trace_part(args.trace_dir, hint=hint)
+                if p and p not in seen:
+                    seen.add(p)
+                    try:
+                        with open(p, "rb") as fh:
+                            add(f"trace/{os.path.basename(p)}", fh.read())
+                    except OSError:
+                        pass  # a part rotating away mid-bundle is fine
+    print(f"📦 {out_path}: {n_ok} document(s) from {len(targets)} "
+          f"target(s)")
+    return 0 if n_ok else 1
 
 
 def main(argv=None) -> None:
@@ -1027,6 +1258,12 @@ def main(argv=None) -> None:
     if args.mode == "top":
         # read-only observer: stdlib HTTP polling, no device, no jax
         raise SystemExit(run_top(args))
+    if args.mode == "explain":
+        # offline forensics join over trace/flight files: no jax
+        raise SystemExit(run_explain(args))
+    if args.mode == "snapshot":
+        # read-only observer + tarfile: no device, no jax
+        raise SystemExit(run_snapshot(args))
     maybe_init_distributed(args)
     if args.mode == "chat":
         run_chat(args)

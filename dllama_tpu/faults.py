@@ -48,7 +48,12 @@ Spec grammar (sites separated by ``;``)::
   ``preempt`` (every chunk-boundary preemption of a batch-class row to
   make room for queued interactive work — a faulted preemption leaves
   the batch row running untouched and the interactive request waiting,
-  never a torn stream).
+  never a torn stream). The continuous-observability seams are
+  ``ts_sample`` (every time-series sampler pass over the metrics
+  registry — a faulted pass is skipped and counted, the history ring
+  just misses one point and the sampler thread lives) and ``alert_eval``
+  (every SLO burn-rate evaluation pass — a faulted evaluation keeps the
+  previous alert states and is counted, never a dead alert engine).
 * ``action`` — ``raise`` (throw :class:`FaultInjected`), ``slow`` (sleep
   ``delay_ms``, default 50), or a *data* action the seam itself interprets:
   ``truncate`` (weights_open: pretend the file is ``drop`` bytes short,
@@ -78,7 +83,7 @@ SITES = ("admit", "step_chunk", "prefill", "prefill_chunk", "prefix_match",
          "logits", "route_pick", "proxy_upstream", "probe",
          "federate_scrape", "flight_dump", "overlap_split",
          "kv_export", "kv_import", "migrate", "ckpt_write", "resume",
-         "preempt")
+         "preempt", "ts_sample", "alert_eval")
 ACTIONS = ("raise", "slow", "truncate", "bitflip", "nan")
 
 #: site -> the metric family that proves the site's failure is VISIBLE on
@@ -128,6 +133,12 @@ SITE_METRICS = {
     # SLO-class seam: a faulted preemption is a batch row that keeps
     # decoding (outcome="injected"), never a client-visible error
     "preempt": "dllama_preemptions_total",
+    # continuous-observability seams (obsv/): a faulted sampler pass is a
+    # skipped history point (outcome="fault"); a faulted burn-rate
+    # evaluation keeps the previous alert states (state="eval_error") —
+    # the watchers are themselves fault-drilled
+    "ts_sample": "dllama_ts_samples_total",
+    "alert_eval": "dllama_alerts_total",
 }
 
 

@@ -1,0 +1,34 @@
+"""Continuous performance observability: the fleet's memory of itself.
+
+The serving stack's ``/metrics`` surface is an instantaneous snapshot —
+every scrape forgets the last one. This package gives each process a
+bounded recollection and the tools to interrogate it:
+
+* :mod:`~dllama_tpu.obsv.timeseries` — a fixed-memory ring-buffer store
+  fed by a sampler thread that snapshots every counter/gauge/histogram
+  percentile at a configurable cadence (``--ts-interval``); served as
+  windowed JSON on ``GET /metrics/history`` per replica and federated
+  per-replica on the router.
+* :mod:`~dllama_tpu.obsv.burnrate` — multi-window (short/long) SLO
+  burn-rate evaluation for per-class TTFT/TPOT/error-rate against the
+  ``--slo-classes`` targets, with hysteresis so a noisy boundary can't
+  flap an alert; firing/resolved transitions are flight-recorded and
+  counted in ``dllama_alerts_total{slo,state}``, the live picture is
+  ``GET /alerts``.
+* :mod:`~dllama_tpu.obsv.forensics` — ``cli explain <request-id>``: one
+  phase waterfall joined from the artifacts the fleet already emits
+  (router hop Server-Timing / trace spans, replica trace spans, flight
+  recorder events), answering "why was this request slow".
+* :mod:`~dllama_tpu.obsv.trajectory` — a durable append-only bench
+  trajectory (``results/trajectory.jsonl``): every BENCH_* run — and
+  every failure, including the previously-lost ``tpu_unreachable``
+  rounds — lands as a structured row with git SHA, host fingerprint and
+  gate results, plus a comparator that flags regressions against the
+  last same-host row.
+
+Everything here is stdlib-only (the router/cli import it jax-free) and
+guarded_by-disciplined for dllama-check.
+"""
+
+from dllama_tpu.obsv.burnrate import BurnRateEngine  # noqa: F401
+from dllama_tpu.obsv.timeseries import Sampler, TimeSeriesStore  # noqa: F401

@@ -1,0 +1,198 @@
+"""Durable bench trajectory: every BENCH_* round leaves a structured row.
+
+``results/trajectory.jsonl`` (override with ``DLLAMA_TRAJECTORY``) is the
+repo's performance memory: one append-only JSON line per bench run — and
+per bench *failure*. The five early rounds that died as unstructured
+"TPU backend unreachable" logs are exactly the rows this file exists to
+keep: a ``status="tpu_unreachable"`` row with the same git SHA / host
+fingerprint as a success, so the trajectory shows *when* the hardware
+came and went, not just the runs that survived.
+
+The comparator flags regressions against the last row from the same host
+for the same bench: throughput-like metrics (``tok_s``, ``*_rps``,
+``*per_s``) must not drop, latency-like metrics (``*_ms``, ``*_s``,
+``overhead*``) must not grow, beyond ``tolerance``. Heuristic by key
+name on purpose — bench result dicts are flat and self-describing, and a
+new metric should land in the trajectory without a registry edit.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import platform
+import subprocess
+import time
+from typing import List, Optional
+
+DEFAULT_PATH = os.path.join("results", "trajectory.jsonl")
+
+#: key-name fragments -> direction ("up" = higher is better)
+_UP_HINTS = ("tok_s", "toks_per_s", "throughput", "_rps", "per_s",
+             "hit_rate", "goodput")
+_DOWN_HINTS = ("_ms", "ttft", "tpot", "latency", "overhead", "stall",
+               "_pct", "_errors", "p50", "p95", "p99")
+
+
+def trajectory_path(path: Optional[str] = None) -> str:
+    return path or os.environ.get("DLLAMA_TRAJECTORY") or DEFAULT_PATH
+
+
+def host_fingerprint() -> str:
+    """Stable same-machine identity: hostname + arch + python. Two rows
+    compare only when this matches — a laptop run never 'regresses' a
+    TPU-host row."""
+    return (f"{platform.node()}/{platform.machine()}/"
+            f"py{platform.python_version()}")
+
+
+def git_sha(cwd: Optional[str] = None) -> str:
+    try:
+        out = subprocess.run(
+            ["git", "rev-parse", "--short=12", "HEAD"], cwd=cwd,
+            capture_output=True, text=True, timeout=10)
+        sha = out.stdout.strip()
+        return sha if out.returncode == 0 and sha else "unknown"
+    except (OSError, subprocess.SubprocessError):
+        return "unknown"
+
+
+def _numeric_metrics(result: dict, prefix: str = "") -> dict:
+    """Flatten the numeric leaves of a bench result dict (one level of
+    nesting is enough for every BENCH_* payload)."""
+    out = {}
+    for k, v in (result or {}).items():
+        key = f"{prefix}{k}"
+        if isinstance(v, bool):
+            continue
+        if isinstance(v, (int, float)):
+            out[key] = float(v)
+        elif isinstance(v, dict) and not prefix:
+            out.update(_numeric_metrics(v, prefix=f"{k}."))
+    return out
+
+
+def make_row(bench: str, status: str, result: Optional[dict] = None,
+             gates: Optional[dict] = None, error: Optional[str] = None,
+             now_s: Optional[float] = None) -> dict:
+    metrics = _numeric_metrics(result)
+    # bench records carry their headline number under the generic key
+    # "value" (no direction hint): alias it under the self-describing
+    # metric name so the comparator knows which way is worse
+    if (isinstance((result or {}).get("metric"), str)
+            and isinstance((result or {}).get("value"), (int, float))
+            and not isinstance(result["value"], bool)):
+        metrics[result["metric"]] = float(result["value"])
+    return {
+        "v": 1,
+        "ts": round(time.time() if now_s is None else now_s, 3),
+        "bench": bench,
+        "status": status,  # ok | error | tpu_unreachable | timeout
+        "git_sha": git_sha(),
+        "host": host_fingerprint(),
+        "gates": dict(gates or {}),
+        "metrics": metrics,
+        "error": error,
+    }
+
+
+def load_rows(path: Optional[str] = None) -> List[dict]:
+    rows = []
+    try:
+        fh = open(trajectory_path(path), "r", encoding="utf-8")
+    except OSError:
+        return rows
+    with fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                row = json.loads(line)
+            except ValueError:
+                continue  # a torn tail line from a killed bench: the
+                #           rows before it are still a valid trajectory
+            if isinstance(row, dict):
+                rows.append(row)
+    return rows
+
+
+def compare(row: dict, prior: List[dict],
+            tolerance: float = 0.10) -> List[dict]:
+    """Regressions of ``row`` vs the last same-host same-bench prior row.
+
+    Returns one record per regressed metric/gate; empty when there is no
+    comparable prior row (first run on a host is a baseline, not a
+    pass)."""
+    base = None
+    for r in reversed(prior):
+        if (r.get("bench") == row.get("bench")
+                and r.get("host") == row.get("host")
+                and r.get("status") == "ok" and r is not row):
+            base = r
+            break
+    if base is None or row.get("status") != "ok":
+        return []
+    flags = []
+    prev_m, cur_m = base.get("metrics") or {}, row.get("metrics") or {}
+    for key, prev in prev_m.items():
+        cur = cur_m.get(key)
+        if cur is None or prev <= 0:
+            continue
+        direction = _direction(key)
+        if direction == "up" and cur < prev * (1.0 - tolerance):
+            flags.append({"metric": key, "direction": "up",
+                          "prev": prev, "cur": cur,
+                          "delta_pct": round((cur / prev - 1) * 100, 2)})
+        elif direction == "down" and cur > prev * (1.0 + tolerance):
+            flags.append({"metric": key, "direction": "down",
+                          "prev": prev, "cur": cur,
+                          "delta_pct": round((cur / prev - 1) * 100, 2)})
+    for gate, ok in (base.get("gates") or {}).items():
+        if ok and not (row.get("gates") or {}).get(gate, True):
+            flags.append({"gate": gate, "prev": True, "cur": False})
+    return flags
+
+
+def _direction(key: str) -> Optional[str]:
+    k = key.lower()
+    if any(h in k for h in _UP_HINTS):
+        return "up"
+    if any(h in k for h in _DOWN_HINTS):
+        return "down"
+    return None
+
+
+def append_row(bench: str, status: str, result: Optional[dict] = None,
+               gates: Optional[dict] = None, error: Optional[str] = None,
+               path: Optional[str] = None,
+               tolerance: float = 0.10) -> dict:
+    """Append one row and compare it against its same-host predecessor.
+
+    Returns ``{"row": ..., "regressions": [...], "path": ...}``; never
+    raises — a bench must finish reporting even when the results
+    directory is unwritable (the row is still returned for stdout)."""
+    row = make_row(bench, status, result=result, gates=gates, error=error)
+    target = trajectory_path(path)
+    prior = load_rows(target)
+    try:
+        d = os.path.dirname(target)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        # a bench killed mid-append leaves an unterminated torn line; start
+        # a fresh line so that wreck costs one row, not two
+        prefix = ""
+        try:
+            with open(target, "rb") as tail:
+                tail.seek(-1, os.SEEK_END)
+                if tail.read(1) != b"\n":
+                    prefix = "\n"
+        except OSError:
+            pass  # no file yet (first row) — nothing to terminate
+        with open(target, "a", encoding="utf-8") as fh:
+            fh.write(prefix + json.dumps(row, separators=(",", ":")) + "\n")
+    except OSError:
+        target = None
+    return {"row": row, "regressions": compare(row, prior,
+                                               tolerance=tolerance),
+            "path": target}

@@ -42,6 +42,8 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from dllama_tpu import faults, observability
 from dllama_tpu.analysis.sanitize import guarded_by
 from dllama_tpu.observability import RequestTrace
+from dllama_tpu.obsv import BurnRateEngine, Sampler, TimeSeriesStore
+from dllama_tpu.obsv.timeseries import parse_window
 from dllama_tpu.runtime.generate import NumericHealthError
 from dllama_tpu.runtime.sampler import SamplerConfig
 from dllama_tpu.serving import kv_transfer
@@ -1162,7 +1164,8 @@ class ServerState:
                  metrics=None, log_json: bool = False,
                  log_prompts: bool = False, log_stream=None, flight=None,
                  role: str = "both", ckpt_interval: int = 32,
-                 slo_classes=None):
+                 slo_classes=None, ts_interval: float = 1.0,
+                 burn_short: float = 60.0, burn_long: float = 300.0):
         """``default_seed``: seed for requests that send none — None means a
         fresh time-based seed per request (the launch-flag --seed plumbs in
         here so an operator can make the whole server reproducible).
@@ -1210,7 +1213,12 @@ class ServerState:
         ``slo_classes``: per-class admission policy (--slo-classes) — a
         {name: lifecycle.SLOClass} dict or the raw spec string (see
         lifecycle.parse_slo_classes). Defaults leave every lane bounded
-        only by ``queue_depth``, i.e. exactly the single-class behavior."""
+        only by ``queue_depth``, i.e. exactly the single-class behavior.
+        ``ts_interval``: time-series sampler cadence in seconds
+        (--ts-interval; 0 disables history + burn-rate alerts).
+        ``burn_short``/``burn_long``: the burn-rate engine's evaluation
+        windows (--burn-short/--burn-long) against the class ``ttft=``/
+        ``tpot=``/``err=`` targets."""
         self.engine = engine
         self.tokenizer = tokenizer
         self.cfg = cfg
@@ -1380,6 +1388,18 @@ class ServerState:
         # The reference restarts pos=0 with no reuse every request
         # (`/root/reference/src/apps/dllama-api/dllama-api.cpp:257`).
         self._sessions: list = []  # [(tokens, session)], oldest first
+        # -- continuous observability (obsv/): bounded metric history
+        # (GET /metrics/history) + SLO burn-rate alerts (GET /alerts),
+        # sampled off this state's registry. The sampler THREAD starts
+        # with the HTTP listener (create_server), so bare in-process
+        # states stay thread-free; --ts-interval 0 disables the whole
+        # subsystem (the BENCH_OBS off-leg).
+        self.ts_store = TimeSeriesStore()
+        self.burn_engine = BurnRateEngine(
+            self.ts_store, self.slo_classes, reg, flight=self.flight,
+            short_s=burn_short, long_s=burn_long)
+        self.sampler = Sampler(reg, self.ts_store, interval_s=ts_interval,
+                               hooks=(self.burn_engine.evaluate,))
 
     @staticmethod
     def _session_matches(cached: list, session, prompt_tokens: list) -> bool:
@@ -1644,7 +1664,8 @@ class OpenAIHandler(BaseHTTPRequestHandler):
     #: SSE streams, and every 4xx/5xx alike
     _KNOWN_ROUTES = ("/v1/chat/completions", "/chat/completions",
                      "/v1/models", "/health", "/healthz", "/ready",
-                     "/metrics", "/stats", "/debug/flight",
+                     "/metrics", "/metrics/history", "/alerts",
+                     "/stats", "/debug/flight",
                      "/v1/prefill", "/v1/kv/import", "/v1/kv/resume")
 
     def _route(self) -> str:
@@ -1798,6 +1819,17 @@ class OpenAIHandler(BaseHTTPRequestHandler):
             self.end_headers()
             self._count(200)
             self.wfile.write(body)
+        elif self.path.split("?", 1)[0] == "/metrics/history":
+            # the time-series ring as windowed JSON: what every sampled
+            # series did over the last ?window= seconds (default 300)
+            self._json(200, dict(
+                st.ts_store.window(parse_window(self.path)),
+                replica_id=st.replica_id))
+        elif self.path == "/alerts":
+            # live SLO burn-rate picture: one entry per configured
+            # (class, signal) target, firing or resolved
+            self._json(200, dict(st.burn_engine.alerts_payload(),
+                                 replica_id=st.replica_id))
         elif self.path == "/stats":
             self._json(200, st.stats())
         elif self.path == "/debug/flight":
@@ -2693,6 +2725,9 @@ def create_server(state: ServerState, host: str = "0.0.0.0", port: int = 9990):
     bound = srv.server_address[1]
     state.replica_id = f"{bound}-{state.start_nonce}"
     state.flight.process = f"replica-{bound}"
+    # history/alerts start with the listener: a bare ServerState (unit
+    # tests, bench replays) stays thread-free, a serving one remembers
+    state.sampler.start()
     return srv
 
 
@@ -2706,6 +2741,7 @@ def drain_and_shutdown(state: ServerState, srv, drain_timeout_s: float) -> bool:
     # if the drain itself wedges, the ring already shows what was in flight
     state.begin_drain()
     idle = state.gate.wait_idle(drain_timeout_s)
+    state.sampler.stop()
     srv.shutdown()
     return idle
 
@@ -2743,6 +2779,9 @@ def serve(args) -> None:
         role=getattr(args, "role", "both") or "both",
         ckpt_interval=getattr(args, "ckpt_interval", 32),
         slo_classes=getattr(args, "slo_classes", None),
+        ts_interval=getattr(args, "ts_interval", 1.0),
+        burn_short=getattr(args, "burn_short", 60.0),
+        burn_long=getattr(args, "burn_long", 300.0),
     )
     srv = create_server(state, host=args.host, port=args.port)
     # label this pid's track group in a merged fleet trace (no-op when

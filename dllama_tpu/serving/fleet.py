@@ -270,6 +270,12 @@ def run_fleet(args) -> None:
     slo_spec = getattr(args, "slo_classes", None)
     if slo_spec and "--slo-classes" not in replica_args:
         replica_args = ["--slo-classes", slo_spec] + replica_args
+    # --ts-interval too: one flag sets the whole fleet's history cadence
+    # (router + every replica sampler), --replica-arg still overrides
+    if "--ts-interval" not in replica_args:
+        replica_args = (["--ts-interval",
+                         str(getattr(args, "ts_interval", 1.0))]
+                        + replica_args)
     # --prefill N --decode M carve the first N+M replicas into dedicated
     # disaggregation roles (the rest stay "both"); the router migrates
     # only when it can see at least one routable replica of EACH

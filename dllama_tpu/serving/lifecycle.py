@@ -221,18 +221,27 @@ class SLOClass:
     *preemptible* — rows beyond interactive's needs are reclaimable.
     """
 
-    __slots__ = ("name", "depth", "deadline_s", "max_resident")
+    __slots__ = ("name", "depth", "deadline_s", "max_resident",
+                 "ttft_ms", "tpot_ms", "err_rate")
 
     def __init__(self, name: str, depth: int = 0, deadline_s: float = 0.0,
-                 max_resident: int = 0):
+                 max_resident: int = 0, ttft_ms: float = 0.0,
+                 tpot_ms: float = 0.0, err_rate: float = 0.0):
         self.name = name
         self.depth = int(depth)
         self.deadline_s = float(deadline_s)
         self.max_resident = int(max_resident)
+        # burn-rate SLO targets (obsv.burnrate): p95 TTFT/TPOT in ms and
+        # the error-fraction budget; 0 = no target, no alert series
+        self.ttft_ms = float(ttft_ms)
+        self.tpot_ms = float(tpot_ms)
+        self.err_rate = float(err_rate)
 
     def to_dict(self) -> dict:
         return {"depth": self.depth, "deadline_s": self.deadline_s,
-                "max_resident": self.max_resident}
+                "max_resident": self.max_resident,
+                "ttft_ms": self.ttft_ms, "tpot_ms": self.tpot_ms,
+                "err_rate": self.err_rate}
 
 
 def parse_slo_classes(spec: str) -> dict:
@@ -240,10 +249,13 @@ def parse_slo_classes(spec: str) -> dict:
 
     Grammar (classes separated by ``;``)::
 
-        interactive:depth=48,deadline=30;batch:depth=16,resident=2
+        interactive:depth=48,deadline=30,ttft=500;batch:depth=16,resident=2
 
-    Every class in :data:`SLO_CLASSES` gets an entry (unnamed classes get
-    defaults), so callers never KeyError on a valid class name."""
+    ``ttft=``/``tpot=`` (p95 targets in ms) and ``err=`` (error-fraction
+    budget) are the burn-rate SLO targets the obsv alert engine evaluates;
+    left unset (0) a signal simply has no alert. Every class in
+    :data:`SLO_CLASSES` gets an entry (unnamed classes get defaults), so
+    callers never KeyError on a valid class name."""
     classes = {name: SLOClass(name) for name in SLO_CLASSES}
     for part in (spec or "").split(";"):
         part = part.strip()
@@ -265,10 +277,16 @@ def parse_slo_classes(spec: str) -> dict:
                 cls.deadline_s = float(v)
             elif k == "resident":
                 cls.max_resident = int(v)
+            elif k == "ttft":
+                cls.ttft_ms = float(v)
+            elif k == "tpot":
+                cls.tpot_ms = float(v)
+            elif k == "err":
+                cls.err_rate = float(v)
             else:
                 raise ValueError(
                     f"unknown SLO option {k!r} (want depth/deadline/"
-                    "resident)")
+                    "resident/ttft/tpot/err)")
     return classes
 
 

@@ -13,7 +13,11 @@ fleet-wide desync.  dllama-check's PROTO-00x passes enforce the rest:
 * PROTO-003 — hop header strings minted vs read; raw ``X-Dllama-*`` /
   ``X-Request-Id`` literals outside this module are findings.
 * PROTO-004 — metric names consumed somewhere in the package must be
-  registered via ``counter()``/``gauge()``/``histogram()``.
+  registered via ``counter()``/``gauge()``/``histogram()``; and
+  ``cli.py`` (the `top`/`explain`/`snapshot` consumers, which scrape the
+  wire rather than share a registry) may not spell a raw ``dllama_*``
+  literal at all — it imports the ``MET_*`` constants below, so the
+  dashboards can never silently desync from the registry.
 
 Keep every value a plain string literal (the analyzer reads this file
 with ``ast``, it never imports it).  Derive bytes at the use site with
@@ -100,3 +104,38 @@ DKV1_OPTIONAL_FIELDS = (
 
 #: The full header contract.  PROTO-001 checks encode/decode against it.
 DKV1_HEADER_FIELDS = DKV1_BASE_FIELDS + DKV1_SCALARS + DKV1_OPTIONAL_FIELDS
+
+# --------------------------------------------------------------------------
+# Metric families read across a process boundary (cli top / explain /
+# snapshot scrape them off /metrics, /metrics/fleet and /metrics/history —
+# they never share a registry with the process that registered them).
+# --------------------------------------------------------------------------
+
+MET_HTTP_REQUESTS = "dllama_http_requests_total"
+MET_TTFT_MS = "dllama_ttft_ms"
+MET_TPOT_MS = "dllama_tpot_ms"
+MET_KV_TRANSFER_BYTES = "dllama_kv_transfer_bytes_total"
+MET_CLASS_TTFT_MS = "dllama_class_ttft_ms"
+MET_CLASS_TPOT_MS = "dllama_class_tpot_ms"
+MET_CLASS_QUEUE_DEPTH = "dllama_class_queue_depth"
+MET_CLASS_RESIDENT_ROWS = "dllama_class_resident_rows"
+MET_TS_SAMPLES = "dllama_ts_samples_total"
+MET_ALERTS = "dllama_alerts_total"
+MET_FEDERATE_SKIPPED = "dllama_router_federate_skipped_total"
+
+#: Every family a cross-process consumer reads.  PROTO-004's cli.py pass
+#: checks this tuple stays registered AND that cli.py spells no family
+#: outside it.
+WIRE_METRICS = (
+    MET_HTTP_REQUESTS,
+    MET_TTFT_MS,
+    MET_TPOT_MS,
+    MET_KV_TRANSFER_BYTES,
+    MET_CLASS_TTFT_MS,
+    MET_CLASS_TPOT_MS,
+    MET_CLASS_QUEUE_DEPTH,
+    MET_CLASS_RESIDENT_ROWS,
+    MET_TS_SAMPLES,
+    MET_ALERTS,
+    MET_FEDERATE_SKIPPED,
+)

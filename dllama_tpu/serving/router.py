@@ -111,6 +111,8 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from dllama_tpu import faults, observability
 from dllama_tpu.analysis.sanitize import guarded_by
+from dllama_tpu.obsv import Sampler, TimeSeriesStore
+from dllama_tpu.obsv.timeseries import parse_window
 from dllama_tpu.serving import kv_transfer
 from dllama_tpu.serving.lifecycle import LifecycleError, Supervisor
 from dllama_tpu.serving.protocol import (HDR_CKPT, HDR_CKPT_WIRE, HDR_CLASS,
@@ -517,7 +519,8 @@ class RouterState:
                  kv_wire: str = "f32",
                  ckpt_interval: int = 32,
                  ckpt_capacity: int = 256,
-                 metrics=None, enable_flight: bool = True):
+                 metrics=None, enable_flight: bool = True,
+                 ts_interval: float = 1.0):
         self.replicas = tuple(replicas)
         self.retry_budget = retry_budget
         self.probe_interval_s = probe_interval_s
@@ -587,6 +590,14 @@ class RouterState:
             "(connect/parse/injected); the replica drops out of that merged "
             "exposition, never the endpoint",
             ("replica",))
+        self._m_federate_skipped = reg.counter(
+            "dllama_router_federate_skipped_total",
+            "Replicas left out of a /metrics/fleet, /metrics/history or "
+            "/alerts federation pass, by reason (not_ready/circuit_open: "
+            "the probe verdict excluded them; unreachable: the scrape "
+            "itself failed) — a hole in the federated picture is counted, "
+            "never silent",
+            ("reason",))
         self._m_migrations = reg.counter(
             "dllama_kv_transfer_migrations_total",
             "Disaggregated prefill->decode migration attempts the router "
@@ -622,6 +633,11 @@ class RouterState:
         # and the rings must not mix
         self.flight = (observability.FlightRecorder(process="router")
                        if enable_flight else None)
+        # the router's own bounded metric history (GET /metrics/history
+        # answers it under "router", next to the federated replica views);
+        # the sampler thread starts/stops with the probe loop
+        self.ts_store = TimeSeriesStore()
+        self.sampler = Sampler(reg, self.ts_store, interval_s=ts_interval)
         self._probe_supervisor = None
         self._probe_stop = threading.Event()
 
@@ -782,9 +798,11 @@ class RouterState:
             on_crash=lambda exc: None,  # state is probe-local; next round
             name="dllama-router-probe")  # rebuilds it from scratch
         self._probe_supervisor.start()
+        self.sampler.start()  # history rides the probe loop's lifetime
 
     def stop_probes(self) -> None:
         self._probe_stop.set()
+        self.sampler.stop()
         if self._probe_supervisor is not None:
             self._probe_supervisor.stop()
 
@@ -850,16 +868,63 @@ class RouterState:
         endpoint itself always answers."""
         parts = []
         for r in self.replicas:
-            s = r.snapshot()
-            if not s["ready"] or s["circuit_open"]:
+            body = self._federated_scrape(r, "/metrics")
+            if body is not None:
+                parts.append((r.name, body.decode("utf-8", "replace")))
+        return merge_expositions(parts)
+
+    def _federated_scrape(self, r: Replica, path: str):
+        """One replica's contribution to a federation pass, or None —
+        every skip is counted by reason in
+        ``dllama_router_federate_skipped_total`` (a hole in the federated
+        picture must be machine-visible, not a silent absence)."""
+        s = r.snapshot()
+        if not s["ready"]:
+            self._m_federate_skipped.inc(reason="not_ready")
+            return None
+        if s["circuit_open"]:
+            self._m_federate_skipped.inc(reason="circuit_open")
+            return None
+        try:
+            faults.fire("federate_scrape")
+            return self._scrape(r, path)
+        except (OSError, ValueError, faults.FaultInjected):
+            self._m_federate_errors.inc(replica=r.name)
+            self._m_federate_skipped.inc(reason="unreachable")
+            return None
+
+    def federate_history(self, window_s: float) -> dict:
+        """The /metrics/history federation: the router's own window plus
+        every in-rotation replica's, keyed per replica."""
+        out = {"window_s": window_s,
+               "router": self.ts_store.window(window_s), "replicas": {}}
+        for r in self.replicas:
+            body = self._federated_scrape(
+                r, f"/metrics/history?window={window_s:g}")
+            if body is None:
                 continue
             try:
-                faults.fire("federate_scrape")
-                body = self._scrape(r, "/metrics")
-                parts.append((r.name, body.decode("utf-8", "replace")))
-            except (OSError, ValueError, faults.FaultInjected):
+                out["replicas"][r.name] = json.loads(body)
+            except ValueError:
                 self._m_federate_errors.inc(replica=r.name)
-        return merge_expositions(parts)
+        return out
+
+    def federate_alerts(self) -> dict:
+        """The /alerts federation: every in-rotation replica's burn-rate
+        alert picture, with a fleet-wide firing count on top."""
+        out = {"replicas": {}, "firing": 0}
+        for r in self.replicas:
+            body = self._federated_scrape(r, "/alerts")
+            if body is None:
+                continue
+            try:
+                payload = json.loads(body)
+            except ValueError:
+                self._m_federate_errors.inc(replica=r.name)
+                continue
+            out["replicas"][r.name] = payload
+            out["firing"] += int(payload.get("firing") or 0)
+        return out
 
     def flight_report(self) -> dict:
         """The router's own flight ring plus every replica's /debug/flight
@@ -901,8 +966,8 @@ class RouterHandler(BaseHTTPRequestHandler):
 
     _KNOWN_ROUTES = ("/v1/chat/completions", "/chat/completions",
                      "/v1/models", "/health", "/healthz", "/ready",
-                     "/metrics", "/metrics/fleet", "/stats",
-                     "/debug/flight")
+                     "/metrics", "/metrics/fleet", "/metrics/history",
+                     "/alerts", "/stats", "/debug/flight")
 
     def _route(self) -> str:
         p = self.path.split("?", 1)[0]
@@ -981,6 +1046,14 @@ class RouterHandler(BaseHTTPRequestHandler):
             self._text(200, st.metrics.render().encode())
         elif self.path == "/metrics/fleet":
             self._text(200, st.federate().encode())
+        elif self.path.split("?", 1)[0] == "/metrics/history":
+            # federated time-series history: the router's own window plus
+            # every in-rotation replica's, per-replica keyed
+            self._json(200, st.federate_history(parse_window(self.path)))
+        elif self.path == "/alerts":
+            # the fleet's live SLO burn-rate picture (replica-evaluated;
+            # the router only federates)
+            self._json(200, st.federate_alerts())
         elif self.path == "/stats":
             self._json(200, st.stats())
         elif self.path == "/debug/flight":
@@ -1727,6 +1800,7 @@ def state_from_args(args, replica_addrs: list) -> RouterState:
         affinity_block=getattr(args, "affinity_block", 256),
         kv_wire=getattr(args, "kv_wire", "f32") or "f32",
         ckpt_interval=getattr(args, "ckpt_interval", 32),
+        ts_interval=getattr(args, "ts_interval", 1.0),
     )
 
 

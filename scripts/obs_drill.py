@@ -9,14 +9,21 @@ is an outage an operator cannot alert on, and that is the regression this
 lane exists to catch.
 
 ``--fleet`` runs the FLEET leg instead: a real ``cli fleet`` subprocess
-topology (router + 2 replicas, tiny synthetic weights, CPU) with tracing
-and the flight recorder on. It passes only if (a) the merged Perfetto
-file contains at least one STITCHED request — a router proxy span and a
-replica request span sharing the request id, tied by a flow arrow — with
-the router and each replica on distinct named process tracks, (b) the
-router's /metrics/fleet chat-route counter sums equal the per-replica
-/metrics sums, and (c) the SIGTERM drain left one flight-recorder dump
-per process whose ring holds the drilled request ids.
+topology (router + 2 replicas, tiny synthetic weights, CPU) with tracing,
+the flight recorder, the time-series sampler and a microsecond
+interactive TTFT SLO target armed. It passes only if (a) the merged
+Perfetto file contains at least one STITCHED request — a router proxy
+span and a replica request span sharing the request id, tied by a flow
+arrow — with the router and each replica on distinct named process
+tracks, (b) the router's /metrics/fleet chat-route counter sums equal
+the per-replica /metrics sums, (c) the SIGTERM drain left one
+flight-recorder dump per process whose ring holds the drilled request
+ids, (d) the drilled chats breach the TTFT SLO so the federated /alerts
+flips to FIRING (transition flight-recorded) and then back to RESOLVED
+once the burst ages out of both burn windows, (e) the federated
+/metrics/history window is non-empty for the router and every replica,
+and (f) ``cli explain`` joins a drilled request into a waterfall whose
+phase sum is within tolerance of the measured wall time.
 
 Artifacts written to --out-dir (uploaded by CI):
     metrics_before.txt / metrics_after.txt   raw Prometheus expositions
@@ -24,6 +31,7 @@ Artifacts written to --out-dir (uploaded by CI):
     trace.jsonl                              Chrome/Perfetto request spans
     requests.jsonl                           structured JSON request logs
     fleet-trace.json / fleet_verdict.json / flight/   (--fleet leg)
+    alerts.json / history.json / explain.json / trajectory.jsonl (--fleet)
 
 Usage:  JAX_PLATFORMS=cpu python scripts/obs_drill.py [--out-dir obs-drill]
                                                       [--fleet]
@@ -163,8 +171,15 @@ def fleet_main(args) -> int:
          "--replicas", "2", "--base-port", str(base_port),
          "--host", "127.0.0.1", "--port", str(router_port),
          "--probe-interval", "0.3", "--ready-timeout", "240",
+         # dense history sampling + a 1-microsecond interactive TTFT
+         # target: every real chat is an SLO breach, so the burn-rate
+         # engine must fire — and, with the burn windows shrunk to
+         # drill scale, resolve again once the drill goes idle
+         "--ts-interval", "0.25",
+         "--slo-classes", "interactive:ttft=0.001",
          "--log-dir", os.path.join(out, "logs"),
-         "--replica-arg", "--batch-window 5 --batch-max 2 --tp 1"],
+         "--replica-arg", "--batch-window 5 --batch-max 2 --tp 1 "
+                          "--burn-short 3 --burn-long 6"],
         env=env, cwd=REPO, stdout=fleet_log, stderr=subprocess.STDOUT)
 
     failures = []
@@ -209,6 +224,103 @@ def fleet_main(args) -> int:
                     f"router response lacks Server-Timing: {timing!r}")
         print(f"drilled {len(drilled_ids)} chat request(s) through the "
               f"front door")
+
+        # -- SLO burn-rate cycle: the microsecond TTFT target makes the
+        #    drilled chats a breach in both burn windows -> federated
+        #    /alerts must show interactive:ttft FIRING; then, idle, the
+        #    burst ages out of the windows and it must RESOLVE (the
+        #    hysteresis needs resolve_after consecutive healthy evals)
+        alert_snaps = {}
+
+        def poll_alerts(phase, want_firing, deadline_s):
+            deadline = time.monotonic() + deadline_s
+            payload = None
+            while time.monotonic() < deadline:
+                status, data = request(router_port, "GET", "/alerts",
+                                       timeout=10)
+                if status == 200:
+                    payload = json.loads(data)
+                    alert_snaps[phase] = payload
+                    if bool(payload.get("firing", 0)) == want_firing:
+                        return payload
+                time.sleep(0.3)
+            return None
+
+        fired = poll_alerts("fired", True, 30)
+        if fired is None:
+            failures.append(
+                "/alerts never fired after the SLO breach burst "
+                f"(last: {alert_snaps.get('fired')})")
+        else:
+            slos = sorted({a["slo"]
+                           for r in fired.get("replicas", {}).values()
+                           for a in r.get("alerts", [])
+                           if a.get("state") == "firing"})
+            print(f"  alerts FIRING: {slos}")
+            if "interactive:ttft" not in slos:
+                failures.append(
+                    f"firing alerts {slos} lack interactive:ttft")
+
+        # the transition must be in the flight ring while firing (the
+        # post-drain dump assertion below only sees the ring's tail)
+        status, data = request(router_port, "GET", "/debug/flight",
+                               timeout=30)
+        if status == 200:
+            report = json.loads(data)
+            kinds = {ev.get("kind")
+                     for snap in report.get("replicas", {}).values()
+                     for ev in snap.get("events", [])}
+            if fired is not None and "alert" not in kinds:
+                failures.append(
+                    f"no 'alert' transition in any replica flight ring "
+                    f"while /alerts was firing (kinds: {sorted(kinds)})")
+
+        # -- federated time-series history: non-empty window for the
+        #    router's own registry and for every replica
+        status, data = request(router_port, "GET",
+                               "/metrics/history?window=120", timeout=30)
+        if status != 200:
+            failures.append(f"/metrics/history returned {status}")
+        else:
+            hist = json.loads(data)
+            with open(os.path.join(out, "history.json"), "w") as f:
+                json.dump(hist, f, indent=2, sort_keys=True)
+            if not (hist.get("router") or {}).get("series"):
+                failures.append(
+                    "router /metrics/history window has no series")
+            reps = hist.get("replicas") or {}
+            if len(reps) != 2:
+                failures.append(
+                    f"/metrics/history federated {sorted(reps)}, "
+                    "wanted 2 replicas")
+            for rname, pay in reps.items():
+                if not (pay.get("series") or {}):
+                    failures.append(
+                        f"replica {rname} history window is empty")
+            # prefix affinity may pin every drilled chat to one replica,
+            # so the served lane's series need only exist SOMEWHERE
+            if reps and not any(
+                    k.startswith("dllama_class_ttft_ms")
+                    for pay in reps.values()
+                    for k in (pay.get("series") or {})):
+                failures.append(
+                    "no replica history holds the sampled per-class "
+                    "TTFT percentile series")
+            n_series = sum(len(p.get("series") or {})
+                           for p in reps.values())
+            print(f"  history window: {n_series} replica series "
+                  f"+ {len((hist.get('router') or {}).get('series') or {})}"
+                  " router series")
+
+        resolved = poll_alerts("resolved", False, 45)
+        if resolved is None:
+            failures.append(
+                "/alerts never resolved after the breach burst aged out "
+                f"(last: {alert_snaps.get('resolved')})")
+        else:
+            print("  alerts RESOLVED (burst aged out of both windows)")
+        with open(os.path.join(out, "alerts.json"), "w") as f:
+            json.dump(alert_snaps, f, indent=2, sort_keys=True)
 
         # -- federation arithmetic: /metrics/fleet sums == per-replica sums
         status, data = request(router_port, "GET", "/metrics/fleet",
@@ -326,12 +438,59 @@ def fleet_main(args) -> int:
             f"no drilled request id in any flight dump "
             f"(drilled {drilled_ids}, dumps held {sorted(seen_ids)})")
 
+    # -- cli explain: the forensics join over the merged trace + flight
+    #    dumps must produce a waterfall whose replica phase sum is within
+    #    tolerance of the router-measured wall time (generous bounds:
+    #    CI boxes jitter, but a sum at 10% or 300% of wall means the
+    #    join picked up the wrong spans)
+    explain_ok = False
+    explain_docs = []
+    for rid in drilled_ids:
+        exp = subprocess.run(
+            [sys.executable, "-m", "dllama_tpu.cli", "explain", rid,
+             "--trace", trace, "--flight", flight_dir, "--json"],
+            env=env, cwd=REPO, capture_output=True, text=True, timeout=60)
+        try:
+            wf = json.loads(exp.stdout)
+        except ValueError:
+            failures.append(
+                f"cli explain {rid} emitted no JSON "
+                f"(rc={exp.returncode}, stderr={exp.stderr[-200:]!r})")
+            continue
+        explain_docs.append(wf)
+        if not wf.get("rows") or not wf.get("wall_ms"):
+            continue
+        cov = wf["phase_sum_ms"] / wf["wall_ms"]
+        print(f"  explain {rid}: wall {wf['wall_ms']:.1f}ms, phase sum "
+              f"{wf['phase_sum_ms']:.1f}ms ({cov:.0%} coverage, "
+              f"{len(wf['rows'])} spans, {len(wf['events'])} marks)")
+        if 0.25 <= cov <= 1.75:
+            explain_ok = True
+    if drilled_ids and not explain_ok:
+        failures.append(
+            "no drilled request produced an explain waterfall whose "
+            "phase sum is within tolerance of wall time")
+    with open(os.path.join(out, "explain.json"), "w") as f:
+        json.dump(explain_docs, f, indent=2, sort_keys=True)
+
     verdict = {"ok": not failures, "failures": failures,
                "stitched_requests": n_stitched,
                "drilled_request_ids": drilled_ids,
                "flight_dumps": [os.path.basename(p) for p in dumps]}
     with open(os.path.join(out, "fleet_verdict.json"), "w") as f:
         json.dump(verdict, f, indent=2, sort_keys=True)
+
+    # the drill leaves its own trajectory row (same durable format the
+    # bench writes), so CI uploads a non-empty trajectory artifact even
+    # on pure-CPU runners
+    from dllama_tpu.obsv import trajectory
+    trajectory.append_row(
+        "obs_drill_fleet", "ok" if not failures else "error",
+        result={"metric": "obs_drill_fleet",
+                "stitched_requests": n_stitched,
+                "flight_dumps": len(dumps)},
+        error="; ".join(failures) or None,
+        path=os.path.join(out, "trajectory.jsonl"))
 
     print(f"\nstitched requests in merged trace: {n_stitched}")
     print(f"flight dumps: {len(dumps)} -> {flight_dir}")
@@ -340,7 +499,7 @@ def fleet_main(args) -> int:
             print(f"FAIL: {msg}", file=sys.stderr)
         return 1
     print("fleet observability drill: stitched trace + exact federation + "
-          "flight dumps all verified")
+          "flight dumps + SLO alert cycle + history + explain all verified")
     return 0
 
 
