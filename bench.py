@@ -170,6 +170,37 @@ def _probe_quant_kernels(kind: str = "q40", timeout_s: int = 240,
     return _run_probe(code, "QPROBE_OK", timeout_s)
 
 
+def _report_lowering_failure(kernel: str, kind: str, shapes: dict,
+                             detail: str) -> None:
+    """Record a kernel-level Pallas lowering failure as a structured
+    trajectory row instead of a log line that scrolls away.
+
+    Called only when the probe child printed BACKEND_TPU_OK — the backend
+    was reachable and compilation of OUR kernel is what died (the exact
+    failure mode of BENCH_r02's (172, 4096) scale plane). The row carries
+    ``error_kind="pallas_lowering"`` plus every grid/BlockSpec the launch
+    would have fed Mosaic (from ops.lowering, the same planner the CPU
+    gate sweeps), so the forensics never depend on scraping a truncated
+    child traceback."""
+    try:
+        from dllama_tpu.obsv import trajectory as _traj
+        from dllama_tpu.ops import lowering as _low
+
+        try:
+            plans = [p.to_dict() for p in _low.lowering_plan(kind, shapes)]
+        except Exception as e:  # noqa: BLE001 — the plan itself may be what's broken
+            plans = [{"plan_error": repr(e)}]
+        rep = _traj.append_row(
+            "kernel_lowering", "error", error=detail[-500:],
+            extra={"error_kind": "pallas_lowering", "kernel": kernel,
+                   "shapes": shapes, "plans": plans})
+        if rep["path"]:
+            log(f"pallas lowering failure recorded to {rep['path']} "
+                f"(kernel={kernel})")
+    except Exception:  # noqa: BLE001 — forensics must never kill the bench
+        pass
+
+
 def _probe_flash_kernel(timeout_s: int = 240) -> None:
     """If DLLAMA_FLASH_DECODE=1, compile+run one tiny flash-decode kernel in
     a subprocess (with the cache dtype the bench will use) BEFORE this
@@ -209,6 +240,14 @@ def _probe_flash_kernel(timeout_s: int = 240) -> None:
     if not ok:
         log(f"flash-decode probe failed ({detail[:200]}); "
             "falling back to dense attention (DLLAMA_FLASH_DECODE unset)")
+        if "BACKEND_TPU_OK" in detail:
+            _report_lowering_failure(
+                "flash_decode", "flash_decode",
+                dict(T=1, L=1, S=512, n_heads=8, n_kv_heads=4, head_size=128,
+                     cache_dtype=("float8_e4m3fn"
+                                  if os.environ.get("BENCH_CACHE") == "f8"
+                                  else "bfloat16")),
+                detail)
         os.environ.pop("DLLAMA_FLASH_DECODE", None)
 
 
@@ -224,9 +263,15 @@ def _probe_q40_with_fallback() -> tuple:
             and "DLLAMA_Q40_NOSUB" not in os.environ):
         log("nosub q40 probe failed on a live TPU; retrying with the "
             "subtracting kernel (DLLAMA_Q40_NOSUB=0)")
+        _report_lowering_failure(
+            "q40_matmul[nosub]", "q40",
+            dict(T=1, K=128, O=128, nosub=True), detail)
         probed, detail = _probe_quant_kernels(nosub_env="0")
         if probed:
             os.environ["DLLAMA_Q40_NOSUB"] = "0"  # before any dllama import
+    if not probed and "BACKEND_TPU_OK" in detail:
+        _report_lowering_failure(
+            "q40_matmul", "q40", dict(T=1, K=128, O=128, nosub=False), detail)
     return probed, detail
 
 

@@ -222,8 +222,8 @@ def run(root: str | None = None, only_files=None) -> Report:
     this package was imported from).  ``only_files`` (repo-relative paths)
     filters the *reported* findings for changed-files mode — every pass
     still sees the whole tree, so cross-file contracts stay sound."""
-    from . import (blocking, callgraph, coverage, hygiene, locks, protocol,
-                   tracesafety)
+    from . import (blocking, callgraph, coverage, hygiene, locks,
+                   pallas_tiling, protocol, tracesafety)
     root = find_root(root) if root is None else os.path.abspath(root)
     sources = []
     findings: list = []
@@ -242,7 +242,8 @@ def run(root: str | None = None, only_files=None) -> Report:
                        locks.check_guarded_globals,
                        blocking.check_blocking,
                        tracesafety.check_trace_safety,
-                       hygiene.check_exceptions)
+                       hygiene.check_exceptions,
+                       pallas_tiling.check_blockspecs)
     for src in sources:
         for p in per_file_passes:
             findings.extend(_apply_suppressions(list(p(src)), src))
@@ -274,14 +275,16 @@ def analyze_source(text: str, filename: str = "snippet.py",
     """Run per-file passes over a source string — the fixture-test entry.
     ``passes`` defaults to all per-file passes plus the cross-file lock
     passes applied to this single file."""
-    from . import blocking, callgraph, hygiene, locks, tracesafety
+    from . import (blocking, callgraph, hygiene, locks, pallas_tiling,
+                   tracesafety)
     src = SourceFile(filename, filename, text)
     findings: list = list(src.bad_suppressions)
     chosen = passes or (callgraph.check_guarded_writes,
                         locks.check_guarded_globals,
                         blocking.check_blocking,
                         tracesafety.check_trace_safety,
-                        hygiene.check_exceptions)
+                        hygiene.check_exceptions,
+                        pallas_tiling.check_blockspecs)
     for p in chosen:
         findings.extend(_apply_suppressions(list(p(src)), src))
     if not passes:

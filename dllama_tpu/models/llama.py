@@ -25,12 +25,13 @@ import numpy as np
 
 from dllama_tpu.formats.weights import WeightFileReader
 from dllama_tpu.models.config import ModelConfig
-from dllama_tpu.ops import flash_decode
+from dllama_tpu.ops import flash_decode, fused_rope_cache
 from dllama_tpu.ops.activations import ACTIVATIONS
 from dllama_tpu.ops.attention import gqa_attention
 from dllama_tpu.ops.norms import rmsnorm
 from dllama_tpu.ops.qmatmul import (
-    QuantTensor, matmul_any, quantize_tensor, slice_to_in_features,
+    QuantTensor, matmul_any, norm_fusion_engages, qmatmul_norm,
+    quantize_tensor, slice_to_in_features,
 )
 from dllama_tpu.ops.rope import apply_rope, rope_table
 from dllama_tpu.parallel.collectives import gather_columns as _gather
@@ -552,15 +553,31 @@ def rope_tables(cfg: ModelConfig) -> dict:
 # Forward pass
 # ---------------------------------------------------------------------------
 
-def _dense_ffn(cfg: ModelConfig, lp: dict, xb: jnp.ndarray, tp_axis=None,
+def _norm_proj(x, norm_w, w, layer, eps):
+    """``rmsnorm(x, norm_w) @ w``. With DLLAMA_FUSE_NORM and a quantized
+    ``w``, the norm rides inside the matmul kernel as an x-block epilogue
+    (qmatmul.qmatmul_norm — bit-identical, one fewer activation HBM
+    round-trip). Callers needing the same normalized activation for several
+    projections call this per projection: fused, the epilogue recomputes
+    in-register (the point); unfused, XLA CSEs the repeated rmsnorm."""
+    if norm_fusion_engages(w):
+        return qmatmul_norm(x, norm_w, w, layer, eps)
+    return matmul_any(rmsnorm(x, norm_w, eps), w, layer)
+
+
+def _dense_ffn(cfg: ModelConfig, lp: dict, x: jnp.ndarray, norm_w, tp_axis=None,
                tp_compress: bool = False, layer=None) -> jnp.ndarray:
+    """FFN half on the RAW (pre-norm) residual ``x``: the ``rms_ffn`` norm is
+    applied via ``_norm_proj`` so it can fuse into the up/gate kernels."""
     act = ACTIVATIONS[cfg.hidden_act]
+    eps = cfg.norm_eps
     if "w13" in lp:  # fused single-kernel up|gate projection (fuse_qkv_ffn)
-        u = matmul_any(xb, lp["w13"], layer)
+        u = _norm_proj(x, norm_w, lp["w13"], layer, eps)
         half = u.shape[-1] // 2
         h = act(u[..., :half]) * u[..., half:]
         return matmul_any(h, lp["w2"], layer)
-    h = act(matmul_any(xb, lp["w1"], layer)) * matmul_any(xb, lp["w3"], layer)
+    h = (act(_norm_proj(x, norm_w, lp["w1"], layer, eps))
+         * _norm_proj(x, norm_w, lp["w3"], layer, eps))
     h = slice_to_in_features(_gather(h, tp_axis, tp_compress), lp["w2"])
     return _gather(matmul_any(h, lp["w2"], layer), tp_axis, tp_compress)
 
@@ -586,9 +603,11 @@ def _ffn_residual(cfg: ModelConfig, lp: dict, x: jnp.ndarray, att_out: jnp.ndarr
         return x + rmsnorm(moe_ffn(cfg, lp, xb, layer, tp_axis, tp_compress),
                            lp["rms_ffn2"], cfg.norm_eps)
     x = x + att_out
-    xb = rmsnorm(x, lp["rms_ffn"], cfg.norm_eps)
-    return x + (moe_ffn(cfg, lp, xb, layer, tp_axis, tp_compress) if cfg.is_moe
-                else _dense_ffn(cfg, lp, xb, tp_axis, tp_compress, layer))
+    if cfg.is_moe:
+        xb = rmsnorm(x, lp["rms_ffn"], cfg.norm_eps)
+        return x + moe_ffn(cfg, lp, xb, layer, tp_axis, tp_compress)
+    return x + _dense_ffn(cfg, lp, x, lp["rms_ffn"], tp_axis, tp_compress,
+                          layer)
 
 
 def _attn_block(cfg: ModelConfig, lp: dict, rope: dict, x, k_cache, v_cache, pos,
@@ -607,18 +626,18 @@ def _attn_block(cfg: ModelConfig, lp: dict, rope: dict, x, k_cache, v_cache, pos
     the update touches only (layer, pos..pos+T) and the attention reads the
     layer's slab. Without it, k_cache/v_cache are this layer's [S, kv, hd]."""
     T = x.shape[0]
-    xb = rmsnorm(x, lp["rms_att"], cfg.norm_eps)
+    eps = cfg.norm_eps
 
     if "wqkv" in lp:  # fused single-kernel projection (fuse_qkv_ffn; no TP)
-        qkv = matmul_any(xb, lp["wqkv"], layer)
+        qkv = _norm_proj(x, lp["rms_att"], lp["wqkv"], layer, eps)
         d, kv = cfg.dim, cfg.kv_dim
         q = qkv[:, :d]
         k = qkv[:, d : d + kv]
         v = qkv[:, d + kv :]
     else:
-        q = matmul_any(xb, lp["wq"], layer)
-        k = matmul_any(xb, lp["wk"], layer)
-        v = matmul_any(xb, lp["wv"], layer)
+        q = _norm_proj(x, lp["rms_att"], lp["wq"], layer, eps)
+        k = _norm_proj(x, lp["rms_att"], lp["wk"], layer, eps)
+        v = _norm_proj(x, lp["rms_att"], lp["wv"], layer, eps)
     q = q.reshape(T, -1, cfg.head_size)
     k = k.reshape(T, -1, cfg.head_size)
     v = v.reshape(T, -1, cfg.head_size)
@@ -626,20 +645,30 @@ def _attn_block(cfg: ModelConfig, lp: dict, rope: dict, x, k_cache, v_cache, pos
     cos = jax.lax.dynamic_slice_in_dim(rope["cos"], pos, T)[:, None, :]
     sin = jax.lax.dynamic_slice_in_dim(rope["sin"], pos, T)[:, None, :]
     q = apply_rope(q, cos, sin, cfg.rope_style)
-    k = apply_rope(k, cos, sin, cfg.rope_style)
 
     if layer is None:
+        k = apply_rope(k, cos, sin, cfg.rope_style)
         k_cache = jax.lax.dynamic_update_slice_in_dim(
             k_cache, k.astype(k_cache.dtype), pos, axis=0)
         v_cache = jax.lax.dynamic_update_slice_in_dim(
             v_cache, v.astype(v_cache.dtype), pos, axis=0)
         out = gqa_attention(q, k_cache, v_cache, pos)
     else:
-        zero = jnp.int32(0)
-        k_cache = jax.lax.dynamic_update_slice(
-            k_cache, k.astype(k_cache.dtype)[None], (layer, pos, zero, zero))
-        v_cache = jax.lax.dynamic_update_slice(
-            v_cache, v.astype(v_cache.dtype)[None], (layer, pos, zero, zero))
+        if fused_rope_cache.engages(T, k_cache.dtype):
+            # DLLAMA_FUSE_ROPE_CACHE=1: K rotates in-kernel and lands with V
+            # in the stacked cache in one pass (ops.fused_rope_cache) —
+            # bit-identical to the apply_rope + dynamic_update_slice below
+            k_cache, v_cache = fused_rope_cache.rope_cache_update(
+                k, v, cos, sin, k_cache, v_cache, pos, layer, cfg.rope_style)
+        else:
+            k = apply_rope(k, cos, sin, cfg.rope_style)
+            zero = jnp.int32(0)
+            k_cache = jax.lax.dynamic_update_slice(
+                k_cache, k.astype(k_cache.dtype)[None],
+                (layer, pos, zero, zero))
+            v_cache = jax.lax.dynamic_update_slice(
+                v_cache, v.astype(v_cache.dtype)[None],
+                (layer, pos, zero, zero))
         # DLLAMA_FLASH_DECODE=1: online-softmax kernel reading ONLY the live
         # cache prefix, straight from the stacked [L, S, kv, hd] cache — no
         # per-layer slab materialization, bytes scale with pos not seq_len
@@ -780,15 +809,15 @@ def _attn_block_batched(cfg: ModelConfig, lp: dict, rope: dict, x, k_cache,
     ``tp_axis`` (inside shard_map): local heads + kv-shard cache, activation
     gathers after the head concat and the wo matmul, exactly `_attn_block`."""
     B = x.shape[0]
-    xb = rmsnorm(x, lp["rms_att"], cfg.norm_eps)
+    eps = cfg.norm_eps
     if "wqkv" in lp:
-        qkv = matmul_any(xb, lp["wqkv"], layer)
+        qkv = _norm_proj(x, lp["rms_att"], lp["wqkv"], layer, eps)
         d, kv = cfg.dim, cfg.kv_dim
         q, k, v = qkv[:, :d], qkv[:, d : d + kv], qkv[:, d + kv :]
     else:
-        q = matmul_any(xb, lp["wq"], layer)
-        k = matmul_any(xb, lp["wk"], layer)
-        v = matmul_any(xb, lp["wv"], layer)
+        q = _norm_proj(x, lp["rms_att"], lp["wq"], layer, eps)
+        k = _norm_proj(x, lp["rms_att"], lp["wk"], layer, eps)
+        v = _norm_proj(x, lp["rms_att"], lp["wv"], layer, eps)
     q = q.reshape(B, -1, cfg.head_size)
     k = k.reshape(B, -1, cfg.head_size)
     v = v.reshape(B, -1, cfg.head_size)
@@ -796,7 +825,17 @@ def _attn_block_batched(cfg: ModelConfig, lp: dict, rope: dict, x, k_cache,
     cos = rope["cos"][pos][:, None, :]  # per-row angle: [B, 1, hs/2]
     sin = rope["sin"][pos][:, None, :]
     q = apply_rope(q, cos, sin, cfg.rope_style)
-    k = apply_rope(k, cos, sin, cfg.rope_style)
+
+    fused_kv = (layer is not None
+                and fused_rope_cache.engages(1, k_cache.dtype))
+    if fused_kv:
+        # DLLAMA_FUSE_ROPE_CACHE=1: rotate each row's K in-kernel and land
+        # K/V at (layer, b, pos[b]) in one pass — bit-identical to the
+        # scatter/DUS writes below, including their end-of-sequence clamp
+        k_cache, v_cache = fused_rope_cache.rope_cache_update_batched(
+            k, v, cos, sin, k_cache, v_cache, pos, layer, cfg.rope_style)
+    else:
+        k = apply_rope(k, cos, sin, cfg.rope_style)
 
     if (layer is not None
             and flash_decode.engages(1, k_cache.shape[2], k_cache.dtype)):
@@ -806,10 +845,11 @@ def _attn_block_batched(cfg: ModelConfig, lp: dict, rope: dict, x, k_cache,
         # the last slot so a row stepped at pos >= seq_len leaves the same
         # cache contents as the dense path's dynamic_update_slice (which
         # clamps), instead of the scatter silently dropping the row.
-        rows = jnp.arange(B, dtype=jnp.int32)
-        wpos = jnp.clip(pos, 0, k_cache.shape[2] - 1)
-        k_cache = k_cache.at[layer, rows, wpos].set(k.astype(k_cache.dtype))
-        v_cache = v_cache.at[layer, rows, wpos].set(v.astype(v_cache.dtype))
+        if not fused_kv:
+            rows = jnp.arange(B, dtype=jnp.int32)
+            wpos = jnp.clip(pos, 0, k_cache.shape[2] - 1)
+            k_cache = k_cache.at[layer, rows, wpos].set(k.astype(k_cache.dtype))
+            v_cache = v_cache.at[layer, rows, wpos].set(v.astype(v_cache.dtype))
         out = flash_decode.flash_decode_attention_batched(
             q, k_cache, v_cache, pos, layer)  # [B, local heads, hs]
     else:
@@ -818,17 +858,18 @@ def _attn_block_batched(cfg: ModelConfig, lp: dict, rope: dict, x, k_cache,
         else:
             slab_k = jax.lax.dynamic_index_in_dim(k_cache, layer, 0, keepdims=False)
             slab_v = jax.lax.dynamic_index_in_dim(v_cache, layer, 0, keepdims=False)
-        write = jax.vmap(
-            lambda c, kk, p: jax.lax.dynamic_update_slice_in_dim(
-                c, kk[None].astype(c.dtype), p, axis=0))
-        slab_k = write(slab_k, k, pos)
-        slab_v = write(slab_v, v, pos)
-        if layer is None:
-            k_cache, v_cache = slab_k, slab_v
-        else:
-            zero = (0, 0, 0, 0)
-            k_cache = jax.lax.dynamic_update_slice(k_cache, slab_k[None], (layer, *zero))
-            v_cache = jax.lax.dynamic_update_slice(v_cache, slab_v[None], (layer, *zero))
+        if not fused_kv:
+            write = jax.vmap(
+                lambda c, kk, p: jax.lax.dynamic_update_slice_in_dim(
+                    c, kk[None].astype(c.dtype), p, axis=0))
+            slab_k = write(slab_k, k, pos)
+            slab_v = write(slab_v, v, pos)
+            if layer is None:
+                k_cache, v_cache = slab_k, slab_v
+            else:
+                zero = (0, 0, 0, 0)
+                k_cache = jax.lax.dynamic_update_slice(k_cache, slab_k[None], (layer, *zero))
+                v_cache = jax.lax.dynamic_update_slice(v_cache, slab_v[None], (layer, *zero))
 
         out = jax.vmap(
             lambda qb, ks, vs, p: gqa_attention(qb[None], ks, vs, p)[0]
@@ -1020,16 +1061,15 @@ def _verify_layer(cfg: ModelConfig, lp: dict, rope: dict, x, k_cache,
     [L, B, S, kv, hd] caches, per-row base positions ``pos``. The shared
     body of ``forward_batched_verify`` and its microbatch-overlap twin."""
     B, T = x.shape[:2]
-    xb = rmsnorm(x, lp["rms_att"], cfg.norm_eps)
-    xf = xb.reshape(B * T, cfg.dim)
+    xf = x.reshape(B * T, cfg.dim)  # raw rows; rmsnorm rides in _norm_proj
     if "wqkv" in lp:
-        qkv = matmul_any(xf, lp["wqkv"], idx)
+        qkv = _norm_proj(xf, lp["rms_att"], lp["wqkv"], idx, cfg.norm_eps)
         d, kv = cfg.dim, cfg.kv_dim
         q, k, v = qkv[:, :d], qkv[:, d : d + kv], qkv[:, d + kv :]
     else:
-        q = matmul_any(xf, lp["wq"], idx)
-        k = matmul_any(xf, lp["wk"], idx)
-        v = matmul_any(xf, lp["wv"], idx)
+        q = _norm_proj(xf, lp["rms_att"], lp["wq"], idx, cfg.norm_eps)
+        k = _norm_proj(xf, lp["rms_att"], lp["wk"], idx, cfg.norm_eps)
+        v = _norm_proj(xf, lp["rms_att"], lp["wv"], idx, cfg.norm_eps)
     # head counts derive from the ARRAY shapes: under tp they are the
     # local slices (the reference's MultiHeadAttSlice head split)
     q = q.reshape(B, T, -1, cfg.head_size)
@@ -1043,18 +1083,27 @@ def _verify_layer(cfg: ModelConfig, lp: dict, rope: dict, x, k_cache,
     cos = rope["cos"][ppos][:, :, None, :]  # [B, T, 1, hs/2]
     sin = rope["sin"][ppos][:, :, None, :]
     q = apply_rope(q, cos, sin, cfg.rope_style)
-    k = apply_rope(k, cos, sin, cfg.rope_style)
 
-    slab_k = jax.lax.dynamic_index_in_dim(k_cache, idx, 0, keepdims=False)
-    slab_v = jax.lax.dynamic_index_in_dim(v_cache, idx, 0, keepdims=False)
-    write = jax.vmap(
-        lambda c, kk, p: jax.lax.dynamic_update_slice_in_dim(
-            c, kk.astype(c.dtype), p, axis=0))
-    slab_k = write(slab_k, k, pos)
-    slab_v = write(slab_v, v, pos)
-    zero = (0, 0, 0, 0)
-    k_cache = jax.lax.dynamic_update_slice(k_cache, slab_k[None], (idx, *zero))
-    v_cache = jax.lax.dynamic_update_slice(v_cache, slab_v[None], (idx, *zero))
+    if fused_rope_cache.engages(T, k_cache.dtype):
+        # DLLAMA_FUSE_ROPE_CACHE=1: rotate the draft rows' K in-kernel and
+        # land K/V at (idx, b, pos[b]..pos[b]+T) in one pass — bit-identical
+        # to the apply_rope + per-row slab writes below
+        k_cache, v_cache = fused_rope_cache.rope_cache_update_verify(
+            k, v, cos, sin, k_cache, v_cache, pos, idx, cfg.rope_style)
+        slab_k = jax.lax.dynamic_index_in_dim(k_cache, idx, 0, keepdims=False)
+        slab_v = jax.lax.dynamic_index_in_dim(v_cache, idx, 0, keepdims=False)
+    else:
+        k = apply_rope(k, cos, sin, cfg.rope_style)
+        slab_k = jax.lax.dynamic_index_in_dim(k_cache, idx, 0, keepdims=False)
+        slab_v = jax.lax.dynamic_index_in_dim(v_cache, idx, 0, keepdims=False)
+        write = jax.vmap(
+            lambda c, kk, p: jax.lax.dynamic_update_slice_in_dim(
+                c, kk.astype(c.dtype), p, axis=0))
+        slab_k = write(slab_k, k, pos)
+        slab_v = write(slab_v, v, pos)
+        zero = (0, 0, 0, 0)
+        k_cache = jax.lax.dynamic_update_slice(k_cache, slab_k[None], (idx, *zero))
+        v_cache = jax.lax.dynamic_update_slice(v_cache, slab_v[None], (idx, *zero))
 
     out = jax.vmap(gqa_attention)(q, slab_k, slab_v, pos)  # [B, T, H, hd]
     heads = _gather(out.reshape(B * T, -1), tp_axis, tp_compress)

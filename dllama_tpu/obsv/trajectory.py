@@ -74,7 +74,11 @@ def _numeric_metrics(result: dict, prefix: str = "") -> dict:
 
 def make_row(bench: str, status: str, result: Optional[dict] = None,
              gates: Optional[dict] = None, error: Optional[str] = None,
-             now_s: Optional[float] = None) -> dict:
+             now_s: Optional[float] = None,
+             extra: Optional[dict] = None) -> dict:
+    """``extra`` carries structured, non-numeric forensics (e.g. the
+    ``error_kind``/``kernel``/``plans`` payload of a Pallas lowering
+    failure) verbatim into the row; keys never override the core schema."""
     metrics = _numeric_metrics(result)
     # bench records carry their headline number under the generic key
     # "value" (no direction hint): alias it under the self-describing
@@ -83,7 +87,7 @@ def make_row(bench: str, status: str, result: Optional[dict] = None,
             and isinstance((result or {}).get("value"), (int, float))
             and not isinstance(result["value"], bool)):
         metrics[result["metric"]] = float(result["value"])
-    return {
+    row = {
         "v": 1,
         "ts": round(time.time() if now_s is None else now_s, 3),
         "bench": bench,
@@ -94,6 +98,9 @@ def make_row(bench: str, status: str, result: Optional[dict] = None,
         "metrics": metrics,
         "error": error,
     }
+    for k, v in (extra or {}).items():
+        row.setdefault(k, v)
+    return row
 
 
 def load_rows(path: Optional[str] = None) -> List[dict]:
@@ -166,13 +173,15 @@ def _direction(key: str) -> Optional[str]:
 def append_row(bench: str, status: str, result: Optional[dict] = None,
                gates: Optional[dict] = None, error: Optional[str] = None,
                path: Optional[str] = None,
-               tolerance: float = 0.10) -> dict:
+               tolerance: float = 0.10,
+               extra: Optional[dict] = None) -> dict:
     """Append one row and compare it against its same-host predecessor.
 
     Returns ``{"row": ..., "regressions": [...], "path": ...}``; never
     raises — a bench must finish reporting even when the results
     directory is unwritable (the row is still returned for stdout)."""
-    row = make_row(bench, status, result=result, gates=gates, error=error)
+    row = make_row(bench, status, result=result, gates=gates, error=error,
+                   extra=extra)
     target = trajectory_path(path)
     prior = load_rows(target)
     try:

@@ -1,0 +1,396 @@
+"""Static TPU tiling verifier for every Pallas kernel in the inventory.
+
+Mosaic rejects a ``pallas_call`` whose operand blocks violate the tiling
+contract: the last two dims of every block must each be **divisible by the
+(sublane, lane) = (8, 128) min tile or equal to the corresponding array
+dim** (sublane widens to 16 for 2-byte and 32 for 1-byte dtypes). The
+round-2 bench hit exactly this on real hardware — the q40 scale plane of
+Llama-2-7B's 11008-wide FFN produced a ``(4, 1024)`` block against a
+``(172, 4096)`` array and the whole 7B path fell back — and the failure
+class is only observable *on* a TPU unless the grid + BlockSpecs are
+re-derivable without one.
+
+That is what this module does: ``lowering_plan(kind, shapes)`` reconstructs
+every ``pallas_call`` a kernel entry point would launch for the given
+logical shapes — same padding, same ``tile_plan``, same BlockSpecs as the
+real launch code in ``ops.qmatmul`` / ``ops.flash_decode`` /
+``ops.fused_rope_cache`` — and ``verify(plans)`` applies the
+divisible-or-whole-dim rule to every block, CPU-only. ``check(...)``
+raises ``TilingError`` with the offending kernel + block/array shapes, the
+same payload bench.py attaches to a ``pallas_lowering`` trajectory row.
+
+CPU gate: ``tests/test_lowering.py`` sweeps 7B/8B/MoE dims x q40/q80 x
+T in {1, 8, 64} (plus f8 caches and the fused variants) so CI catches the
+next violation before a hardware window burns. Report:
+``python -m dllama_tpu.ops.lowering --json`` dumps the full shape matrix.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+
+import jax.numpy as jnp
+
+SUBLANE, LANE = 8, 128
+
+
+class TilingError(ValueError):
+    """A block in a planned pallas_call violates Mosaic's tiling rule."""
+
+
+@dataclass(frozen=True)
+class OperandPlan:
+    """One operand (or output / scratch buffer) of a planned pallas_call."""
+
+    name: str
+    array: tuple  # full array shape
+    block: tuple  # BlockSpec block shape ("ANY" memory space -> block == array)
+    dtype: str = "float32"
+
+    def violations(self) -> list[str]:
+        out = []
+        if len(self.block) != len(self.array):
+            return [f"{self.name}: block rank {len(self.block)} != "
+                    f"array rank {len(self.array)}"]
+        if not self.block:
+            return out
+        itemsize = jnp.dtype(self.dtype).itemsize
+        sub = {4: SUBLANE, 2: 16, 1: 32}.get(itemsize, SUBLANE)
+        # the contract applies to the last two dims; leading block dims
+        # only need to fit inside the array
+        checks = []
+        if len(self.block) >= 2:
+            checks.append((-2, sub, "sublane"))
+        checks.append((-1, LANE, "lane"))
+        for ax, mult, label in checks:
+            b, a = self.block[ax], self.array[ax]
+            if b != a and b % mult != 0:
+                out.append(
+                    f"{self.name}: {label} block dim {b} is neither a "
+                    f"multiple of {mult} nor the whole array dim {a} "
+                    f"(block {self.block} vs array {self.array}, "
+                    f"{self.dtype})")
+        for ax in range(len(self.block) - 2):
+            if self.block[ax] > self.array[ax]:
+                out.append(f"{self.name}: leading block dim {self.block[ax]} "
+                           f"exceeds array dim {self.array[ax]}")
+        return out
+
+
+@dataclass(frozen=True)
+class KernelPlan:
+    """Grid + every operand block of one pallas_call, statically derived."""
+
+    kernel: str
+    grid: tuple
+    operands: tuple  # of OperandPlan
+    note: str = ""
+
+    def violations(self) -> list[str]:
+        return [f"{self.kernel}: {v}" for op in self.operands
+                for v in op.violations()]
+
+    def to_dict(self) -> dict:
+        return {
+            "kernel": self.kernel,
+            "grid": list(self.grid),
+            "operands": [
+                {"name": o.name, "array": list(o.array),
+                 "block": list(o.block), "dtype": o.dtype}
+                for o in self.operands
+            ],
+            "violations": self.violations(),
+        }
+
+
+def _pad8(n: int) -> int:
+    return max(8, (n + 7) // 8 * 8)
+
+
+def _cdiv(a: int, b: int) -> int:
+    return -(-a // b)
+
+
+def _legacy_tile_plan(kind: str, k_padded: int, out_features: int):
+    """The pre-K_MULTIPLE planner (no scale-plane alignment) — kept ONLY so
+    the verifier can reconstruct and flag the round-2 failure: feeding the
+    raw unpadded 7B hidden dim (11008) yields bk=256 and the infamous
+    (4, 1024) scale block against the (172, O) plane."""
+    from dllama_tpu.ops.qmatmul import _TILE_CELL_CAP, _pad_up
+
+    bo = out_features if out_features < 128 else min(
+        1024, _pad_up(out_features, 128))
+    for bk in sorted({k_padded, k_padded // 2, 8192, 4096, 2048, 1024,
+                      512, 256}, reverse=True):
+        if bk and k_padded % bk == 0 and bk * bo <= _TILE_CELL_CAP:
+            return bk, bo
+    return k_padded, bo
+
+
+def _quant_plans(kind: str, shapes: dict) -> list[KernelPlan]:
+    """Plans for q40_matmul / q80_matmul (+ stacked variants, + the nosub
+    correction kernel, + the fused-norm variants) — mirrors ops.qmatmul."""
+    from dllama_tpu.ops import qmatmul as qm
+
+    T = int(shapes.get("T", 1))
+    K = int(shapes["K"])
+    O = int(shapes["O"])
+    L = shapes.get("L")  # not None -> the layer-stacked scalar-prefetch path
+    nosub = bool(shapes.get("nosub", kind == "q40" and qm.Q40_NOSUB))
+    fused_norm = bool(shapes.get("fused_norm", False))
+    kp = int(shapes.get("k_padded") or qm._pad_up(K, qm.K_MULTIPLE[kind]))
+    if kp % qm.K_MULTIPLE[kind] == 0:
+        bk, bo = qm.tile_plan(kind, kp, O)
+    else:
+        bk, bo = _legacy_tile_plan(kind, kp, O)
+    Tp = _pad8(T)
+    bt = min(Tp, qm.T_BLOCK)
+    grid = (_cdiv(Tp, bt), _cdiv(O, bo), kp // bk)
+    stacked = "_stacked" if L else ""
+    fused = "_norm" if fused_norm else ""
+    lead = (int(L),) if L else ()
+    blead = (1,) if L else ()
+
+    def op(name, arr, blk, dtype="float32"):
+        return OperandPlan(name, tuple(arr), tuple(blk), dtype)
+
+    plans = []
+    if kind == "q80":
+        operands = [
+            op("x", (Tp, kp), (bt, bk), "bfloat16"),
+            op("w", lead + (kp, O), blead + (bk, bo), "int8"),
+            op("scales", lead + (kp // qm.QK, O), blead + (bk // qm.QK, bo)),
+        ]
+    else:
+        operands = [
+            op("x_lo", (Tp, kp // 2), (bt, bk // 2), "bfloat16"),
+            op("x_hi", (Tp, kp // 2), (bt, bk // 2), "bfloat16"),
+            op("w_packed", lead + (kp // 2, O), blead + (bk // 2, bo), "uint8"),
+            op("s_lo", lead + (kp // 64, O), blead + (bk // 64, bo)),
+            op("s_hi", lead + (kp // 64, O), blead + (bk // 64, bo)),
+        ]
+    if fused_norm:
+        # norm planes: [L, 1, K] for the stacked kernels ([1, 1, K] when the
+        # caller pre-sliced a flat [K] weight — same block tiling either way)
+        operands.append(op("inv", (Tp, 1), (bt, 1)))
+        if kind == "q80":
+            operands.append(op("norm_w", lead + (1, kp), blead + (1, bk)))
+        else:
+            operands.append(
+                op("norm_w_lo", lead + (1, kp // 2), blead + (1, bk // 2)))
+            operands.append(
+                op("norm_w_hi", lead + (1, kp // 2), blead + (1, bk // 2)))
+    operands.append(op("out", (Tp, O), (bt, bo)))
+    plans.append(KernelPlan(
+        kernel=f"{kind}_matmul{stacked}{fused}", grid=grid,
+        operands=tuple(operands),
+        note=f"T={T} K={K} k_padded={kp} O={O} bk={bk} bo={bo}"))
+
+    if kind == "q40" and nosub:
+        NS = kp // 64
+        cgrid = (_cdiv(Tp, bt), _cdiv(O, bo))
+        plans.append(KernelPlan(
+            kernel=f"q40_correction{stacked}", grid=cgrid,
+            operands=(
+                op("xs_lo", (Tp, NS), (bt, NS)),
+                op("xs_hi", (Tp, NS), (bt, NS)),
+                op("s_lo", lead + (NS, O), blead + (NS, bo)),
+                op("s_hi", lead + (NS, O), blead + (NS, bo)),
+                op("out", (Tp, O), (bt, bo)),
+            ),
+            note=f"nosub correction, NS={NS}"))
+    return plans
+
+
+def _flash_plans(shapes: dict) -> list[KernelPlan]:
+    """Plans for flash_decode_attention[_batched] — mirrors
+    ops.flash_decode._launch (caches ride memory_space=ANY, so their DMA'd
+    VMEM scratch blocks are what the tiling rule constrains)."""
+    from dllama_tpu.ops import flash_decode as fd
+
+    T = int(shapes.get("T", 1))
+    B = int(shapes.get("B", 1))
+    L = int(shapes.get("L", 1))
+    S = int(shapes["S"])
+    n_heads = int(shapes["n_heads"])
+    n_kv = int(shapes.get("n_kv_heads", n_heads))
+    hd = int(shapes["head_size"])
+    cache_dtype = str(shapes.get("cache_dtype", "bfloat16"))
+    batched = B > 1 or bool(shapes.get("batched", False))
+    group = n_heads // n_kv
+    Tg = (1 if batched else T) * group
+    Tgp = _pad8(Tg)
+    name = "flash_decode_batched" if batched else "flash_decode"
+    ops = (
+        OperandPlan("q", (B, n_kv, Tgp, hd), (1, 1, Tgp, hd), "bfloat16"),
+        OperandPlan("qpos", (B, Tgp, 1), (1, Tgp, 1), "int32"),
+        OperandPlan("k_cache[ANY]", (L, B, S, n_kv, hd), (L, B, S, n_kv, hd),
+                    cache_dtype),
+        OperandPlan("v_cache[ANY]", (L, B, S, n_kv, hd), (L, B, S, n_kv, hd),
+                    cache_dtype),
+        OperandPlan("k_buf[scratch]", (2, fd.BLOCK_S, hd), (2, fd.BLOCK_S, hd),
+                    cache_dtype),
+        OperandPlan("v_buf[scratch]", (2, fd.BLOCK_S, hd), (2, fd.BLOCK_S, hd),
+                    cache_dtype),
+        OperandPlan("out", (B, n_kv, Tgp, hd), (1, 1, Tgp, hd), "bfloat16"),
+    )
+    return [KernelPlan(kernel=name, grid=(B, n_kv), operands=ops,
+                       note=f"S={S} Tgp={Tgp} cache={cache_dtype}")]
+
+
+def _rope_cache_plans(shapes: dict) -> list[KernelPlan]:
+    """Plans for fused_rope_cache.rope_cache_update[_batched|_verify] — the
+    rope + cache-write epilogue kernel (ops.fused_rope_cache). All three
+    wrappers launch the same [B, T]-shaped kernel: solo is B=1, batched
+    decode is T=1, spec-verify is the general B x T case."""
+    T = int(shapes.get("T", 1))
+    B = int(shapes.get("B", 1))
+    L = int(shapes.get("L", 1))
+    S = int(shapes["S"])
+    n_kv = int(shapes["n_kv_heads"])
+    hd = int(shapes["head_size"])
+    cache_dtype = str(shapes.get("cache_dtype", "bfloat16"))
+    batched = B > 1 or bool(shapes.get("batched", False))
+    if not batched:
+        name = "rope_cache_update"
+    elif T == 1:
+        name = "rope_cache_update_batched"
+    else:
+        name = "rope_cache_update_verify"
+    kv_shape = (B, T, n_kv, hd)
+    ops = (
+        OperandPlan("k", kv_shape, (1,) + kv_shape[1:], "bfloat16"),
+        OperandPlan("v", kv_shape, (1,) + kv_shape[1:], "bfloat16"),
+        OperandPlan("cos", kv_shape[:2] + (1, hd // 2),
+                    (1,) + kv_shape[1:2] + (1, hd // 2)),
+        OperandPlan("sin", kv_shape[:2] + (1, hd // 2),
+                    (1,) + kv_shape[1:2] + (1, hd // 2)),
+        OperandPlan("k_cache[ANY]", (L, B, S, n_kv, hd), (L, B, S, n_kv, hd),
+                    cache_dtype),
+        OperandPlan("v_cache[ANY]", (L, B, S, n_kv, hd), (L, B, S, n_kv, hd),
+                    cache_dtype),
+        OperandPlan("k_scratch", kv_shape[1:], kv_shape[1:], cache_dtype),
+        OperandPlan("v_scratch", kv_shape[1:], kv_shape[1:], cache_dtype),
+    )
+    return [KernelPlan(kernel=name, grid=(B,), operands=ops,
+                       note=f"S={S} T={T} cache={cache_dtype}")]
+
+
+def lowering_plan(kind: str, shapes: dict) -> list[KernelPlan]:
+    """Enumerate every pallas_call (grid + BlockSpec blocks) the named
+    kernel entry point would launch for the given logical shapes.
+
+    ``kind``: "q40" | "q80" (shapes: T, K, O, optional L for the stacked
+    scalar-prefetch variant, nosub, fused_norm, k_padded override),
+    "flash_decode" (shapes: T, B, L, S, n_heads, n_kv_heads, head_size,
+    cache_dtype), or "rope_cache" (shapes: T, B, L, S, n_kv_heads,
+    head_size, cache_dtype).
+    """
+    if kind in ("q40", "q80"):
+        return _quant_plans(kind, shapes)
+    if kind == "flash_decode":
+        return _flash_plans(shapes)
+    if kind == "rope_cache":
+        return _rope_cache_plans(shapes)
+    raise ValueError(f"unknown kernel kind {kind!r}")
+
+
+def verify(plans: list[KernelPlan]) -> list[str]:
+    """All tiling violations across the plans (empty == lowerable)."""
+    return [v for p in plans for v in p.violations()]
+
+
+def check(kind: str, shapes: dict) -> list[KernelPlan]:
+    """lowering_plan + verify; raises TilingError naming the offending
+    kernel and block/array shapes on any violation."""
+    plans = lowering_plan(kind, shapes)
+    bad = verify(plans)
+    if bad:
+        raise TilingError(
+            f"{kind} {shapes}: " + "; ".join(bad))
+    return plans
+
+
+# ---------------------------------------------------------------------------
+# The CPU-sweepable shape matrix (the CI gate + the --json report)
+# ---------------------------------------------------------------------------
+
+#: real model dims the bench/CLI loads: (name, dim, hidden, n_heads,
+#: n_kv_heads, head_size, vocab)
+MODEL_DIMS = (
+    ("llama2_7b", 4096, 11008, 32, 32, 128, 32000),
+    ("llama3_8b", 4096, 14336, 32, 8, 128, 128256),
+    ("tinyllama", 2048, 5632, 32, 4, 64, 32000),
+    ("moe_mixtral", 4096, 14336, 32, 8, 128, 32000),
+)
+
+SWEEP_T = (1, 8, 64)
+
+
+def sweep(ts=SWEEP_T, kinds=("q40", "q80"),
+          cache_dtypes=("bfloat16", "float32", "float8_e4m3fn")) -> dict:
+    """Run the full shape matrix; returns {case_name: [plan dicts]} with
+    violations inline (the CI artifact). Raises nothing — callers gate on
+    the 'violations' fields."""
+    out = {}
+    for name, dim, hidden, n_heads, n_kv, hd, vocab in MODEL_DIMS:
+        L = 32
+        for kind in kinds:
+            for T in ts:
+                for tag, K, O in (("qkv", dim, dim),
+                                  ("kv_proj", dim, n_kv * hd),
+                                  ("up", dim, hidden),
+                                  ("down", hidden, dim),
+                                  ("wcls", dim, vocab)):
+                    for stacked in (None, L):
+                        for fused in (False, True):
+                            case = (f"{name}/{kind}/{tag}/T{T}"
+                                    f"{'/stacked' if stacked else ''}"
+                                    f"{'/fused_norm' if fused else ''}")
+                            plans = lowering_plan(kind, dict(
+                                T=T, K=K, O=O, L=stacked, fused_norm=fused))
+                            out[case] = [p.to_dict() for p in plans]
+        for dt in cache_dtypes:
+            for T in (1, 8):
+                case = f"{name}/flash/T{T}/{dt}"
+                out[case] = [p.to_dict() for p in lowering_plan(
+                    "flash_decode", dict(
+                        T=T, L=L, S=2048, n_heads=n_heads,
+                        n_kv_heads=n_kv, head_size=hd, cache_dtype=dt))]
+            # solo decode (B=1, T up to spec-verify rows), batched decode
+            # (T=1), and the batched spec-verify step (B x draft_len+1)
+            for B, T in ((1, 1), (1, 9), (8, 1), (8, 9)):
+                case = f"{name}/rope_cache/B{B}/T{T}/{dt}"
+                out[case] = [p.to_dict() for p in lowering_plan(
+                    "rope_cache", dict(
+                        T=T, B=B, L=L, S=2048, n_kv_heads=n_kv,
+                        head_size=hd, cache_dtype=dt, batched=B > 1))]
+    return out
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        description="Static TPU tiling verifier: sweep the kernel inventory")
+    ap.add_argument("--json", action="store_true",
+                    help="dump the full shape-matrix report as JSON")
+    args = ap.parse_args(argv)
+    report = sweep()
+    n_viol = sum(len(p["violations"]) for plans in report.values()
+                 for p in plans)
+    if args.json:
+        print(json.dumps({"cases": report, "n_cases": len(report),
+                          "n_violations": n_viol}, indent=1))
+    else:
+        for case, plans in sorted(report.items()):
+            for p in plans:
+                for v in p["violations"]:
+                    print(f"VIOLATION {case}: {v}")
+        print(f"{len(report)} cases, {n_viol} violations")
+    return 1 if n_viol else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

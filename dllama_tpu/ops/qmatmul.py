@@ -56,6 +56,31 @@ QK = blocks.QK  # 32 values per quantization block
 Q40_NOSUB = os.environ.get("DLLAMA_Q40_NOSUB", "1") != "0"
 
 
+def norm_fusion_enabled() -> bool:
+    """DLLAMA_FUSE_NORM=1: fuse the rmsnorm epilogue into the projection
+    kernels' t-blocks (``qmatmul_norm``) instead of materializing the
+    normalized activation in HBM between two dispatches. Read per call (not
+    import time) so tests and the bench can flip it."""
+    return os.environ.get("DLLAMA_FUSE_NORM", "0") == "1"
+
+
+def norm_fusion_engages(w) -> bool:
+    """THE gate for the norm+projection fusion at one call site: the flag is
+    on AND the matrix is quantized (dense matmuls already fuse their norm
+    under XLA; the Pallas custom call is what breaks that fusion)."""
+    return norm_fusion_enabled() and isinstance(w, QuantTensor)
+
+
+def rmsnorm_inv(x: jnp.ndarray, eps: float) -> jnp.ndarray:
+    """The per-row normalizer ``1/sqrt(mean(x^2) + eps)`` as [T, 1] f32 —
+    computed OUTSIDE the fused kernels (it needs the whole logical K row;
+    the kernels see K in bk-blocks) with exactly ops.norms.rmsnorm's op
+    order so the in-kernel epilogue is bit-identical to the composition."""
+    xf = x.astype(jnp.float32)
+    return jnp.reciprocal(
+        jnp.sqrt(jnp.mean(xf * xf, axis=-1, keepdims=True) + eps))
+
+
 def _interpret_default() -> bool:
     return jax.default_backend() != "tpu"
 
@@ -151,65 +176,125 @@ def tile_plan(kind: str, k_padded: int, out_features: int) -> tuple[int, int]:
 # Q80: int8 weights, one f32 scale per 32 input rows
 # ---------------------------------------------------------------------------
 
-def _q80_kernel(*refs, acc_dtype, stacked=False):
+def _q80_kernel(*refs, acc_dtype, stacked=False, fuse_norm=False):
     from jax.experimental import pallas as pl
 
     if stacked:  # scalar-prefetch layout: leading layer axis, idx_ref first
-        _idx_ref, x_ref, w_ref, s_ref, o_ref = refs
+        refs = refs[1:]
+        x_ref, w_ref, s_ref, *refs = refs
         wq, s = w_ref[0], s_ref[0]
     else:
-        x_ref, w_ref, s_ref, o_ref = refs
+        x_ref, w_ref, s_ref, *refs = refs
         wq, s = w_ref[...], s_ref[...]
+    if fuse_norm:  # rmsnorm epilogue operands: [bt, 1] inv, [1, bk] weight
+        inv_ref, nw_ref, o_ref = refs
+    else:
+        (o_ref,) = refs
 
     @pl.when(pl.program_id(2) == 0)  # grid (t, o, k): init at each k sweep
     def _init():
         o_ref[...] = jnp.zeros_like(o_ref)
 
+    if fuse_norm:
+        # exactly ops.norms.rmsnorm's elementwise tail — f32 product order
+        # weight * (x * inv), cast once to bf16 — so the fused activation
+        # tile is bit-identical to the unfused rmsnorm's output
+        nw = nw_ref[0] if stacked else nw_ref[...]  # drop the layer axis
+        x = (nw * (x_ref[...].astype(jnp.float32) * inv_ref[...])
+             ).astype(jnp.bfloat16)
+    else:
+        x = x_ref[...]
     w = wq.astype(jnp.int32).astype(jnp.float32)  # [bk, bo]
     bk, bo = w.shape
     scale = jnp.reshape(
         jnp.broadcast_to(s[:, None, :], (bk // QK, QK, bo)), (bk, bo)
     )
     wd = (w * scale).astype(jnp.bfloat16)
-    o_ref[...] += jnp.dot(x_ref[...], wd, preferred_element_type=acc_dtype)
+    o_ref[...] += jnp.dot(x, wd, preferred_element_type=acc_dtype)
+
+
+def _norm_operands(norm_w, norm_inv, k_padded):
+    """Pad the fused-rmsnorm epilogue operands to kernel layout: the norm
+    weight as a [1, k_padded] f32 plane (zero pad cols, so padded activation
+    columns stay exactly 0 after the in-kernel epilogue) and the
+    ``rmsnorm_inv`` normalizer row-padded like the activations."""
+    nw = norm_w.astype(jnp.float32)
+    if nw.shape[-1] != k_padded:
+        pad = [(0, 0)] * (nw.ndim - 1) + [(0, k_padded - nw.shape[-1])]
+        nw = jnp.pad(nw, pad)
+    nw = nw[..., None, :]  # [1, K] flat | [L, 1, K] layer-stacked
+    inv_p, _ = _pad_rows(norm_inv)
+    return nw, inv_p
+
+
+def _norm_layer_map(norm_w):
+    """Plane selector for the stacked kernels' norm-weight index_map: the
+    scalar-prefetched layer for a stacked [L, K] weight, plane 0 for a
+    flat [K] weight the caller already sliced (llama's scan body)."""
+    if norm_w.ndim == 2:
+        return lambda idx: idx[0]
+    return lambda idx: 0
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
 def q80_matmul(x: jnp.ndarray, w: jnp.ndarray, scales: jnp.ndarray,
-               interpret: bool | None = None) -> jnp.ndarray:
-    """``x [T, K] @ dequant(w int8 [K, O], scales [K/32, O]) -> [T, O]`` f32."""
+               interpret: bool | None = None,
+               norm_w: jnp.ndarray | None = None,
+               norm_inv: jnp.ndarray | None = None) -> jnp.ndarray:
+    """``x [T, K] @ dequant(w int8 [K, O], scales [K/32, O]) -> [T, O]`` f32.
+
+    ``norm_w``/``norm_inv`` (both or neither): fuse the rmsnorm epilogue
+    into the kernel's t-block — x arrives RAW and each tile is normalized
+    in VMEM (``norm_w [K]`` f32, ``norm_inv = rmsnorm_inv(x, eps) [T, 1]``),
+    bit-identical to ``q80_matmul(rmsnorm(x, norm_w), ...)`` while never
+    materializing the normalized activation in HBM."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     if interpret is None:
         interpret = _interpret_default()
+    fused = norm_w is not None
     K, O = w.shape  # K is the *packed* (padded) input dim
-    xp, t = _pad_rows(_pad_cols(x.astype(jnp.bfloat16), K))
+    # fused: keep x's own dtype (the epilogue normalizes in f32 from the raw
+    # activation, exactly like rmsnorm) — bf16 only for the plain kernel
+    xp, t = _pad_rows(_pad_cols(x if fused else x.astype(jnp.bfloat16), K))
     T = xp.shape[0]
     bk, bo = tile_plan("q80", K, O)
     bt = min(T, T_BLOCK)
+    in_specs = [
+        pl.BlockSpec((bt, bk), lambda t_, o, k: (t_, k)),
+        pl.BlockSpec((bk, bo), lambda t_, o, k: (k, o)),
+        pl.BlockSpec((bk // QK, bo), lambda t_, o, k: (k, o)),
+    ]
+    operands = [xp, w, scales]
+    if fused:
+        nw, inv_p = _norm_operands(norm_w, norm_inv, K)
+        in_specs += [
+            pl.BlockSpec((bt, 1), lambda t_, o, k: (t_, 0)),  # dllama: allow[PALLAS-001] reason=whole-array lane dim (proven: tests/test_lowering.py sweep)
+            pl.BlockSpec((1, bk), lambda t_, o, k: (0, k)),  # dllama: allow[PALLAS-001] reason=whole-array sublane dim (proven: tests/test_lowering.py sweep)
+        ]
+        operands += [inv_p, nw]
     out = pl.pallas_call(
-        functools.partial(_q80_kernel, acc_dtype=jnp.float32),
+        functools.partial(_q80_kernel, acc_dtype=jnp.float32,
+                          fuse_norm=fused),
         grid=(pl.cdiv(T, bt), pl.cdiv(O, bo), K // bk),
-        in_specs=[
-            pl.BlockSpec((bt, bk), lambda t_, o, k: (t_, k)),
-            pl.BlockSpec((bk, bo), lambda t_, o, k: (k, o)),
-            pl.BlockSpec((bk // QK, bo), lambda t_, o, k: (k, o)),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((bt, bo), lambda t_, o, k: (t_, o)),
         out_shape=jax.ShapeDtypeStruct((T, O), jnp.float32),
         compiler_params=compat.tpu_compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary")
         ),
         interpret=interpret,
-    )(xp, w, scales)
+    )(*operands)
     return out[:t]
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
 def q80_matmul_stacked(x: jnp.ndarray, w: jnp.ndarray, scales: jnp.ndarray,
                        layer: jnp.ndarray,
-                       interpret: bool | None = None) -> jnp.ndarray:
+                       interpret: bool | None = None,
+                       norm_w: jnp.ndarray | None = None,
+                       norm_inv: jnp.ndarray | None = None) -> jnp.ndarray:
     """Layer-indexed ``x [T, K] @ dequant(w[layer])`` over STACKED planes
     ``w int8 [L, K, O]``, ``scales [L, K/32, O]``, with a traced ``layer``.
 
@@ -226,31 +311,46 @@ def q80_matmul_stacked(x: jnp.ndarray, w: jnp.ndarray, scales: jnp.ndarray,
 
     if interpret is None:
         interpret = _interpret_default()
+    fused = norm_w is not None
     _, K, O = w.shape
-    xp, t = _pad_rows(_pad_cols(x.astype(jnp.bfloat16), K))
+    xp, t = _pad_rows(_pad_cols(x if fused else x.astype(jnp.bfloat16), K))
     T = xp.shape[0]
     bk, bo = tile_plan("q80", K, O)
     bt = min(T, T_BLOCK)
+    in_specs = [
+        pl.BlockSpec((bt, bk), lambda t_, o, k, idx: (t_, k)),
+        pl.BlockSpec((1, bk, bo), lambda t_, o, k, idx: (idx[0], k, o)),
+        pl.BlockSpec((1, bk // QK, bo),
+                     lambda t_, o, k, idx: (idx[0], k, o)),
+    ]
+    operands = [xp, w, scales]
+    if fused:
+        # norm weight: layer-stacked [L, K] (kernel indexes plane idx[0]) or
+        # already-sliced flat [K] (the scan body's lp dict — plane 0)
+        lsel = _norm_layer_map(norm_w)
+        nw, inv_p = _norm_operands(
+            norm_w if norm_w.ndim == 2 else norm_w[None], norm_inv, K)
+        in_specs += [
+            pl.BlockSpec((bt, 1), lambda t_, o, k, idx: (t_, 0)),  # dllama: allow[PALLAS-001] reason=whole-array lane dim (proven: tests/test_lowering.py sweep)
+            pl.BlockSpec((1, 1, bk), lambda t_, o, k, idx: (lsel(idx), 0, k)),  # dllama: allow[PALLAS-001] reason=whole-array sublane dim (proven: tests/test_lowering.py sweep)
+        ]
+        operands += [inv_p, nw]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(pl.cdiv(T, bt), pl.cdiv(O, bo), K // bk),
-        in_specs=[
-            pl.BlockSpec((bt, bk), lambda t_, o, k, idx: (t_, k)),
-            pl.BlockSpec((1, bk, bo), lambda t_, o, k, idx: (idx[0], k, o)),
-            pl.BlockSpec((1, bk // QK, bo),
-                         lambda t_, o, k, idx: (idx[0], k, o)),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((bt, bo), lambda t_, o, k, idx: (t_, o)),
     )
     out = pl.pallas_call(
-        functools.partial(_q80_kernel, acc_dtype=jnp.float32, stacked=True),
+        functools.partial(_q80_kernel, acc_dtype=jnp.float32, stacked=True,
+                          fuse_norm=fused),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((T, O), jnp.float32),
         compiler_params=compat.tpu_compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary")
         ),
         interpret=interpret,
-    )(jnp.asarray(layer, jnp.int32).reshape(1), xp, w, scales)
+    )(jnp.asarray(layer, jnp.int32).reshape(1), *operands)
     return out[:t]
 
 
@@ -258,20 +358,35 @@ def q80_matmul_stacked(x: jnp.ndarray, w: jnp.ndarray, scales: jnp.ndarray,
 # Q40: packed nibbles, two scale planes (even/odd 32-blocks)
 # ---------------------------------------------------------------------------
 
-def _q40_kernel(*refs, acc_dtype, stacked=False, nosub=False):
+def _q40_kernel(*refs, acc_dtype, stacked=False, nosub=False, fuse_norm=False):
     from jax.experimental import pallas as pl
 
     if stacked:  # scalar-prefetch layout: leading layer axis, idx_ref first
-        _idx_ref, xlo_ref, xhi_ref, w_ref, slo_ref, shi_ref, o_ref = refs
+        refs = refs[1:]
+        xlo_ref, xhi_ref, w_ref, slo_ref, shi_ref, *refs = refs
         pk8, slo, shi = w_ref[0], slo_ref[0], shi_ref[0]
     else:
-        xlo_ref, xhi_ref, w_ref, slo_ref, shi_ref, o_ref = refs
+        xlo_ref, xhi_ref, w_ref, slo_ref, shi_ref, *refs = refs
         pk8, slo, shi = w_ref[...], slo_ref[...], shi_ref[...]
+    if fuse_norm:  # rmsnorm epilogue: [bt, 1] inv + split norm-weight planes
+        inv_ref, nwlo_ref, nwhi_ref, o_ref = refs
+    else:
+        (o_ref,) = refs
 
     @pl.when(pl.program_id(2) == 0)  # grid (t, o, k): init at each k sweep
     def _init():
         o_ref[...] = jnp.zeros_like(o_ref)
 
+    if fuse_norm:  # same f32 order as ops.norms.rmsnorm -> bit-identical
+        inv = inv_ref[...]
+        nwlo = nwlo_ref[0] if stacked else nwlo_ref[...]  # drop layer axis
+        nwhi = nwhi_ref[0] if stacked else nwhi_ref[...]
+        xlo = (nwlo * (xlo_ref[...].astype(jnp.float32) * inv)
+               ).astype(jnp.bfloat16)
+        xhi = (nwhi * (xhi_ref[...].astype(jnp.float32) * inv)
+               ).astype(jnp.bfloat16)
+    else:
+        xlo, xhi = xlo_ref[...], xhi_ref[...]
     pk = pk8.astype(jnp.int32)  # [bk/2, bo]
     hk, bo = pk.shape
     # nosub drops the nibble recentering (the binding VPU op); the caller
@@ -290,8 +405,8 @@ def _q40_kernel(*refs, acc_dtype, stacked=False, nosub=False):
     )
     w_lo = (lo * s_lo).astype(jnp.bfloat16)
     w_hi = (hi * s_hi).astype(jnp.bfloat16)
-    o_ref[...] += jnp.dot(xlo_ref[...], w_lo, preferred_element_type=acc_dtype)
-    o_ref[...] += jnp.dot(xhi_ref[...], w_hi, preferred_element_type=acc_dtype)
+    o_ref[...] += jnp.dot(xlo, w_lo, preferred_element_type=acc_dtype)
+    o_ref[...] += jnp.dot(xhi, w_hi, preferred_element_type=acc_dtype)
 
 
 def _q40_corr_kernel(*refs):
@@ -371,11 +486,38 @@ def _q40_correction(xp, s_lo, s_hi, layer=None, interpret=False):
     )(jnp.asarray(layer, jnp.int32).reshape(1), xs_lo, xs_hi, s_lo, s_hi)
 
 
+def _q40_split(xp: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """[T, K] -> the lo/hi 32-row halves of each 64-row superblock (the
+    packed-nibble pairing), each [T, K/2] — a pure reshape."""
+    T, K = xp.shape
+    xr = xp.reshape(T, K // 64, 64)
+    return xr[:, :, :QK].reshape(T, K // 2), xr[:, :, QK:].reshape(T, K // 2)
+
+
+def _q40_normed(xp, norm_w, norm_inv, layer=None):
+    """The normalized padded activation the fused q40 kernel computes in its
+    tiles, materialized OUTSIDE for the nosub correction's block sums only
+    (an elementwise+reduce XLA fuses; [T, K/64] output, no [T, K] HBM
+    round-trip). Must match the in-kernel epilogue bit-for-bit."""
+    nw = norm_w[layer] if (layer is not None and norm_w.ndim == 2) else norm_w
+    nw = nw.astype(jnp.float32)
+    if nw.shape[-1] != xp.shape[-1]:
+        nw = jnp.pad(nw, (0, xp.shape[-1] - nw.shape[-1]))
+    inv_p, _ = _pad_rows(norm_inv)
+    return (nw * (xp.astype(jnp.float32) * inv_p)).astype(jnp.bfloat16)
+
+
 @functools.partial(jax.jit, static_argnames=("interpret", "nosub"))
 def q40_matmul(x: jnp.ndarray, packed: jnp.ndarray, s_lo: jnp.ndarray,
                s_hi: jnp.ndarray, interpret: bool | None = None,
-               nosub: bool | None = None) -> jnp.ndarray:
-    """``x [T, K] @ dequant(packed uint8 [K/2, O]) -> [T, O]`` f32."""
+               nosub: bool | None = None,
+               norm_w: jnp.ndarray | None = None,
+               norm_inv: jnp.ndarray | None = None) -> jnp.ndarray:
+    """``x [T, K] @ dequant(packed uint8 [K/2, O]) -> [T, O]`` f32.
+
+    ``norm_w``/``norm_inv``: fused rmsnorm epilogue (see ``q80_matmul``) —
+    the norm weight rides split into the same lo/hi half-superblock planes
+    as the activations."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -383,35 +525,47 @@ def q40_matmul(x: jnp.ndarray, packed: jnp.ndarray, s_lo: jnp.ndarray,
         interpret = _interpret_default()
     if nosub is None:
         nosub = Q40_NOSUB
+    fused = norm_w is not None
     O = packed.shape[1]
     K = packed.shape[0] * 2  # the *packed* (padded) input dim
-    xp, t = _pad_rows(_pad_cols(x.astype(jnp.bfloat16), K))
+    xp, t = _pad_rows(_pad_cols(x if fused else x.astype(jnp.bfloat16), K))
     T = xp.shape[0]
     # split activations into the lo/hi 32-row halves of each 64-row superblock
-    xr = xp.reshape(T, K // 64, 64)
-    x_lo = xr[:, :, :QK].reshape(T, K // 2)
-    x_hi = xr[:, :, QK:].reshape(T, K // 2)
+    x_lo, x_hi = _q40_split(xp)
     bk, bo = tile_plan("q40", K, O)
     bt = min(T, T_BLOCK)
+    in_specs = [
+        pl.BlockSpec((bt, bk // 2), lambda t_, o, k: (t_, k)),
+        pl.BlockSpec((bt, bk // 2), lambda t_, o, k: (t_, k)),
+        pl.BlockSpec((bk // 2, bo), lambda t_, o, k: (k, o)),
+        pl.BlockSpec((bk // 64, bo), lambda t_, o, k: (k, o)),
+        pl.BlockSpec((bk // 64, bo), lambda t_, o, k: (k, o)),
+    ]
+    operands = [x_lo, x_hi, packed, s_lo, s_hi]
+    if fused:
+        nw, inv_p = _norm_operands(norm_w, norm_inv, K)
+        nw_lo, nw_hi = _q40_split(nw)
+        in_specs += [
+            pl.BlockSpec((bt, 1), lambda t_, o, k: (t_, 0)),  # dllama: allow[PALLAS-001] reason=whole-array lane dim (proven: tests/test_lowering.py sweep)
+            pl.BlockSpec((1, bk // 2), lambda t_, o, k: (0, k)),  # dllama: allow[PALLAS-001] reason=whole-array sublane dim (proven: tests/test_lowering.py sweep)
+            pl.BlockSpec((1, bk // 2), lambda t_, o, k: (0, k)),  # dllama: allow[PALLAS-001] reason=whole-array sublane dim (proven: tests/test_lowering.py sweep)
+        ]
+        operands += [inv_p, nw_lo, nw_hi]
     out = pl.pallas_call(
-        functools.partial(_q40_kernel, acc_dtype=jnp.float32, nosub=nosub),
+        functools.partial(_q40_kernel, acc_dtype=jnp.float32, nosub=nosub,
+                          fuse_norm=fused),
         grid=(pl.cdiv(T, bt), pl.cdiv(O, bo), K // bk),
-        in_specs=[
-            pl.BlockSpec((bt, bk // 2), lambda t_, o, k: (t_, k)),
-            pl.BlockSpec((bt, bk // 2), lambda t_, o, k: (t_, k)),
-            pl.BlockSpec((bk // 2, bo), lambda t_, o, k: (k, o)),
-            pl.BlockSpec((bk // 64, bo), lambda t_, o, k: (k, o)),
-            pl.BlockSpec((bk // 64, bo), lambda t_, o, k: (k, o)),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((bt, bo), lambda t_, o, k: (t_, o)),
         out_shape=jax.ShapeDtypeStruct((T, O), jnp.float32),
         compiler_params=compat.tpu_compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary")
         ),
         interpret=interpret,
-    )(x_lo, x_hi, packed, s_lo, s_hi)
+    )(*operands)
     if nosub:
-        out = out - _q40_correction(xp, s_lo, s_hi, interpret=interpret)
+        xn = _q40_normed(xp, norm_w, norm_inv) if fused else xp
+        out = out - _q40_correction(xn, s_lo, s_hi, interpret=interpret)
     return out[:t]
 
 
@@ -419,10 +573,13 @@ def q40_matmul(x: jnp.ndarray, packed: jnp.ndarray, s_lo: jnp.ndarray,
 def q40_matmul_stacked(x: jnp.ndarray, packed: jnp.ndarray, s_lo: jnp.ndarray,
                        s_hi: jnp.ndarray, layer: jnp.ndarray,
                        interpret: bool | None = None,
-                       nosub: bool | None = None) -> jnp.ndarray:
+                       nosub: bool | None = None,
+                       norm_w: jnp.ndarray | None = None,
+                       norm_inv: jnp.ndarray | None = None) -> jnp.ndarray:
     """Layer-indexed q40 matmul over STACKED planes ``packed uint8 [L, K/2,
     O]`` with a traced ``layer`` — see ``q80_matmul_stacked`` for why the
-    layer selection must happen inside the kernel's index_map."""
+    layer selection must happen inside the kernel's index_map. ``norm_w``
+    ([L, K] stacked) / ``norm_inv``: fused rmsnorm epilogue."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -430,39 +587,55 @@ def q40_matmul_stacked(x: jnp.ndarray, packed: jnp.ndarray, s_lo: jnp.ndarray,
         interpret = _interpret_default()
     if nosub is None:
         nosub = Q40_NOSUB
+    fused = norm_w is not None
     O = packed.shape[2]
     K = packed.shape[1] * 2
-    xp, t = _pad_rows(_pad_cols(x.astype(jnp.bfloat16), K))
+    xp, t = _pad_rows(_pad_cols(x if fused else x.astype(jnp.bfloat16), K))
     T = xp.shape[0]
-    xr = xp.reshape(T, K // 64, 64)
-    x_lo = xr[:, :, :QK].reshape(T, K // 2)
-    x_hi = xr[:, :, QK:].reshape(T, K // 2)
+    x_lo, x_hi = _q40_split(xp)
     bk, bo = tile_plan("q40", K, O)
     bt = min(T, T_BLOCK)
+    in_specs = [
+        pl.BlockSpec((bt, bk // 2), lambda t_, o, k, idx: (t_, k)),
+        pl.BlockSpec((bt, bk // 2), lambda t_, o, k, idx: (t_, k)),
+        pl.BlockSpec((1, bk // 2, bo), lambda t_, o, k, idx: (idx[0], k, o)),
+        pl.BlockSpec((1, bk // 64, bo), lambda t_, o, k, idx: (idx[0], k, o)),
+        pl.BlockSpec((1, bk // 64, bo), lambda t_, o, k, idx: (idx[0], k, o)),
+    ]
+    operands = [x_lo, x_hi, packed, s_lo, s_hi]
+    if fused:  # norm weight [L, K] stacked | flat [K] -> split lo/hi planes
+        lsel = _norm_layer_map(norm_w)
+        nw, inv_p = _norm_operands(
+            norm_w if norm_w.ndim == 2 else norm_w[None], norm_inv, K)
+        L = nw.shape[0]
+        nw_lo, nw_hi = _q40_split(nw.reshape(L, K))
+        nw_lo, nw_hi = nw_lo[:, None, :], nw_hi[:, None, :]  # [L, 1, K/2]
+        in_specs += [
+            pl.BlockSpec((bt, 1), lambda t_, o, k, idx: (t_, 0)),  # dllama: allow[PALLAS-001] reason=whole-array lane dim (proven: tests/test_lowering.py sweep)
+            pl.BlockSpec((1, 1, bk // 2), lambda t_, o, k, idx: (lsel(idx), 0, k)),  # dllama: allow[PALLAS-001] reason=whole-array sublane dim (proven: tests/test_lowering.py sweep)
+            pl.BlockSpec((1, 1, bk // 2), lambda t_, o, k, idx: (lsel(idx), 0, k)),  # dllama: allow[PALLAS-001] reason=whole-array sublane dim (proven: tests/test_lowering.py sweep)
+        ]
+        operands += [inv_p, nw_lo, nw_hi]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(pl.cdiv(T, bt), pl.cdiv(O, bo), K // bk),
-        in_specs=[
-            pl.BlockSpec((bt, bk // 2), lambda t_, o, k, idx: (t_, k)),
-            pl.BlockSpec((bt, bk // 2), lambda t_, o, k, idx: (t_, k)),
-            pl.BlockSpec((1, bk // 2, bo), lambda t_, o, k, idx: (idx[0], k, o)),
-            pl.BlockSpec((1, bk // 64, bo), lambda t_, o, k, idx: (idx[0], k, o)),
-            pl.BlockSpec((1, bk // 64, bo), lambda t_, o, k, idx: (idx[0], k, o)),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((bt, bo), lambda t_, o, k, idx: (t_, o)),
     )
     out = pl.pallas_call(
         functools.partial(_q40_kernel, acc_dtype=jnp.float32, stacked=True,
-                          nosub=nosub),
+                          nosub=nosub, fuse_norm=fused),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((T, O), jnp.float32),
         compiler_params=compat.tpu_compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary")
         ),
         interpret=interpret,
-    )(jnp.asarray(layer, jnp.int32).reshape(1), x_lo, x_hi, packed, s_lo, s_hi)
+    )(jnp.asarray(layer, jnp.int32).reshape(1), *operands)
     if nosub:
-        out = out - _q40_correction(xp, s_lo, s_hi, layer=layer,
+        xn = (_q40_normed(xp, norm_w, norm_inv, layer=layer) if fused
+              else xp)
+        out = out - _q40_correction(xn, s_lo, s_hi, layer=layer,
                                     interpret=interpret)
     return out[:t]
 
@@ -522,6 +695,34 @@ def qmatmul(x: jnp.ndarray, qt: QuantTensor, layer=None) -> jnp.ndarray:
             out = q80_matmul(x, qt.w, qt.s)
         else:
             out = q80_matmul_stacked(x, qt.w, qt.s, layer)
+    else:
+        raise ValueError(f"unknown QuantTensor kind {qt.kind!r}")
+    return out.astype(x.dtype)
+
+
+def qmatmul_norm(x: jnp.ndarray, norm_w: jnp.ndarray, qt: QuantTensor,
+                 layer=None, eps: float = 1e-5) -> jnp.ndarray:
+    """``rmsnorm(x, norm_w) @ dequant(qt)`` with the norm fused into the
+    matmul kernel as an x-block epilogue (DLLAMA_FUSE_NORM): the raw
+    activation streams into VMEM once and the normalized bf16 tile is
+    produced in-register, eliminating the separate rmsnorm HBM round trip.
+    Bit-identical to the unfused composition — same f32 op order, same final
+    bf16 cast (tests/test_fused_ops.py). ``norm_w`` is ``[K]`` flat or
+    ``[L, K]`` when ``layer`` selects a layer of a stacked QuantTensor."""
+    inv = rmsnorm_inv(x, eps)
+    if qt.kind == "q40":
+        if layer is None:
+            out = q40_matmul(x, qt.w, qt.s, qt.s2, norm_w=norm_w,
+                             norm_inv=inv)
+        else:
+            out = q40_matmul_stacked(x, qt.w, qt.s, qt.s2, layer,
+                                     norm_w=norm_w, norm_inv=inv)
+    elif qt.kind == "q80":
+        if layer is None:
+            out = q80_matmul(x, qt.w, qt.s, norm_w=norm_w, norm_inv=inv)
+        else:
+            out = q80_matmul_stacked(x, qt.w, qt.s, layer, norm_w=norm_w,
+                                     norm_inv=inv)
     else:
         raise ValueError(f"unknown QuantTensor kind {qt.kind!r}")
     return out.astype(x.dtype)

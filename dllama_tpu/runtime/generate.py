@@ -223,6 +223,24 @@ class Engine:
         self.tp_overlap_active = False
         self.tp_overlap_reason = ("not requested" if not tp_overlap
                                   else "no mesh (single device)")
+        #: decode kernel-fusion resolution, machine-visible like the TP
+        #: wire above: what each DLLAMA_* fusion flag resolved to on THIS
+        #: engine (served on /stats), so a flag that silently declined —
+        #: dense weights, dense-pjit TP — shows up without log scraping
+        from dllama_tpu.ops import flash_decode as _flash
+        from dllama_tpu.ops import fused_rope_cache as _frc
+        from dllama_tpu.ops import qmatmul as _qm
+        from dllama_tpu.parallel.quant_tp import has_quant_leaves as _hql
+
+        self.kernel_fusions = {
+            "flash_decode": "on" if _flash.flash_enabled() else "off",
+            "fuse_norm": (
+                "off" if not _qm.norm_fusion_enabled()
+                else "on" if _hql(params)
+                else "requested (dense weights: no quant projection "
+                     "epilogue to fuse into)"),
+            "fuse_rope_cache": "on" if _frc.fuse_enabled() else "off",
+        }
         # fused-loop chunk: one host round trip per chunk of tokens. Bigger
         # chunks amortize dispatch/sync latency (dominant on tunneled or
         # remote-PJRT setups) at the cost of coarser streaming granularity.
@@ -341,6 +359,9 @@ class Engine:
                           "under pjit); dense attention used — quantized "
                           "weights take flash under TP via shard_map",
                           file=_sys.stderr, flush=True)
+                    self.kernel_fusions["flash_decode"] = (
+                        "requested (dense-pjit TP: Pallas calls don't "
+                        "partition under pjit)")
 
                 def fwd(cfg_, params_, rope_, tokens_, cache_, pos_):
                     return llama.forward(cfg_, params_, rope_, tokens_,

@@ -1550,6 +1550,10 @@ class ServerState:
                                            False) else "off"),
             "tp_overlap_reason": getattr(self.engine, "tp_overlap_reason",
                                          "not requested"),
+            # decode kernel-fusion resolution (flash / fused norm / fused
+            # rope+cache): the env flags resolved against what this
+            # engine's weights and TP path can actually engage
+            "kernel_fusions": getattr(self.engine, "kernel_fusions", {}),
             # per-SLO-class lane picture: gate in-flight depth + the
             # scheduler's waiting/resident/preempted counts. The router's
             # class-aware scoring penalizes a replica by ITS lane's

@@ -19,6 +19,16 @@ sizes (T rows x F features, gathered across all visible devices). Same
 difference-timing idiom, so the tunnel round trip cancels.
 
 Usage: python scripts/kernel_bench.py gather [F] [T] [iters]
+
+``fused`` mode times the two decode epilogue fusions against their unfused
+compositions at decode activation sizes — rmsnorm folded into the q40/q80
+projection (DLLAMA_FUSE_NORM's kernel) vs rmsnorm-then-qmatmul, and the
+one-pass rope+cache write (DLLAMA_FUSE_ROPE_CACHE's kernel) vs
+apply_rope + dynamic_update_slice. Same difference-timing idiom; each pair
+appends a delta row (fused_ms, unfused_ms, delta_ms) to
+results/trajectory.jsonl so the win is tracked across rounds, not eyeballed.
+
+Usage: python scripts/kernel_bench.py fused [K] [O] [iters] [T]
 """
 
 import functools
@@ -146,6 +156,95 @@ def bench_gather(F=4096, T=1, iters=256):
     return results
 
 
+def _timed_scan(step_fn, carry, iters):
+    """Difference-timed ms/call for ``step_fn`` chained through one jitted
+    scan — same tunnel-cancelling idiom as bench()."""
+    @functools.partial(jax.jit, static_argnames=("n",))
+    def run(c, n):
+        c, _ = jax.lax.scan(lambda c, _: (step_fn(c), ()), c, None, length=n)
+        return jnp.sum(jax.tree.leaves(c)[0].astype(jnp.float32))
+
+    t1 = _timed_host_sync(functools.partial(run, n=iters), carry)
+    t2 = _timed_host_sync(functools.partial(run, n=2 * iters), carry)
+    return max(t2 - t1, 1e-9) * 1e3 / iters
+
+
+def bench_fused(kind="q40", K=4096, O=4096, iters=256, T=1):
+    """Fused-vs-unfused delta for both decode epilogues; one trajectory
+    row per pair. delta_ms = fused - unfused, so negative is a win and
+    the trajectory comparator's "_ms means lower-is-better" rule flags a
+    fusion that stops paying for itself."""
+    from dllama_tpu.obsv import trajectory
+    from dllama_tpu.ops import fused_rope_cache, rope
+    from dllama_tpu.ops.norms import rmsnorm
+
+    rng = np.random.default_rng(0)
+    rows = {}
+
+    # -- rmsnorm folded into the quantized projection -----------------------
+    qt = qmatmul.quantize_tensor(
+        rng.standard_normal((K, O)).astype(np.float32) * 0.1, kind)
+    nw = jnp.asarray(rng.standard_normal((K,)).astype(np.float32) * 0.5 + 1.0)
+    x = jnp.asarray(rng.standard_normal((T, K)).astype(np.float32)
+                    ).astype(jnp.bfloat16)
+
+    def _chain(y):  # feed output back as the next activation (no CSE)
+        y = y[:, :K] if O >= K else jnp.pad(y, ((0, 0), (0, K - O)))
+        return (y * 1e-2).astype(jnp.bfloat16)
+
+    norm_ms = {
+        "unfused": _timed_scan(
+            lambda c: _chain(qmatmul.qmatmul(rmsnorm(c, nw, 1e-5), qt)),
+            x, iters),
+        "fused": _timed_scan(
+            lambda c: _chain(qmatmul.qmatmul_norm(c, nw, qt)), x, iters),
+    }
+    rows[f"norm_{kind}"] = norm_ms
+
+    # -- rope + cache write -------------------------------------------------
+    L, S, n_kv, hd = 1, 2048, 8, 128
+    k0 = jnp.asarray(rng.standard_normal((T, n_kv, hd)).astype(np.float32)
+                     ).astype(jnp.bfloat16)
+    kc0 = jnp.zeros((L, S, n_kv, hd), jnp.bfloat16)
+    cos_t, sin_t = map(jnp.asarray, rope.rope_table(S, hd, 10000.0))
+    pos, layer = jnp.int32(S // 2), jnp.int32(0)
+    cos = jax.lax.dynamic_slice_in_dim(cos_t, pos, T)[:, None, :]
+    sin = jax.lax.dynamic_slice_in_dim(sin_t, pos, T)[:, None, :]
+
+    def rope_unfused(c):
+        kc, vc = c
+        kr = rope.apply_rope(k0, cos, sin, rope.INTERLEAVED)
+        z = jnp.int32(0)
+        kc = jax.lax.dynamic_update_slice(kc, kr.astype(kc.dtype)[None],
+                                          (layer, pos, z, z))
+        vc = jax.lax.dynamic_update_slice(vc, k0.astype(vc.dtype)[None],
+                                          (layer, pos, z, z))
+        return kc, vc
+
+    def rope_fused(c):
+        return fused_rope_cache.rope_cache_update(
+            k0, k0, cos, sin, c[0], c[1], pos, layer, rope.INTERLEAVED)
+
+    rope_ms = {
+        "unfused": _timed_scan(rope_unfused, (kc0, kc0), iters),
+        "fused": _timed_scan(rope_fused, (kc0, kc0), iters),
+    }
+    rows["rope_cache"] = rope_ms
+
+    for name, ms in rows.items():
+        delta = ms["fused"] - ms["unfused"]
+        print(f"fused {name:10s} K={K} O={O} T={T}: "
+              f"fused {ms['fused']:7.4f} ms  unfused {ms['unfused']:7.4f} ms"
+              f"  delta {delta:+.4f} ms/call", flush=True)
+        trajectory.append_row(
+            f"kernel_fused/{name}", "ok",
+            result={"metric": f"{name}_delta_ms", "value": delta,
+                    "fused_ms": ms["fused"], "unfused_ms": ms["unfused"],
+                    "K": K, "O": O, "T": T,
+                    "backend": jax.default_backend()})
+    return rows
+
+
 if __name__ == "__main__":
     kind = sys.argv[1] if len(sys.argv) > 1 else "all"
     if kind == "gather":
@@ -153,6 +252,14 @@ if __name__ == "__main__":
         T = int(sys.argv[3]) if len(sys.argv) > 3 else 1
         iters = int(sys.argv[4]) if len(sys.argv) > 4 else 256
         bench_gather(F, T, iters)
+        sys.exit(0)
+    if kind == "fused":
+        K = int(sys.argv[2]) if len(sys.argv) > 2 else 4096
+        O = int(sys.argv[3]) if len(sys.argv) > 3 else 4096
+        iters = int(sys.argv[4]) if len(sys.argv) > 4 else 64
+        T = int(sys.argv[5]) if len(sys.argv) > 5 else 1
+        for k in ("q40", "q80"):
+            bench_fused(k, K, O, iters, T)
         sys.exit(0)
     K = int(sys.argv[2]) if len(sys.argv) > 2 else 4096
     O = int(sys.argv[3]) if len(sys.argv) > 3 else 11008
