@@ -143,6 +143,36 @@ def build_parser() -> argparse.ArgumentParser:
         help="per-class SLO lane config passed to every replica's serve "
         "argv (see `serve --slo-classes`); a --replica-arg "
         "'--slo-classes ...' overrides")
+    # -- elastic fleet: the closed autoscale loop (serving/autoscale.py
+    #    decides, serving/fleet.py's ElasticSupervisor executes) --
+    fp.add_argument(
+        "--autoscale", action="store_true",
+        help="close the loop: evaluate the SLO/pressure policy every "
+        "--scale-interval and scale the replica set live between "
+        "--min-replicas and --max-replicas (default: the fleet stays at "
+        "--replicas forever)")
+    fp.add_argument("--min-replicas", type=int, default=1, metavar="N",
+                    help="autoscale floor (never drain below this)")
+    fp.add_argument("--max-replicas", type=int, default=0, metavar="N",
+                    help="autoscale ceiling (0: use --replicas)")
+    fp.add_argument("--scale-interval", type=float, default=1.0,
+                    metavar="S", help="seconds between policy evaluations")
+    fp.add_argument("--scale-up-pressure", type=float, default=0.75,
+                    metavar="P", help="fleet pressure at/above which an "
+                    "observation counts toward scale-up")
+    fp.add_argument("--scale-down-pressure", type=float, default=0.25,
+                    metavar="P", help="fleet pressure at/below which a "
+                    "quiet observation counts toward scale-down")
+    fp.add_argument("--scale-cooldown-up", type=float, default=5.0,
+                    metavar="S", help="min seconds between a scale event "
+                    "and the next scale-up")
+    fp.add_argument("--scale-cooldown-down", type=float, default=20.0,
+                    metavar="S", help="min seconds between a scale event "
+                    "and the next scale-down")
+    fp.add_argument("--prewarm-tokens", type=int, default=16, metavar="N",
+                    help="decode budget of each pre-warm prefill replayed "
+                    "into a joining replica (must exceed the batch chunk "
+                    "or the row finishes before it exports KV pages)")
     add_router_flags(fp, default_port=9900)
 
     # live fleet terminal view: polls the router's /stats + /metrics/fleet
@@ -982,8 +1012,10 @@ def run_top(args) -> int:
 
     from dllama_tpu.serving.protocol import (MET_CLASS_QUEUE_DEPTH,
                                              MET_CLASS_RESIDENT_ROWS,
+                                             MET_FLEET_REPLICAS,
                                              MET_HTTP_REQUESTS,
                                              MET_KV_TRANSFER_BYTES,
+                                             MET_SCALE_EVENTS,
                                              MET_TPOT_MS, MET_TTFT_MS)
 
     host, _, port_s = args.router.rpartition(":")
@@ -1018,6 +1050,37 @@ def run_top(args) -> int:
                     f"replicas {load.get('replicas_ready', '?')}/"
                     f"{load.get('replicas_total', '?')} ready  "
                     f"affinity {stats.get('affinity_entries', 0)}")
+                # elastic fleet row: registered size + scale-event
+                # counters, rendered only when the router exposes the
+                # families (pre-elastic routers just omit the row); every
+                # value parse is guarded — a torn /stats body mid-scale
+                # must degrade a cell, never kill the dashboard loop
+                mets = stats.get("metrics") or {}
+
+                def fam_values(fam):
+                    return (mets.get(fam) or {}).get("values") or []
+
+                size_vals = fam_values(MET_FLEET_REPLICAS)
+                if size_vals:
+                    try:
+                        size = f"{float(size_vals[0].get('value')):.0f}"
+                    except (TypeError, ValueError):
+                        size = "?"
+                    events = {}
+                    for v in fam_values(MET_SCALE_EVENTS):
+                        ev = (v.get("labels") or {}).get("event")
+                        try:
+                            events[ev] = int(float(v.get("value")))
+                        except (TypeError, ValueError):
+                            continue  # torn stats value: drop this cell
+                    marks = "  ".join(
+                        f"{ev} {events[ev]}"
+                        for ev in ("joined", "draining", "retired",
+                                   "spawn_failed", "prewarm_fallback",
+                                   "drain_killed", "injected")
+                        if events.get(ev))
+                    lines.append(f"elastic: {size} registered  "
+                                 + (marks or "no scale events yet"))
                 lines.append("")
                 lines.append(
                     f"{'replica':<22}{'role':<9}{'state':<10}{'infl':>5}"
@@ -1029,6 +1092,12 @@ def run_top(args) -> int:
                     name = snap.get("name", "?")
                     state = ("circuit" if snap.get("circuit_open")
                              else "ready" if snap.get("ready") else "down")
+                    # a mid-transition lifecycle outranks the probe
+                    # verdict in the column: joining/draining is WHY the
+                    # replica isn't taking normal traffic
+                    lc = snap.get("state")
+                    if lc and lc != "active":
+                        state = lc
                     rload = snap.get("load") or {}
                     age = snap.get("probed_age_s")
 
@@ -1105,6 +1174,20 @@ def run_top(args) -> int:
                     hist = json_mod.loads(hist_body)
                     spark_key = f"{MET_TTFT_MS}:p95"
                     rows = []
+                    # fleet-size trajectory from the router's OWN series
+                    # (the registered-replica gauge is router state, so it
+                    # lives under "router", not any replica)
+                    rseries = ((hist.get("router") or {}).get("series")
+                               or {})
+                    fpts = rseries.get(MET_FLEET_REPLICAS)
+                    if fpts:
+                        try:
+                            rows.append(
+                                f"  {'fleet size':<22}replicas "
+                                f"{_spark([p[1] for p in fpts])} "
+                                f"{float(fpts[-1][1]):.0f}")
+                        except (TypeError, ValueError, IndexError):
+                            pass  # torn history payload: drop the row
                     for rname, pay in sorted(
                             (hist.get("replicas") or {}).items()):
                         pts = (pay.get("series") or {}).get(spark_key)

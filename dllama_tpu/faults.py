@@ -53,7 +53,14 @@ Spec grammar (sites separated by ``;``)::
   registry — a faulted pass is skipped and counted, the history ring
   just misses one point and the sampler thread lives) and ``alert_eval``
   (every SLO burn-rate evaluation pass — a faulted evaluation keeps the
-  previous alert states and is counted, never a dead alert engine).
+  previous alert states and is counted, never a dead alert engine). The
+  elastic-fleet seams are ``policy_eval`` (every autoscaler policy tick —
+  a faulted tick is one skipped evaluation, counted, and the supervisor
+  loop lives), ``scale_up`` (every replica-add transition — a faulted
+  spawn is rolled back and counted, the fleet stays at its old size) and
+  ``scale_down`` (every replica-retire transition — a faulted drain
+  escalates along the same SIGKILL + mid-stream-failover ladder as a
+  real drain timeout, never a client-visible error).
 * ``action`` — ``raise`` (throw :class:`FaultInjected`), ``slow`` (sleep
   ``delay_ms``, default 50), or a *data* action the seam itself interprets:
   ``truncate`` (weights_open: pretend the file is ``drop`` bytes short,
@@ -83,7 +90,8 @@ SITES = ("admit", "step_chunk", "prefill", "prefill_chunk", "prefix_match",
          "logits", "route_pick", "proxy_upstream", "probe",
          "federate_scrape", "flight_dump", "overlap_split",
          "kv_export", "kv_import", "migrate", "ckpt_write", "resume",
-         "preempt", "ts_sample", "alert_eval")
+         "preempt", "ts_sample", "alert_eval", "policy_eval", "scale_up",
+         "scale_down")
 ACTIONS = ("raise", "slow", "truncate", "bitflip", "nan")
 
 #: site -> the metric family that proves the site's failure is VISIBLE on
@@ -139,6 +147,15 @@ SITE_METRICS = {
     # the watchers are themselves fault-drilled
     "ts_sample": "dllama_ts_samples_total",
     "alert_eval": "dllama_alerts_total",
+    # elastic-fleet seams (serving/fleet.py supervisor): a faulted policy
+    # evaluation skips one autoscaler tick (decision="injected") and the
+    # loop lives; a faulted scale-up/scale-down degrades along the
+    # documented ladder (spawn fails -> retired, pre-warm fails -> cold
+    # join, drain timeout -> SIGKILL + stream failover) and every rung is
+    # an ``event=...`` row on the scale-events counter
+    "policy_eval": "dllama_fleet_policy_evals_total",
+    "scale_up": "dllama_fleet_scale_events_total",
+    "scale_down": "dllama_fleet_scale_events_total",
 }
 
 

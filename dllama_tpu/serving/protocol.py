@@ -122,6 +122,10 @@ MET_CLASS_RESIDENT_ROWS = "dllama_class_resident_rows"
 MET_TS_SAMPLES = "dllama_ts_samples_total"
 MET_ALERTS = "dllama_alerts_total"
 MET_FEDERATE_SKIPPED = "dllama_router_federate_skipped_total"
+MET_FLEET_REPLICAS = "dllama_fleet_replicas"
+MET_SCALE_EVENTS = "dllama_fleet_scale_events_total"
+MET_POLICY_EVALS = "dllama_fleet_policy_evals_total"
+MET_CKPT_EXPIRED = "dllama_router_ckpt_expired_total"
 
 #: Every family a cross-process consumer reads.  PROTO-004's cli.py pass
 #: checks this tuple stays registered AND that cli.py spells no family
@@ -138,4 +142,8 @@ WIRE_METRICS = (
     MET_TS_SAMPLES,
     MET_ALERTS,
     MET_FEDERATE_SKIPPED,
+    MET_FLEET_REPLICAS,
+    MET_SCALE_EVENTS,
+    MET_POLICY_EVALS,
+    MET_CKPT_EXPIRED,
 )

@@ -242,17 +242,38 @@ def saturated(snap: dict) -> bool:
             and load.get("queue_depth", 0) > 0)
 
 
+#: replica lifecycle states (elastic fleet): only ``active`` replicas are
+#: pick()-able. A scaled-up replica registers as ``joining`` (probed and
+#: pre-warmed, but taking no traffic) until the supervisor activates it;
+#: a retiring replica is marked ``draining`` (finishes its in-flight
+#: streams, takes no new picks and is never a resume target) and becomes
+#: ``gone`` when deregistered. Statically-configured replicas start
+#: ``active`` — the classic fixed fleet is the degenerate lifecycle.
+LIFECYCLE_JOINING = "joining"
+LIFECYCLE_ACTIVE = "active"
+LIFECYCLE_DRAINING = "draining"
+LIFECYCLE_GONE = "gone"
+LIFECYCLES = (LIFECYCLE_JOINING, LIFECYCLE_ACTIVE, LIFECYCLE_DRAINING,
+              LIFECYCLE_GONE)
+
+
 @guarded_by("_lock", "_ready", "_info", "_failures", "_circuit_until",
-            "_inflight", "_probed_at", "_clock_offset_us", "_replica_id")
+            "_inflight", "_probed_at", "_clock_offset_us", "_replica_id",
+            "_state")
 class Replica:
     """One upstream ``dllama-api`` process as the router sees it: the last
-    probe verdict + load snapshot, the passive circuit breaker, and the
-    router-side in-flight count. All mutable state lives behind ``_lock``;
-    readers take :meth:`snapshot` — no caller ever holds two replica locks,
-    so the lock graph stays acyclic by construction."""
+    probe verdict + load snapshot, the passive circuit breaker, the
+    router-side in-flight count, and the elastic-fleet lifecycle state.
+    All mutable state lives behind ``_lock``; readers take
+    :meth:`snapshot` — no caller ever holds two replica locks, so the
+    lock graph stays acyclic by construction."""
 
     def __init__(self, host: str, port: int, circuit_base_s: float = 0.25,
-                 circuit_max_s: float = 5.0):
+                 circuit_max_s: float = 5.0,
+                 lifecycle: str = LIFECYCLE_ACTIVE):
+        if lifecycle not in LIFECYCLES:
+            raise ValueError(f"unknown lifecycle {lifecycle!r} "
+                             f"(know {LIFECYCLES})")
         self.host = host
         self.port = port
         self.name = f"{host}:{port}"
@@ -272,6 +293,18 @@ class Replica:
         # and the replica's self-reported identity (restart detection)
         self._clock_offset_us = 0
         self._replica_id = None
+        self._state = lifecycle
+
+    def set_lifecycle(self, state: str) -> None:
+        if state not in LIFECYCLES:
+            raise ValueError(f"unknown lifecycle {state!r} "
+                             f"(know {LIFECYCLES})")
+        with self._lock:
+            self._state = state
+
+    def lifecycle(self) -> str:
+        with self._lock:
+            return self._state
 
     def mark_probe(self, ready: bool, info: dict | None,
                    offset_us: int | None = None):
@@ -350,6 +383,7 @@ class Replica:
         with self._lock:
             return {
                 "name": self.name,
+                "state": self._state,
                 # disaggregation role the replica declared on /ready:
                 # "prefill" replicas take new prompts and hand their KV to
                 # a "decode" replica at first token; "both" (the default,
@@ -416,10 +450,18 @@ class CheckpointStore:
     is the number of in-flight checkpointing streams. Capacity eviction
     drops the least-recently-touched stream, which degrades THAT stream's
     failover to the fallback matrix's ``no_ckpt`` row — a bounded store
-    costs coverage under pressure, never correctness or memory."""
+    costs coverage under pressure, never correctness or memory.
 
-    def __init__(self, capacity: int = 256):
+    An entry orphaned by ABNORMAL teardown (the relay thread died before
+    its ``finally`` pop — a killed router worker, an OS-level socket
+    reset during the pop path) has no stream left to resume; with
+    ``ttl_s`` > 0 the periodic :meth:`sweep` (the probe loop drives it)
+    reclaims such entries instead of letting them squat until LRU
+    pressure evicts a LIVE stream's checkpoint to make room."""
+
+    def __init__(self, capacity: int = 256, ttl_s: float = 0.0):
         self.capacity = max(1, int(capacity))
+        self.ttl_s = max(0.0, float(ttl_s))
         self._lock = threading.Lock()
         self._map: OrderedDict = OrderedDict()
 
@@ -446,6 +488,72 @@ class CheckpointStore:
     def pop(self, rid: str) -> None:
         with self._lock:
             self._map.pop(rid, None)
+
+    def sweep(self, now: float = None) -> int:
+        """Drop every entry older than ``ttl_s`` (0 disables); returns the
+        count reclaimed. A LIVE stream's entry is refreshed by every
+        checkpoint frame (put() restamps ``stored_at``), so only streams
+        that stopped checkpointing TTL out — and a stream that went that
+        long without a frame has nothing fresher to resume from anyway."""
+        if self.ttl_s <= 0:
+            return 0
+        if now is None:
+            now = time.monotonic()
+        cutoff = now - self.ttl_s
+        with self._lock:
+            dead = [rid for rid, e in self._map.items()
+                    if e["stored_at"] < cutoff]
+            for rid in dead:
+                del self._map[rid]
+        return len(dead)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._map)
+
+
+@guarded_by("_lock", "_map")
+class HotPrompts:
+    """Bounded LRU of recently-routed prompt bodies, keyed by first-block
+    affinity hash: the scale-up pre-warm source. A freshly spawned
+    replica replays the hottest of these through a warm sibling's
+    ``/v1/prefill`` -> its own ``/v1/kv/import`` before taking traffic,
+    so its radix cache holds the fleet's hot prefixes from minute zero.
+    Oversized bodies are skipped (pre-warm is for hot SHORT prefixes;
+    shipping a near-window prompt would serialize the join on one
+    transfer) and capacity eviction drops the least-recently-seen
+    conversation — a best-effort warmth hint, never request state."""
+
+    def __init__(self, capacity: int = 32, max_bytes: int = 16384):
+        self.capacity = max(1, int(capacity))
+        self.max_bytes = int(max_bytes)
+        self._lock = threading.Lock()
+        self._map: OrderedDict = OrderedDict()  # key -> (hits, body_json)
+
+    def record(self, hashes: list, req: dict) -> None:
+        try:
+            body = json.dumps(req, sort_keys=True)
+        except (TypeError, ValueError):
+            return
+        if len(body) > self.max_bytes:
+            return
+        key = (hashes[0] if hashes
+               else hashlib.sha256(body.encode()).hexdigest())
+        with self._lock:
+            hits, _ = self._map.get(key, (0, None))
+            self._map[key] = (hits + 1, body)
+            self._map.move_to_end(key)
+            while len(self._map) > self.capacity:
+                self._map.popitem(last=False)
+
+    def top(self, n: int) -> list:
+        """The ``n`` hottest recorded request bodies (dicts), most-hit
+        first, recency breaking ties (the LRU order is recency)."""
+        with self._lock:
+            items = list(self._map.values())
+        items.reverse()  # LRU order is oldest-first; stable sort then
+        items.sort(key=lambda hv: hv[0], reverse=True)  # keeps recent first
+        return [json.loads(body) for _, body in items[:n]]
 
     def __len__(self) -> int:
         with self._lock:
@@ -503,12 +611,15 @@ def merge_expositions(parts: list) -> str:
     return "\n".join(out) + "\n" if out else ""
 
 
+@guarded_by("_replicas_lock", "_replicas")
 class RouterState:
     """Config + fleet picture + metrics for one router process. The
-    replica list is immutable after construction (drain/death is a probe
-    verdict on a Replica, never a list edit), so readers iterate it
-    without a lock; all mutable state lives inside Replica/AffinityIndex/
-    MetricsRegistry, each behind its own lock."""
+    replica set is a dynamic registry (the elastic fleet registers and
+    deregisters replicas live): :attr:`replicas` snapshots it as a tuple
+    under ``_replicas_lock``, so readers still iterate a stable sequence
+    while :meth:`register_replica`/:meth:`deregister_replica` edit the
+    underlying list. Each Replica's mutable state (including its
+    joining/active/draining/gone lifecycle) lives behind its own lock."""
 
     def __init__(self, replicas: list, retry_budget: int = 2,
                  probe_interval_s: float = 1.0,
@@ -519,9 +630,11 @@ class RouterState:
                  kv_wire: str = "f32",
                  ckpt_interval: int = 32,
                  ckpt_capacity: int = 256,
+                 ckpt_ttl_s: float = 600.0,
                  metrics=None, enable_flight: bool = True,
                  ts_interval: float = 1.0):
-        self.replicas = tuple(replicas)
+        self._replicas_lock = threading.Lock()
+        self._replicas = list(replicas)
         self.retry_budget = retry_budget
         self.probe_interval_s = probe_interval_s
         self.connect_timeout_s = connect_timeout_s
@@ -538,8 +651,10 @@ class RouterState:
         # checkpoint each ckpt_interval emitted tokens (0 disables both
         # the checkpoint frames and the resume orchestration)
         self.ckpt_interval = max(0, int(ckpt_interval))
-        self.ckpt_store = CheckpointStore(ckpt_capacity)
+        self.ckpt_store = CheckpointStore(ckpt_capacity, ttl_s=ckpt_ttl_s)
         self.affinity = AffinityIndex(affinity_capacity)
+        # pre-warm source material for scaled-up replicas (see HotPrompts)
+        self.hot_prompts = HotPrompts()
         self.started_at = time.time()
         # a fresh registry per router (not the process default): in-process
         # tests run several routers side by side, and the router's series
@@ -620,13 +735,38 @@ class RouterState:
             "Live checkpoints in the router's bounded resume store (one "
             "per in-flight checkpointing stream; popped at stream end)")
         self._m_ckpt_entries.set_function(self.ckpt_store.__len__)
+        self._m_ckpt_expired = reg.counter(
+            "dllama_router_ckpt_expired_total",
+            "Checkpoint-store entries reclaimed by the TTL sweep (orphaned "
+            "by abnormal stream teardown — no relay was left to pop them); "
+            "LRU capacity eviction is NOT counted here")
+        self._m_fleet_replicas = reg.gauge(
+            "dllama_fleet_replicas",
+            "Replicas currently registered with the router (every "
+            "lifecycle state but gone: joining and draining replicas are "
+            "paid-for capacity even while they take no new picks)")
+        self._m_fleet_replicas.set_function(self._count_registered)
+        self._m_scale_events = reg.counter(
+            "dllama_fleet_scale_events_total",
+            "Elastic-fleet scale transitions, by event (joined/draining/"
+            "retired are the normal lifecycle edges; spawn_failed/"
+            "prewarm_fallback/drain_killed/injected count the degraded "
+            "paths — every failure mode is a row here, never a silent "
+            "retry loop)",
+            ("event",))
+        self._m_policy_evals = reg.counter(
+            "dllama_fleet_policy_evals_total",
+            "Autoscaler policy-engine evaluations, by decision (up/down/"
+            "hold, or injected when the policy_eval fault seam fired and "
+            "the tick was skipped)",
+            ("decision",))
         self._m_probe_age = reg.gauge(
             "dllama_router_probe_age_seconds",
             "Seconds since each replica's last completed /ready probe "
             "(absent until one completes); pick() stops trusting a load "
             "snapshot older than twice the probe interval",
             ("replica",))
-        for r in self.replicas:
+        for r in self._replicas:
             self._m_probe_age.set_function(r.probe_age_s, replica=r.name)
         # the router's own flight recorder — like its registry, never the
         # process default: in-process fleet tests run replicas beside it
@@ -640,6 +780,82 @@ class RouterState:
         self.sampler = Sampler(reg, self.ts_store, interval_s=ts_interval)
         self._probe_supervisor = None
         self._probe_stop = threading.Event()
+
+    # -- the dynamic replica registry -------------------------------------
+
+    @property
+    def replicas(self) -> tuple:
+        """A point-in-time snapshot of the registered replica set. Every
+        reader iterates this tuple (never the underlying list), so a
+        concurrent register/deregister changes what the NEXT reader sees,
+        never what the current one is iterating."""
+        with self._replicas_lock:
+            return tuple(self._replicas)
+
+    def _count_registered(self) -> int:
+        with self._replicas_lock:
+            return len(self._replicas)
+
+    def register_replica(self, host: str, port: int,
+                         lifecycle: str = LIFECYCLE_JOINING):
+        """Add a replica to the routing set (idempotent by host:port —
+        re-registering an existing name returns the existing Replica).
+        New elastic replicas join as ``joining``: probed, federated into
+        the fleet picture, but invisible to pick() until
+        :meth:`activate_replica`."""
+        name = f"{host}:{port}"
+        with self._replicas_lock:
+            for r in self._replicas:
+                if r.name == name:
+                    return r
+            r = Replica(host, port, lifecycle=lifecycle)
+            self._replicas = self._replicas + [r]
+        self._m_probe_age.set_function(r.probe_age_s, replica=r.name)
+        if self.flight is not None:
+            self.flight.record("replica_register", replica=name,
+                               lifecycle=lifecycle)
+        return r
+
+    def activate_replica(self, name: str) -> bool:
+        """joining -> active: the replica starts taking picks. Counted as
+        the ``joined`` scale event (the marker `cli top` renders)."""
+        for r in self.replicas:
+            if r.name == name:
+                r.set_lifecycle(LIFECYCLE_ACTIVE)
+                self._m_scale_events.inc(event="joined")
+                return True
+        return False
+
+    def drain_replica(self, name: str) -> bool:
+        """active -> draining: no new picks, no resume targeting, but the
+        replica keeps its in-flight streams (and stays federated) until
+        the supervisor finishes the drain."""
+        for r in self.replicas:
+            if r.name == name:
+                r.set_lifecycle(LIFECYCLE_DRAINING)
+                self._m_scale_events.inc(event="draining")
+                return True
+        return False
+
+    def deregister_replica(self, name: str) -> bool:
+        """Remove a replica from the routing set (the ``retired`` scale
+        event). Its probe-age gauge series is retired with it — the
+        callback is swapped for NaN, which the gauge renderer skips."""
+        gone = None
+        with self._replicas_lock:
+            for r in self._replicas:
+                if r.name == name:
+                    gone = r
+                    break
+            if gone is None:
+                return False
+            self._replicas = [r for r in self._replicas if r.name != name]
+        gone.set_lifecycle(LIFECYCLE_GONE)
+        self._m_probe_age.set_function(lambda: float("nan"), replica=name)
+        self._m_scale_events.inc(event="retired")
+        if self.flight is not None:
+            self.flight.record("replica_deregister", replica=name)
+        return True
 
     # -- routing ----------------------------------------------------------
 
@@ -667,6 +883,11 @@ class RouterState:
             if r.name in exclude:
                 continue
             s = r.snapshot()
+            if s["state"] != LIFECYCLE_ACTIVE:
+                continue  # joining replicas are still pre-warming;
+                #            draining ones must never gain NEW streams
+                #            (that includes resume targeting — a resumed
+                #            stream would just need a second failover)
             if not (s["ready"] and not s["circuit_open"]):
                 continue
             if role is not None:
@@ -716,7 +937,8 @@ class RouterState:
         roles = set()
         for r in self.replicas:
             s = r.snapshot()
-            if s["ready"] and not s["circuit_open"]:
+            if (s["state"] == LIFECYCLE_ACTIVE
+                    and s["ready"] and not s["circuit_open"]):
                 roles.add(s["role"])
         return "prefill" in roles and "decode" in roles
 
@@ -780,6 +1002,12 @@ class RouterState:
             if self.probe_replica(r):
                 n_ready += 1
         self._m_replicas_ready.set(float(n_ready))
+        # the probe cadence doubles as the checkpoint-store TTL sweep:
+        # entries orphaned by abnormal stream teardown are reclaimed here
+        # instead of squatting until LRU pressure evicts a live stream's
+        expired = self.ckpt_store.sweep()
+        if expired:
+            self._m_ckpt_expired.inc(expired)
         return n_ready
 
     def _probe_loop(self) -> None:
@@ -814,15 +1042,22 @@ class RouterState:
         picture so one curl answers 'can you take traffic, and how much'."""
         snaps = [r.snapshot() for r in self.replicas]
         routable = [s for s in snaps
-                    if s["ready"] and not s["circuit_open"]]
+                    if s["state"] == LIFECYCLE_ACTIVE
+                    and s["ready"] and not s["circuit_open"]]
         agg = {
             "slots_occupied": 0, "slots_total": 0, "queue_depth": 0,
             "kv_pages_free": 0, "kv_pages_total": 0,
+            "kv_pages_reclaimable": 0,
         }
         for s in routable:
             load = s.get("load") or {}
             for k in agg:
                 agg[k] += load.get(k, 0)
+            # radix-cached pages are evictable on demand: capacity the
+            # autoscaler must see as available, or a warmed-up idle
+            # fleet reads as saturated forever and never scales down
+            agg["kv_pages_reclaimable"] += (
+                (load.get("kv_pages") or {}).get("pages_cached", 0))
         return len(routable) > 0, {
             "status": "ready" if routable else "not_ready",
             "replicas_total": len(snaps),
@@ -1089,6 +1324,10 @@ class RouterHandler(BaseHTTPRequestHandler):
             except (ValueError, AttributeError):
                 pass  # malformed messages: no affinity hint, routing
                 #       still proceeds (the replica owns the 400)
+        if isinstance(req, dict) and req.get("messages"):
+            # remember the conversation as pre-warm material: a scaled-up
+            # replica replays the hottest of these before taking traffic
+            self.state.hot_prompts.record(hashes, req)
         if isinstance(req, dict) and self._try_disagg(req, hashes):
             return  # migrated (or finished at the prefill replica)
         self._proxy("POST", body, affinity_hashes=hashes,
@@ -1800,6 +2039,7 @@ def state_from_args(args, replica_addrs: list) -> RouterState:
         affinity_block=getattr(args, "affinity_block", 256),
         kv_wire=getattr(args, "kv_wire", "f32") or "f32",
         ckpt_interval=getattr(args, "ckpt_interval", 32),
+        ckpt_ttl_s=getattr(args, "ckpt_ttl", 600.0),
         ts_interval=getattr(args, "ts_interval", 1.0),
     )
 

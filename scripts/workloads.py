@@ -159,12 +159,38 @@ def kill_burst(seed=0, n=6, max_tokens=48):
             for i in range(n)]
 
 
+def diurnal(seed=0, cycles=2, bursts_per_cycle=3, burst_size=4,
+            burst_gap_s=1.5, idle_s=10.0, max_tokens=24):
+    """Bursty-diurnal replay for the elastic-fleet gate: each cycle is a
+    busy window of interactive bursts followed by a long idle trough —
+    the shape where a static fleet pays for capacity the trough never
+    uses, and an elastic one must grow into the burst and shed back down
+    without a single client-visible error. The same prompt repeats within
+    a cycle on purpose: it becomes the router's hot prefix, the material
+    a scale-up pre-warms into the joining replica."""
+    rng = random.Random(seed)
+    reqs, t, k = [], 0.5, 0
+    for c in range(cycles):
+        refrain = _sentence(rng, 5)
+        for b in range(bursts_per_cycle):
+            for i in range(burst_size):
+                reqs.append(Req(
+                    t + 0.05 * i, f"diurnal-{c}-{k}", "interactive",
+                    [{"role": "user", "content": f"[cycle {c}] {refrain}"}],
+                    max_tokens))
+                k += 1
+            t += burst_gap_s
+        t += idle_s
+    return reqs
+
+
 SCENARIOS = {
     "bursty": bursty_mix,
     "longctx": long_context,
     "multiturn": multi_turn,
     "disconnects": abusive_disconnects,
     "killburst": kill_burst,
+    "diurnal": diurnal,
 }
 
 
