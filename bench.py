@@ -3106,6 +3106,503 @@ def run_elastic_bench(n: int) -> dict:
     return result
 
 
+def run_c10k_bench(n: int) -> dict:
+    """BENCH_C10K=N: N concurrent slow-drip SSE sessions through ONE
+    event-loop router with adversarial chaos peers running alongside —
+    jax-free and fully in-process (the replicas are evloop stub servers,
+    not engines: this bench measures the DATA PLANE, not decode).
+
+    Topology: 2 stub replicas (selectors loops) <- the router's evloop
+    front door <- N well-behaved SSE clients on sharded selectors loops,
+    PLUS a chaos cohort (scripts/chaos_peer.py: slow-loris dribblers,
+    midstream-hang readers fed a firehose, RST peers) PLUS one mid-SSE
+    STALL session whose upstream goes silent right after a checkpoint
+    frame and must be checkpoint-resumed on the sibling byte-identically
+    (dllama_stream_resume_total{outcome="stall"}).
+
+    Every event carries the replica's monotonic send stamp, so "added
+    latency" is exactly the router + scheduling cost, not the drip.
+    N is scaled down only when RLIMIT_NOFILE demands it (~5 fds per
+    session across the four sockets each one fans out to).
+
+    Gates (each failure lands in result["error"]):
+      * zero client-visible errors on the well-behaved cohort, chaos on
+      * peak concurrent streams >= 0.9 * N (the sessions truly overlap)
+      * p99 added event latency <= C10K_P99_MS (default 2000 ms)
+      * RSS growth <= max(N * C10K_RSS_KB (default 64 KiB), 192 MiB)
+      * the stall session's body is EXACTLY the no-failure stream and
+        the resume was accounted with outcome="stall"
+      * every chaos mode bit: slow-loris cut at --header-timeout,
+        midstream-hang killed at --client-stall-timeout, RST absorbed —
+        and the router still answers /health afterwards
+      * admission control: a --max-conns 4 router sheds connection 5
+        with the canned 503 BEFORE allocating state (reason=max_conns)
+
+    BENCH_C10K_OUT writes the full report JSON for CI artifacts."""
+    import base64
+    import http.client as hc
+    import importlib.util
+    import resource
+    import socket
+    import threading
+
+    from dllama_tpu.serving import evloop
+    from dllama_tpu.serving import router as router_mod
+    from dllama_tpu.serving.protocol import HDR_RESUME_OFFSET
+
+    # ---- fd budget: ~5 fds per session (client sock, router front +
+    # upstream, replica sock, slack) — raise the soft limit, then scale
+    soft, hard = resource.getrlimit(resource.RLIMIT_NOFILE)
+    if soft < hard:
+        try:
+            resource.setrlimit(resource.RLIMIT_NOFILE, (hard, hard))
+            soft = hard
+        except (ValueError, OSError):
+            pass
+    n_eff = max(8, min(n, (soft - 512) // 5))
+    if n_eff < n:
+        log(f"c10k: RLIMIT_NOFILE {soft} caps the run at {n_eff} "
+            f"sessions (asked {n})")
+
+    # ---- pacing: ramp at a bounded accept rate; drip slowly enough that
+    # the single-process GIL can push every event through all three hops
+    rate = max(100.0, float(os.environ.get("C10K_RAMP_RATE", "1500")))
+    ramp_s = n_eff / rate
+    drip_s = max(0.4, n_eff / 6000.0)
+    n_events = max(8, min(60, int((ramp_s + 3.0) / drip_s) + 2))
+    # the inter-byte stall budget must clear one drip interval with slack
+    stall_timeout_s = drip_s * 2.0 + 1.0
+
+    # ---- the stall-session fixture: what the client must end up with
+    ev_a = b"data: alpha\n\n"
+    ev_b = b"data: bravo\n\n"
+    ev_c = b"data: charlie\n\n"
+    sse_done = b"data: [DONE]\n\n"
+    visible = ev_a + ev_b + ev_c + sse_done
+    snap = b"c10k-stall-snapshot"
+    ckpt_off = len(ev_a)
+    ckpt_frame = (b"event: dllama-ckpt\ndata: %d %s\n\n"
+                  % (ckpt_off, base64.b64encode(snap)))
+    resume_bodies: list = []
+
+    # ---- stub replica: /ready, slow-drip SSE chat (send-stamped), the
+    # stall session, a firehose for the hanging chaos readers, resume
+    def stub_handler(server, sock, addr):
+        buf = bytearray()
+        while True:
+            req = yield from evloop.read_request(sock, buf)
+            if req is None:
+                return
+            if req.method == "GET" and req.path == "/ready":
+                body = json.dumps({
+                    "status": "ready", "slots_occupied": 0,
+                    "slots_total": 65536, "queue_depth": 0,
+                    "kv_pages_free": 65536, "kv_pages_total": 65536,
+                    "prefix_hit_rate": 0.0}).encode()
+                yield from evloop.send_all(sock, evloop.response_bytes(
+                    200, [("Content-Type", "application/json"),
+                          ("Content-Length", str(len(body)))], body))
+            elif req.method == "POST" and req.path == "/v1/kv/resume":
+                resume_bodies.append(req.body)
+                cont = visible[ckpt_off:]
+                yield from evloop.send_all(sock, evloop.response_bytes(
+                    200, [("Content-Type", "text/event-stream"),
+                          (HDR_RESUME_OFFSET, str(ckpt_off)),
+                          ("Content-Length", str(len(cont)))], cont))
+            elif req.method == "POST":
+                head = evloop.response_bytes(
+                    200, [("Content-Type", "text/event-stream"),
+                          ("Connection", "close")])
+                if b"stall-session" in req.body:
+                    # checkpoint, one more event, then SILENCE with the
+                    # socket open: the death only the stall budget sees
+                    yield from evloop.send_all(
+                        sock, head + ev_a + ckpt_frame + ev_b)
+                    yield from evloop.sleep(120.0)
+                    return
+                if b"chaos" in req.body:
+                    # firehose for midstream-hang peers: the bounded
+                    # relay buffer pauses THIS send (backpressure) until
+                    # the client-stall kill tears the path down (OSError
+                    # here ends the task — the loop treats that as the
+                    # normal teardown)
+                    yield from evloop.send_all(sock, head)
+                    block = b"data: " + b"x" * 8192 + b"\n\n"
+                    while True:
+                        yield from evloop.send_all(sock, block)
+                yield from evloop.send_all(sock, head)
+                for k in range(n_events):
+                    yield from evloop.sleep(drip_s)
+                    ev = (b"data: " + json.dumps(
+                        {"k": k, "t_us": int(time.monotonic() * 1e6)}
+                    ).encode() + b"\n\n")
+                    yield from evloop.send_all(sock, ev)
+                yield from evloop.send_all(sock, b"data: [DONE]\n\n")
+                return
+            else:
+                yield from evloop.send_all(sock, evloop.response_bytes(
+                    404, [("Content-Length", "0")]))
+            if not req.keep_alive:
+                return
+
+    def boot_stub(name: str):
+        srv = evloop.EventLoopServer(("127.0.0.1", 0), stub_handler)
+        threading.Thread(target=srv.serve_forever, daemon=True,
+                         name=f"c10k-replica-{name}").start()
+        return srv
+
+    def _rss_kb() -> int:
+        try:
+            with open("/proc/self/status") as f:
+                for line in f:
+                    if line.startswith("VmRSS:"):
+                        return int(line.split()[1])
+        except OSError:
+            pass
+        return 0
+
+    def _drain(sock, timeout: float) -> bytes:
+        sock.settimeout(timeout)
+        out = bytearray()
+        try:
+            while True:
+                b = sock.recv(65536)
+                if not b:
+                    break
+                out += b
+        except OSError:
+            pass
+        return bytes(out)
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    spec_cp = importlib.util.spec_from_file_location(
+        "dllama_chaos_peer", os.path.join(repo, "scripts", "chaos_peer.py"))
+    chaos = importlib.util.module_from_spec(spec_cp)
+    spec_cp.loader.exec_module(chaos)
+
+    gates: list = []
+    report: dict = {"n_requested": n, "n_sessions": n_eff,
+                    "events_per_session": n_events,
+                    "drip_s": drip_s, "ramp_s": round(ramp_s, 2),
+                    "stall_timeout_s": stall_timeout_s}
+    rep_a = rep_b = state = srv = None
+    stop_mon = threading.Event()
+    shards: list = []
+    try:
+        rep_a, rep_b = boot_stub("a"), boot_stub("b")
+        state = router_mod.RouterState(
+            [router_mod.Replica("127.0.0.1", rep_a.server_address[1]),
+             router_mod.Replica("127.0.0.1", rep_b.server_address[1])],
+            probe_interval_s=3600.0, connect_timeout_s=5.0,
+            header_timeout_s=3.0, first_byte_timeout_s=15.0,
+            stall_timeout_s=stall_timeout_s, client_stall_timeout_s=2.0,
+            ckpt_interval=2, probe_read_timeout_s=2.0)
+        srv = router_mod.create_router_server(state, "127.0.0.1", 0)
+        threading.Thread(target=srv.serve_forever, daemon=True,
+                         name="c10k-router").start()
+        port = srv.server_address[1]
+        ready0 = state.probe_once()
+        if ready0 != 2:
+            gates.append(f"boot probe saw {ready0}/2 stub replicas ready")
+        log(f"c10k: router on :{port}, {n_eff} sessions x {n_events} "
+            f"events, drip {drip_s:.2f}s, ramp {ramp_s:.1f}s")
+
+        # ---- the well-behaved cohort: sharded selectors client loops
+        n_shards = 4 if n_eff >= 1000 else 2
+        for i in range(n_shards):
+            shards.append({"loop": evloop.Loop(), "count": 0, "done": 0,
+                           "active": 0, "errors": 0, "err_samples": [],
+                           "lats": []})
+
+        def make_session(shard, gidx):
+            def session():
+                counted = False
+                sock = None
+                try:
+                    yield from evloop.sleep(gidx / rate)
+                    dl = time.monotonic() + 60.0
+                    sock = yield from evloop.dial(("127.0.0.1", port), dl)
+                    up = evloop.Upstream(sock, "127.0.0.1", port)
+                    body = json.dumps({
+                        "model": "m", "stream": True,
+                        "messages": [{"role": "user",
+                                      "content": f"c10k-{gidx}"}]}).encode()
+                    yield from up.request(
+                        "POST", "/v1/chat/completions",
+                        {"Content-Type": "application/json"}, body, dl)
+                    resp = yield from up.get_response(dl)
+                    if resp.status != 200:
+                        raise OSError(f"status {resp.status}")
+                    shard["active"] += 1
+                    counted = True
+                    buf = bytearray()
+                    seen_done, n_ev = False, 0
+                    while not seen_done:
+                        data = yield from resp.read_some(
+                            time.monotonic() + drip_s + 10.0)
+                        if not data:
+                            break
+                        now_us = time.monotonic() * 1e6
+                        buf += data
+                        while True:
+                            cut = buf.find(b"\n\n")
+                            if cut < 0:
+                                break
+                            frame = bytes(buf[:cut])
+                            del buf[:cut + 2]
+                            if frame == b"data: [DONE]":
+                                seen_done = True
+                            elif frame.startswith(b"data: {"):
+                                stamp = json.loads(frame[6:])
+                                shard["lats"].append(
+                                    (now_us - stamp["t_us"]) / 1000.0)
+                                n_ev += 1
+                    if not seen_done or n_ev != n_events:
+                        raise OSError(f"incomplete stream: done="
+                                      f"{seen_done} events {n_ev}"
+                                      f"/{n_events}")
+                except Exception as e:  # noqa: BLE001 — every failure gates
+                    shard["errors"] += 1
+                    if len(shard["err_samples"]) < 5:
+                        shard["err_samples"].append(
+                            f"{type(e).__name__}: {e}")
+                finally:
+                    if sock is not None:
+                        try:
+                            sock.close()
+                        except OSError:
+                            pass
+                    if counted:
+                        shard["active"] -= 1
+                    shard["done"] += 1
+                    if shard["done"] == shard["count"]:
+                        shard["loop"].stop()
+            return session()
+
+        for gidx in range(n_eff):
+            shards[gidx % n_shards]["count"] += 1
+        for gidx in range(n_eff):
+            sh = shards[gidx % n_shards]
+            sh["loop"].spawn(make_session(sh, gidx))
+
+        base_rss = _rss_kb()
+        peak = {"active": 0, "rss_kb": base_rss}
+
+        def monitor():
+            while not stop_mon.is_set():
+                act = sum(sh["active"] for sh in shards)
+                peak["active"] = max(peak["active"], act)
+                peak["rss_kb"] = max(peak["rss_kb"], _rss_kb())
+                stop_mon.wait(0.1)
+
+        mon = threading.Thread(target=monitor, daemon=True)
+        mon.start()
+
+        # ---- chaos cohorts + the stall session, live during the ramp
+        n_peers = max(5, min(20, n_eff // 50))
+        chaos_dur = max(8.0, ramp_s + 4.0)
+        chaos_out: dict = {}
+        chaos_threads = [
+            threading.Thread(
+                target=lambda m=mode: chaos_out.__setitem__(
+                    m, chaos.run_cohort(m, "127.0.0.1", port, n_peers,
+                                        chaos_dur)),
+                daemon=True, name=f"c10k-chaos-{mode}")
+            for mode in ("slowloris", "midstream_hang", "reset")]
+        stall_out: dict = {}
+
+        def run_stall():
+            time.sleep(min(2.0, ramp_s / 2 + 0.2))
+            try:
+                conn = hc.HTTPConnection("127.0.0.1", port, timeout=90)
+                conn.request(
+                    "POST", "/v1/chat/completions",
+                    json.dumps({"model": "m", "stream": True,
+                                "messages": [{"role": "user",
+                                              "content": "stall-session"}]
+                                }).encode(),
+                    headers={"Content-Type": "application/json"})
+                resp = conn.getresponse()
+                stall_out["status"] = resp.status
+                stall_out["body"] = resp.read()
+                conn.close()
+            except Exception as e:  # noqa: BLE001 — gated below
+                stall_out["error"] = f"{type(e).__name__}: {e}"
+
+        stall_thread = threading.Thread(target=run_stall, daemon=True)
+
+        shard_threads = [
+            threading.Thread(target=sh["loop"].run, daemon=True,
+                             name=f"c10k-shard-{i}")
+            for i, sh in enumerate(shards)]
+        t0 = time.monotonic()
+        for t in shard_threads + chaos_threads + [stall_thread]:
+            t.start()
+        join_budget = ramp_s + n_events * drip_s + 90.0
+        for t in shard_threads:
+            t.join(max(10.0, join_budget - (time.monotonic() - t0)))
+        for sh in shards:
+            sh["loop"].call_threadsafe(sh["loop"].stop)  # no-op if done
+        for t in chaos_threads:
+            t.join(30.0)
+        stall_thread.join(120.0)
+        stop_mon.set()
+        mon.join(5.0)
+        wall_s = time.monotonic() - t0
+
+        # ---- gates ----------------------------------------------------
+        total_err = sum(sh["errors"] for sh in shards)
+        total_done = sum(sh["done"] for sh in shards)
+        samples = [s for sh in shards for s in sh["err_samples"]][:5]
+        if total_err:
+            gates.append(f"{total_err} well-behaved client error(s), "
+                         f"e.g. {samples}")
+        if total_done != n_eff:
+            gates.append(f"only {total_done}/{n_eff} sessions finished "
+                         f"inside {join_budget:.0f}s")
+        if peak["active"] < 0.9 * n_eff:
+            gates.append(f"peak concurrency {peak['active']} never "
+                         f"reached 0.9 x {n_eff} — sessions did not "
+                         "overlap")
+        lats = [x for sh in shards for x in sh["lats"]]
+        p50 = _pct(lats, 50) if lats else None
+        p99 = _pct(lats, 99) if lats else None
+        p99_bound = float(os.environ.get("C10K_P99_MS", "2000"))
+        if p99 is None:
+            gates.append("no event latencies recorded")
+        elif p99 > p99_bound:
+            gates.append(f"p99 added event latency {p99:.0f} ms exceeds "
+                         f"the {p99_bound:.0f} ms budget")
+        rss_growth_kb = max(0, peak["rss_kb"] - base_rss)
+        rss_budget_kb = max(
+            n_eff * float(os.environ.get("C10K_RSS_KB", "64")),
+            192 * 1024)
+        if rss_growth_kb > rss_budget_kb:
+            gates.append(f"RSS grew {rss_growth_kb} KiB "
+                         f"(> {rss_budget_kb:.0f} KiB budget)")
+        if stall_out.get("status") != 200:
+            gates.append(f"stall session: {stall_out}")
+        elif stall_out.get("body") != visible:
+            gates.append("stall session body is not byte-identical to "
+                         "the no-failure stream "
+                         f"({len(stall_out.get('body') or b'')} vs "
+                         f"{len(visible)} bytes)")
+        if state._m_resumes.value(outcome="stall") < 1:
+            gates.append("no resume was accounted with outcome=stall")
+        if snap not in resume_bodies:
+            gates.append("the sibling never received the checkpoint "
+                         "snapshot on /v1/kv/resume")
+        for mode, key in (("slowloris", "cut_by_router"),
+                          ("midstream_hang", "killed_by_router"),
+                          ("reset", "sent_rst")):
+            got = (chaos_out.get(mode) or {}).get(key, 0)
+            if got < 1:
+                gates.append(f"chaos {mode}: {key}=0 of {n_peers} peers "
+                             f"({chaos_out.get(mode)})")
+        try:
+            conn = hc.HTTPConnection("127.0.0.1", port, timeout=10)
+            conn.request("GET", "/health")
+            health = conn.getresponse().status
+            conn.close()
+        except OSError as e:
+            health = f"unreachable: {e}"
+        if health != 200:
+            gates.append(f"router /health after chaos: {health}")
+
+        report.update({
+            "wall_s": round(wall_s, 1), "peak_active": peak["active"],
+            "sessions_done": total_done, "client_errors": total_err,
+            "error_samples": samples,
+            "added_latency_ms": {"p50": p50, "p99": p99,
+                                 "n_events": len(lats)},
+            "rss_base_kb": base_rss, "rss_peak_kb": peak["rss_kb"],
+            "rss_growth_kb": rss_growth_kb,
+            "rss_per_conn_kb": round(rss_growth_kb / n_eff, 1),
+            "chaos": chaos_out,
+            "stall": {"status": stall_out.get("status"),
+                      "byte_identical":
+                          stall_out.get("body") == visible,
+                      "error": stall_out.get("error"),
+                      "resume_outcome_stall":
+                          state._m_resumes.value(outcome="stall")},
+            "router_health_after": health,
+        })
+        log(f"c10k: {total_done}/{n_eff} sessions, peak {peak['active']} "
+            f"concurrent, p99 added {p99 if p99 is None else round(p99)} "
+            f"ms, +{rss_growth_kb} KiB RSS over {wall_s:.1f}s")
+
+        # ---- admission-control proof on a tiny --max-conns router ------
+        mini_state = router_mod.RouterState(
+            [router_mod.Replica("127.0.0.1", rep_a.server_address[1])],
+            probe_interval_s=3600.0, max_conns=4)
+        mini = router_mod.create_router_server(mini_state, "127.0.0.1", 0)
+        threading.Thread(target=mini.serve_forever, daemon=True,
+                         name="c10k-mini-router").start()
+        held = []
+        try:
+            for _ in range(4):
+                c = hc.HTTPConnection("127.0.0.1",
+                                      mini.server_address[1], timeout=10)
+                c.request("GET", "/health")
+                c.getresponse().read()
+                held.append(c)  # keep-alive: the slot stays occupied
+            s = socket.create_connection(
+                ("127.0.0.1", mini.server_address[1]), timeout=10)
+            data = _drain(s, timeout=5.0)
+            s.close()
+            got_503 = b"503" in data.split(b"\r\n", 1)[0]
+            sheds = mini_state._m_sheds.value(reason="max_conns")
+            report["shed"] = {"got_503": got_503, "sheds": sheds}
+            if not got_503 or sheds < 1:
+                gates.append(f"max-conns shed proof failed: 503="
+                             f"{got_503} sheds={sheds} "
+                             f"({data[:80]!r})")
+        finally:
+            for c in held:
+                c.close()
+            mini_state.stop_probes()
+            mini.shutdown()
+            mini.server_close()
+    finally:
+        stop_mon.set()
+        for sh in shards:
+            try:
+                sh["loop"].call_threadsafe(sh["loop"].stop)
+            except Exception:  # noqa: BLE001 — loop already torn down
+                pass
+        if state is not None:
+            state.stop_probes()
+        if srv is not None:
+            srv.shutdown()
+            srv.server_close()
+        for rep in (rep_a, rep_b):
+            if rep is not None:
+                rep.shutdown()
+                rep.server_close()
+
+    report["gates_failed"] = gates
+    out_path = os.environ.get("BENCH_C10K_OUT")
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump(report, f, indent=2, default=str)
+        log(f"report written to {out_path}")
+    result = {
+        "metric": "smoke_c10k_conns",
+        "value": n_eff,
+        "unit": "conns",
+        "vs_baseline": None,
+        "baseline": "the same process's thread-per-connection ceiling "
+                    "(a threaded data plane cannot hold this many "
+                    "concurrent SSE relays at bounded RSS)",
+        "weights": "none-data-plane-only",
+        "platform": "cpu-evloop",
+        "n_devices": 2,
+    }
+    if gates:
+        result["error"] = "; ".join(gates)
+    return result
+
+
 def _trajectory_note(status: str, result=None, error=None) -> None:
     """Append this round to the durable bench trajectory
     (results/trajectory.jsonl) and surface comparator regressions.
@@ -3144,6 +3641,7 @@ def main() -> None:
                  else "failover" if _env_count("BENCH_FAILOVER")
                  else "workloads" if _env_count("BENCH_WORKLOADS")
                  else "elastic" if _env_count("BENCH_ELASTIC")
+                 else "c10k" if _env_count("BENCH_C10K")
                  else "decode")
     err_metric = {"tiny": "tinyllama_1.1b", "llama3": "llama3_8b",
                   "moe": "mixtral_lite", "grok": "grok1_lite",
@@ -3183,7 +3681,8 @@ def main() -> None:
     nfailover = _env_count("BENCH_FAILOVER")
     nworkloads = _env_count("BENCH_WORKLOADS")
     nelastic = _env_count("BENCH_ELASTIC")
-    if nrouter or ndisagg or nfailover or nworkloads or nelastic:
+    nc10k = _env_count("BENCH_C10K")
+    if nrouter or ndisagg or nfailover or nworkloads or nelastic or nc10k:
         # the router, disaggregation, failover and workload replays are
         # jax-free IN THIS PROCESS (replicas are CPU subprocesses), so
         # branch before the backend probes: a dead TPU tunnel must not
@@ -3193,10 +3692,12 @@ def main() -> None:
                       else run_disagg_bench(ndisagg) if ndisagg
                       else run_failover_bench(nfailover) if nfailover
                       else run_workloads_bench(nworkloads) if nworkloads
-                      else run_elastic_bench(nelastic))
+                      else run_elastic_bench(nelastic) if nelastic
+                      else run_c10k_bench(nc10k))
         except Exception as e:  # noqa: BLE001 — emit the machine-readable record
             result = {"metric": err_metric, "value": None,
-                      "unit": "req/s" if nrouter else "ms",
+                      "unit": ("req/s" if nrouter
+                               else "conns" if nc10k else "ms"),
                       "vs_baseline": None,
                       "error": f"{type(e).__name__}: {e}"}
         if deadline_s > 0:

@@ -1,4 +1,4 @@
-"""BLOCK-00x: blocking operations while holding a guarded_by lock.
+"""BLOCK-00x / LOOP-001: blocking operations where a block stalls others.
 
 BLOCK-001  blocking call lexically inside ``with self.<lock>`` where
            <lock> is a guard lock declared by the enclosing class's
@@ -8,6 +8,15 @@ BLOCK-001  blocking call lexically inside ``with self.<lock>`` where
 BLOCK-002  blocking call while holding a module-level lock (declared via
            ``guard_globals`` or bound to ``threading.Lock()``/``RLock()``
            at module scope).
+LOOP-001   blocking call ANYWHERE inside a function annotated
+           ``@loop_callback`` (``analysis.sanitize``) — event-loop
+           callbacks/coroutines run on the single ``selectors`` loop
+           thread (``serving/evloop.py``), where one blocking call
+           stalls EVERY connection the process carries; no lock needs
+           to be held for the collapse. Nested ``def``s inherit the
+           annotation (they run on the same thread). The loop's audited
+           non-blocking leaf primitives stay UNannotated on purpose:
+           they are the few lines allowed to touch raw socket calls.
 
 "Blocking" is a deliberate shortlist, not a taint analysis:
 
@@ -151,4 +160,42 @@ def check_blocking(src: SourceFile):
             tracker = _BlockTracker(on_block)
             for stmt in node.body:
                 tracker.visit(stmt)
+
+    # LOOP-001: blocking calls inside @loop_callback functions — no lock
+    # required; the loop thread IS the contended resource. The dedupe set
+    # is file-wide: a nested def that is itself annotated must not report
+    # the same call twice (once per enclosing walk).
+    seen: set = set()
+    for node in ast.walk(src.tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if not _is_loop_callback(node):
+            continue
+        for call in ast.walk(node):
+            if not isinstance(call, ast.Call):
+                continue
+            reason = blocking_reason(call)
+            if reason is None:
+                continue
+            key = (call.lineno, call.col_offset)
+            if key in seen:
+                continue
+            seen.add(key)
+            findings.append(Finding(
+                "LOOP-001", src.rel, call.lineno,
+                f"blocking {reason} in event-loop callback {node.name}() — "
+                f"one blocking call on the loop thread stalls every "
+                f"connection; yield to the loop (evloop primitives) or "
+                f"ship it to a worker via evloop.run_in_thread"))
     return findings
+
+
+def _is_loop_callback(fn) -> bool:
+    """Does ``fn`` carry the ``@loop_callback`` annotation (bare or
+    dotted, optionally called)?"""
+    for dec in fn.decorator_list:
+        target = dec.func if isinstance(dec, ast.Call) else dec
+        dotted = _dotted(target)
+        if dotted and dotted.split(".")[-1] == "loop_callback":
+            return True
+    return False

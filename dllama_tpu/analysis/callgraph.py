@@ -18,6 +18,22 @@ A genuinely unlocked call path is a finding whose message carries the
 full chain, e.g. ``Gate.flush() -> Gate._bump_locked() called at
 x.py:12``.  The conservative direction is preserved: this pass only ever
 *removes* findings relative to the lexical rule, never adds sites.
+
+Cross-module suppression: the proof is module-local by design, so a
+helper whose only callers live in *another* module can never be proven
+here.  Rather than forcing a per-write allow-comment on every such line,
+a single method-level suppression on (or directly above) the ``def``
+(the rule id is spelled ``LOCK-nnn`` here so this docstring is not
+itself parsed as one)::
+
+    def _publish(self):  # dllama: allow[LOCK-nnn] reason=cross-module:fleet.Controller._apply
+
+suppresses every LOCK-001 inside that method, provided the reason names
+the external callee (``cross-module:<dotted-callee>``).  Suppressed
+findings carry ``suppressed_anchor`` (the ``def``-line of the allow) so
+SUP-002 still audits the comment for staleness: when the method stops
+producing LOCK-001 findings the anchor has nothing to suppress and the
+comment is flagged stale like any other.
 """
 
 from __future__ import annotations
@@ -123,6 +139,7 @@ def check_guarded_writes(src: SourceFile):
         for meth in methods:
             if meth.name == "__init__":
                 continue
+            xmod = _cross_module_suppression(src, meth)
             for stmt, field, lock in writes[meth.name]:
                 if provable(meth.name, lock, frozenset()):
                     continue
@@ -135,5 +152,24 @@ def check_guarded_writes(src: SourceFile):
                     elif not call_sites.get(meth.name):
                         msg += ("; helper has no call site in this module — "
                                 "cannot prove callers hold the lock")
-                findings.append(Finding("LOCK-001", src.rel, stmt.lineno, msg))
+                f = Finding("LOCK-001", src.rel, stmt.lineno, msg)
+                if xmod is not None:
+                    f.suppressed = True
+                    f.reason = xmod.reason
+                    # Anchors the finding to the def-line allow so SUP-002
+                    # can still see this suppression doing work.
+                    f.suppressed_anchor = xmod.line
+                findings.append(f)
     return findings
+
+
+def _cross_module_suppression(src: SourceFile, meth):
+    """A method-level ``allow[LOCK-001] reason=cross-module:<callee>`` on
+    (or directly above) the ``def`` line — the only suppression shape that
+    may cover a whole method body, because a module-local graph cannot see
+    the external caller that holds the lock."""
+    for s in src.suppressions:
+        if (s.rule == "LOCK-001" and s.line in (meth.lineno, meth.lineno - 1)
+                and s.reason.startswith("cross-module:")):
+            return s
+    return None

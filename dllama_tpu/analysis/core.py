@@ -10,7 +10,11 @@ Suppression syntax (audited, reason mandatory; the rule id is spelled
     self._hot = x  # dllama: allow[LOCK-nnn] reason=publish-only; readers tolerate tears
 
 A suppression comment applies to findings on its own line or the line
-directly below (comment-above style). A suppression with no ``reason=`` text
+directly below (comment-above style). One widening exists: a LOCK-nnn
+allow on a ``def`` line whose reason starts with ``cross-module:`` covers
+the whole method body (see ``analysis.callgraph`` — the interprocedural
+proof is module-local, so externally-called helpers can never be proven).
+A suppression with no ``reason=`` text
 is itself a finding (SUP-001), and one whose rule no longer fires at that
 site is a finding too (SUP-002, stale suppression) — the gate counts
 unsuppressed findings only, so every exception to a rule stays visible in
@@ -207,8 +211,14 @@ def _stale_suppressions(sources, findings) -> list:
     out: list = []
     for src in sources:
         for s in src.suppressions:
+            # A finding "hits" its suppression when anchored to the same
+            # line (or the line below, comment-above style) — or, for
+            # method-level cross-module LOCK-001 allows, when the finding
+            # carries a suppressed_anchor pointing back at the comment.
             hit = any(f.rule == s.rule and f.path == src.rel
-                      and f.line in (s.line, s.line + 1) for f in findings)
+                      and (f.line in (s.line, s.line + 1)
+                           or getattr(f, "suppressed_anchor", None) == s.line)
+                      for f in findings)
             if not hit:
                 out.append(Finding(
                     "SUP-002", src.rel, s.line,

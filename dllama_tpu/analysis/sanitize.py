@@ -287,6 +287,25 @@ def guard_globals(lock: str, *names: str) -> None:
     return None
 
 
+def loop_callback(fn):
+    """Annotate ``fn`` as an event-loop callback/coroutine: it runs on the
+    single ``selectors`` loop thread (``serving/evloop.py``), where ANY
+    blocking call stalls every connection the process is carrying — one
+    ``time.sleep`` in a handler is a fleet-wide latency spike.
+
+    dllama-check's LOOP-001 statically forbids the blocking shortlist
+    (blocking ``socket.recv/send/connect/accept``, ``time.sleep``,
+    no-timeout ``Queue.get``/``.join``, ``conn.getresponse``/``urlopen``)
+    inside annotated functions, including their nested ``def``s. The
+    audited non-blocking leaf primitives in evloop.py stay UNannotated —
+    they are the few lines allowed to touch raw socket calls, and they
+    never block (every socket is non-blocking; EAGAIN yields to the loop).
+
+    Metadata-only: returns ``fn`` unchanged (generator-ness preserved)."""
+    fn.__loop_callback__ = True
+    return fn
+
+
 def check_invariants(check_method: str, *mutators: str):
     """Class decorator: under ``DLLAMA_SANITIZE=1`` run ``check_method`` after
     every listed mutating method, so the chaos/paged suites execute the

@@ -55,6 +55,39 @@ def build_parser() -> argparse.ArgumentParser:
             help="upstream response/stream read timeout after the status "
             "line; 0 = unlimited (long decodes stream for minutes)")
         rp.add_argument(
+            "--first-byte-timeout", type=float, default=0.0, metavar="S",
+            help="deadline for the upstream status line after the request "
+            "was sent (the replica's queue+prefill window); 0 falls back "
+            "to --upstream-timeout (0 = unlimited)")
+        rp.add_argument(
+            "--stall-timeout", type=float, default=0.0, metavar="S",
+            help="inter-byte stall budget on SSE relay: an upstream "
+            "silent past this mid-stream is treated as DEAD and the "
+            "stream is checkpoint-resumed on a sibling (counted as "
+            "outcome=stall); 0 disables stall detection")
+        rp.add_argument(
+            "--header-timeout", type=float, default=10.0, metavar="S",
+            help="deadline for a client to land a full request head "
+            "(the slow-loris kill); 0 = unlimited")
+        rp.add_argument(
+            "--client-stall-timeout", type=float, default=30.0, metavar="S",
+            help="hard kill for clients that stop draining their socket "
+            "mid-response: a blocked client write past this closes the "
+            "connection (and its upstream within one chunk); 0 = wait "
+            "forever (backpressure still pauses the upstream read)")
+        rp.add_argument(
+            "--max-conns", type=int, default=0, metavar="N",
+            help="connection-count admission: at N open client "
+            "connections, new ones are shed at accept time with a canned "
+            "503 + Retry-After before any state is allocated; 0 = "
+            "unlimited")
+        rp.add_argument(
+            "--probe-read-timeout", type=float, default=2.0, metavar="S",
+            help="per-probe READ deadline, distinct from --connect-timeout:"
+            " a gray replica (accepts, then silence) costs one read "
+            "deadline and is marked circuit-open, never a wedged probe "
+            "pass; 0 falls back to --connect-timeout")
+        rp.add_argument(
             "--retry-budget", type=int, default=2, metavar="N",
             help="extra replicas tried after a retriable upstream failure "
             "(connect error or 503); 429/504 always pass through untouched")

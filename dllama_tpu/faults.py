@@ -60,7 +60,16 @@ Spec grammar (sites separated by ``;``)::
   spawn is rolled back and counted, the fleet stays at its old size) and
   ``scale_down`` (every replica-retire transition — a faulted drain
   escalates along the same SIGKILL + mid-stream-failover ladder as a
-  real drain timeout, never a client-visible error).
+  real drain timeout, never a client-visible error). The event-loop
+  data-plane seams are ``conn_accept`` (the router's admission gate at
+  accept time — a faulted gate sheds that connection with the canned
+  503 + Retry-After before any per-connection state exists, counted
+  under reason="injected"), ``relay_stall`` (every upstream read in the
+  SSE relay — a faulted read is a stall verdict: after the grace drain
+  the stream checkpoint-resumes on a sibling exactly as if the
+  inter-byte budget had expired) and ``client_write`` (every write to a
+  client socket — a faulted write is a vanished client: counted, and
+  the upstream connection closes within one chunk).
 * ``action`` — ``raise`` (throw :class:`FaultInjected`), ``slow`` (sleep
   ``delay_ms``, default 50), or a *data* action the seam itself interprets:
   ``truncate`` (weights_open: pretend the file is ``drop`` bytes short,
@@ -91,7 +100,7 @@ SITES = ("admit", "step_chunk", "prefill", "prefill_chunk", "prefix_match",
          "federate_scrape", "flight_dump", "overlap_split",
          "kv_export", "kv_import", "migrate", "ckpt_write", "resume",
          "preempt", "ts_sample", "alert_eval", "policy_eval", "scale_up",
-         "scale_down")
+         "scale_down", "conn_accept", "relay_stall", "client_write")
 ACTIONS = ("raise", "slow", "truncate", "bitflip", "nan")
 
 #: site -> the metric family that proves the site's failure is VISIBLE on
@@ -156,6 +165,15 @@ SITE_METRICS = {
     "policy_eval": "dllama_fleet_policy_evals_total",
     "scale_up": "dllama_fleet_scale_events_total",
     "scale_down": "dllama_fleet_scale_events_total",
+    # event-loop data-plane seams (serving/router.py on serving/evloop.py):
+    # a faulted accept gate sheds that connection with the canned 503
+    # (reason="injected"); a faulted relay read is a stall verdict that
+    # takes the checkpoint-resume path (outcome="stall" when the resume
+    # lands); a faulted client write is a client that vanished — counted,
+    # upstream closed within one chunk
+    "conn_accept": "dllama_router_sheds_total",
+    "relay_stall": "dllama_stream_resume_total",
+    "client_write": "dllama_router_client_disconnects_total",
 }
 
 

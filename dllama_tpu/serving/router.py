@@ -35,12 +35,29 @@ circuit-breaking on data-path connect errors (exponential backoff, closed
 again by the next successful probe). Either alone has a blind spot — the
 probe is periodic, the data path only sees replicas it already picked.
 
+The data plane is a stdlib ``selectors`` event loop (:mod:`evloop`): one
+thread, non-blocking sockets, one coroutine per client connection — 10k
+concurrent SSE streams fit in one process because an idle stream costs a
+parked generator, not an OS thread. The loop structure is what makes the
+robustness machinery expressible: per-edge deadlines (``--header-timeout``
+kills slow-loris clients, connect/first-byte budgets bound each upstream
+hop, the ``--stall-timeout`` inter-byte budget turns a GRAY upstream —
+accepted socket, then silence mid-SSE — into a checkpoint-resume with
+``outcome=stall``), slow-client backpressure (the relay holds one chunk at
+a time, so a client that stops draining pauses its upstream read instead
+of growing router RSS, and is hard-killed past ``--client-stall-timeout``),
+and ``--max-conns`` admission (new connections shed with a canned 503 +
+Retry-After at accept time, BEFORE any state is allocated). Control-plane
+work that legitimately blocks — probes, federation scrapes — runs on
+worker threads, never the loop.
+
 The router serves its own ``/health``, ``/ready``, ``/metrics`` and
 ``/stats`` (aggregating per-replica state) and generates/propagates
 ``X-Request-Id`` across the hop so a trace correlates end-to-end. Fault
-seams ``route_pick``, ``proxy_upstream``, ``probe`` and
-``federate_scrape`` are wired through ``faults.SITES``; injected failures
-take the same retry/circuit paths as real ones.
+seams ``route_pick``, ``proxy_upstream``, ``probe``, ``federate_scrape``,
+``conn_accept``, ``relay_stall`` and ``client_write`` are wired through
+``faults.SITES``; injected failures take the same retry/circuit/shed
+paths as real ones.
 
 Fleet observability (this is the stitching half of observability.py):
 
@@ -107,10 +124,10 @@ import sys
 import threading
 import time
 from collections import OrderedDict
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from dllama_tpu import faults, observability
-from dllama_tpu.analysis.sanitize import guarded_by
+from dllama_tpu.analysis.sanitize import guarded_by, loop_callback
+from dllama_tpu.serving import evloop
 from dllama_tpu.obsv import Sampler, TimeSeriesStore
 from dllama_tpu.obsv.timeseries import parse_window
 from dllama_tpu.serving import kv_transfer
@@ -632,13 +649,36 @@ class RouterState:
                  ckpt_capacity: int = 256,
                  ckpt_ttl_s: float = 600.0,
                  metrics=None, enable_flight: bool = True,
-                 ts_interval: float = 1.0):
+                 ts_interval: float = 1.0,
+                 max_conns: int = 0,
+                 header_timeout_s: float = 10.0,
+                 first_byte_timeout_s: float = 0.0,
+                 stall_timeout_s: float = 0.0,
+                 client_stall_timeout_s: float = 30.0,
+                 probe_read_timeout_s: float = 2.0):
         self._replicas_lock = threading.Lock()
         self._replicas = list(replicas)
         self.retry_budget = retry_budget
         self.probe_interval_s = probe_interval_s
         self.connect_timeout_s = connect_timeout_s
         self.upstream_timeout_s = upstream_timeout_s
+        # event-loop data-plane budgets, one per edge (0 = that edge is
+        # unbounded). header: the slow-loris kill — a client must land a
+        # full request head within this. first_byte: connect-to-status-line
+        # on the upstream hop (falls back to upstream_timeout). stall: the
+        # inter-byte budget on SSE relay — an upstream silent past it is
+        # treated as DEAD and checkpoint-resumed on a sibling
+        # (outcome=stall). client_stall: the slow-client hard kill — a
+        # client not draining its socket past this loses the connection.
+        self.max_conns = max(0, int(max_conns))
+        self.header_timeout_s = header_timeout_s
+        self.first_byte_timeout_s = first_byte_timeout_s
+        self.stall_timeout_s = stall_timeout_s
+        self.client_stall_timeout_s = client_stall_timeout_s
+        # probes get their own short READ deadline, distinct from connect:
+        # a gray replica (accepts, then silence) costs one read timeout,
+        # never a wedged probe pass
+        self.probe_read_timeout_s = probe_read_timeout_s
         self.affinity_block = affinity_block
         if kv_wire not in kv_transfer.WIRE_MODES:
             raise ValueError(f"unknown --kv-wire {kv_wire!r} "
@@ -682,6 +722,25 @@ class RouterState:
             "dllama_router_probe_failures_total",
             "Active /ready probes that errored (connect/parse/injected)",
             ("replica",))
+        self._m_probe_errors = reg.counter(
+            "dllama_router_probe_errors_total",
+            "Active /ready probes that errored, by failure mode: connect "
+            "(refused/unreachable), stall (the GRAY failure — the replica "
+            "accepted the socket then went silent past the probe read "
+            "deadline; marked circuit-open immediately), parse (garbled "
+            "body), injected",
+            ("replica", "reason"))
+        self._m_sheds = reg.counter(
+            "dllama_router_sheds_total",
+            "Connections refused at accept time, before any per-connection "
+            "state was allocated (max_conns = the --max-conns admission "
+            "gate answered 503 + Retry-After; injected = the conn_accept "
+            "fault seam fired)",
+            ("reason",))
+        self._m_conns = reg.gauge(
+            "dllama_router_open_conns",
+            "Client connections currently open on the event loop (the "
+            "number --max-conns admission-controls)")
         self._m_client_disconnects = reg.counter(
             "dllama_router_client_disconnects_total",
             "Streaming clients that vanished mid-SSE (the upstream replica "
@@ -725,7 +784,9 @@ class RouterState:
             "dllama_stream_resume_total",
             "Mid-stream failover resume attempts after an upstream died "
             "mid-SSE, by outcome (ok = the stream continued bit-identically "
-            "on a sibling replica; every other outcome — no_ckpt, "
+            "on a sibling replica after a clean death; stall = same, but "
+            "the death verdict came from the inter-byte --stall-timeout "
+            "budget on a silent upstream; every other outcome — no_ckpt, "
             "stale_ckpt, admit_failed, no_replica, injected, exhausted — "
             "ended the stream with a clean SSE error event + [DONE], never "
             "a silent TCP cut)",
@@ -946,13 +1007,29 @@ class RouterState:
 
     def probe_replica(self, r: Replica) -> bool:
         """One active /ready probe. Fires the ``probe`` seam; any failure
-        (connect, timeout, unparseable body, injected) is a DOWN verdict
-        that takes the replica out of rotation until a probe succeeds."""
+        (connect, stall, unparseable body, injected) is a DOWN verdict
+        that takes the replica out of rotation until a probe succeeds.
+
+        Two deadlines, one per edge: ``connect_timeout_s`` covers the TCP
+        connect, then the socket is re-armed with the (short)
+        ``probe_read_timeout_s`` for the response read. A GRAY replica —
+        one that accepts the socket and then never answers — used to cost
+        the whole connect timeout per probe pass AND read as merely
+        not-ready; now it costs one read deadline, is counted under
+        ``dllama_router_probe_errors_total{reason=stall}``, and is marked
+        circuit-open immediately (accepting-but-silent is worse than
+        refusing: the data path would hang there too)."""
+        connected = False
         try:
             faults.fire("probe")
             conn = http.client.HTTPConnection(
                 r.host, r.port, timeout=self.connect_timeout_s)
             try:
+                conn.connect()
+                connected = True
+                if conn.sock is not None:
+                    conn.sock.settimeout(
+                        self.probe_read_timeout_s or self.connect_timeout_s)
                 t_send = time.monotonic()
                 conn.request("GET", "/ready",
                              headers={HDR_REQUEST_ID:
@@ -987,11 +1064,23 @@ class RouterState:
                                        replica=r.name, prev=prev_gen,
                                        new=new_gen)
             return ready
-        except (OSError, ValueError, faults.FaultInjected):
+        except (OSError, ValueError, faults.FaultInjected) as e:
             # an unreachable/garbled probe IS the health signal, not an
             # error to propagate: record DOWN and keep the loop alive
             r.mark_probe(False, None)
+            if isinstance(e, faults.FaultInjected):
+                reason = "injected"
+            elif isinstance(e, TimeoutError) and connected:
+                reason = "stall"
+                r.mark_conn_failure()  # gray: circuit-open NOW, not just
+                #                        not-ready — the data path would
+                #                        hang on this replica too
+            elif isinstance(e, ValueError):
+                reason = "parse"
+            else:
+                reason = "connect"
             self._m_probe_failures.inc(replica=r.name)
+            self._m_probe_errors.inc(replica=r.name, reason=reason)
             return False
 
     def probe_once(self) -> int:
@@ -1186,31 +1275,88 @@ class RouterState:
         return out
 
 
-class RouterHandler(BaseHTTPRequestHandler):
-    """The front-door HTTP surface. Local routes (/health /ready /metrics
-    /stats) answer from RouterState; everything else on the OpenAI surface
-    proxies to a picked replica with failover. Every response — local,
-    proxied, or error — echoes X-Request-Id, and the same id travels on
-    the upstream hop so one grep correlates router and replica traces."""
+class ClientGone(OSError):
+    """The client vanished mid-response: EOF/reset on its socket, a write
+    stalled past the client-stall budget, or an injected ``client_write``
+    fault. Raised (once counted) so every relay unwinds through its
+    ``finally`` blocks — the upstream socket closes within one chunk and
+    the replica's cancel-on-disconnect frees the decode slot."""
 
-    protocol_version = "HTTP/1.1"
-    state: RouterState = None  # set by create_router_server
 
-    def log_message(self, fmt, *args):  # quiet; the CLI prints its own lines
-        pass
+class RouterConnection:
+    """One client connection on the event loop — the front-door HTTP
+    surface. Local routes (/health /ready /metrics /stats) answer from
+    RouterState; everything else on the OpenAI surface proxies to a
+    picked replica with failover. Every response — local, proxied, or
+    error — echoes X-Request-Id, and the same id travels on the upstream
+    hop so one grep correlates router and replica traces.
+
+    The connection is a single coroutine (:meth:`run`) driven by the
+    server's :class:`~dllama_tpu.serving.evloop.Loop`: requests are read
+    under the header deadline (keep-alive between them), responses are
+    written under the client-stall deadline, and the relay loops hold at
+    most one chunk in hand — a slow client pauses its upstream read
+    instead of growing router RSS. Every method here runs ON the loop
+    thread: no blocking calls allowed (LOOP-001 enforces the shortlist);
+    control-plane work that legitimately blocks (federation scrapes over
+    http.client) is shipped to a worker via ``evloop.run_in_thread``."""
 
     _KNOWN_ROUTES = ("/v1/chat/completions", "/chat/completions",
                      "/v1/models", "/health", "/healthz", "/ready",
                      "/metrics", "/metrics/fleet", "/metrics/history",
                      "/alerts", "/stats", "/debug/flight")
 
-    def _route(self) -> str:
-        p = self.path.split("?", 1)[0]
-        return p if p in self._KNOWN_ROUTES else "other"
+    def __init__(self, server, state: RouterState, sock, addr):
+        self.server = server
+        self.state = state
+        self.sock = sock
+        self.addr = addr
+        self.buf = bytearray()  # client bytes carried across requests
+        self.req = None
+        self.path = "/"
+        self.close_after = False
+        self._client_counted = False  # one disconnect count per connection
 
-    def _begin_request(self) -> None:
+    # -- the connection loop ----------------------------------------------
+
+    @loop_callback
+    def run(self):
+        st = self.state
+        try:
+            while True:
+                deadline = (time.monotonic() + st.header_timeout_s
+                            if st.header_timeout_s else None)
+                try:
+                    req = yield from evloop.read_request(
+                        self.sock, self.buf, deadline)
+                except evloop.HttpError as e:
+                    self._begin_request(None)
+                    self.close_after = True
+                    yield from self._error(e.status, str(e))
+                    return
+                if req is None:
+                    return  # clean keep-alive close
+                self.req = req
+                self.path = req.path
+                self.close_after = not req.keep_alive
+                self._begin_request(req)
+                if req.method == "GET":
+                    yield from self._do_GET()
+                elif req.method == "POST":
+                    yield from self._do_POST()
+                else:
+                    yield from self._error(
+                        405, f"method {req.method} not allowed")
+                if self.close_after:
+                    return
+        except (evloop.ProtocolError, evloop.LoopTimeout, ClientGone):
+            # garbled head, slow-loris past the header budget, or a client
+            # that vanished/stalled mid-response: nothing left to answer
+            return
+
+    def _begin_request(self, req) -> None:
         self._rid = observability.sanitize_request_id(
-            self.headers.get(HDR_REQUEST_ID))
+            req.header(HDR_REQUEST_ID) if req is not None else None)
         self._t_begin = time.monotonic()
         # one router span per request: its pid:span value is BOTH the
         # X-Dllama-Parent-Span the replica parents its trace under and the
@@ -1218,99 +1364,154 @@ class RouterHandler(BaseHTTPRequestHandler):
         self._span_id = observability.next_span_id()
         self._parent_value = observability.parent_span_value(self._span_id)
 
+    def _route(self) -> str:
+        p = self.path.split("?", 1)[0]
+        return p if p in self._KNOWN_ROUTES else "other"
+
     def _count(self, code: int) -> None:
         self.state._m_http.inc(route=self._route(), code=str(code))
 
     def _server_timing(self) -> str:
         return f"total;dur={(time.monotonic() - self._t_begin) * 1e3:.3f}"
 
-    def _json(self, code: int, obj: dict, headers: dict = None) -> None:
-        body = json.dumps(obj).encode()
-        self.send_response(code)
-        self.send_header("Content-Type", "application/json")
-        self.send_header("Content-Length", str(len(body)))
-        self.send_header(HDR_REQUEST_ID, self._rid)
-        self.send_header(HDR_SERVER_TIMING, self._server_timing())
+    def _client_deadline(self):
+        t = self.state.client_stall_timeout_s
+        return time.monotonic() + t if t else None
+
+    # -- writing to the client --------------------------------------------
+
+    @loop_callback
+    def _send(self, data: bytes):
+        """One client write under the client-stall budget. A failed or
+        stalled write (or an injected ``client_write`` fault) counts the
+        disconnect ONCE for the connection and raises ClientGone."""
+        try:
+            faults.fire("client_write")
+            yield from evloop.send_all(self.sock, data,
+                                       self._client_deadline())
+        except (OSError, faults.FaultInjected) as e:
+            if not self._client_counted:
+                self._client_counted = True
+                self.state._m_client_disconnects.inc()
+            raise ClientGone(f"client write failed: {e}")
+
+    @loop_callback
+    def _respond(self, code: int, headers: list, body: bytes):
+        """One complete framed response (the common, non-SSE shape):
+        Content-Length so keep-alive survives, standard response headers,
+        a single send."""
+        hs = list(headers)
+        hs.append(("Content-Length", str(len(body))))
+        hs.append((HDR_REQUEST_ID, self._rid))
+        hs.append((HDR_SERVER_TIMING, self._server_timing()))
+        if self.close_after:
+            hs.append(("Connection", "close"))
+        self._count(code)
+        yield from self._send(evloop.response_bytes(code, hs, body))
+
+    @loop_callback
+    def _json(self, code: int, obj: dict, headers: dict = None):
+        hs = [("Content-Type", "application/json")]
         for k, v in (headers or {}).items():
-            self.send_header(k, v)
-        self.end_headers()
-        self._count(code)
-        self.wfile.write(body)
+            hs.append((k, v))
+        yield from self._respond(code, hs, json.dumps(obj).encode())
 
-    def _text(self, code: int, body: bytes) -> None:
-        self.send_response(code)
-        self.send_header("Content-Type",
-                         "text/plain; version=0.0.4; charset=utf-8")
-        self.send_header("Content-Length", str(len(body)))
-        self.send_header(HDR_REQUEST_ID, self._rid)
-        self.send_header(HDR_SERVER_TIMING, self._server_timing())
-        self.end_headers()
-        self._count(code)
-        self.wfile.write(body)
+    @loop_callback
+    def _text(self, code: int, body: bytes):
+        yield from self._respond(
+            code,
+            [("Content-Type", "text/plain; version=0.0.4; charset=utf-8")],
+            body)
 
-    def _error(self, code: int, message: str) -> None:
-        self._json(code, {"error": {"message": message,
-                                    "type": "router_error",
-                                    "request_id": self._rid}})
+    @loop_callback
+    def _error(self, code: int, message: str):
+        yield from self._json(code, {"error": {"message": message,
+                                               "type": "router_error",
+                                               "request_id": self._rid}})
 
-    def _lifecycle_error(self, e: LifecycleError) -> None:
+    @loop_callback
+    def _lifecycle_error(self, e: LifecycleError):
         headers = {}
         if e.retry_after_s is not None:
             headers["Retry-After"] = str(max(1, int(round(e.retry_after_s))))
-        self._json(e.http_status,
-                   {"error": {"message": str(e), "type": "server_error",
-                              "request_id": self._rid}},
-                   headers=headers)
+        yield from self._json(
+            e.http_status,
+            {"error": {"message": str(e), "type": "server_error",
+                       "request_id": self._rid}},
+            headers=headers)
+
+    # -- upstream deadlines -----------------------------------------------
+
+    def _deadline(self, seconds: float):
+        return time.monotonic() + seconds if seconds else None
+
+    def _first_byte_deadline(self):
+        st = self.state
+        return self._deadline(st.first_byte_timeout_s
+                              or st.upstream_timeout_s)
+
+    def _body_deadline(self):
+        return self._deadline(self.state.upstream_timeout_s)
+
+    def _stall_deadline(self):
+        st = self.state
+        return self._deadline(st.stall_timeout_s or st.upstream_timeout_s)
 
     # -- local routes -----------------------------------------------------
 
-    def do_GET(self):
-        self._begin_request()
+    @loop_callback
+    def _do_GET(self):
         st = self.state
-        if self.path in ("/health", "/healthz"):
+        bare = self.path.split("?", 1)[0]
+        if bare in ("/health", "/healthz"):
             # LIVENESS of the router process itself: 200 whenever it can
             # answer, even with zero routable replicas (readiness's job)
             _, info = st.readiness()
-            self._json(200, {"status": "ok", "role": "router",
-                             "replicas_total": info["replicas_total"],
-                             "replicas_ready": info["replicas_ready"]})
-        elif self.path == "/ready":
+            yield from self._json(
+                200, {"status": "ok", "role": "router",
+                      "replicas_total": info["replicas_total"],
+                      "replicas_ready": info["replicas_ready"]})
+        elif bare == "/ready":
             ready, info = st.readiness()
-            self._json(200 if ready else 503, info)
-        elif self.path == "/metrics":
-            self._text(200, st.metrics.render().encode())
-        elif self.path == "/metrics/fleet":
-            self._text(200, st.federate().encode())
-        elif self.path.split("?", 1)[0] == "/metrics/history":
+            yield from self._json(200 if ready else 503, info)
+        elif bare == "/metrics":
+            yield from self._text(200, st.metrics.render().encode())
+        elif bare == "/metrics/fleet":
+            # federation scrapes the fleet over blocking http.client: a
+            # worker thread's job, never the loop's
+            body = yield from evloop.run_in_thread(st.federate)
+            yield from self._text(200, body.encode())
+        elif bare == "/metrics/history":
             # federated time-series history: the router's own window plus
             # every in-rotation replica's, per-replica keyed
-            self._json(200, st.federate_history(parse_window(self.path)))
-        elif self.path == "/alerts":
+            window = parse_window(self.path)
+            obj = yield from evloop.run_in_thread(
+                lambda: st.federate_history(window))
+            yield from self._json(200, obj)
+        elif bare == "/alerts":
             # the fleet's live SLO burn-rate picture (replica-evaluated;
             # the router only federates)
-            self._json(200, st.federate_alerts())
-        elif self.path == "/stats":
-            self._json(200, st.stats())
-        elif self.path == "/debug/flight":
-            self._json(200, st.flight_report())
-        elif self.path == "/v1/models":
+            obj = yield from evloop.run_in_thread(st.federate_alerts)
+            yield from self._json(200, obj)
+        elif bare == "/stats":
+            yield from self._json(200, st.stats())
+        elif bare == "/debug/flight":
+            obj = yield from evloop.run_in_thread(st.flight_report)
+            yield from self._json(200, obj)
+        elif bare == "/v1/models":
             # model identity is fleet-wide (one model per fleet): proxy to
             # any routable replica
-            self._proxy("GET", b"", affinity_hashes=[])
+            yield from self._proxy("GET", b"", affinity_hashes=[])
         else:
-            self._error(404, f"unknown path {self.path}")
+            yield from self._error(404, f"unknown path {self.path}")
 
-    def do_POST(self):
-        self._begin_request()
-        if self.path not in ("/v1/chat/completions", "/chat/completions"):
-            self._error(404, f"unknown path {self.path}")
+    @loop_callback
+    def _do_POST(self):
+        bare = self.path.split("?", 1)[0]
+        if bare not in ("/v1/chat/completions", "/chat/completions"):
+            yield from self._error(404, f"unknown path {self.path}")
             return
-        try:
-            length = int(self.headers.get("Content-Length", "0"))
-            body = self.rfile.read(length) if length else b"{}"
-        except (ValueError, OSError) as e:
-            self._error(400, f"bad request body: {e}")
-            return
+        body = self.req.body or b"{}"
         try:
             req = json.loads(body or b"{}")
         except ValueError:
@@ -1328,22 +1529,26 @@ class RouterHandler(BaseHTTPRequestHandler):
             # remember the conversation as pre-warm material: a scaled-up
             # replica replays the hottest of these before taking traffic
             self.state.hot_prompts.record(hashes, req)
-        if isinstance(req, dict) and self._try_disagg(req, hashes):
-            return  # migrated (or finished at the prefill replica)
-        self._proxy("POST", body, affinity_hashes=hashes,
-                    slo_class=(self.headers.get(HDR_CLASS)
-                               or "").strip().lower() or None)
+        if isinstance(req, dict):
+            migrated = yield from self._try_disagg(req, hashes)
+            if migrated:
+                return  # migrated (or finished at the prefill replica)
+        yield from self._proxy(
+            "POST", body, affinity_hashes=hashes,
+            slo_class=(self.req.header(HDR_CLASS)
+                       or "").strip().lower() or None)
 
     # -- disaggregated migration ------------------------------------------
 
-    def _try_disagg(self, req: dict, hashes: list) -> bool:
+    @loop_callback
+    def _try_disagg(self, req: dict, hashes: list):
         """One migration attempt: prefill hop -> KV relay -> decode hop.
 
         Returns True iff the request was fully answered here — either the
         decode replica took the handoff and streamed the rest of the row,
         or the row finished during prefill and the prefill replica's
         client-shape answer was relayed verbatim. EVERY failure path
-        returns False so do_POST falls back to normal routing (a full
+        returns False so _do_POST falls back to normal routing (a full
         re-prefill on whatever replica pick() chooses): a dead decode
         replica or torn transfer costs latency, never a client error.
 
@@ -1376,20 +1581,19 @@ class RouterHandler(BaseHTTPRequestHandler):
             detail["prefill"] = prefill.name
             body = json.dumps(dict(req, kv_wire=st.kv_wire)).encode()
             prefill.begin()
-            conn = None
+            up = None
             try:
                 try:
                     faults.fire("proxy_upstream")
-                    conn = http.client.HTTPConnection(
-                        prefill.host, prefill.port,
-                        timeout=st.connect_timeout_s)
-                    conn.request("POST", "/v1/prefill", body,
-                                 headers=self._upstream_headers())
-                    if conn.sock is not None:
-                        conn.sock.settimeout(st.upstream_timeout_s or None)
-                    resp = conn.getresponse()
-                except (OSError, http.client.HTTPException,
-                        faults.FaultInjected) as e:
+                    up = yield from evloop.open_upstream(
+                        self.server.pool, prefill.host, prefill.port,
+                        self._deadline(st.connect_timeout_s))
+                    yield from up.request(
+                        "POST", "/v1/prefill", self._upstream_headers(),
+                        body, self._deadline(st.connect_timeout_s))
+                    resp = yield from up.get_response(
+                        self._first_byte_deadline())
+                except (OSError, faults.FaultInjected) as e:
                     prefill.mark_conn_failure()
                     st._m_upstream_errors.inc(replica=prefill.name)
                     detail["error"] = repr(e)[:200]
@@ -1407,18 +1611,21 @@ class RouterHandler(BaseHTTPRequestHandler):
                     # IS the client-shape answer — relay it verbatim
                     outcome = "prefill_done"
                     if "text/event-stream" in ctype:
-                        self._relay_sse(resp, conn, prefill)
+                        yield from self._relay_sse(resp, up, prefill)
                     else:
-                        self._relay_buffered(resp.status, resp.read(),
-                                             self._relay_headers(resp))
+                        payload = yield from resp.read_all(
+                            self._body_deadline())
+                        yield from self._relay_buffered(
+                            resp.status, payload, self._relay_headers(resp))
                     if hashes:
                         st.affinity.record(hashes, prefill.name)
                     return True
-                stream = resp.read()  # the framed KV page stream, whole
+                # the framed KV page stream, whole
+                stream = yield from resp.read_all(self._body_deadline())
             finally:
                 prefill.end()
-                if conn is not None:
-                    conn.close()
+                if up is not None:
+                    up.close()
             # -- hop 2: decode import -------------------------------------
             tried: set = set()
             for _ in range(1 + st.retry_budget):
@@ -1430,23 +1637,21 @@ class RouterHandler(BaseHTTPRequestHandler):
                 tried.add(decode.name)
                 detail["decode"] = decode.name
                 decode.begin()
-                conn = None
+                up = None
                 try:
                     try:
                         faults.fire("proxy_upstream")
-                        conn = http.client.HTTPConnection(
-                            decode.host, decode.port,
-                            timeout=st.connect_timeout_s)
+                        up = yield from evloop.open_upstream(
+                            self.server.pool, decode.host, decode.port,
+                            self._deadline(st.connect_timeout_s))
                         headers = self._upstream_headers()
                         headers["Content-Type"] = kv_transfer.CONTENT_TYPE
-                        conn.request("POST", "/v1/kv/import", stream,
-                                     headers=headers)
-                        if conn.sock is not None:
-                            conn.sock.settimeout(
-                                st.upstream_timeout_s or None)
-                        resp = conn.getresponse()
-                    except (OSError, http.client.HTTPException,
-                            faults.FaultInjected) as e:
+                        yield from up.request(
+                            "POST", "/v1/kv/import", headers, stream,
+                            self._deadline(st.connect_timeout_s))
+                        resp = yield from up.get_response(
+                            self._first_byte_deadline())
+                    except (OSError, faults.FaultInjected) as e:
                         decode.mark_conn_failure()
                         st._m_upstream_errors.inc(replica=decode.name)
                         detail["error"] = repr(e)[:200]
@@ -1463,10 +1668,12 @@ class RouterHandler(BaseHTTPRequestHandler):
                     outcome = "ok"
                     if "text/event-stream" in (resp.getheader("Content-Type")
                                                or ""):
-                        self._relay_sse(resp, conn, decode)
+                        yield from self._relay_sse(resp, up, decode)
                     else:
-                        self._relay_buffered(resp.status, resp.read(),
-                                             self._relay_headers(resp))
+                        payload = yield from resp.read_all(
+                            self._body_deadline())
+                        yield from self._relay_buffered(
+                            resp.status, payload, self._relay_headers(resp))
                     # affinity points at the PREFILL replica: the next
                     # turn's prompt prefix is warm THERE (published at
                     # admit), and warm prefill is where affinity saves
@@ -1477,8 +1684,8 @@ class RouterHandler(BaseHTTPRequestHandler):
                     return True
                 finally:
                     decode.end()
-                    if conn is not None:
-                        conn.close()
+                    if up is not None:
+                        up.close()
             outcome = "import_fallback"
             return False
         finally:
@@ -1501,11 +1708,14 @@ class RouterHandler(BaseHTTPRequestHandler):
     # -- the proxy core ---------------------------------------------------
 
     def _upstream_headers(self) -> dict:
+        req = self.req
         h = {HDR_REQUEST_ID: self._rid,
              HDR_PARENT_SPAN: self._parent_value,
-             "Content-Type": self.headers.get("Content-Type",
-                                              "application/json"),
-             "Accept": self.headers.get("Accept", "*/*")}
+             "Content-Type": (req.header("Content-Type")
+                              if req is not None else None)
+             or "application/json",
+             "Accept": (req.header("Accept") if req is not None else None)
+             or "*/*"}
         st = self.state
         if st.ckpt_interval > 0:
             # opt every upstream stream into mid-stream checkpointing (the
@@ -1516,13 +1726,15 @@ class RouterHandler(BaseHTTPRequestHandler):
         # the SLO class rides every upstream hop untouched: the REPLICA
         # owns validation (unknown class -> its 400 passes straight
         # through), the router only scores by it
-        cls = (self.headers.get(HDR_CLASS) or "").strip()
+        cls = ((req.header(HDR_CLASS) if req is not None else None)
+               or "").strip()
         if cls:
             h[HDR_CLASS] = cls
         return h
 
+    @loop_callback
     def _proxy(self, method: str, body: bytes, affinity_hashes: list,
-               slo_class: str = None) -> None:
+               slo_class: str = None):
         """Dispatch one request with failover.
 
         Retriable = the hop died before the client received anything — a
@@ -1552,41 +1764,41 @@ class RouterHandler(BaseHTTPRequestHandler):
                 except NoReplicaAvailable as e:
                     if last_503 is not None:
                         hop["status"] = last_503[0]
-                        self._relay_buffered(*last_503)
+                        yield from self._relay_buffered(*last_503)
                         return
                     hop["error"] = "no_replica"
                     hop["status"] = e.http_status
-                    self._lifecycle_error(e)
+                    yield from self._lifecycle_error(e)
                     return
                 except faults.FaultInjected as e:
                     # an injected route_pick fault is a router bug
                     # stand-in: surfaces as a 500 the ingress counter sees
                     hop["error"] = "route_pick"
                     hop["status"] = 500
-                    self._error(500, str(e))
+                    yield from self._error(500, str(e))
                     return
                 tried.add(replica.name)
                 replica.begin()
-                conn = None
+                up = None
+                handed_off = False  # up's socket pooled or owned by a relay
                 t0 = time.monotonic()
                 hop["replica"] = replica.name
                 hop["t_conn"], hop["t_ttfb"] = t0, None
                 try:
                     try:
                         faults.fire("proxy_upstream")
-                        conn = http.client.HTTPConnection(
-                            replica.host, replica.port,
-                            timeout=st.connect_timeout_s)
-                        conn.request(method, self.path, body or None,
-                                     headers=self._upstream_headers())
-                        # two-phase timeout: strict on connect/status-line,
-                        # then unlimited (or --upstream-timeout) for the
-                        # body — a long decode must not trip the connect
-                        # timeout
-                        if conn.sock is not None:
-                            conn.sock.settimeout(
-                                st.upstream_timeout_s or None)
-                        resp = conn.getresponse()
+                        up = yield from evloop.open_upstream(
+                            self.server.pool, replica.host, replica.port,
+                            self._deadline(st.connect_timeout_s))
+                        yield from up.request(
+                            method, self.path, self._upstream_headers(),
+                            body, self._deadline(st.connect_timeout_s))
+                        # two-phase deadline: strict on connect/send, then
+                        # the first-byte budget for the status line, then
+                        # unlimited (or --upstream-timeout) for the body —
+                        # a long decode must not trip the connect timeout
+                        resp = yield from up.get_response(
+                            self._first_byte_deadline())
                         st._m_ttfb.observe((time.monotonic() - t0) * 1000.0)
                         hop["t_ttfb"] = time.monotonic()
                         hop["status"] = resp.status
@@ -1597,10 +1809,11 @@ class RouterHandler(BaseHTTPRequestHandler):
                                      in (resp.getheader("Content-Type")
                                          or ""))
                         if not streaming:
-                            payload = (resp.status, resp.read(),
+                            payload = (resp.status,
+                                       (yield from resp.read_all(
+                                           self._body_deadline())),
                                        self._relay_headers(resp))
-                    except (OSError, http.client.HTTPException,
-                            faults.FaultInjected) as e:
+                    except (OSError, faults.FaultInjected) as e:
                         replica.mark_conn_failure()
                         st._m_upstream_errors.inc(replica=replica.name)
                         if st.flight is not None:
@@ -1614,8 +1827,8 @@ class RouterHandler(BaseHTTPRequestHandler):
                             continue
                         hop["error"] = "upstream"
                         hop["status"] = 502
-                        self._error(502,
-                                    f"upstream {replica.name} failed: {e}")
+                        yield from self._error(
+                            502, f"upstream {replica.name} failed: {e}")
                         return
                     if resp.status == 503:
                         # draining or scheduler-crashed: out of rotation
@@ -1631,15 +1844,22 @@ class RouterHandler(BaseHTTPRequestHandler):
                             st._m_retries.inc()
                             last_503 = payload
                             continue
-                        self._relay_buffered(*payload)
+                        yield from self._relay_buffered(*payload)
                         return
                     # a usable response (200/429/504/4xx/...): this hop is
                     # done retrying — forward it verbatim
                     replica.mark_conn_success()
                     if streaming:
-                        self._relay_sse(resp, conn, replica)
+                        handed_off = True  # the relay closes the socket
+                        yield from self._relay_sse(resp, up, replica)
                     else:
-                        self._relay_buffered(*payload)
+                        if resp.reusable and not up.buf:
+                            # fully-drained framed body on a keep-alive
+                            # socket: back to the pool for the next hop
+                            self.server.pool.put(replica.host, replica.port,
+                                                 up.sock)
+                            handed_off = True
+                        yield from self._relay_buffered(*payload)
                     if resp.status == 200 and affinity_hashes:
                         st.affinity.record(affinity_hashes, replica.name)
                     return
@@ -1647,8 +1867,8 @@ class RouterHandler(BaseHTTPRequestHandler):
                     # runs on every exit AND every retry `continue`: the
                     # in-flight count and the upstream socket never leak
                     replica.end()
-                    if conn is not None:
-                        conn.close()
+                    if up is not None and not handed_off:
+                        up.close()
         finally:
             self._finish_proxy(hop)
 
@@ -1721,86 +1941,88 @@ class RouterHandler(BaseHTTPRequestHandler):
                 out[k] = v
         return out
 
-    def _relay_buffered(self, status: int, body: bytes,
-                        headers: dict) -> None:
-        self.send_response(status)
-        for k, v in headers.items():
-            self.send_header(k, v)
-        self.send_header("Content-Length", str(len(body)))
-        self.send_header(HDR_REQUEST_ID, self._rid)
-        self.send_header(HDR_SERVER_TIMING, self._server_timing())
-        self.send_header("Connection", "close")
-        self.end_headers()
-        self._count(status)
-        try:
-            self.wfile.write(body)
-        except OSError:
-            # client vanished before the (already complete) body landed:
-            # nothing upstream to cancel, nothing to retry
-            self.state._m_client_disconnects.inc()
+    @loop_callback
+    def _relay_buffered(self, status: int, body: bytes, headers: dict):
+        # client vanishing before the (already complete) body lands counts
+        # in _send and unwinds run(): nothing upstream to cancel or retry
+        yield from self._respond(status, list(headers.items()), body)
 
-    def _relay_sse(self, resp, conn, replica) -> None:
+    @loop_callback
+    def _relay_sse(self, resp, up, replica):
         """SSE passthrough: relay upstream bytes to the client as they
-        arrive (read1 returns per-recv, not per-buffer-fill, so chunk
-        latency adds no buffering delay) — byte-identical bodies.
+        arrive (one chunk in hand at a time — the client write completes
+        before the next upstream read, which IS the backpressure: a slow
+        client pauses its upstream instead of growing router RSS) —
+        byte-identical bodies.
 
         The one stateful obligation: when the CLIENT disconnects
         mid-stream, close the UPSTREAM connection immediately — the
         replica's cancel-on-disconnect frees the decode slot within one
-        chunk. Closing at generator/handler GC instead would keep the
-        dead stream decoding for its full completion.
+        chunk. Closing at generator GC instead would keep the dead
+        stream decoding for its full completion.
 
         With ``--ckpt-interval`` > 0 the relay is RESUMABLE instead:
         event-aligned forwarding that strips ``dllama-ckpt`` control
         frames into the checkpoint store and, on upstream death without
-        ``[DONE]``, splices a sibling's /v1/kv/resume stream into this
-        same client connection (:meth:`_relay_sse_resumable`)."""
-        self.send_response(200)
-        self.send_header("Content-Type",
-                         resp.getheader("Content-Type", "text/event-stream"))
-        self.send_header("Cache-Control", "no-cache")
-        self.send_header("Connection", "close")
-        self.send_header(HDR_REQUEST_ID, self._rid)
-        upstream_timing = resp.getheader(HDR_SERVER_TIMING)
-        if upstream_timing:
-            self.send_header(HDR_SERVER_TIMING, upstream_timing)
-        self.send_header(HDR_SERVER_TIMING, self._server_timing())
-        self.end_headers()
-        self._count(200)
-        if self.state.ckpt_interval > 0:
-            self._relay_sse_resumable(resp, conn, replica)
-            return
+        ``[DONE]`` — including a SILENT upstream past the inter-byte
+        stall budget — splices a sibling's /v1/kv/resume stream into
+        this same client connection (:meth:`_relay_sse_resumable`)."""
+        self.close_after = True  # SSE is EOF-delimited toward the client
         try:
+            hs = [("Content-Type",
+                   resp.getheader("Content-Type") or "text/event-stream"),
+                  ("Cache-Control", "no-cache"),
+                  ("Connection", "close"),
+                  (HDR_REQUEST_ID, self._rid)]
+            upstream_timing = resp.getheader(HDR_SERVER_TIMING)
+            if upstream_timing:
+                hs.append((HDR_SERVER_TIMING, upstream_timing))
+            hs.append((HDR_SERVER_TIMING, self._server_timing()))
+            self._count(200)
+            yield from self._send(evloop.response_bytes(200, hs))
+            if self.state.ckpt_interval > 0:
+                yield from self._relay_sse_resumable(resp, up, replica)
+                return
             while True:
                 try:
-                    chunk = resp.read1(65536)
-                except (OSError, http.client.HTTPException):
-                    break  # upstream died mid-stream: the partial body and
-                    #        missing [DONE] are the client's truncation signal
+                    chunk = yield from resp.read_some(self._stall_deadline())
+                except OSError:
+                    break  # upstream died/stalled mid-stream: the partial
+                    #        body and missing [DONE] are the client's
+                    #        truncation signal (no resume without ckpts)
                 if not chunk:
                     break
                 try:
-                    self.wfile.write(chunk)
-                    self.wfile.flush()
-                except OSError:
-                    self.state._m_client_disconnects.inc()
+                    yield from self._send(chunk)
+                except ClientGone:
                     break
         finally:
             # the immediacy guarantee: upstream socket down NOW, on every
             # exit path (client gone, upstream EOF, relay error)
-            conn.close()
+            up.close()
 
-    def _relay_sse_resumable(self, resp, conn, replica) -> None:
+    @loop_callback
+    def _relay_sse_resumable(self, resp, up, replica):
         """The failover relay (client headers already sent): forward the
         upstream stream EVENT-aligned, stripping ``dllama-ckpt`` control
         frames into the checkpoint store, and treat an upstream end
-        without ``[DONE]`` as a mid-stream death. One death resumes on a
-        sibling via :meth:`_resume_stream` — the continued stream's first
-        ``forwarded - offset`` bytes are what the client already holds
-        (bit-identical regeneration from the checkpoint), so they are
-        discarded and the splice leaves no repeat and no gap. A SECOND
-        death, or any fallback-matrix row, terminates cleanly: a typed
-        SSE ``error`` event + ``[DONE]`` instead of a bare TCP cut."""
+        without ``[DONE]`` as a mid-stream death — clean EOF/torn read
+        (cause ``eof``) and an upstream SILENT past the inter-byte
+        ``--stall-timeout`` budget (cause ``stall``) take the same resume
+        path, distinguished only in the outcome label. One death resumes
+        on a sibling via :meth:`_resume_stream` — the continued stream's
+        first ``forwarded - offset`` bytes are what the client already
+        holds (bit-identical regeneration from the checkpoint), so they
+        are discarded and the splice leaves no repeat and no gap. A
+        SECOND death, or any fallback-matrix row, terminates cleanly: a
+        typed SSE ``error`` event + ``[DONE]`` instead of a bare TCP cut.
+
+        The stall verdict gets one grace read (STALL_DRAIN_GRACE_S):
+        bytes already in flight at the expiry instant — including a
+        ``[DONE]`` that arrived in the same read as the budget ran out —
+        are delivered and FORGIVE the stall; only true silence fails
+        over. Without the grace, that race would fail over a stream the
+        client was one event away from completing."""
         st = self.state
         rid = self._rid
         forwarded = 0  # client-visible bytes forwarded (event-aligned —
@@ -1810,36 +2032,32 @@ class RouterHandler(BaseHTTPRequestHandler):
         client_gone = False
         owned = False  # True once `replica` was begin()-ed by a resume
         #                (the original caller begin/ends the FIRST hop)
-
-        def to_client(data: bytes) -> None:
-            nonlocal forwarded, client_gone
-            if client_gone or not data:
-                return
-            try:
-                self.wfile.write(data)
-                self.wfile.flush()
-            except OSError:
-                st._m_client_disconnects.inc()
-                client_gone = True
-            else:
-                forwarded += len(data)
-
-        def fail_stream(message: str) -> None:
-            # the torn-stream bugfix: resume exhausted -> the client gets
-            # a typed terminal error event and a [DONE], so "torn" is
-            # distinguishable from "complete" without timeout heuristics
-            to_client(b"data: " + json.dumps(
-                {"error": {"message": message, "type": "upstream_error",
-                           "code": 502}}).encode() + b"\n\n")
-            to_client(b"data: [DONE]\n\n")
-
         try:
             while True:
                 scanner = observability.SSEScanner()
+                cause = "eof"
                 while True:  # one upstream's lifetime
                     try:
-                        chunk = resp.read1(65536)
-                    except (OSError, http.client.HTTPException):
+                        faults.fire("relay_stall")
+                        chunk = yield from resp.read_some(
+                            self._stall_deadline())
+                    except (evloop.LoopTimeout, faults.FaultInjected):
+                        # stall verdict — grace drain first: bytes already
+                        # in flight beat the expired budget
+                        chunk = resp.try_read_now()
+                        if not chunk:
+                            try:
+                                chunk = yield from resp.read_some(
+                                    time.monotonic()
+                                    + evloop.STALL_DRAIN_GRACE_S)
+                            except OSError:
+                                chunk = b""
+                        if not chunk:
+                            cause = "stall"
+                            break
+                        # data surfaced: forgive the stall and continue
+                        # with a fresh inter-byte budget
+                    except OSError:
                         chunk = b""  # a torn read is a death, same as EOF
                     if not chunk:
                         break
@@ -1863,7 +2081,13 @@ class RouterHandler(BaseHTTPRequestHandler):
                             skip = 0
                         if fields.get("data", b"").strip() == b"[DONE]":
                             saw_done = True
-                        to_client(ev)
+                        if not client_gone:
+                            try:
+                                yield from self._send(ev)
+                            except ClientGone:
+                                client_gone = True
+                            else:
+                                forwarded += len(ev)
                     if client_gone or saw_done:
                         break
                 if saw_done or client_gone:
@@ -1874,7 +2098,7 @@ class RouterHandler(BaseHTTPRequestHandler):
                 if st.flight is not None:
                     st.flight.record("upstream_stream_death",
                                      replica=replica.name, request_id=rid,
-                                     forwarded=forwarded)
+                                     forwarded=forwarded, cause=cause)
                 if owned:
                     # second death during resume: the fallback matrix says
                     # terminate cleanly, don't chase replicas forever
@@ -1882,35 +2106,56 @@ class RouterHandler(BaseHTTPRequestHandler):
                         "exhausted", {"dead": replica.name,
                                       "forwarded": forwarded},
                         time.monotonic())
-                    fail_stream("upstream replica died again after a "
-                                "resume; stream incomplete")
+                    yield from self._fail_stream(
+                        "upstream replica died again after a "
+                        "resume; stream incomplete")
                     return
-                got = self._resume_stream(rid, replica, forwarded)
+                got = yield from self._resume_stream(rid, replica,
+                                                     forwarded, cause)
                 if isinstance(got, str):
-                    fail_stream(got)  # outcome already accounted
+                    yield from self._fail_stream(got)  # already accounted
                     return
-                conn.close()  # the dead upstream's socket
-                resp, conn, replica, offset = got
+                up.close()  # the dead upstream's socket
+                resp, up, replica, offset = got
                 skip = forwarded - offset
                 owned = True
         finally:
-            conn.close()
+            up.close()
             if owned:
                 replica.end()
             st.ckpt_store.pop(rid)
 
-    def _resume_stream(self, rid: str, dead, forwarded: int):
+    @loop_callback
+    def _fail_stream(self, message: str):
+        # the torn-stream obligation: resume exhausted -> the client gets
+        # a typed terminal error event and a [DONE], so "torn" is
+        # distinguishable from "complete" without timeout heuristics
+        try:
+            yield from self._send(
+                b"data: " + json.dumps(
+                    {"error": {"message": message, "type": "upstream_error",
+                               "code": 502}}).encode()
+                + b"\n\ndata: [DONE]\n\n")
+        except ClientGone:
+            pass  # the client is gone; there is no one left to tell
+
+    @loop_callback
+    def _resume_stream(self, rid: str, dead, forwarded: int,
+                       cause: str = "eof"):
         """One resume orchestration after ``dead`` died mid-SSE at byte
         ``forwarded``. Fires the ``resume`` seam at the decision point.
 
-        Returns ``(resp, conn, replica, offset)`` on success — outcome
-        "ok", the sibling's in-flight count held (begin without end) until
-        the relay finishes — or a client-facing failure message string
-        with the fallback-matrix outcome (no_ckpt / stale_ckpt /
-        no_replica / admit_failed / injected) already accounted."""
+        Returns ``(resp, up, replica, offset)`` on success — outcome
+        "ok" (or "stall" when the death verdict came from the inter-byte
+        stall budget), the sibling's in-flight count held (begin without
+        end) until the relay finishes — or a client-facing failure
+        message string with the fallback-matrix outcome (no_ckpt /
+        stale_ckpt / no_replica / admit_failed / injected) already
+        accounted."""
         st = self.state
         outcome = "no_ckpt"
-        detail: dict = {"dead": dead.name, "forwarded": forwarded}
+        detail: dict = {"dead": dead.name, "forwarded": forwarded,
+                        "cause": cause}
         t0 = time.monotonic()
         try:
             try:
@@ -1943,23 +2188,22 @@ class RouterHandler(BaseHTTPRequestHandler):
                 detail["sibling"] = sibling.name
                 sibling.begin()
                 ok = False
-                conn = None
+                up = None
                 try:
                     try:
                         faults.fire("proxy_upstream")
-                        conn = http.client.HTTPConnection(
-                            sibling.host, sibling.port,
-                            timeout=st.connect_timeout_s)
+                        up = yield from evloop.open_upstream(
+                            self.server.pool, sibling.host, sibling.port,
+                            self._deadline(st.connect_timeout_s))
                         headers = self._upstream_headers()
                         headers["Content-Type"] = kv_transfer.CONTENT_TYPE
-                        conn.request("POST", "/v1/kv/resume",
-                                     entry["payload"], headers=headers)
-                        if conn.sock is not None:
-                            conn.sock.settimeout(
-                                st.upstream_timeout_s or None)
-                        resp = conn.getresponse()
-                    except (OSError, http.client.HTTPException,
-                            faults.FaultInjected) as e:
+                        yield from up.request(
+                            "POST", "/v1/kv/resume", headers,
+                            entry["payload"],
+                            self._deadline(st.connect_timeout_s))
+                        resp = yield from up.get_response(
+                            self._first_byte_deadline())
+                    except (OSError, faults.FaultInjected) as e:
                         sibling.mark_conn_failure()
                         st._m_upstream_errors.inc(replica=sibling.name)
                         detail["error"] = repr(e)[:200]
@@ -1975,14 +2219,16 @@ class RouterHandler(BaseHTTPRequestHandler):
                         detail["status"] = resp.status
                         continue
                     sibling.mark_conn_success()
-                    outcome = "ok"
+                    # a successful resume after a STALL death is the stall
+                    # outcome — the row BENCH_C10K asserts on
+                    outcome = "stall" if cause == "stall" else "ok"
                     ok = True
-                    return resp, conn, sibling, offset
+                    return resp, up, sibling, offset
                 finally:
                     if not ok:
                         sibling.end()
-                        if conn is not None:
-                            conn.close()
+                        if up is not None:
+                            up.close()
             outcome = "admit_failed" if attempted else "no_replica"
             return ("no sibling replica accepted the checkpoint; "
                     "stream incomplete" if attempted else
@@ -2015,8 +2261,43 @@ class RouterHandler(BaseHTTPRequestHandler):
 
 def create_router_server(state: RouterState, host: str = "0.0.0.0",
                          port: int = 9900):
-    handler = type("Handler", (RouterHandler,), {"state": state})
-    return ThreadingHTTPServer((host, port), handler)
+    """The router's event-loop front door: one selectors loop carrying
+    every client connection as a coroutine (same server_address /
+    serve_forever / shutdown / server_close surface the threaded server
+    had). Admission runs at accept time, BEFORE any per-connection state
+    exists: the ``conn_accept`` seam fires first (injectable shed), then
+    ``--max-conns`` sheds with a canned 503 + Retry-After — an overloaded
+    router refuses cheaply instead of degrading every live stream."""
+    shed_body = json.dumps(
+        {"error": {"message": "router at connection capacity",
+                   "type": "server_error"}}).encode()
+    retry_after = str(max(1, int(round(max(1.0, state.probe_interval_s)))))
+    shed_response = evloop.response_bytes(503, [
+        ("Content-Type", "application/json"),
+        ("Retry-After", retry_after),
+        ("Content-Length", str(len(shed_body))),
+        ("Connection", "close"),
+    ], shed_body)
+
+    def gate(server):
+        try:
+            faults.fire("conn_accept")
+        except faults.FaultInjected:
+            return "injected"
+        if state.max_conns and server.open_conns >= state.max_conns:
+            return "max_conns"
+        return None
+
+    def conn_handler(server, sock, addr):
+        return RouterConnection(server, state, sock, addr).run()
+
+    srv = evloop.EventLoopServer(
+        (host, port), conn_handler, gate=gate,
+        shed_response=shed_response,
+        on_shed=lambda reason: state._m_sheds.inc(reason=reason))
+    srv.pool = evloop.UpstreamPool()
+    state._m_conns.set_function(lambda: float(srv.open_conns))
+    return srv
 
 
 def state_from_args(args, replica_addrs: list) -> RouterState:
@@ -2041,6 +2322,12 @@ def state_from_args(args, replica_addrs: list) -> RouterState:
         ckpt_interval=getattr(args, "ckpt_interval", 32),
         ckpt_ttl_s=getattr(args, "ckpt_ttl", 600.0),
         ts_interval=getattr(args, "ts_interval", 1.0),
+        max_conns=getattr(args, "max_conns", 0),
+        header_timeout_s=getattr(args, "header_timeout", 10.0),
+        first_byte_timeout_s=getattr(args, "first_byte_timeout", 0.0),
+        stall_timeout_s=getattr(args, "stall_timeout", 0.0),
+        client_stall_timeout_s=getattr(args, "client_stall_timeout", 30.0),
+        probe_read_timeout_s=getattr(args, "probe_read_timeout", 2.0),
     )
 
 

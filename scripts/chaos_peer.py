@@ -1,0 +1,192 @@
+"""Adversarial TCP peers for the router's event-loop front door.
+
+Three client shapes that punish a threaded data plane and must be
+non-events for the selectors one (``dllama_tpu/serving/evloop.py``):
+
+* ``slowloris`` — opens connections and dribbles request-HEADER bytes a
+  few at a time, forever. Against a thread-per-connection server each
+  peer pins a thread; against the event loop each peer is one idle fd
+  that dies at ``--header-timeout``.
+* ``midstream_hang`` — starts a real streaming request, reads the first
+  bytes of the SSE response, then STOPS READING while holding the
+  socket open. The router's bounded relay buffer must pause the
+  upstream (structural backpressure) and hard-kill the peer at
+  ``--client-stall-timeout`` — without growing RSS in between.
+* ``reset`` — sends a partial request then closes with ``SO_LINGER(1, 0)``
+  so the kernel emits RST, not FIN: the router sees ECONNRESET at read
+  or write time and must tear down one connection's state, nothing else.
+
+Importable (``bench.py``'s BENCH_C10K chaos cohort drives these in
+threads — plain BLOCKING sockets on purpose, the chaos lives outside
+the loop under test) and runnable standalone::
+
+    python scripts/chaos_peer.py slowloris --port 9900 --peers 50 --duration 10
+
+Each run returns/prints a stats dict; a chaos peer being shed, killed,
+or reset is SUCCESS — the one outcome that may never happen is the
+router becoming unresponsive to well-behaved traffic, which is the
+cohort running next to these in BENCH_C10K.
+"""
+
+import argparse
+import json
+import socket
+import struct
+import threading
+import time
+
+_REQ_HEAD = (b"POST /v1/chat/completions HTTP/1.1\r\n"
+             b"Host: chaos\r\n"
+             b"Content-Type: application/json\r\n")
+_CHAT = (b'{"model": "m", "stream": true, '
+         b'"messages": [{"role": "user", "content": "chaos"}]}')
+
+
+def _connect(host: str, port: int, timeout: float = 5.0):
+    try:
+        return socket.create_connection((host, port), timeout=timeout)
+    except OSError:
+        return None  # shed at accept (503 + close) or refused: fine
+
+
+def slowloris(host: str, port: int, duration_s: float = 10.0,
+              drip_interval_s: float = 0.5) -> dict:
+    """ONE slow-loris peer: dribble header bytes until the router cuts
+    us off or the duration ends. Returns how far we got."""
+    stats = {"mode": "slowloris", "bytes_sent": 0, "cut_by_router": False}
+    sock = _connect(host, port)
+    if sock is None:
+        return stats
+    deadline = time.monotonic() + duration_s
+    body = _REQ_HEAD + b"Content-Length: 10\r\nX-Drip: "
+    i = 0
+    try:
+        while time.monotonic() < deadline:
+            # two bytes at a time, never a complete header block
+            chunk = body[i % len(body):][:2] or b"aa"
+            sock.sendall(chunk)
+            stats["bytes_sent"] += len(chunk)
+            i += 2
+            time.sleep(drip_interval_s)
+    except OSError:
+        stats["cut_by_router"] = True  # the header deadline did its job
+    finally:
+        sock.close()
+    return stats
+
+
+def midstream_hang(host: str, port: int, duration_s: float = 10.0,
+                   read_bytes: int = 1024) -> dict:
+    """ONE hanging-reader peer: start a stream, read a little, then go
+    silent with the socket open. A router with bounded relay buffers
+    kills us at the client-stall budget; one that buffers unboundedly
+    eats the whole stream into RSS instead."""
+    stats = {"mode": "midstream_hang", "got_stream": False,
+             "killed_by_router": False}
+    sock = _connect(host, port)
+    if sock is None:
+        return stats
+    try:
+        # a small receive window makes the backpressure bite early
+        sock.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF, 4096)
+    except OSError:
+        pass
+    try:
+        sock.sendall(_REQ_HEAD
+                     + b"Content-Length: %d\r\n\r\n" % len(_CHAT) + _CHAT)
+        sock.settimeout(5.0)
+        got = sock.recv(read_bytes)
+        stats["got_stream"] = bool(got)
+        # ... and now we stop reading. Hold the socket until the router
+        # kills it (recv on a dead socket returns b"" / raises) or the
+        # duration ends.
+        sock.settimeout(duration_s)
+        deadline = time.monotonic() + duration_s
+        while time.monotonic() < deadline:
+            time.sleep(0.25)
+            # poke with a 1-byte WRITE: reading would drain the stalled
+            # stream (the thing we refuse to do), and a read-side peek
+            # only shows the buffered backlog, never the FIN behind it.
+            # A closed connection turns the second poke into
+            # EPIPE/ECONNRESET; while alive the pokes are junk trailing
+            # the finished request that the router never parses (this
+            # connection dies before it could pipeline another).
+            try:
+                sock.send(b" ")
+            except OSError:
+                stats["killed_by_router"] = True
+                break
+    except OSError:
+        stats["killed_by_router"] = True
+    finally:
+        sock.close()
+    return stats
+
+
+def reset(host: str, port: int, after_bytes: int = 40) -> dict:
+    """ONE resetting peer: a partial request, then RST (SO_LINGER 1,0).
+    The router must see ECONNRESET on one connection and carry on."""
+    stats = {"mode": "reset", "sent_rst": False}
+    sock = _connect(host, port)
+    if sock is None:
+        return stats
+    try:
+        sock.sendall(_REQ_HEAD[:after_bytes])
+        sock.setsockopt(socket.SOL_SOCKET, socket.SO_LINGER,
+                        struct.pack("ii", 1, 0))
+        stats["sent_rst"] = True
+    except OSError:
+        pass
+    finally:
+        sock.close()  # with linger(1,0): RST, not FIN
+    return stats
+
+
+MODES = {"slowloris": slowloris, "midstream_hang": midstream_hang,
+         "reset": reset}
+
+
+def run_cohort(mode: str, host: str, port: int, peers: int,
+               duration_s: float) -> dict:
+    """``peers`` concurrent peers of one mode (each in a thread — these
+    are blocking sockets by design), merged stats."""
+    fn = MODES[mode]
+    results: list = [None] * peers
+    kwargs = {} if mode == "reset" else {"duration_s": duration_s}
+
+    def one(i):
+        results[i] = fn(host, port, **kwargs)
+
+    threads = [threading.Thread(target=one, args=(i,), daemon=True)
+               for i in range(peers)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(duration_s + 30.0)
+    merged: dict = {"mode": mode, "peers": peers}
+    for r in results:
+        for k, v in (r or {}).items():
+            if isinstance(v, bool):
+                merged[k] = merged.get(k, 0) + int(v)
+            elif isinstance(v, int):
+                merged[k] = merged.get(k, 0) + v
+    return merged
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="adversarial peers for the router front door")
+    ap.add_argument("mode", choices=sorted(MODES))
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, required=True)
+    ap.add_argument("--peers", type=int, default=10)
+    ap.add_argument("--duration", type=float, default=10.0)
+    args = ap.parse_args(argv)
+    out = run_cohort(args.mode, args.host, args.port, args.peers,
+                     args.duration)
+    print(json.dumps(out, sort_keys=True))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

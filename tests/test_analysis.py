@@ -904,6 +904,167 @@ def test_block002_negative_urlopen_outside_lock():
 
 
 # ---------------------------------------------------------------------------
+# LOOP-001: blocking calls in event-loop callbacks
+# ---------------------------------------------------------------------------
+
+
+def test_loop001_sleep_in_loop_callback():
+    src = _snippet("""
+        import time
+        from dllama_tpu.analysis.sanitize import loop_callback
+
+        @loop_callback
+        def tick():
+            time.sleep(0.5)
+        """)
+    hits = [f for f in analyze_source(src) if f.rule == "LOOP-001"]
+    assert len(hits) == 1 and not hits[0].suppressed
+    assert "tick()" in hits[0].message
+    assert "run_in_thread" in hits[0].message  # the fix is named
+
+
+def test_loop001_socket_and_http_io_flagged():
+    src = _snippet("""
+        from dllama_tpu.analysis.sanitize import loop_callback
+
+        @loop_callback
+        def relay(sock, conn):
+            sock.sendall(b"x")
+            data = sock.recv(4096)
+            conn.request("GET", "/ready")
+            return conn.getresponse(), data
+        """)
+    assert _rules(analyze_source(src)).count("LOOP-001") == 4
+
+
+def test_loop001_negative_unannotated_leaf():
+    # the evloop leaf primitives are deliberately UNannotated: the same
+    # calls without @loop_callback are not findings (no lock held either)
+    src = _snippet("""
+        import time
+
+        def recv_some(sock):
+            time.sleep(0.0)
+            return sock.recv(4096)
+        """)
+    assert "LOOP-001" not in _rules(analyze_source(src))
+
+
+def test_loop001_nested_annotated_def_reported_once():
+    # a nested def that is ITSELF annotated sits inside two annotated
+    # walks — the call must be reported exactly once
+    src = _snippet("""
+        import time
+        from dllama_tpu.analysis.sanitize import loop_callback
+
+        @loop_callback
+        def outer():
+            @loop_callback
+            def inner():
+                time.sleep(0.5)
+            yield inner
+        """)
+    assert _rules(analyze_source(src)).count("LOOP-001") == 1
+
+
+def test_loop001_nested_unannotated_def_inherits():
+    # nested defs run on the same loop thread: the annotation is NOT
+    # scoped away by an inner unannotated def
+    src = _snippet("""
+        import time
+        from dllama_tpu.analysis.sanitize import loop_callback
+
+        @loop_callback
+        def outer():
+            def inner():
+                time.sleep(0.5)
+            yield inner
+        """)
+    assert _rules(analyze_source(src)).count("LOOP-001") == 1
+
+
+def test_loop001_suppressible_with_reason():
+    src = _snippet("""
+        import time
+        from dllama_tpu.analysis.sanitize import loop_callback
+
+        @loop_callback
+        def tick():
+            time.sleep(0.0)  # dllama: allow[LOOP-001] reason=0s sleep is a yield hint
+        """)
+    hits = [f for f in analyze_source(src) if f.rule == "LOOP-001"]
+    assert len(hits) == 1 and hits[0].suppressed
+
+
+def test_loop_callback_runtime_decorator_is_transparent():
+    # the runtime annotation must not wrap: generators stay generators
+    @sanitize.loop_callback
+    def gen():
+        yield 1
+
+    assert getattr(gen, "__loop_callback__", False) is True
+    assert list(gen()) == [1]
+
+
+# ---------------------------------------------------------------------------
+# cross-module LOCK-001 suppression (method-level, SUP-002-audited)
+# ---------------------------------------------------------------------------
+
+_XMOD_HEAD = _snippet("""
+    import threading
+    from dllama_tpu.analysis.sanitize import guarded_by
+
+    @guarded_by("_lock", "_count")
+    class Gate:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._count = 0
+    """)
+
+
+def test_lock001_cross_module_allow_covers_whole_method():
+    """A def-line allow[LOCK-001] reason=cross-module:<callee> covers
+    EVERY write in the method (the external caller holding the lock is
+    invisible to the module-local proof) — and SUP-002 stays quiet
+    because the suppression is doing work."""
+    src = _XMOD_HEAD + (
+        "    def _bump(self):  # dllama: allow[LOCK-001] "
+        "reason=cross-module:fleet.Controller._apply\n"
+        "        self._count += 1\n"
+        "        self._count += 2\n")
+    findings = analyze_source(src)
+    lock1 = [f for f in findings if f.rule == "LOCK-001"]
+    assert len(lock1) == 2 and all(f.suppressed for f in lock1)
+    assert all(f.reason.startswith("cross-module:") for f in lock1)
+    assert "SUP-002" not in _rules(findings)
+
+
+def test_lock001_cross_module_allow_goes_stale():
+    # the method stopped writing unlocked: the allow has nothing left to
+    # suppress and SUP-002 flags it like any other stale comment
+    src = _XMOD_HEAD + (
+        "    def _bump(self):  # dllama: allow[LOCK-001] "
+        "reason=cross-module:fleet.Controller._apply\n"
+        "        with self._lock:\n"
+        "            self._count += 1\n")
+    findings = analyze_source(src)
+    assert "LOCK-001" not in _rules(findings)
+    assert "SUP-002" in _rules(findings)
+
+
+def test_lock001_plain_method_allow_stays_line_scoped():
+    # WITHOUT the cross-module: prefix a def-line allow keeps the old
+    # line-scoped semantics: only the line directly below is covered
+    src = _XMOD_HEAD + (
+        "    def _bump(self):  # dllama: allow[LOCK-001] "
+        "reason=publish only\n"
+        "        self._count += 1\n"
+        "        self._count += 2\n")
+    lock1 = [f for f in analyze_source(src) if f.rule == "LOCK-001"]
+    assert [f.suppressed for f in lock1] == [True, False]
+
+
+# ---------------------------------------------------------------------------
 # PROTO-001..004: wire-protocol conformance (mini serving/ tree)
 # ---------------------------------------------------------------------------
 
@@ -1220,8 +1381,8 @@ _DESYNCS = [
      "emit_frame(_SSE_CKPT_PREFIX",
      'emit_frame(b"event: dllama-ckpt2\\ndata: "'),
     ("hop-header", "dllama_tpu/serving/router.py",
-     "self.send_header(HDR_REQUEST_ID, self._rid)",
-     'self.send_header("X-Request-Id", self._rid)'),
+     "hs.append((HDR_REQUEST_ID, self._rid))",
+     'hs.append(("X-Request-Id", self._rid))'),
     ("site-metric", "dllama_tpu/faults.py",
      "SITE_METRICS = {",
      'SITE_METRICS = {\n    "bogus_site": "dllama_bogus_total",'),

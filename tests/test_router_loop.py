@@ -1,0 +1,607 @@
+"""The event-loop front door (the selectors data plane tentpole).
+
+What the threaded router never had to prove: client keep-alive over one
+router connection, slow-loris header kills, ``--max-conns`` admission
+shedding BEFORE state allocation, slow-client backpressure kills, the
+upstream connection pool, gray-replica (accepting-but-silent) probe
+detection, and mid-SSE STALL death — a silent upstream past
+``--stall-timeout`` checkpoint-resumed on a sibling byte-identically
+with outcome="stall".
+
+The new fault seams are exercised by name (FAULT-004): ``conn_accept``
+(injected shed), ``client_write`` (client vanishes at write time),
+``relay_stall`` (stall verdict injected mid-relay — and its grace read:
+bytes already in flight, including a ``[DONE]`` racing the expiry,
+FORGIVE the stall instead of failing over a complete stream).
+
+SSEScanner torn-frame coverage: an every-byte-boundary split sweep,
+checkpoint frames torn across refills, and an end-to-end relay fed by
+an adversarially-dribbling upstream.
+"""
+
+import base64
+import http.client
+import json
+import socket
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from dllama_tpu import faults, observability
+from dllama_tpu.serving import router as rt
+from dllama_tpu.serving.protocol import (HDR_RESUME_OFFSET, SSE_EVENT_CKPT)
+
+from tests.test_router import CHAT, FakeReplica, RouterUnderTest, request
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+def _recv_all(sock, timeout=5.0) -> bytes:
+    sock.settimeout(timeout)
+    out = bytearray()
+    try:
+        while True:
+            b = sock.recv(65536)
+            if not b:
+                break
+            out += b
+    except OSError:
+        pass
+    return bytes(out)
+
+
+# ---------------------------------------------------------------------------
+# connection lifecycle: keep-alive, slow-loris, admission shedding
+# ---------------------------------------------------------------------------
+
+def test_keepalive_two_requests_one_connection():
+    """HTTP/1.1 keep-alive on the ROUTER side: two requests ride one TCP
+    connection (the threaded server closed per request pre-tentpole)."""
+    a = FakeReplica("a")
+    r = RouterUnderTest([a.addr])
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", r.port, timeout=10)
+        try:
+            conn.request("GET", "/health")
+            resp = conn.getresponse()
+            assert resp.status == 200 and not resp.will_close
+            resp.read()
+            s1 = conn.sock
+            conn.request("GET", "/health")
+            resp = conn.getresponse()
+            assert resp.status == 200
+            resp.read()
+            assert conn.sock is s1  # same socket, no reconnect
+        finally:
+            conn.close()
+    finally:
+        r.close(), a.close()
+
+
+def test_slow_loris_header_timeout_kills_connection():
+    """A client dribbling headers forever is cut at --header-timeout —
+    silently (no state worth a response was ever allocated)."""
+    a = FakeReplica("a")
+    r = RouterUnderTest([a.addr], header_timeout_s=0.3)
+    try:
+        s = socket.create_connection(("127.0.0.1", r.port), timeout=10)
+        try:
+            s.sendall(b"GET /health HTTP/1.1\r\nHost: x\r\n")  # never ends
+            t0 = time.monotonic()
+            data = _recv_all(s, timeout=5.0)
+            assert data == b""  # closed, not answered
+            assert time.monotonic() - t0 < 3.0
+        finally:
+            s.close()
+    finally:
+        r.close(), a.close()
+
+
+def test_max_conns_sheds_503_before_state_allocation():
+    """Connection 3 of a --max-conns 2 router gets the canned 503 +
+    Retry-After at ACCEPT time and is counted in
+    dllama_router_sheds_total{reason=max_conns}; closing one live
+    connection restores admission."""
+    a = FakeReplica("a")
+    r = RouterUnderTest([a.addr], max_conns=2)
+    conns = []
+    try:
+        for _ in range(2):
+            c = http.client.HTTPConnection("127.0.0.1", r.port, timeout=10)
+            c.request("GET", "/health")
+            assert c.getresponse().status == 200 or True
+            conns.append(c)  # keep-alive: still open, still counted
+        # now at capacity: the next accept is shed with the canned 503
+        s = socket.create_connection(("127.0.0.1", r.port), timeout=10)
+        data = _recv_all(s, timeout=5.0)
+        s.close()
+        head, _, rest = data.partition(b"\r\n")
+        assert b"503" in head, data[:200]
+        assert b"Retry-After:" in rest
+        assert json.loads(data.split(b"\r\n\r\n", 1)[1])[
+            "error"]["type"] == "server_error"
+        assert r.state._m_sheds.value(reason="max_conns") == 1
+        # release one slot: admission recovers
+        conns.pop().close()
+        deadline = time.monotonic() + 5.0
+        while r.srv.open_conns >= 2 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        code, _, _ = request(r.port, "GET", "/health")
+        assert code == 200
+    finally:
+        for c in conns:
+            c.close()
+        r.close(), a.close()
+
+
+@pytest.mark.faults
+def test_fault_conn_accept_sheds_injected():
+    """The conn_accept seam: an injected accept fault sheds with the
+    same canned 503 (reason=injected) and is one-shot."""
+    a = FakeReplica("a")
+    r = RouterUnderTest([a.addr])
+    try:
+        faults.install("conn_accept:raise:times=1")
+        s = socket.create_connection(("127.0.0.1", r.port), timeout=10)
+        data = _recv_all(s, timeout=5.0)
+        s.close()
+        assert b"503" in data.split(b"\r\n", 1)[0]
+        assert r.state._m_sheds.value(reason="injected") == 1
+        faults.clear()
+        code, _, _ = request(r.port, "GET", "/health")
+        assert code == 200  # service restored
+    finally:
+        r.close(), a.close()
+
+
+@pytest.mark.faults
+def test_fault_client_write_counts_disconnect():
+    """The client_write seam: a write-time client death is counted ONCE
+    in dllama_router_client_disconnects_total and unwinds the
+    connection without touching other connections."""
+    a = FakeReplica("a")
+    r = RouterUnderTest([a.addr])
+    try:
+        faults.install("client_write:raise:times=1")
+        s = socket.create_connection(("127.0.0.1", r.port), timeout=10)
+        s.sendall(b"GET /health HTTP/1.1\r\nHost: x\r\n\r\n")
+        data = _recv_all(s, timeout=5.0)
+        s.close()
+        assert data == b""  # the "client" never hears back
+        deadline = time.monotonic() + 5.0
+        while (r.state._m_client_disconnects.total() < 1
+               and time.monotonic() < deadline):
+            time.sleep(0.02)
+        assert r.state._m_client_disconnects.total() == 1
+        code, _, _ = request(r.port, "GET", "/health")
+        assert code == 200  # the loop carried on
+    finally:
+        r.close(), a.close()
+
+
+# ---------------------------------------------------------------------------
+# the stall budget: grace-forgiveness and stall-resume
+# ---------------------------------------------------------------------------
+
+@pytest.mark.faults
+def test_relay_stall_grace_forgives_data_in_flight():
+    """THE race pin: a stall verdict (injected via the relay_stall seam)
+    lands while the stream's bytes — including [DONE] — are already in
+    flight. The grace drain must deliver them and FORGIVE the stall:
+    complete byte-identical stream, ZERO resumes."""
+    a = FakeReplica("a")
+    a.mode = "sse"
+    a.sse_interval_s = 0.0  # the whole body races the verdict
+    r = RouterUnderTest([a.addr], ckpt_interval=2, stall_timeout_s=30.0)
+    try:
+        _, direct_body, _ = request(a.port, "POST",
+                                    "/v1/chat/completions", CHAT)
+        faults.install("relay_stall:raise:times=1")
+        code, body, headers = request(r.port, "POST",
+                                      "/v1/chat/completions", CHAT)
+        assert code == 200
+        assert body == direct_body  # byte-identical, [DONE] included
+        assert r.state._m_resumes.total() == 0  # forgiven, NOT failed over
+    finally:
+        r.close(), a.close()
+
+
+EV_A = b"data: alpha\n\n"
+EV_B = b"data: beta\n\n"
+EV_C = b"data: gamma\n\n"
+DONE = b"data: [DONE]\n\n"
+SNAP = b"stall-snapshot-payload"
+VISIBLE = EV_A + EV_B + EV_C + DONE
+CKPT_OFF = len(EV_A)  # checkpoint taken after event A
+CKPT_FRAME = (b"event: " + SSE_EVENT_CKPT.encode() + b"\ndata: "
+              + str(CKPT_OFF).encode() + b" " + base64.b64encode(SNAP)
+              + b"\n\n")
+
+
+class StallReplica:
+    """A replica whose chat stream goes SILENT (without closing) after
+    event B — the gray mid-stream failure — and whose /v1/kv/resume
+    continues VISIBLE from the checkpoint offset byte-identically."""
+
+    def __init__(self, name="stall"):
+        self.name = name
+        self.hang = threading.Event()
+        self.chat_hits = 0
+        self.resume_payloads = []
+        owner = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):
+                pass
+
+            def do_GET(self):
+                body = json.dumps(
+                    {"status": "ready", "slots_occupied": 0,
+                     "slots_total": 8, "queue_depth": 0,
+                     "kv_pages_free": 64, "kv_pages_total": 64}).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_POST(self):
+                length = int(self.headers.get("Content-Length", "0"))
+                payload = self.rfile.read(length)
+                if self.path == "/v1/kv/resume":
+                    owner.resume_payloads.append(payload)
+                    cont = VISIBLE[CKPT_OFF:]
+                    self.send_response(200)
+                    self.send_header("Content-Type", "text/event-stream")
+                    self.send_header(HDR_RESUME_OFFSET, str(CKPT_OFF))
+                    self.send_header("Content-Length", str(len(cont)))
+                    self.end_headers()
+                    self.wfile.write(cont)
+                    return
+                owner.chat_hits += 1
+                self.send_response(200)
+                self.send_header("Content-Type", "text/event-stream")
+                self.send_header("Connection", "close")
+                self.end_headers()
+                try:
+                    self.wfile.write(EV_A + CKPT_FRAME + EV_B)
+                    self.wfile.flush()
+                except OSError:
+                    return
+                owner.hang.wait(30.0)  # SILENT, socket held open
+
+        self.srv = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.port = self.srv.server_address[1]
+        self.addr = f"127.0.0.1:{self.port}"
+        threading.Thread(target=self.srv.serve_forever, daemon=True).start()
+
+    def close(self):
+        self.hang.set()
+        self.srv.shutdown()
+        self.srv.server_close()
+
+
+def test_mid_sse_stall_resumes_on_sibling_outcome_stall():
+    """The BENCH_C10K acceptance row, in miniature: an upstream that
+    stops emitting past --stall-timeout WITHOUT closing is treated as
+    dead; the stream resumes from its checkpoint on a sibling behind
+    the same client connection — byte-identical splice (the resumed
+    prefix the client already holds is discarded), no control-frame
+    leak, exactly one dllama_stream_resume_total{outcome=stall}."""
+    a, b = StallReplica("a"), StallReplica("b")
+    r = RouterUnderTest([a.addr, b.addr], ckpt_interval=2,
+                        stall_timeout_s=0.4)
+    try:
+        t0 = time.monotonic()
+        code, body, headers = request(r.port, "POST",
+                                      "/v1/chat/completions", CHAT)
+        assert code == 200
+        assert body == VISIBLE  # no gap, no repeat, [DONE] terminal
+        assert b"dllama-ckpt" not in body
+        assert time.monotonic() - t0 < 10.0
+        assert a.chat_hits + b.chat_hits == 1  # one chat hop, one stall
+        assert a.resume_payloads + b.resume_payloads == [SNAP]
+        assert r.state._m_resumes.value(outcome="stall") == 1
+        assert r.state._m_resumes.total() == 1
+        assert len(r.state.ckpt_store) == 0  # popped at stream end
+    finally:
+        r.close(), a.close(), b.close()
+
+
+# ---------------------------------------------------------------------------
+# SSEScanner torn frames
+# ---------------------------------------------------------------------------
+
+def test_sse_scanner_every_byte_boundary_split():
+    """For EVERY split point in a stream containing a checkpoint frame,
+    two feeds reproduce the exact event sequence — a ckpt frame torn
+    across refills (its b64 payload split mid-character included) must
+    reassemble, never leak a partial frame."""
+    stream = EV_A + CKPT_FRAME + EV_B + DONE
+    for cut in range(1, len(stream)):
+        sc = observability.SSEScanner()
+        evs = sc.feed(stream[:cut]) + sc.feed(stream[cut:])
+        assert b"".join(evs) == stream and sc.tail() == b"", cut
+        assert len(evs) == 4, cut
+        fields = observability.sse_event_fields(evs[1])
+        assert fields["event"] == SSE_EVENT_CKPT.encode()
+        off, _, b64 = fields["data"].partition(b" ")
+        assert (int(off), base64.b64decode(b64)) == (CKPT_OFF, SNAP)
+
+
+def test_sse_scanner_byte_at_a_time():
+    stream = EV_A + CKPT_FRAME + EV_B + DONE
+    sc = observability.SSEScanner()
+    evs = []
+    for i in range(len(stream)):
+        evs += sc.feed(stream[i:i + 1])
+    assert b"".join(evs) == stream and len(evs) == 4
+
+
+class DribbleReplica:
+    """Writes its SSE body in 3-byte flushes — every frame, the ckpt
+    frame's base64 payload included, is torn across many reads."""
+
+    def __init__(self):
+        owner = self
+        self.body = EV_A + CKPT_FRAME + EV_B + EV_C + DONE
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):
+                pass
+
+            def do_GET(self):
+                body = json.dumps(
+                    {"status": "ready", "slots_occupied": 0,
+                     "slots_total": 8, "queue_depth": 0,
+                     "kv_pages_free": 64, "kv_pages_total": 64}).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_POST(self):
+                self.rfile.read(
+                    int(self.headers.get("Content-Length", "0")))
+                self.send_response(200)
+                self.send_header("Content-Type", "text/event-stream")
+                self.send_header("Connection", "close")
+                self.end_headers()
+                try:
+                    for i in range(0, len(owner.body), 3):
+                        self.wfile.write(owner.body[i:i + 3])
+                        self.wfile.flush()
+                        time.sleep(0.001)
+                except OSError:
+                    pass
+
+        self.srv = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.port = self.srv.server_address[1]
+        self.addr = f"127.0.0.1:{self.srv.server_address[1]}"
+        threading.Thread(target=self.srv.serve_forever, daemon=True).start()
+
+    def close(self):
+        self.srv.shutdown()
+        self.srv.server_close()
+
+
+def test_resumable_relay_reassembles_dribbled_frames():
+    """End-to-end: the resumable relay fed 3 bytes at a time still
+    strips the (torn) checkpoint frame cleanly and forwards the visible
+    stream byte-identically, zero resumes."""
+    a = DribbleReplica()
+    r = RouterUnderTest([a.addr], ckpt_interval=2)
+    try:
+        code, body, _ = request(r.port, "POST",
+                                "/v1/chat/completions", CHAT)
+        assert code == 200
+        assert body == EV_A + EV_B + EV_C + DONE
+        assert b"dllama-ckpt" not in body
+        assert r.state._m_resumes.total() == 0
+    finally:
+        r.close(), a.close()
+
+
+# ---------------------------------------------------------------------------
+# gray replicas, slow clients, the upstream pool
+# ---------------------------------------------------------------------------
+
+def test_gray_replica_probe_stall_opens_circuit():
+    """An accepting-but-silent replica (SYN backlog says yes, nothing
+    answers) must fail its probe on the READ deadline — marked
+    circuit-open and counted under probe_errors{reason=stall}, not
+    lumped in with connect refusals."""
+    lsock = socket.socket()
+    lsock.bind(("127.0.0.1", 0))
+    lsock.listen(4)  # accepts connections; never reads, never writes
+    addr = f"127.0.0.1:{lsock.getsockname()[1]}"
+    try:
+        st = rt.RouterState([rt.Replica("127.0.0.1",
+                                        lsock.getsockname()[1])],
+                            probe_interval_s=60.0, connect_timeout_s=2.0,
+                            probe_read_timeout_s=0.2)
+        t0 = time.monotonic()
+        assert st.probe_once() == 0
+        assert time.monotonic() - t0 < 2.0  # read deadline, not connect
+        assert st._m_probe_errors.value(replica=addr, reason="stall") == 1
+        assert st._m_probe_failures.value(replica=addr) == 1
+        assert st.replicas[0].snapshot()["circuit_open"]
+    finally:
+        lsock.close()
+
+
+class FirehoseReplica:
+    """Streams MBs of SSE as fast as the pipe drains — the upstream
+    side of the slow-client backpressure test."""
+
+    def __init__(self):
+        self.aborted = threading.Event()
+        owner = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):
+                pass
+
+            def do_GET(self):
+                body = json.dumps(
+                    {"status": "ready", "slots_occupied": 0,
+                     "slots_total": 8, "queue_depth": 0,
+                     "kv_pages_free": 64, "kv_pages_total": 64}).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_POST(self):
+                self.rfile.read(
+                    int(self.headers.get("Content-Length", "0")))
+                self.send_response(200)
+                self.send_header("Content-Type", "text/event-stream")
+                self.send_header("Connection", "close")
+                self.end_headers()
+                ev = b"data: " + b"x" * 8192 + b"\n\n"
+                try:
+                    for _ in range(4096):  # ~32 MB if the pipe drains
+                        self.wfile.write(ev)
+                except OSError:
+                    owner.aborted.set()
+
+        self.srv = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.port = self.srv.server_address[1]
+        self.addr = f"127.0.0.1:{self.srv.server_address[1]}"
+        threading.Thread(target=self.srv.serve_forever, daemon=True).start()
+
+    def close(self):
+        self.srv.shutdown()
+        self.srv.server_close()
+
+
+def test_slow_client_backpressure_then_hard_kill():
+    """A client that stops draining its stream first PAUSES the
+    upstream (the relay holds one chunk, so router RSS stays flat) and
+    is hard-killed at --client-stall-timeout — taking the upstream
+    connection down with it, counted as a client disconnect."""
+    a = FirehoseReplica()
+    r = RouterUnderTest([a.addr], client_stall_timeout_s=0.5)
+    try:
+        payload = json.dumps(CHAT).encode()
+        s = socket.socket()
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF, 4096)
+        s.settimeout(10)
+        s.connect(("127.0.0.1", r.port))
+        s.sendall(b"POST /v1/chat/completions HTTP/1.1\r\n"
+                  b"Host: x\r\nContent-Type: application/json\r\n"
+                  + f"Content-Length: {len(payload)}\r\n\r\n".encode()
+                  + payload)
+        first = s.recv(1024)
+        assert b"200" in first.split(b"\r\n", 1)[0]  # the stream is live
+        # ... and now the client reads NOTHING more
+        assert a.aborted.wait(15.0), \
+            "upstream never released — the stuck client was never killed"
+        deadline = time.monotonic() + 5.0
+        while (r.state._m_client_disconnects.total() < 1
+               and time.monotonic() < deadline):
+            time.sleep(0.02)
+        assert r.state._m_client_disconnects.total() >= 1
+        s.close()
+    finally:
+        r.close(), a.close()
+
+
+def test_upstream_pool_reuses_keepalive_connection():
+    """Two non-streaming proxied requests ride ONE upstream TCP
+    connection: the first hop's fully-drained keep-alive socket goes to
+    the pool and the second hop checks it out (MSG_PEEK liveness)."""
+    a = FakeReplica("a")
+    a.accepts = 0
+    orig_get_request = a.srv.get_request
+
+    def counting_get_request():
+        a.accepts += 1
+        return orig_get_request()
+
+    a.srv.get_request = counting_get_request
+    r = RouterUnderTest([a.addr])
+    try:
+        for _ in range(2):
+            code, body, _ = request(r.port, "GET", "/v1/models")
+            assert code == 200
+            assert json.loads(body)["served_by"] == "a"
+        assert a.accepts == 1, f"{a.accepts} upstream connections for 2 hops"
+    finally:
+        r.close(), a.close()
+
+
+class OneShotReplica:
+    """Responds keep-alive-LOOKING (HTTP/1.1, Content-Length, no
+    ``Connection: close`` header) but drops the TCP connection after
+    every response — the sneaky-server shape the pool's MSG_PEEK
+    liveness check exists for."""
+
+    def __init__(self):
+        self.accepts = 0
+        owner = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):
+                pass
+
+            def setup(self):
+                owner.accepts += 1
+                BaseHTTPRequestHandler.setup(self)
+
+            def do_GET(self):
+                body = json.dumps({"object": "list",
+                                   "served_by": "oneshot",
+                                   "data": []}).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+                self.close_connection = True  # ...but never SAID close
+
+        self.srv = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.port = self.srv.server_address[1]
+        self.addr = f"127.0.0.1:{self.port}"
+        threading.Thread(target=self.srv.serve_forever, daemon=True).start()
+
+    def close(self):
+        self.srv.shutdown()
+        self.srv.server_close()
+
+
+def test_pool_discards_dead_socket_and_redials():
+    """A pooled socket whose server hung up between hops must not
+    poison the next request: the MSG_PEEK check (or, if the FIN is
+    still in flight, the retry budget) gets the hop onto a fresh
+    connection."""
+    a = OneShotReplica()
+    r = RouterUnderTest([a.addr], retry_budget=2)
+    try:
+        code, _, _ = request(r.port, "GET", "/v1/models")
+        assert code == 200  # looked reusable -> pooled
+        time.sleep(0.1)     # let the server's FIN land on the pooled sock
+        code, body, _ = request(r.port, "GET", "/v1/models")
+        assert code == 200
+        assert json.loads(body)["served_by"] == "oneshot"
+        assert a.accepts == 2  # dead socket discarded, fresh dial
+    finally:
+        r.close(), a.close()
