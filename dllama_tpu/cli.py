@@ -515,6 +515,20 @@ def build_parser() -> argparse.ArgumentParser:
             "when >=2 rows are resident; needs the quantized shard_map TP "
             "path — dense or MoE runs warn and drop to monolithic)",
         )
+        sp.add_argument(
+            "--tp-reduce",
+            default="off",
+            choices=["off", "plain", "q80"],
+            help="row-parallel reduce direction for wo/w2: each K-shard is "
+            "repacked per device, full-width f32 partial sums ride a "
+            "pinned-order ppermute ring reduce-scatter, and the residual "
+            "add + rmsnorm fold into the scattered shard (the hidden-width "
+            "gather disappears). 'plain' keeps a deterministic bit-"
+            "reproducible summation order; 'q80' block-quantizes each hop "
+            "(~3.6x less reduce wire, error analytically bounded). Needs "
+            "the quantized shard_map TP path and shard-granularity-"
+            "divisible dims — anything else warns and drops to gather-only",
+        )
         sp.add_argument("--nthreads", type=int, default=None, help=argparse.SUPPRESS)
         if mode in ("inference", "generate"):
             sp.add_argument(
@@ -657,8 +671,16 @@ def load_engine(args):
             tp_note = f" x tp={n_tp} (shard_map)" if n_tp > 1 else ""
             print(f"🧮 weights resident as {wft} (fused dequant-matmul kernels){tp_note}")
             # with a mesh, each stacked tensor streams straight into its TP
-            # sharding — no device ever holds the whole quantized model
-            params = llama.quant_params_from_reader(reader, cfg, wft, mesh=mesh)
+            # sharding — no device ever holds the whole quantized model.
+            # --tp-reduce (when it will engage) streams wo/w2 straight into
+            # their per-shard K repacks, skipping an on-device re-pack
+            row_stream = False
+            if mesh is not None and getattr(args, "tp_reduce", "off") != "off":
+                from dllama_tpu.parallel.quant_tp import validate_tp_reduce
+
+                row_stream = validate_tp_reduce(cfg, wft, n_tp) is None
+            params = llama.quant_params_from_reader(
+                reader, cfg, wft, mesh=mesh, tp_reduce=row_stream)
         else:
             # bf16/f16/f32 request a dense on-device dtype for the weights
             # (dequantized at load when the file is q40/q80)
@@ -702,6 +724,10 @@ def load_engine(args):
         # knobs that would turn it on (the Engine only knows its inputs)
         print("⚠️  --tp-overlap needs --tp > 1 with quantized weights "
               "(q40/q80); running monolithic TP programs")
+    tp_reduce = getattr(args, "tp_reduce", "off")
+    if tp_reduce != "off" and (mesh is None or wft not in ("q40", "q80")):
+        print("⚠️  --tp-reduce needs --tp > 1 with quantized weights "
+              "(q40/q80); running gather-only TP programs")
     from dllama_tpu.runtime.generate import DECODE_CHUNK
 
     # explicit None check: an invalid explicit value (e.g. 0) must reach
@@ -709,12 +735,15 @@ def load_engine(args):
     chunk = getattr(args, "decode_chunk", None)
     engine = Engine(cfg, params, sampler_cfg, cache_dtype=cache_dtype, mesh=mesh,
                     tp_compress=compress_active, tp_overlap=tp_overlap,
+                    tp_reduce=tp_reduce,
                     decode_chunk=DECODE_CHUNK if chunk is None else chunk)
     if mesh is not None:
         wire = "q80-compressed" if compress_active else "plain"
         overlap = (", microbatch overlap" if engine.tp_overlap_active else "")
+        reduce_ = (f", row-parallel {engine.tp_reduce} reduce"
+                   if engine.tp_reduce_active else "")
         print(f"🔗 tensor-parallel over {n_tp} devices (ICI mesh, {wire} "
-              f"gathers{overlap})")
+              f"gathers{overlap}{reduce_})")
     return engine, tok, cfg
 
 

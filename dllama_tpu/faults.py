@@ -32,7 +32,10 @@ Spec grammar (sites separated by ``;``)::
   counted, proving the black box cannot crash the process) and
   ``overlap_split`` (every dispatch the Engine routes through a
   microbatch-overlap TP program — an injected failure there flows
-  through the same chunk error handling as a real one). The
+  through the same chunk error handling as a real one) and
+  ``tp_reduce`` (every dispatch served by the row-parallel
+  reduce-direction TP programs, Engine._reduce_dispatch — same chunk
+  error path). The
   disaggregation seams are ``kv_export`` (every KV page-stream export on
   a prefill replica), ``kv_import`` (every page-stream import/admit on a
   decode replica — a faulted import is a failed transfer the router's
@@ -97,7 +100,7 @@ import time
 SITES = ("admit", "step_chunk", "prefill", "prefill_chunk", "prefix_match",
          "page_alloc", "stream", "scheduler", "weights_open", "weights_read",
          "logits", "route_pick", "proxy_upstream", "probe",
-         "federate_scrape", "flight_dump", "overlap_split",
+         "federate_scrape", "flight_dump", "overlap_split", "tp_reduce",
          "kv_export", "kv_import", "migrate", "ckpt_write", "resume",
          "preempt", "ts_sample", "alert_eval", "policy_eval", "scale_up",
          "scale_down", "conn_accept", "relay_stall", "client_write")
@@ -136,6 +139,10 @@ SITE_METRICS = {
     # program (Engine._overlap_engaged) — a faulted split takes the same
     # error path as a real chunk failure
     "overlap_split": "dllama_tp_overlap_chunks_total",
+    # every dispatch the row-parallel reduce-direction TP programs serve
+    # (Engine._reduce_dispatch) — a faulted dispatch takes the same chunk
+    # error path as a real one
+    "tp_reduce": "dllama_tp_reduce_chunks_total",
     # disaggregation seams: a faulted export/import is a failed transfer
     # the exporting/importing replica counts; a faulted migration is a
     # router-side fallback to re-prefill on the decode replica

@@ -34,7 +34,12 @@ from dllama_tpu.ops.qmatmul import (
     quantize_tensor, slice_to_in_features,
 )
 from dllama_tpu.ops.rope import apply_rope, rope_table
-from dllama_tpu.parallel.collectives import gather_columns as _gather
+from dllama_tpu.parallel.collectives import (
+    gather_columns as _gather,
+    reduce_scatter_columns as _reduce_scatter,
+    rms_inv_scattered as _rms_inv,
+    scatter_features as _scatter,
+)
 
 
 # ---------------------------------------------------------------------------
@@ -151,7 +156,8 @@ def quantize_params(params: dict, kind: str, quantize_wcls: bool = True) -> dict
 
 def quant_params_from_reader(reader: WeightFileReader, cfg: ModelConfig,
                              kind: str = "q40", mesh=None,
-                             fuse: bool = True) -> dict:
+                             fuse: bool = True,
+                             tp_reduce: bool = False) -> dict:
     """Load a `.m` file with the big matrices kept block-quantized for the
     fused kernels. When the file's own float type matches ``kind``, the file
     bits are repacked losslessly (no dequant->requant roundtrip), so decode
@@ -195,8 +201,11 @@ def quant_params_from_reader(reader: WeightFileReader, cfg: ModelConfig,
         quant_tp.validate_quant_tp(cfg, n_tp)
 
         def place(name: str, leaf, sharded: bool):
-            leaf = quant_tp.prepare_quant_leaf(name, leaf, cfg, n_tp)
-            specs = quant_tp.leaf_specs(leaf, sharded)
+            leaf = quant_tp.prepare_quant_leaf(name, leaf, cfg, n_tp,
+                                               tp_reduce=tp_reduce)
+            row = (tp_reduce and name in quant_tp.ROW_SHARDED_MATRICES
+                   and isinstance(leaf, QuantTensor))
+            specs = quant_tp.leaf_specs(leaf, sharded, row=row)
             return jax.tree.map(
                 lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), leaf, specs
             )
@@ -273,9 +282,13 @@ def quant_params_from_reader(reader: WeightFileReader, cfg: ModelConfig,
             per_specs = None
             for i in range(cfg.n_layers):
                 leaf = quant_tp.prepare_quant_leaf(
-                    name, load_layer_leaf(i, name), cfg, n_tp)
+                    name, load_layer_leaf(i, name), cfg, n_tp,
+                    tp_reduce=tp_reduce)
                 if stack is None:
-                    per_specs = quant_tp.leaf_specs(leaf, sharded)
+                    row = (tp_reduce
+                           and name in quant_tp.ROW_SHARDED_MATRICES
+                           and isinstance(leaf, QuantTensor))
+                    per_specs = quant_tp.leaf_specs(leaf, sharded, row=row)
                     out_sh = jax.tree.map(
                         lambda x, s: NamedSharding(mesh, P(None, *tuple(s))),
                         leaf, per_specs,
@@ -565,6 +578,58 @@ def _norm_proj(x, norm_w, w, layer, eps):
     return matmul_any(rmsnorm(x, norm_w, eps), w, layer)
 
 
+def _check_tp_reduce(cfg: ModelConfig, tp_reduce) -> bool:
+    """Static validation of the row-parallel reduce mode; True when active.
+
+    MoE is rejected at trace time with the same machine-visible style as
+    ``_check_overlap_split``: the expert stacks keep output-axis shards
+    (every device holds a slice of EVERY expert), so there is no K-sharded
+    down-projection to feed partials from."""
+    if tp_reduce is None:
+        return False
+    if tp_reduce not in ("plain", "q80"):
+        raise ValueError(f"tp_reduce must be None, 'plain' or 'q80', "
+                         f"got {tp_reduce!r}")
+    if cfg.is_moe:
+        raise ValueError(
+            "tp_reduce requires a dense FFN: MoE expert stacks shard their "
+            "output axis (a slice of every expert per device), so no "
+            "row-parallel down-projection exists to produce partial sums")
+    return True
+
+
+def _row_norm_gather(x_s: jnp.ndarray, norm_w, tp_axis, tp_compress: bool,
+                     eps: float, full_dim: int) -> jnp.ndarray:
+    """The fused norm+reduce epilogue's gather half: rmsnorm the SCATTERED
+    residual ``[..., dim/tp]`` (one scalar psum for the mean-square, see
+    ``collectives.rms_inv_scattered``) and all-gather the normalized rows.
+    The full-width gather that the un-fused path would spend reassembling
+    the raw residual is gone — the one gather per sub-block now carries the
+    next matmul's already-normalized input. Mirrors ``ops.norms.rmsnorm``'s
+    f32 accumulation and ``w * (x * inv)`` ordering."""
+    inv = _rms_inv(x_s, tp_axis, full_dim, eps)
+    xn = _gather((x_s.astype(jnp.float32) * inv[..., None]).astype(x_s.dtype),
+                 tp_axis, tp_compress)
+    return (norm_w.astype(jnp.float32) * xn.astype(jnp.float32)
+            ).astype(x_s.dtype)
+
+
+def _dense_ffn_row(cfg: ModelConfig, lp: dict, xn: jnp.ndarray,
+                   layer=None) -> jnp.ndarray:
+    """Row-parallel FFN half on the ALREADY-NORMALIZED full-width input:
+    w1/w3 emit their local output shards, which feed the K-sharded w2
+    directly — no hidden-width gather at all (the row-parallel point: the
+    gathered hidden is ~2.7x dim for 7B). Returns [T, dim] f32 PARTIAL sums
+    for the caller's ring reduce-scatter. ``lp['w2']`` is a
+    ``row_shard_quant_leaf`` repack whose ``k_logical`` equals the local
+    hidden shard width, so the quant kernel pads the activation to the
+    per-shard K itself."""
+    act = ACTIVATIONS[cfg.hidden_act]
+    h = (act(matmul_any(xn, lp["w1"], layer))
+         * matmul_any(xn, lp["w3"], layer))
+    return matmul_any(h, lp["w2"], layer).astype(jnp.float32)
+
+
 def _dense_ffn(cfg: ModelConfig, lp: dict, x: jnp.ndarray, norm_w, tp_axis=None,
                tp_compress: bool = False, layer=None) -> jnp.ndarray:
     """FFN half on the RAW (pre-norm) residual ``x``: the ``rms_ffn`` norm is
@@ -611,7 +676,8 @@ def _ffn_residual(cfg: ModelConfig, lp: dict, x: jnp.ndarray, att_out: jnp.ndarr
 
 
 def _attn_block(cfg: ModelConfig, lp: dict, rope: dict, x, k_cache, v_cache, pos,
-                tp_axis=None, tp_compress: bool = False, layer=None):
+                tp_axis=None, tp_compress: bool = False, layer=None,
+                row_mode: bool = False):
     """One attention sub-block. Returns (attn output [T, dim], new k/v cache).
 
     With ``tp_axis`` (inside shard_map, quantized TP): the projections are
@@ -624,11 +690,22 @@ def _attn_block(cfg: ModelConfig, lp: dict, rope: dict, x, k_cache, v_cache, pos
     With ``layer`` (the scalar-prefetch scan path): quant matrices in ``lp``
     are layer-stacked and k_cache/v_cache are the FULL [L, S, kv, hd] caches;
     the update touches only (layer, pos..pos+T) and the attention reads the
-    layer's slab. Without it, k_cache/v_cache are this layer's [S, kv, hd]."""
+    layer's slab. Without it, k_cache/v_cache are this layer's [S, kv, hd].
+
+    ``row_mode`` (the --tp-reduce row-parallel path): ``x`` arrives ALREADY
+    normalized (the caller's fused norm+gather epilogue), so the projections
+    skip ``_norm_proj``; and ``wo`` is K-sharded, so the LOCAL head concat
+    feeds it with NO gather and the return value is a full-width f32
+    PARTIAL sum for the caller's ring reduce-scatter — both of the attention
+    sub-block's gathers disappear."""
     T = x.shape[0]
     eps = cfg.norm_eps
 
-    if "wqkv" in lp:  # fused single-kernel projection (fuse_qkv_ffn; no TP)
+    if row_mode:  # pre-normalized input; rms_att was applied by the caller
+        q = matmul_any(x, lp["wq"], layer)
+        k = matmul_any(x, lp["wk"], layer)
+        v = matmul_any(x, lp["wv"], layer)
+    elif "wqkv" in lp:  # fused single-kernel projection (fuse_qkv_ffn; no TP)
         qkv = _norm_proj(x, lp["rms_att"], lp["wqkv"], layer, eps)
         d, kv = cfg.dim, cfg.kv_dim
         q = qkv[:, :d]
@@ -681,6 +758,11 @@ def _attn_block(cfg: ModelConfig, lp: dict, rope: dict, x, k_cache, v_cache, pos
             k_slab = jax.lax.dynamic_index_in_dim(k_cache, layer, 0, keepdims=False)
             v_slab = jax.lax.dynamic_index_in_dim(v_cache, layer, 0, keepdims=False)
             out = gqa_attention(q, k_slab, v_slab, pos)
+    if row_mode:
+        # local heads feed the K-sharded wo directly: no head gather, no
+        # output gather — the [T, dim] f32 partial rides the ring reduce
+        return (matmul_any(out.reshape(T, -1), lp["wo"], layer)
+                .astype(jnp.float32), k_cache, v_cache)
     out = _gather(out.reshape(T, -1), tp_axis, tp_compress)  # local heads -> full
     return _gather(matmul_any(out, lp["wo"], layer), tp_axis, tp_compress), k_cache, v_cache
 
@@ -697,6 +779,7 @@ def forward(
     tp_compress: bool = False,
     allow_flash: bool = True,
     last_pos=None,
+    tp_reduce=None,
 ) -> tuple:
     """Process T tokens starting at ``pos``. Returns (logits [T, vocab] f32, new cache).
 
@@ -720,11 +803,23 @@ def forward(
     and at a 128k vocab the [bucket, vocab] classifier matmul dwarfs the
     one row actually consumed; every layer still processes (and caches) all
     T positions.
+
+    ``tp_reduce`` (None | 'plain' | 'q80'): the row-parallel reduce path —
+    wo/w2 are K-sharded (``quant_tp.row_shard_quant_leaf`` repacks), the
+    residual rides the layer scan SCATTERED to [T, dim/tp], each sub-block's
+    partial sums take a ring reduce-scatter (Q80-compressed hops when
+    'q80'), and the fused norm+reduce epilogue folds residual-add + rmsnorm
+    into the scattered shard so the one gather per sub-block carries the
+    next matmul's already-normalized input. Quantized shard_map path only.
     """
     x = embed(cfg, params, tokens)
     layers = params["layers"]
-
     quant_scan = any(isinstance(v, QuantTensor) for v in layers.values())
+    # row mode needs the quantized index-scan (row_shard_quant_leaf repacks
+    # quant planes; the Engine declines it elsewhere)
+    row = (_check_tp_reduce(cfg, tp_reduce) and tp_axis is not None
+           and quant_scan)
+    red_compress = tp_reduce == "q80"
     # Dense weights normally scan the layer stack as scan-xs (per-layer
     # slabs); when flash decode engages, take the index-scan instead so the
     # stacked KV cache rides the carry and the flash kernel reads its live
@@ -740,6 +835,9 @@ def forward(
         # a scalar-prefetched idx steers each kernel's own DMA straight into
         # the stacked plane (qmatmul.*_stacked) and the KV cache is updated
         # in place at (idx, pos).
+        if row:
+            x = _scatter(x, tp_axis)  # residual rides the scan scattered
+
         def layer_step(carry, idx):
             x, k_cache, v_cache = carry
             lp = {
@@ -747,6 +845,20 @@ def forward(
                        else jax.lax.dynamic_index_in_dim(leaf, idx, 0, keepdims=False))
                 for name, leaf in layers.items()
             }
+            if row:
+                xn = _row_norm_gather(x, lp["rms_att"], tp_axis, tp_compress,
+                                      cfg.norm_eps, cfg.dim)
+                att_p, k_cache, v_cache = _attn_block(
+                    cfg, lp, rope, xn, k_cache, v_cache, pos, tp_axis,
+                    tp_compress, layer=idx, row_mode=True)
+                x = x + _reduce_scatter(att_p, tp_axis,
+                                        red_compress).astype(x.dtype)
+                xn = _row_norm_gather(x, lp["rms_ffn"], tp_axis, tp_compress,
+                                      cfg.norm_eps, cfg.dim)
+                ffn_p = _dense_ffn_row(cfg, lp, xn, layer=idx)
+                x = x + _reduce_scatter(ffn_p, tp_axis,
+                                        red_compress).astype(x.dtype)
+                return (x, k_cache, v_cache), None
             att_out, k_cache, v_cache = _attn_block(
                 cfg, lp, rope, x, k_cache, v_cache, pos, tp_axis, tp_compress,
                 layer=idx,
@@ -773,7 +885,13 @@ def forward(
 
     if last_pos is not None:
         x = jax.lax.dynamic_slice_in_dim(x, last_pos, 1, axis=0)
-    x = rmsnorm(x, params["rms_final"], cfg.norm_eps)
+    if row:
+        # one last fused norm+gather reassembles the scattered residual
+        # already normalized for the classifier
+        x = _row_norm_gather(x, params["rms_final"], tp_axis, tp_compress,
+                             cfg.norm_eps, cfg.dim)
+    else:
+        x = rmsnorm(x, params["rms_final"], cfg.norm_eps)
     logits = matmul_any(x, params["wcls"]).astype(jnp.float32)
     if tp_axis is not None and gather_logits:
         # slice off any lane-alignment vocab padding (zero logits there would
@@ -799,7 +917,7 @@ def init_batch_cache(cfg: ModelConfig, batch: int, cache_dtype=jnp.float32,
 
 def _attn_block_batched(cfg: ModelConfig, lp: dict, rope: dict, x, k_cache,
                         v_cache, pos, layer=None, tp_axis=None,
-                        tp_compress: bool = False):
+                        tp_compress: bool = False, row_mode: bool = False):
     """Batched-decode attention: x [B, dim] carries B INDEPENDENT sequences,
     each at its own position pos[b]. The projections are ordinary [B, K]
     matmuls (identical to a T=B prefill row block — the quant kernels need
@@ -807,10 +925,16 @@ def _attn_block_batched(cfg: ModelConfig, lp: dict, rope: dict, x, k_cache,
     vmap over the pure-jnp attention. Caches are [L, B, S, kv, hd] under the
     layer scan (``layer`` given) or this layer's [B, S, kv, hd] slab.
     ``tp_axis`` (inside shard_map): local heads + kv-shard cache, activation
-    gathers after the head concat and the wo matmul, exactly `_attn_block`."""
+    gathers after the head concat and the wo matmul, exactly `_attn_block`.
+    ``row_mode``: pre-normalized input, K-sharded wo, f32 partial output —
+    see ``_attn_block``."""
     B = x.shape[0]
     eps = cfg.norm_eps
-    if "wqkv" in lp:
+    if row_mode:  # pre-normalized input; rms_att was applied by the caller
+        q = matmul_any(x, lp["wq"], layer)
+        k = matmul_any(x, lp["wk"], layer)
+        v = matmul_any(x, lp["wv"], layer)
+    elif "wqkv" in lp:
         qkv = _norm_proj(x, lp["rms_att"], lp["wqkv"], layer, eps)
         d, kv = cfg.dim, cfg.kv_dim
         q, k, v = qkv[:, :d], qkv[:, d : d + kv], qkv[:, d + kv :]
@@ -874,6 +998,9 @@ def _attn_block_batched(cfg: ModelConfig, lp: dict, rope: dict, x, k_cache,
         out = jax.vmap(
             lambda qb, ks, vs, p: gqa_attention(qb[None], ks, vs, p)[0]
         )(q, slab_k, slab_v, pos)  # [B, local heads, hs]
+    if row_mode:  # local heads -> K-sharded wo: no gathers, f32 partials
+        return (matmul_any(out.reshape(B, -1), lp["wo"], layer)
+                .astype(jnp.float32), k_cache, v_cache)
     out = _gather(out.reshape(B, -1), tp_axis, tp_compress)
     return (_gather(matmul_any(out, lp["wo"], layer), tp_axis, tp_compress),
             k_cache, v_cache)
@@ -890,6 +1017,7 @@ def forward_batched(
     gather_logits: bool = True,
     tp_compress: bool = False,
     allow_flash: bool = True,
+    tp_reduce=None,
 ) -> tuple:
     """One decode step for B independent sequences -> (logits [B, vocab], cache).
 
@@ -903,15 +1031,22 @@ def forward_batched(
     parallel.quant_tp.make_tp_forward_batched) — same gathers as ``forward``.
     ``allow_flash=False``: caller runs under pjit with sharded dense params
     (see ``forward``) — pin the dense xs-scan.
+    ``tp_reduce``: the row-parallel wo/w2 reduce path, see ``forward``.
     """
     x = embed(cfg, params, tokens)
     layers = params["layers"]
     quant_scan = any(isinstance(v, QuantTensor) for v in layers.values())
+    row = (_check_tp_reduce(cfg, tp_reduce) and tp_axis is not None
+           and quant_scan)
+    red_compress = tp_reduce == "q80"
     # same routing as `forward`: dense weights take the index-scan when the
     # batched flash kernel engages, so the stacked [L, B, S, kv, hd] cache
     # stays in the carry and each row reads only its own live prefix
     if quant_scan or (allow_flash and flash_decode.engages(
             1, cache["k"].shape[2], cache["k"].dtype)):
+        if row:
+            x = _scatter(x, tp_axis)  # residual rides the scan scattered
+
         def layer_step(carry, idx):
             x, k_cache, v_cache = carry
             lp = {
@@ -919,6 +1054,20 @@ def forward_batched(
                        else jax.lax.dynamic_index_in_dim(leaf, idx, 0, keepdims=False))
                 for name, leaf in layers.items()
             }
+            if row:
+                xn = _row_norm_gather(x, lp["rms_att"], tp_axis, tp_compress,
+                                      cfg.norm_eps, cfg.dim)
+                att_p, k_cache, v_cache = _attn_block_batched(
+                    cfg, lp, rope, xn, k_cache, v_cache, pos, layer=idx,
+                    tp_axis=tp_axis, tp_compress=tp_compress, row_mode=True)
+                x = x + _reduce_scatter(att_p, tp_axis,
+                                        red_compress).astype(x.dtype)
+                xn = _row_norm_gather(x, lp["rms_ffn"], tp_axis, tp_compress,
+                                      cfg.norm_eps, cfg.dim)
+                ffn_p = _dense_ffn_row(cfg, lp, xn, layer=idx)
+                x = x + _reduce_scatter(ffn_p, tp_axis,
+                                        red_compress).astype(x.dtype)
+                return (x, k_cache, v_cache), None
             att_out, k_cache, v_cache = _attn_block_batched(
                 cfg, lp, rope, x, k_cache, v_cache, pos, layer=idx,
                 tp_axis=tp_axis, tp_compress=tp_compress)
@@ -941,7 +1090,11 @@ def forward_batched(
         x, (new_k, new_v) = jax.lax.scan(
             layer_step, x, (layers, cache["k"], cache["v"])
         )
-    x = rmsnorm(x, params["rms_final"], cfg.norm_eps)
+    if row:
+        x = _row_norm_gather(x, params["rms_final"], tp_axis, tp_compress,
+                             cfg.norm_eps, cfg.dim)
+    else:
+        x = rmsnorm(x, params["rms_final"], cfg.norm_eps)
     logits = matmul_any(x, params["wcls"]).astype(jnp.float32)
     if tp_axis is not None and gather_logits:
         # slice off lane-alignment vocab padding, exactly like `forward`
@@ -986,6 +1139,7 @@ def forward_batched_overlap(
     tp_compress: bool = False,
     allow_flash: bool = True,
     ring: bool = True,
+    tp_reduce=None,
 ) -> tuple:
     """``forward_batched`` with the rows split into two microbatches whose
     per-layer schedules interleave — the TokenWeave-style compute/comm
@@ -1009,7 +1163,15 @@ def forward_batched_overlap(
     so splitting [B] into [B//2] + [B - B//2] permutes nothing. Both
     halves advance inside ONE layer scan, so weights still stream from
     HBM once per layer for all B rows. MoE is rejected (see
-    ``_check_overlap_split``)."""
+    ``_check_overlap_split``).
+
+    ``tp_reduce`` composes: each microbatch runs the row-parallel sequence
+    (fused norm+gather, K-sharded wo/w2, ring reduce-scatter) with the SAME
+    interleaving — the reduce-scatters are tp-1 ppermute hops by
+    construction, so they give the scheduler the same hop-granular
+    boundaries the ring gathers do. Row mode is NOT bit-identical to the
+    monolithic gather path (split-K reassociation); it IS the same math as
+    the non-overlap row-parallel step, microbatch-split exactly."""
     B = tokens.shape[0]
     h = _check_overlap_split(cfg, B)
     ga = _overlap_axis(tp_axis, ring)
@@ -1019,6 +1181,27 @@ def forward_batched_overlap(
     ka, kb = cache["k"][:, :h], cache["k"][:, h:]
     va, vb = cache["v"][:, :h], cache["v"][:, h:]
     layers = params["layers"]
+    quant_scan = any(isinstance(v, QuantTensor) for v in layers.values())
+    row = (_check_tp_reduce(cfg, tp_reduce) and tp_axis is not None
+           and quant_scan)
+    red_compress = tp_reduce == "q80"
+    if row:
+        xa, xb = _scatter(xa, ga), _scatter(xb, ga)
+
+    def _row_half(lp, idx, x_s, kc, vc, p):
+        """One microbatch's row-parallel layer: fused norm+gather feeds the
+        attention, partials ride the ring, residual adds stay scattered."""
+        xn = _row_norm_gather(x_s, lp["rms_att"], ga, tp_compress,
+                              cfg.norm_eps, cfg.dim)
+        att_p, kc, vc = _attn_block_batched(
+            cfg, lp, rope, xn, kc, vc, p, layer=idx,
+            tp_axis=ga, tp_compress=tp_compress, row_mode=True)
+        x_s = x_s + _reduce_scatter(att_p, ga, red_compress).astype(x_s.dtype)
+        xn = _row_norm_gather(x_s, lp["rms_ffn"], ga, tp_compress,
+                              cfg.norm_eps, cfg.dim)
+        ffn_p = _dense_ffn_row(cfg, lp, xn, layer=idx)
+        x_s = x_s + _reduce_scatter(ffn_p, ga, red_compress).astype(x_s.dtype)
+        return x_s, kc, vc
 
     def layer_step(carry, idx):
         xa, xb, ka, kb, va, vb = carry
@@ -1027,6 +1210,10 @@ def forward_batched_overlap(
                    else jax.lax.dynamic_index_in_dim(leaf, idx, 0, keepdims=False))
             for name, leaf in layers.items()
         }
+        if row:
+            xa, ka, va = _row_half(lp, idx, xa, ka, va, pa)
+            xb, kb, vb = _row_half(lp, idx, xb, kb, vb, pb)
+            return (xa, xb, ka, kb, va, vb), None
         att_a, ka, va = _attn_block_batched(
             cfg, lp, rope, xa, ka, va, pa, layer=idx,
             tp_axis=ga, tp_compress=tp_compress)
@@ -1041,12 +1228,18 @@ def forward_batched_overlap(
         layer_step, (xa, xb, ka, kb, va, vb),
         jnp.arange(cfg.n_layers, dtype=jnp.int32),
     )
+    if row:  # per-half fused final norm (rmsnorm is per-row, so exact)
+        xa = _row_norm_gather(xa, params["rms_final"], ga, tp_compress,
+                              cfg.norm_eps, cfg.dim)
+        xb = _row_norm_gather(xb, params["rms_final"], ga, tp_compress,
+                              cfg.norm_eps, cfg.dim)
     # rejoin, then a tail IDENTICAL to forward_batched's: the final rmsnorm,
     # logits matmul and (plain fused) logits gather see the same [B, dim]
     x = jnp.concatenate([xa, xb], axis=0)
     new_k = jnp.concatenate([ka, kb], axis=1)
     new_v = jnp.concatenate([va, vb], axis=1)
-    x = rmsnorm(x, params["rms_final"], cfg.norm_eps)
+    if not row:
+        x = rmsnorm(x, params["rms_final"], cfg.norm_eps)
     logits = matmul_any(x, params["wcls"]).astype(jnp.float32)
     if tp_axis is not None and gather_logits:
         logits = _gather(logits, tp_axis)[..., : cfg.vocab_size]
@@ -1056,17 +1249,31 @@ def forward_batched_overlap(
 
 
 def _verify_layer(cfg: ModelConfig, lp: dict, rope: dict, x, k_cache,
-                  v_cache, pos, idx, tp_axis=None, tp_compress: bool = False):
+                  v_cache, pos, idx, tp_axis=None, tp_compress: bool = False,
+                  row_mode: bool = False, red_compress: bool = False):
     """One layer of the batched spec-verify step: x [B, T, dim], stacked
     [L, B, S, kv, hd] caches, per-row base positions ``pos``. The shared
-    body of ``forward_batched_verify`` and its microbatch-overlap twin."""
+    body of ``forward_batched_verify`` and its microbatch-overlap twin.
+
+    ``row_mode`` (--tp-reduce): ``x`` arrives SCATTERED ``[B, T, dim/tp]``
+    and stays scattered on return — the fused norm+gather feeds the
+    projections, the K-sharded ``wo``/``w2`` partials ride the ring
+    reduce-scatter, and the residual adds happen on the shard."""
     B, T = x.shape[:2]
-    xf = x.reshape(B * T, cfg.dim)  # raw rows; rmsnorm rides in _norm_proj
-    if "wqkv" in lp:
+    if row_mode:
+        x_s = x.reshape(B * T, x.shape[-1])  # scattered residual rows
+        xn = _row_norm_gather(x_s, lp["rms_att"], tp_axis, tp_compress,
+                              cfg.norm_eps, cfg.dim)
+        q = matmul_any(xn, lp["wq"], idx)
+        k = matmul_any(xn, lp["wk"], idx)
+        v = matmul_any(xn, lp["wv"], idx)
+    elif "wqkv" in lp:
+        xf = x.reshape(B * T, cfg.dim)  # raw rows; rmsnorm rides in _norm_proj
         qkv = _norm_proj(xf, lp["rms_att"], lp["wqkv"], idx, cfg.norm_eps)
         d, kv = cfg.dim, cfg.kv_dim
         q, k, v = qkv[:, :d], qkv[:, d : d + kv], qkv[:, d + kv :]
     else:
+        xf = x.reshape(B * T, cfg.dim)  # raw rows; rmsnorm rides in _norm_proj
         q = _norm_proj(xf, lp["rms_att"], lp["wq"], idx, cfg.norm_eps)
         k = _norm_proj(xf, lp["rms_att"], lp["wk"], idx, cfg.norm_eps)
         v = _norm_proj(xf, lp["rms_att"], lp["wv"], idx, cfg.norm_eps)
@@ -1106,6 +1313,19 @@ def _verify_layer(cfg: ModelConfig, lp: dict, rope: dict, x, k_cache,
         v_cache = jax.lax.dynamic_update_slice(v_cache, slab_v[None], (idx, *zero))
 
     out = jax.vmap(gqa_attention)(q, slab_k, slab_v, pos)  # [B, T, H, hd]
+    if row_mode:
+        # local heads feed the K-sharded wo directly; the partial rides the
+        # ring reduce-scatter and the residual add stays on the shard
+        att_p = matmul_any(out.reshape(B * T, -1), lp["wo"], idx
+                           ).astype(jnp.float32)
+        x_s = x_s + _reduce_scatter(att_p, tp_axis, red_compress
+                                    ).astype(x_s.dtype)
+        xn = _row_norm_gather(x_s, lp["rms_ffn"], tp_axis, tp_compress,
+                              cfg.norm_eps, cfg.dim)
+        ffn_p = _dense_ffn_row(cfg, lp, xn, layer=idx)
+        x_s = x_s + _reduce_scatter(ffn_p, tp_axis, red_compress
+                                    ).astype(x_s.dtype)
+        return x_s.reshape(B, T, -1), k_cache, v_cache
     heads = _gather(out.reshape(B * T, -1), tp_axis, tp_compress)
     att = _gather(matmul_any(heads, lp["wo"], idx), tp_axis, tp_compress)
     x = _ffn_residual(cfg, lp, x.reshape(B * T, cfg.dim),
@@ -1124,6 +1344,7 @@ def forward_batched_verify(
     tp_axis: str | None = None,
     gather_logits: bool = True,
     tp_compress: bool = False,
+    tp_reduce=None,
 ) -> tuple:
     """T tokens for each of B independent sequences -> (logits [B, T, vocab]
     f32, cache): the BATCHED speculative-verify step. Row b's math is
@@ -1144,6 +1365,12 @@ def forward_batched_verify(
     B, T = tokens.shape
     x = embed(cfg, params, tokens)  # [B, T, dim]
     layers = params["layers"]
+    quant_scan = any(isinstance(v, QuantTensor) for v in layers.values())
+    row = (_check_tp_reduce(cfg, tp_reduce) and tp_axis is not None
+           and quant_scan)
+    red_compress = tp_reduce == "q80"
+    if row:
+        x = _scatter(x, tp_axis)
 
     def layer_step(carry, idx):
         x, k_cache, v_cache = carry
@@ -1154,14 +1381,19 @@ def forward_batched_verify(
         }
         x, k_cache, v_cache = _verify_layer(
             cfg, lp, rope, x, k_cache, v_cache, pos, idx,
-            tp_axis=tp_axis, tp_compress=tp_compress)
+            tp_axis=tp_axis, tp_compress=tp_compress,
+            row_mode=row, red_compress=red_compress)
         return (x, k_cache, v_cache), None
 
     (x, new_k, new_v), _ = jax.lax.scan(
         layer_step, (x, cache["k"], cache["v"]),
         jnp.arange(cfg.n_layers, dtype=jnp.int32),
     )
-    x = rmsnorm(x, params["rms_final"], cfg.norm_eps)
+    if row:  # fused final norm on the scattered residual
+        x = _row_norm_gather(x, params["rms_final"], tp_axis, tp_compress,
+                             cfg.norm_eps, cfg.dim)
+    else:
+        x = rmsnorm(x, params["rms_final"], cfg.norm_eps)
     logits = matmul_any(x.reshape(B * T, cfg.dim),
                         params["wcls"]).astype(jnp.float32)
     if tp_axis is not None and gather_logits:
@@ -1184,13 +1416,16 @@ def forward_batched_verify_overlap(
     gather_logits: bool = True,
     tp_compress: bool = False,
     ring: bool = True,
+    tp_reduce=None,
 ) -> tuple:
     """``forward_batched_verify`` with the rows split into two interleaved
     microbatches — the spec-verify twin of ``forward_batched_overlap``
     (same exactness argument: ``_verify_layer`` is per-row throughout, the
     flattened [h*T, dim] matmuls compute each row from the full K, and
     ring-gather chunk order is fixed). Both halves share one layer scan so
-    weights stream once per layer."""
+    weights stream once per layer. ``tp_reduce`` composes the same way as
+    in ``forward_batched_overlap``: each half runs the row-parallel
+    ``_verify_layer`` against the ring axis."""
     B, T = tokens.shape
     h = _check_overlap_split(cfg, B)
     ga = _overlap_axis(tp_axis, ring)
@@ -1200,6 +1435,12 @@ def forward_batched_verify_overlap(
     ka, kb = cache["k"][:, :h], cache["k"][:, h:]
     va, vb = cache["v"][:, :h], cache["v"][:, h:]
     layers = params["layers"]
+    quant_scan = any(isinstance(v, QuantTensor) for v in layers.values())
+    row = (_check_tp_reduce(cfg, tp_reduce) and tp_axis is not None
+           and quant_scan)
+    red_compress = tp_reduce == "q80"
+    if row:
+        xa, xb = _scatter(xa, ga), _scatter(xb, ga)
 
     def layer_step(carry, idx):
         xa, xb, ka, kb, va, vb = carry
@@ -1209,19 +1450,27 @@ def forward_batched_verify_overlap(
             for name, leaf in layers.items()
         }
         xa, ka, va = _verify_layer(cfg, lp, rope, xa, ka, va, pa, idx,
-                                   tp_axis=ga, tp_compress=tp_compress)
+                                   tp_axis=ga, tp_compress=tp_compress,
+                                   row_mode=row, red_compress=red_compress)
         xb, kb, vb = _verify_layer(cfg, lp, rope, xb, kb, vb, pb, idx,
-                                   tp_axis=ga, tp_compress=tp_compress)
+                                   tp_axis=ga, tp_compress=tp_compress,
+                                   row_mode=row, red_compress=red_compress)
         return (xa, xb, ka, kb, va, vb), None
 
     (xa, xb, ka, kb, va, vb), _ = jax.lax.scan(
         layer_step, (xa, xb, ka, kb, va, vb),
         jnp.arange(cfg.n_layers, dtype=jnp.int32),
     )
+    if row:  # per-half fused final norm (rmsnorm is per-row, so exact)
+        xa = _row_norm_gather(xa, params["rms_final"], ga, tp_compress,
+                              cfg.norm_eps, cfg.dim)
+        xb = _row_norm_gather(xb, params["rms_final"], ga, tp_compress,
+                              cfg.norm_eps, cfg.dim)
     x = jnp.concatenate([xa, xb], axis=0)
     new_k = jnp.concatenate([ka, kb], axis=1)
     new_v = jnp.concatenate([va, vb], axis=1)
-    x = rmsnorm(x, params["rms_final"], cfg.norm_eps)
+    if not row:
+        x = rmsnorm(x, params["rms_final"], cfg.norm_eps)
     logits = matmul_any(x.reshape(B * T, cfg.dim),
                         params["wcls"]).astype(jnp.float32)
     if tp_axis is not None and gather_logits:

@@ -333,6 +333,11 @@ def sweep(ts=SWEEP_T, kinds=("q40", "q80"),
     """Run the full shape matrix; returns {case_name: [plan dicts]} with
     violations inline (the CI artifact). Raises nothing — callers gate on
     the 'violations' fields."""
+    import math
+
+    from dllama_tpu.ops.qmatmul import K_MULTIPLE, _pad_up
+    from dllama_tpu.parallel.quant_tp import ROW_SHARD_GRANULARITY
+
     out = {}
     for name, dim, hidden, n_heads, n_kv, hd, vocab in MODEL_DIMS:
         L = 32
@@ -350,6 +355,23 @@ def sweep(ts=SWEEP_T, kinds=("q40", "q80"),
                                     f"{'/fused_norm' if fused else ''}")
                             plans = lowering_plan(kind, dict(
                                 T=T, K=K, O=O, L=stacked, fused_norm=fused))
+                            out[case] = [p.to_dict() for p in plans]
+                # row-parallel (--tp-reduce) repack: wo/w2 K-sharded per
+                # device, each shard's K padded to K_MULTIPLE on its own —
+                # the local kernel must keep a Mosaic-valid tiling at the
+                # CHUNK width, not the full K (quant_tp.row_shard_quant_leaf)
+                for tp in (2, 8):
+                    fpw = _pad_up(hidden,
+                                  math.lcm(K_MULTIPLE[kind], 128 * tp))
+                    for tag, chunk, O in (("row_wo", dim // tp, dim),
+                                          ("row_w2", fpw // tp, dim)):
+                        if chunk % ROW_SHARD_GRANULARITY[kind]:
+                            continue  # validate_tp_reduce declines these
+                        for T in ts:
+                            case = f"{name}/{kind}/{tag}/tp{tp}/T{T}/stacked"
+                            plans = lowering_plan(kind, dict(
+                                T=T, K=chunk, O=O, L=L,
+                                k_padded=_pad_up(chunk, K_MULTIPLE[kind])))
                             out[case] = [p.to_dict() for p in plans]
         for dt in cache_dtypes:
             for T in (1, 8):

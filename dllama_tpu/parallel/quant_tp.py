@@ -27,6 +27,17 @@ split. Two reasons this beats K-slicing for quant blocks:
 The attention out-projection ``wo`` and FFN down-projection ``w2`` therefore
 consume *gathered* inputs instead of producing psum partials — see
 ``parallel.collectives.gather_columns``.
+
+Opt-in ROW-PARALLEL mode (``--tp-reduce``): ``wo``/``w2`` alone switch to
+K-sharding, so they consume the up-projections' *local* output shards with
+no gather at all and emit full-width f32 partials, reduced by
+``parallel.collectives.reduce_columns``'s quantizable ring reduce-scatter.
+The superblock-misalignment objection above is sidestepped by re-packing
+each K-shard INDEPENDENTLY (``row_shard_quant_leaf``): every shard's K is
+padded to ``K_MULTIPLE`` on its own, so each local plane keeps exactly the
+Mosaic-valid tiling of an unsharded tensor — at the cost of requiring the
+per-shard logical K to land on the scale-plane slicing granularity
+(64 input rows for q40's even/odd twin scales, 32 for q80).
 """
 
 from __future__ import annotations
@@ -73,11 +84,45 @@ SHARDED_MATRICES = frozenset(
     {"wq", "wk", "wv", "wo", "w1", "w2", "w3", "moe_up", "moe_gate", "moe_down"}
 )
 
+#: matrices that K-shard (row-parallel) under ``--tp-reduce`` instead of
+#: output-sharding — exactly the two whose inputs are produced sharded by
+#: the preceding matmuls (local heads feed wo, local up/gate halves feed w2)
+ROW_SHARDED_MATRICES = frozenset({"wo", "w2"})
+
+#: K rows covered by one scale-plane row: q40's s/s2 twins each span a
+#: 64-row superblock half; q80 scales span one 32-row block
+ROW_SHARD_GRANULARITY = {"q40": 64, "q80": 32}
+
 
 def validate_quant_tp(cfg: ModelConfig, n_tp: int) -> None:
     check_tp_compatible(cfg, n_tp)
     if cfg.dim % n_tp or cfg.kv_dim % n_tp:
         raise ValueError(f"tp={n_tp} must divide dim={cfg.dim} and kv_dim={cfg.kv_dim}")
+
+
+def row_shard_chunk_k(cfg: ModelConfig, name: str, kind: str, n_tp: int) -> int:
+    """Logical K rows each device's row shard of ``name`` consumes: wo eats
+    the local head concat (dim/tp); w2 eats the local half of the
+    lane-aligned hidden width w1/w3 produce (ffn_padded_width/tp)."""
+    base = cfg.dim if name == "wo" else ffn_padded_width(cfg, kind, n_tp)
+    return base // n_tp
+
+
+def validate_tp_reduce(cfg: ModelConfig, kind: str, n_tp: int):
+    """None when row-parallel wo/w2 can engage, else a machine-visible
+    decline reason (the Engine's warn-and-drop surfaces it on /stats)."""
+    if cfg.is_moe:
+        return ("moe: row-parallel reduce needs a dense FFN (the "
+                "selected-experts union spans all rows)")
+    for name in sorted(ROW_SHARDED_MATRICES):
+        chunk = row_shard_chunk_k(cfg, name, kind, n_tp)
+        gran = ROW_SHARD_GRANULARITY[kind]
+        if chunk % gran:
+            return (f"{name}: per-shard K {chunk} off the {kind} slicing "
+                    f"granularity {gran} (scale planes cover {gran} input "
+                    f"rows; need dim and the padded hidden divisible by "
+                    f"{gran}*tp)")
+    return None
 
 
 # ---------------------------------------------------------------------------
@@ -137,11 +182,60 @@ def _pad_qt_in(qt: QuantTensor, target_k: int) -> QuantTensor:
     return QuantTensor(w=w, s=s, s2=s2, kind=qt.kind, k_logical=qt.k_logical)
 
 
-def prepare_quant_leaf(name: str, leaf, cfg: ModelConfig, n_tp: int):
+def row_shard_quant_leaf(name: str, leaf: QuantTensor, cfg: ModelConfig,
+                         n_tp: int) -> QuantTensor:
+    """Re-pack ``wo``/``w2`` for row-parallel (K-sharded) execution: slice
+    the packed planes into ``n_tp`` K-chunks along the LOGICAL input rows,
+    pad each chunk's K to ``K_MULTIPLE`` independently with inert zero-scale
+    rows, and concatenate the repacked chunks back along the packed-K axis.
+    The global planes carry ``n_tp * kp_shard`` K rows sharded with
+    ``_row_shard_spec``, so under shard_map every device sees a standard
+    stacked QuantTensor of its own chunk — same Mosaic tiling as an
+    unsharded pack — with ``k_logical`` set to the LOCAL chunk width the
+    sharded activation actually has. Idempotent (a repacked leaf passes
+    through), like the other prepare helpers."""
+    kind = leaf.kind
+    chunk = row_shard_chunk_k(cfg, name, kind, n_tp)
+    gran = ROW_SHARD_GRANULARITY[kind]
+    if chunk % gran:
+        raise ValueError(
+            f"row-parallel {name}: per-shard K {chunk} is not a multiple of "
+            f"the {kind} scale-plane granularity {gran} — the K slice would "
+            f"split a superblock (use validate_tp_reduce to gate)")
+    kp_shard = _pad_up(chunk, K_MULTIPLE[kind])
+    if leaf.k_logical == chunk and leaf.k_padded == n_tp * kp_shard:
+        return leaf
+    if name == "w2":
+        # align to the padded hidden width first so chunk boundaries match
+        # the w1/w3 output shards (idempotent when already padded)
+        leaf = _pad_qt_in(leaf, ffn_padded_width(cfg, kind, n_tp))
+
+    def repack(plane, per):  # ``per`` = logical K rows per plane row
+        xp = np if isinstance(plane, np.ndarray) else jnp
+        parts = [
+            _pad_axis(plane[..., i * chunk // per:(i + 1) * chunk // per, :],
+                      -2, kp_shard // per)
+            for i in range(n_tp)
+        ]
+        return xp.concatenate(parts, axis=-2)
+
+    if kind == "q40":
+        return QuantTensor(w=repack(leaf.w, 2), s=repack(leaf.s, 64),
+                           s2=repack(leaf.s2, 64), kind=kind, k_logical=chunk)
+    return QuantTensor(w=repack(leaf.w, 1), s=repack(leaf.s, 32),
+                       s2=leaf.s2, kind=kind, k_logical=chunk)
+
+
+def prepare_quant_leaf(name: str, leaf, cfg: ModelConfig, n_tp: int,
+                       tp_reduce: bool = False):
     """Lane-align one param leaf for tp-sharded Pallas execution (see above).
-    Identity for dense arrays, unsharded matrices, and already-aligned dims."""
+    Identity for dense arrays, unsharded matrices, and already-aligned dims.
+    ``tp_reduce=True`` re-packs wo/w2 per K-shard for the row-parallel
+    reduce path instead of the output-axis treatment."""
     if not isinstance(leaf, QuantTensor) or n_tp <= 1:
         return leaf
+    if tp_reduce and name in ROW_SHARDED_MATRICES:
+        return row_shard_quant_leaf(name, leaf, cfg, n_tp)
     if name in ("w1", "w3", "moe_up", "moe_gate"):
         return _pad_qt_out(leaf, ffn_padded_width(cfg, leaf.kind, n_tp))
     if name in ("w2", "moe_down"):
@@ -151,10 +245,23 @@ def prepare_quant_leaf(name: str, leaf, cfg: ModelConfig, n_tp: int):
     return leaf
 
 
-def leaf_specs(leaf, sharded: bool):
+def _row_shard_spec(arr) -> P:
+    """Shard the packed-K (second-to-last) axis over tp; empty placeholder
+    planes (q80's s2) replicate."""
+    if arr.ndim < 2 or arr.shape[-1] == 0 or arr.shape[-2] == 0:
+        return P(*([None] * arr.ndim))
+    spec = [None] * arr.ndim
+    spec[-2] = TP
+    return P(*spec)
+
+
+def leaf_specs(leaf, sharded: bool, row: bool = False):
     """PartitionSpec(s) for one param leaf — a QuantTensor gets a spec per
-    plane (same treedef), a plain array a single spec."""
-    mk = _out_shard_spec if sharded else _replicated_spec
+    plane (same treedef), a plain array a single spec. ``row=True`` shards
+    the packed-K axis (a ``row_shard_quant_leaf``-repacked wo/w2) instead of
+    the output axis."""
+    mk = (_row_shard_spec if row
+          else _out_shard_spec if sharded else _replicated_spec)
     if isinstance(leaf, QuantTensor):
         return QuantTensor(
             w=mk(leaf.w), s=mk(leaf.s), s2=mk(leaf.s2),
@@ -163,44 +270,56 @@ def leaf_specs(leaf, sharded: bool):
     return mk(leaf)
 
 
-def quant_param_specs(params: dict, cfg: ModelConfig, n_tp: int) -> dict:
+def quant_param_specs(params: dict, cfg: ModelConfig, n_tp: int,
+                      tp_reduce: bool = False) -> dict:
     """Leaf-level PartitionSpec tree matching ``params`` (QuantTensor fields
     get their own specs). Quantized matrices and the dense big matrices are
     output-sharded; norms/embedding are replicated (the root holds them whole
-    in the reference too). ``wcls`` is sharded only when tp divides vocab."""
+    in the reference too). ``wcls`` is sharded only when tp divides vocab.
+    ``tp_reduce``: wo/w2 K-shard instead (quantized leaves only — a dense
+    wo/w2 stays output-sharded, the Engine declines row mode there)."""
     validate_quant_tp(cfg, n_tp)
     shard_wcls = cfg.vocab_size % n_tp == 0
+
+    def _row(name, leaf):
+        return (tp_reduce and name in ROW_SHARDED_MATRICES
+                and isinstance(leaf, QuantTensor))
+
     specs: dict = {
         "embedding": _replicated_spec(params["embedding"]),
         "rms_final": _replicated_spec(params["rms_final"]),
         "wcls": leaf_specs(params["wcls"], shard_wcls),
         "layers": {
-            name: leaf_specs(leaf, name in SHARDED_MATRICES)
+            name: leaf_specs(leaf, name in SHARDED_MATRICES,
+                             row=_row(name, leaf))
             for name, leaf in params["layers"].items()
         },
     }
     return specs
 
 
-def prepare_quant_params(params: dict, cfg: ModelConfig, n_tp: int) -> dict:
+def prepare_quant_params(params: dict, cfg: ModelConfig, n_tp: int,
+                         tp_reduce: bool = False) -> dict:
     """Lane-align every leaf (idempotent: already-padded leaves pass through)."""
     return {
         "embedding": params["embedding"],
         "rms_final": params["rms_final"],
         "wcls": prepare_quant_leaf("wcls", params["wcls"], cfg, n_tp),
         "layers": {
-            k: prepare_quant_leaf(k, v, cfg, n_tp)
+            k: prepare_quant_leaf(k, v, cfg, n_tp, tp_reduce=tp_reduce)
             for k, v in params["layers"].items()
         },
     }
 
 
-def shard_quant_params(params: dict, mesh, cfg: ModelConfig) -> dict:
+def shard_quant_params(params: dict, mesh, cfg: ModelConfig,
+                       tp_reduce: bool = False) -> dict:
     """Place a (possibly quantized) param pytree onto the mesh output-sharded,
-    lane-aligning shardable axes first (see the padding notes above)."""
+    lane-aligning shardable axes first (see the padding notes above).
+    ``tp_reduce=True`` re-packs and K-shards wo/w2 for row-parallel mode."""
     n_tp = mesh.shape[TP]
-    params = prepare_quant_params(params, cfg, n_tp)
-    specs = quant_param_specs(params, cfg, n_tp)
+    params = prepare_quant_params(params, cfg, n_tp, tp_reduce=tp_reduce)
+    specs = quant_param_specs(params, cfg, n_tp, tp_reduce=tp_reduce)
     return jax.tree.map(
         lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), params, specs
     )
@@ -212,16 +331,18 @@ def batch_cache_spec() -> P:
 
 
 def _make_tp_program(cfg: ModelConfig, mesh, params: dict, compress: bool,
-                     inner_fn, cache_spec_fn):
+                     inner_fn, cache_spec_fn, tp_reduce=None):
     """THE shard_map builder behind every quantized-TP program — solo
     decode/prefill, batched decode, batched spec-verify. One place for the
     in/out specs, the vocab-divisibility gather_logits condition, and the
     check_vma setting, so the three entry points can never drift.
     ``inner_fn(cfg, params, rope, tokens, cache, pos, *, tp_axis,
-    gather_logits, tp_compress)`` is the llama forward variant;
-    ``cache_spec_fn`` its cache PartitionSpec ([L,S,...] vs [L,B,S,...])."""
+    gather_logits, tp_compress, tp_reduce)`` is the llama forward variant;
+    ``cache_spec_fn`` its cache PartitionSpec ([L,S,...] vs [L,B,S,...]).
+    ``tp_reduce`` (None | 'plain' | 'q80') runs wo/w2 row-parallel — the
+    params must have been sharded with ``tp_reduce=True``."""
     n_tp = mesh.shape[TP]
-    pspecs = quant_param_specs(params, cfg, n_tp)
+    pspecs = quant_param_specs(params, cfg, n_tp, tp_reduce=bool(tp_reduce))
     gather_logits = cfg.vocab_size % n_tp == 0
     cspec = {"k": cache_spec_fn(), "v": cache_spec_fn()}
 
@@ -236,6 +357,7 @@ def _make_tp_program(cfg: ModelConfig, mesh, params: dict, compress: bool,
         return inner_fn(
             cfg, params, rope, tokens, cache, pos,
             tp_axis=TP, gather_logits=gather_logits, tp_compress=compress,
+            tp_reduce=tp_reduce,
         )
 
     return fwd
@@ -243,7 +365,7 @@ def _make_tp_program(cfg: ModelConfig, mesh, params: dict, compress: bool,
 
 def make_tp_forward_batched(cfg: ModelConfig, mesh, params: dict,
                             compress: bool = False, overlap: bool = False,
-                            overlap_ring: bool = True):
+                            overlap_ring: bool = True, tp_reduce=None):
     """``fwd(params, rope, cache, tokens, pos) -> (logits, cache)`` for the
     BATCHED decode step (``llama.forward_batched``: tokens/pos are [B]) as a
     shard_map program over the same output-sharded quant planes as
@@ -253,34 +375,39 @@ def make_tp_forward_batched(cfg: ModelConfig, mesh, params: dict,
     ``overlap=True`` builds the two-microbatch compute/communication
     overlap variant (``llama.forward_batched_overlap`` — bit-identical,
     needs B >= 2 and a dense FFN); ``overlap_ring`` picks ppermute ring
-    gathers vs fused all-gathers + XLA latency hiding."""
+    gathers vs fused all-gathers + XLA latency hiding. ``tp_reduce``
+    (None | 'plain' | 'q80') row-parallelizes wo/w2 (see _make_tp_program);
+    it composes with overlap — each microbatch's reduce-scatters are ring
+    hops already, so they interleave with the other microbatch's compute
+    exactly like the ring gathers do."""
     from dllama_tpu.models import llama
 
     inner = (partial(llama.forward_batched_overlap, ring=overlap_ring)
              if overlap else llama.forward_batched)
     return _make_tp_program(cfg, mesh, params, compress,
-                            inner, batch_cache_spec)
+                            inner, batch_cache_spec, tp_reduce=tp_reduce)
 
 
 def make_tp_verify_batched(cfg: ModelConfig, mesh, params: dict,
                            compress: bool = False, overlap: bool = False,
-                           overlap_ring: bool = True):
+                           overlap_ring: bool = True, tp_reduce=None):
     """``fwd(params, rope, cache, tokens, pos) -> (logits, cache)`` for the
     BATCHED speculative-verify step (``llama.forward_batched_verify``:
     tokens [B, T], pos [B]) as a shard_map program over the same
     output-sharded quant planes — batched speculation under tensor
     parallelism: draft_len+1 positions x B rows share every local weight
-    stream AND every ICI gather per launch. ``overlap``/``overlap_ring``
-    as in ``make_tp_forward_batched``."""
+    stream AND every ICI gather per launch. ``overlap``/``overlap_ring``/
+    ``tp_reduce`` as in ``make_tp_forward_batched``."""
     from dllama_tpu.models import llama
 
     inner = (partial(llama.forward_batched_verify_overlap, ring=overlap_ring)
              if overlap else llama.forward_batched_verify)
     return _make_tp_program(cfg, mesh, params, compress,
-                            inner, batch_cache_spec)
+                            inner, batch_cache_spec, tp_reduce=tp_reduce)
 
 
-def make_tp_forward(cfg: ModelConfig, mesh, params: dict, compress: bool = False):
+def make_tp_forward(cfg: ModelConfig, mesh, params: dict, compress: bool = False,
+                    tp_reduce=None):
     """Build ``fwd(params, rope, cache, tokens, pos) -> (logits, cache)``:
     the quantized-TP decode/prefill forward as one shard_map program.
 
@@ -291,8 +418,10 @@ def make_tp_forward(cfg: ModelConfig, mesh, params: dict, compress: bool = False
     ``compress=True`` moves the per-layer activation gathers as int8 blocks
     with f32 block scales — the reference's Q80 wire compression
     (``--buffer-float-type q80``) applied to the ICI collectives.
+    ``tp_reduce`` (None | 'plain' | 'q80') row-parallelizes wo/w2 (see
+    ``_make_tp_program``).
     """
     from dllama_tpu.models import llama
 
     return _make_tp_program(cfg, mesh, params, compress,
-                            llama.forward, cache_spec)
+                            llama.forward, cache_spec, tp_reduce=tp_reduce)

@@ -57,6 +57,30 @@ def prefill_bucket(n: int) -> int:
     return n
 
 
+def dense_stack_wire_feat_bytes(cfg: ModelConfig, hidden: int,
+                                per_feat: float, tp_reduce=None) -> float:
+    """Modeled per-row wire bytes (before the (tp-1)/tp ring fraction) the
+    dense layer stack's collectives carry in one forward — the analytic
+    model Engine._wire_bytes and BENCH_REDUCE share, so the benchmark's
+    reported delta IS the serving model's delta.
+
+    Gather-only: 4 all-gathers per layer (heads, wo out, padded hidden,
+    w2 out) at ``per_feat`` bytes/feature (1.125 under q80 wire
+    compression).  Row-parallel (``tp_reduce``): per layer 2 normalized
+    gathers (dim each, still ``per_feat``) + 2 reduce-scatters (dim each —
+    f32 partials at 4 B/feature, or 1.125 under q80 hop compression) + 2
+    scalar f32 psums for the fused rmsnorm, plus one extra final-norm
+    gather and psum per forward.  The hidden-width gather — the widest
+    collective of the gather-only schedule — disappears entirely."""
+    if not tp_reduce:
+        return cfg.n_layers * (3 * cfg.dim + hidden) * per_feat
+    red_feat = 1.125 if tp_reduce == "q80" else 4.0
+    gather_feats = (2 * cfg.n_layers + 1) * cfg.dim
+    reduce_feats = 2 * cfg.n_layers * cfg.dim
+    psum_scalars = (2 * cfg.n_layers + 1) * 4.0
+    return (gather_feats * per_feat + reduce_feats * red_feat + psum_scalars)
+
+
 @dataclasses.dataclass
 class TokenStats:
     """Per-token timing — the reference's G/I/T/S/R line
@@ -113,6 +137,7 @@ class Engine:
         fuse_quant: bool = True,
         tp_compress: bool = False,
         tp_overlap: bool = False,
+        tp_reduce: str = "off",
         decode_chunk: int = DECODE_CHUNK,
         numeric_checks: bool = True,
         metrics=DEFAULT_METRICS,
@@ -134,6 +159,22 @@ class Engine:
         drop to monolithic — ``tp_overlap_active``/``tp_overlap_reason``
         record the resolution machine-visibly (the server surfaces them
         on /stats).
+
+        ``tp_reduce`` ('off' | 'plain' | 'q80'): row-parallel reduce
+        direction — wo/w2 K-shard (parallel.quant_tp.row_shard_quant_leaf),
+        their full-width f32 partial sums ride a pinned-order ppermute ring
+        reduce-scatter (collectives.reduce_scatter_columns; 'q80'
+        block-quantizes each hop's payload), and the residual add + rmsnorm
+        fold into the scattered shard so the next gather carries
+        already-normalized data. 'plain' keeps a deterministic summation
+        order (bit-reproducible run to run); 'q80' trades an analytically
+        bounded per-hop error for ~3.6x less reduce-direction wire.
+        Requested but unavailable combinations (no mesh, dense-pjit TP,
+        MoE, shard granularity misfit) warn and drop to the gather-only
+        programs — ``tp_reduce_active``/``tp_reduce_reason`` record the
+        resolution machine-visibly, like ``tp_overlap``'s. Composes with
+        ``tp_overlap``: each microbatch's reduce-scatters are ring hops
+        already, so they interleave exactly like the ring gathers.
 
         ``numeric_checks``: fuse the numeric-health watchdog — an
         ``isfinite(logits)`` per-row flag — into every decode step (plus the
@@ -200,6 +241,10 @@ class Engine:
                 "dllama_tp_overlap_chunks_total",
                 "Decode/verify dispatches routed through the microbatch "
                 "compute/communication-overlap TP programs")
+            self._m_reduce = metrics.counter(
+                "dllama_tp_reduce_chunks_total",
+                "Decode/verify dispatches served by the row-parallel "
+                "(K-sharded wo/w2, ring reduce-scatter) TP programs")
         else:
             self._m_prefill = self._m_step = self._m_chunk = None
             self._m_prefill_chunk = self._m_migrations = None
@@ -209,6 +254,7 @@ class Engine:
             self._m_prefix_hits = self._m_prefix_misses = None
             self._m_prefix_tokens = self._m_cow = None
             self._m_prefix_evictions = self._m_overlap = None
+            self._m_reduce = None
         self.cfg = cfg
         self.sampler_cfg = sampler_cfg
         self.mesh = mesh
@@ -223,6 +269,18 @@ class Engine:
         self.tp_overlap_active = False
         self.tp_overlap_reason = ("not requested" if not tp_overlap
                                   else "no mesh (single device)")
+        if tp_reduce in (None, "off"):
+            tp_reduce = None
+        elif tp_reduce not in ("plain", "q80"):
+            raise ValueError(f"tp_reduce must be 'off', 'plain' or 'q80', "
+                             f"got {tp_reduce!r}")
+        #: row-parallel reduce-direction resolution, same warn-and-drop
+        #: contract as tp_overlap above: ``tp_reduce`` is the resolved mode
+        #: ('off' when dropped), active/reason the machine-visible why
+        self.tp_reduce = "off"
+        self.tp_reduce_active = False
+        self.tp_reduce_reason = ("not requested" if tp_reduce is None
+                                 else "no mesh (single device)")
         #: decode kernel-fusion resolution, machine-visible like the TP
         #: wire above: what each DLLAMA_* fusion flag resolved to on THIS
         #: engine (served on /stats), so a flag that silently declined —
@@ -269,15 +327,42 @@ class Engine:
                 # quantized weights x TP: pallas kernels don't auto-partition
                 # under pjit, so the forward runs as a shard_map program over
                 # output-sharded quant planes (parallel.quant_tp)
-                self.params = quant_tp.shard_quant_params(params, mesh, cfg)
+                red = None
+                if tp_reduce is not None:
+                    from dllama_tpu.parallel.mesh import TP as _TP
+
+                    kind = next(
+                        (leaf.kind for leaf in jax.tree.leaves(
+                            params,
+                            is_leaf=lambda x: hasattr(x, "kind"))
+                         if hasattr(leaf, "kind")), "q40")
+                    why = quant_tp.validate_tp_reduce(
+                        cfg, kind, mesh.shape[_TP])
+                    if why is not None:
+                        self.tp_reduce_reason = why
+                        import sys as _sys
+
+                        print(f"dllama: tp_reduce requested but declined "
+                              f"({why}); gather-only TP programs used",
+                              file=_sys.stderr, flush=True)
+                    else:
+                        red = tp_reduce
+                        self.tp_reduce = tp_reduce
+                        self.tp_reduce_active = True
+                        self.tp_reduce_reason = "on"
+                self.params = quant_tp.shard_quant_params(
+                    params, mesh, cfg, tp_reduce=red is not None)
                 tp_fwd = quant_tp.make_tp_forward(
-                    cfg, mesh, self.params, compress=tp_compress
+                    cfg, mesh, self.params, compress=tp_compress,
+                    tp_reduce=red
                 )
                 tp_fwd_b = quant_tp.make_tp_forward_batched(
-                    cfg, mesh, self.params, compress=tp_compress
+                    cfg, mesh, self.params, compress=tp_compress,
+                    tp_reduce=red
                 )
                 tp_fwd_v = quant_tp.make_tp_verify_batched(
-                    cfg, mesh, self.params, compress=tp_compress
+                    cfg, mesh, self.params, compress=tp_compress,
+                    tp_reduce=red
                 )
                 if tp_compress:
                     self.tp_wire = "q80"
@@ -298,11 +383,11 @@ class Engine:
                     else:
                         tp_fwd_b_ov = quant_tp.make_tp_forward_batched(
                             cfg, mesh, self.params, compress=tp_compress,
-                            overlap=True,
+                            overlap=True, tp_reduce=red,
                         )
                         tp_fwd_v_ov = quant_tp.make_tp_verify_batched(
                             cfg, mesh, self.params, compress=tp_compress,
-                            overlap=True,
+                            overlap=True, tp_reduce=red,
                         )
 
                         def fwd_b_ov(cfg_, params_, rope_, tokens_, cache_,
@@ -331,6 +416,16 @@ class Engine:
 
             else:
                 self.supports_batch_spec = False
+                if tp_reduce is not None:
+                    self.tp_reduce_reason = (
+                        "dense-pjit TP path (row-parallel reduce needs the "
+                        "shard_map quant path's K-sharded packs)")
+                    import sys as _sys
+
+                    print("dllama: tp_reduce requested but the params are "
+                          "dense — the row-parallel programs ride the "
+                          "shard_map quant-TP path; gather-only pjit used",
+                          file=_sys.stderr, flush=True)
                 if tp_overlap:
                     self.tp_overlap_reason = (
                         "dense-pjit TP path (overlap needs the shard_map "
@@ -856,9 +951,11 @@ class Engine:
                 layer_feats = cfg.n_layers * (
                     3 * cfg.dim + min(E, rows * k) * hidden
                 )
+                bytes_ = layer_feats * per_feat
             else:
-                layer_feats = cfg.n_layers * (3 * cfg.dim + hidden)
-            bytes_ = layer_feats * per_feat
+                bytes_ = dense_stack_wire_feat_bytes(
+                    cfg, hidden, per_feat,
+                    self.tp_reduce if self.tp_reduce_active else None)
             if cfg.vocab_size % tp == 0:
                 # the logits gather moves the lane-PADDED vocab (sliced back
                 # after the gather), already cast to f32 and never compressed
@@ -886,10 +983,24 @@ class Engine:
             self._m_overlap.inc()
         return True
 
+    def _reduce_dispatch(self) -> None:
+        """Per-dispatch accounting for the row-parallel reduce direction:
+        unlike overlap there is no program choice (row mode rebuilds ALL
+        the TP programs), so this fires the ``tp_reduce`` fault seam and
+        counts the dispatch (dllama_tp_reduce_chunks_total) — the
+        machine-visible proof a replay was actually served by the
+        reduce-direction programs, scraped by BENCH_REDUCE."""
+        if not self.tp_reduce_active:
+            return
+        faults.fire("tp_reduce")
+        if self._m_reduce is not None:
+            self._m_reduce.inc()
+
     def batch_loop(self, rows: int):
         """The fused batched-decode chunk program for a dispatch with
         ``rows`` live rows — the overlap twin when built and engaged,
         else the monolithic program."""
+        self._reduce_dispatch()
         if self._decode_loop_batch_ov is not None \
                 and self._overlap_engaged(rows):
             return self._decode_loop_batch_ov
@@ -897,6 +1008,7 @@ class Engine:
 
     def paged_loop(self, rows: int):
         """Paged twin of :meth:`batch_loop` (same engagement rule)."""
+        self._reduce_dispatch()
         if self._decode_loop_paged_ov is not None \
                 and self._overlap_engaged(rows):
             return self._decode_loop_paged_ov
@@ -905,6 +1017,7 @@ class Engine:
     def verify_program(self, rows: int):
         """The batched spec-verify program for ``rows`` live rows (see
         :meth:`batch_loop`)."""
+        self._reduce_dispatch()
         if self._verify_batch_ov is not None \
                 and self._overlap_engaged(rows):
             return self._verify_batch_ov
@@ -1047,6 +1160,7 @@ class Engine:
                 return
         for _ in range(max(steps, 0)):
             t1 = time.perf_counter()
+            self._reduce_dispatch()  # solo steps ride the row programs too
             token, ok, cache = self._decode_step(
                 cache, token, jnp.int32(pos), next_key(), temp, topp,
                 self._poison_flag()
@@ -1149,6 +1263,7 @@ class Engine:
             # prefill_bucket(r) >= r, so full chunks resolve to chunk_size
             n = min(chunk_size, prefill_bucket(remaining))
             n = min(n, self.cfg.seq_len - pos)  # never write cache out of range
+            self._reduce_dispatch()  # solo chunks ride the row programs too
             chunk, cache, ok = self._decode_loop(
                 cache, token, jnp.int32(pos), next_key(), temp, topp,
                 self._poison_flag(), n_steps=n

@@ -1346,17 +1346,21 @@ class ServerState:
             "emitted tokens on an opted-in stream), by outcome",
             ("outcome",))
         # info-style gauge (value 1, identity in the labels): the resolved
-        # TP wire format and overlap mode ride /metrics — and therefore the
-        # router's federated /metrics/fleet — so a q80 request that was
-        # warned-and-dropped to plain gathers is machine-visible fleet-wide
+        # TP wire format, overlap mode and reduce direction ride /metrics —
+        # and therefore the router's federated /metrics/fleet — so a q80
+        # request that was warned-and-dropped to plain gathers (or a
+        # tp_reduce that declined) is machine-visible fleet-wide
+        from dllama_tpu.serving.protocol import TP_WIRE_INFO_LABELS
+
         reg.gauge("dllama_tp_wire_info",
-                  "Resolved TP wire/overlap configuration (labels carry "
-                  "the values; constant 1)",
-                  labelnames=("tp_wire", "tp_overlap")).set(
+                  "Resolved TP wire/overlap/reduce configuration (labels "
+                  "carry the values; constant 1)",
+                  labelnames=TP_WIRE_INFO_LABELS).set(
             1.0,
             tp_wire=getattr(engine, "tp_wire", "plain"),
             tp_overlap=("on" if getattr(engine, "tp_overlap_active", False)
-                        else "off"))
+                        else "off"),
+            tp_reduce=getattr(engine, "tp_reduce", "off"))
         reg.gauge("dllama_batch_queue_depth",
                   "Arrivals waiting for the batch scheduler").set_function(
             lambda: float(self.batcher.queue_depth())
@@ -1550,6 +1554,11 @@ class ServerState:
                                            False) else "off"),
             "tp_overlap_reason": getattr(self.engine, "tp_overlap_reason",
                                          "not requested"),
+            # row-parallel reduce direction, same contract: the resolved
+            # mode ("off" when declined) plus the machine-visible reason
+            "tp_reduce": getattr(self.engine, "tp_reduce", "off"),
+            "tp_reduce_reason": getattr(self.engine, "tp_reduce_reason",
+                                        "not requested"),
             # decode kernel-fusion resolution (flash / fused norm / fused
             # rope+cache): the env flags resolved against what this
             # engine's weights and TP path can actually engage

@@ -126,6 +126,14 @@ MET_FLEET_REPLICAS = "dllama_fleet_replicas"
 MET_SCALE_EVENTS = "dllama_fleet_scale_events_total"
 MET_POLICY_EVALS = "dllama_fleet_policy_evals_total"
 MET_CKPT_EXPIRED = "dllama_router_ckpt_expired_total"
+MET_TP_REDUCE_CHUNKS = "dllama_tp_reduce_chunks_total"
+
+#: Label names of the ``dllama_tp_wire_info`` info-gauge (value 1, identity
+#: in the labels): the resolved gather wire, overlap mode, and row-parallel
+#: reduce mode.  The server registers with exactly these labels and
+#: BENCH_REDUCE / fleet dashboards read them back off /metrics, so the
+#: tuple lives here with the other cross-process names.
+TP_WIRE_INFO_LABELS = ("tp_wire", "tp_overlap", "tp_reduce")
 
 #: Every family a cross-process consumer reads.  PROTO-004's cli.py pass
 #: checks this tuple stays registered AND that cli.py spells no family
@@ -146,4 +154,5 @@ WIRE_METRICS = (
     MET_SCALE_EVENTS,
     MET_POLICY_EVALS,
     MET_CKPT_EXPIRED,
+    MET_TP_REDUCE_CHUNKS,
 )

@@ -29,6 +29,16 @@ appends a delta row (fused_ms, unfused_ms, delta_ms) to
 results/trajectory.jsonl so the win is tracked across rounds, not eyeballed.
 
 Usage: python scripts/kernel_bench.py fused [K] [O] [iters] [T]
+
+``reduce`` mode microbenches the row-parallel reduce direction
+(``--tp-reduce``) at decode partial-sum shapes: a fused ``jax.lax.psum``
+vs the pinned-order ``lax.ppermute`` ring reduce-scatter(+gather) vs the
+Q80-compressed ring (int8 quants + bitcast f32 scales per hop). Each
+schedule appends a row to results/trajectory.jsonl with its modeled
+wire bytes, so the quantized ring's win (or loss) on real hardware is
+tracked across rounds. Same difference-timing idiom.
+
+Usage: python scripts/kernel_bench.py reduce [F] [T] [iters]
 """
 
 import functools
@@ -156,6 +166,73 @@ def bench_gather(F=4096, T=1, iters=256):
     return results
 
 
+def bench_reduce(F=4096, T=1, iters=256):
+    """Time one full-width f32 partial-sum reduction three ways at a
+    decode shape: the fused ``jax.lax.psum`` (XLA's schedule, baseline),
+    the pinned-order ring reduce-scatter + gather (``--tp-reduce plain``
+    — bit-reproducible), and the Q80-compressed ring (``--tp-reduce
+    q80``).  Ring wire bytes per chip: (tp-1) hops x F/tp chunk at 4.0
+    (plain) or 1.125 (q80) bytes/feature for the scatter half, plus the
+    (tp-1)/tp x F x 4.0 trailing f32 gather."""
+    from dllama_tpu import compat
+    from dllama_tpu.obsv import trajectory
+    from dllama_tpu.parallel import collectives
+    from dllama_tpu.parallel.mesh import tp_mesh
+
+    tp = len(jax.devices())
+    if tp < 2:
+        raise SystemExit(
+            "reduce mode needs >1 device (TPU slice, or CPU with "
+            "XLA_FLAGS=--xla_force_host_platform_device_count=8)")
+    mesh = tp_mesh(tp)
+    F_eff = F // (32 * tp) * (32 * tp)  # whole q80-aligned chunks per device
+    rng = np.random.default_rng(0)
+    # [tp, T, F]: axis 0 sharded, so each device carries one full-width partial
+    x = jnp.asarray(rng.standard_normal((tp, T, F_eff)).astype(np.float32))
+
+    results = {}
+    for name, red in (
+        ("psum", lambda p: jax.lax.psum(p, "tp")),
+        ("ring", lambda p: collectives.reduce_columns(p, "tp", False)),
+        ("ring+q80", lambda p: collectives.reduce_columns(p, "tp", True)),
+    ):
+        def tp_reduce(xs, _red=red):
+            # scale down so the chained sum of sums stays finite over the scan
+            return (_red(xs[0]) * np.float32(1.0 / (2.0 * tp)))[None]
+
+        sharded = compat.shard_map(
+            tp_reduce, mesh=mesh,
+            in_specs=jax.sharding.PartitionSpec("tp"),
+            out_specs=jax.sharding.PartitionSpec("tp"))
+
+        @functools.partial(jax.jit, static_argnames=("n",))
+        def run(xs, n):
+            def step(xs, _):
+                return sharded(xs), ()
+            xs, _ = jax.lax.scan(step, xs, None, length=n)
+            return jnp.sum(xs)
+
+        t1 = _timed_host_sync(functools.partial(run, n=iters), x)
+        t2 = _timed_host_sync(functools.partial(run, n=2 * iters), x)
+        ms = max(t2 - t1, 1e-9) * 1e3 / iters
+        scat_feat = 1.125 if name == "ring+q80" else 4.0
+        if name == "psum":
+            wire = T * F_eff * 4.0 * 2 * (tp - 1) / tp  # reduce-scatter+gather
+        else:
+            wire = T * F_eff * (tp - 1) / tp * (scat_feat + 4.0)
+        results[name] = ms
+        print(f"reduce {name:8s} F={F_eff} T={T} tp={tp}: {ms:7.4f} ms/call"
+              f"  {wire/1e3:7.1f} KB wire/chip"
+              f"   [t({iters})={t1*1e3:.0f}ms t({2*iters})={t2*1e3:.0f}ms]",
+              flush=True)
+        trajectory.append_row(
+            f"kernel_reduce/{name}", "ok",
+            result={"metric": f"{name}_ms", "value": ms,
+                    "wire_kb_chip": wire / 1e3, "F": F_eff, "T": T, "tp": tp,
+                    "backend": jax.default_backend()})
+    return results
+
+
 def _timed_scan(step_fn, carry, iters):
     """Difference-timed ms/call for ``step_fn`` chained through one jitted
     scan — same tunnel-cancelling idiom as bench()."""
@@ -252,6 +329,12 @@ if __name__ == "__main__":
         T = int(sys.argv[3]) if len(sys.argv) > 3 else 1
         iters = int(sys.argv[4]) if len(sys.argv) > 4 else 256
         bench_gather(F, T, iters)
+        sys.exit(0)
+    if kind == "reduce":
+        F = int(sys.argv[2]) if len(sys.argv) > 2 else 4096
+        T = int(sys.argv[3]) if len(sys.argv) > 3 else 1
+        iters = int(sys.argv[4]) if len(sys.argv) > 4 else 256
+        bench_reduce(F, T, iters)
         sys.exit(0)
     if kind == "fused":
         K = int(sys.argv[2]) if len(sys.argv) > 2 else 4096
